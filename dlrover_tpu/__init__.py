@@ -1,0 +1,10 @@
+"""dlrover_tpu: a TPU-native elastic, fault-tolerant training framework.
+
+Re-designs the capabilities of DLRover (elastic agent, master-coordinated
+rendezvous, flash checkpoint, node health checks, diagnosis, autoscaling)
+for JAX/XLA on TPU slices, and adds a TPU-first compute path (pjit/shard_map
+parallelism, Pallas kernels, ring attention) that the reference delegates to
+wrapped frameworks.
+"""
+
+__version__ = "0.1.0"

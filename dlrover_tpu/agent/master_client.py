@@ -1,0 +1,298 @@
+"""Agent/worker-side client of the master (singleton, typed wrappers).
+
+Parity: reference ``elastic_agent/master_client.py:61-499`` — every RPC the
+agent or a worker issues goes through here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import NodeEnv, NodeType, RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.rpc.transport import RpcClient
+
+
+class MasterClient:
+    _instance: Optional["MasterClient"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self, master_addr: str, node_id: int, node_type: str = NodeType.WORKER):
+        self._client = RpcClient(master_addr)
+        self.master_addr = master_addr
+        self.node_id = node_id
+        self.node_type = node_type
+
+    # -- singleton ----------------------------------------------------------
+
+    @classmethod
+    def singleton_instance(cls) -> "MasterClient":
+        with cls._instance_lock:
+            if cls._instance is None:
+                addr = os.environ.get(NodeEnv.MASTER_ADDR, "")
+                node_id = int(os.environ.get(NodeEnv.NODE_ID, "0"))
+                if not addr:
+                    raise RuntimeError(
+                        f"{NodeEnv.MASTER_ADDR} not set; no master to talk to"
+                    )
+                cls._instance = MasterClient(addr, node_id)
+            return cls._instance
+
+    @classmethod
+    def reset_singleton(cls, instance: Optional["MasterClient"] = None):
+        with cls._instance_lock:
+            cls._instance = instance
+
+    def available(self, timeout: float = 5.0) -> bool:
+        return self._client.available(timeout)
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def join_rendezvous(
+        self,
+        node_rank: int,
+        local_world_size: int = 1,
+        rdzv_name: str = RendezvousName.TRAINING,
+        node_ip: str = "",
+        node_port: int = 0,
+        slice_name: str = "",
+        coords: Tuple = (),
+    ) -> int:
+        resp = self._client.get(
+            msg.JoinRendezvousRequest(
+                node_id=self.node_id,
+                node_rank=node_rank,
+                local_world_size=local_world_size,
+                rdzv_name=rdzv_name,
+                node_ip=node_ip,
+                node_port=node_port,
+                slice_name=slice_name,
+                coords=coords,
+            )
+        )
+        return resp.round
+
+    def get_comm_world(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> msg.CommWorldResponse:
+        return self._client.get(
+            msg.CommWorldRequest(node_id=self.node_id, rdzv_name=rdzv_name)
+        )
+
+    def num_nodes_waiting(self, rdzv_name: str = RendezvousName.TRAINING) -> int:
+        resp = self._client.get(msg.NumNodesWaitingRequest(rdzv_name=rdzv_name))
+        return resp.waiting_num
+
+    def network_ready(self) -> Tuple[bool, str]:
+        resp = self._client.get(msg.NetworkReadyRequest())
+        return resp.success, resp.reason
+
+    def get_fault_nodes(self) -> List[int]:
+        return self._client.get(msg.FaultNodesRequest()).nodes
+
+    def get_stragglers(self) -> List[int]:
+        return self._client.get(msg.StragglersRequest()).nodes
+
+    def report_network_check_result(self, normal: bool, elapsed: float):
+        return self._client.report(
+            msg.NetworkCheckResult(
+                node_id=self.node_id, normal=normal, elapsed_time=elapsed
+            )
+        )
+
+    # -- node lifecycle -----------------------------------------------------
+
+    def report_node_address(
+        self, addr: str, port: int = 0, slice_name: str = "", coords: Tuple = ()
+    ):
+        return self._client.report(
+            msg.NodeAddressReport(
+                node_type=self.node_type,
+                node_id=self.node_id,
+                addr=addr,
+                port=port,
+                slice_name=slice_name,
+                coords=coords,
+            )
+        )
+
+    def report_heartbeat(self) -> List[msg.DiagnosisAction]:
+        resp = self._client.report(
+            msg.HeartbeatReport(
+                node_type=self.node_type,
+                node_id=self.node_id,
+                timestamp=time.time(),
+            )
+        )
+        return resp.actions if resp else []
+
+    def report_failure(
+        self,
+        error_data: str,
+        restart_count: int = 0,
+        level: str = "error",
+        exit_code: int = 1,
+    ):
+        return self._client.report(
+            msg.NodeFailureReport(
+                node_type=self.node_type,
+                node_id=self.node_id,
+                restart_count=restart_count,
+                error_data=error_data,
+                level=level,
+                exit_code=exit_code,
+            )
+        )
+
+    def report_succeeded(self):
+        return self._client.report(
+            msg.SucceededReport(node_type=self.node_type, node_id=self.node_id)
+        )
+
+    def report_used_resource(
+        self, cpu_percent: float, memory_mb: float, tpu_duty_cycle: float = 0.0
+    ):
+        return self._client.report(
+            msg.ResourceUsageReport(
+                node_type=self.node_type,
+                node_id=self.node_id,
+                cpu_percent=cpu_percent,
+                memory_mb=memory_mb,
+                tpu_duty_cycle=tpu_duty_cycle,
+            )
+        )
+
+    def report_global_step(self, step: int):
+        return self._client.report(
+            msg.GlobalStepReport(
+                node_id=self.node_id, step=step, timestamp=time.time()
+            )
+        )
+
+    def report_node_check_status(self, status: str):
+        return self._client.report(
+            msg.NodeCheckStatusReport(node_id=self.node_id, status=status)
+        )
+
+    def get_running_nodes(self) -> List[msg.NodeMeta]:
+        return self._client.get(msg.RunningNodesRequest()).nodes
+
+    def get_training_status(self) -> str:
+        return self._client.get(msg.TrainingStatusRequest()).status
+
+    # -- data sharding ------------------------------------------------------
+
+    def report_dataset_shard_params(self, params: msg.DatasetShardParams):
+        return self._client.report(params)
+
+    def get_task(self, dataset_name: str) -> msg.Task:
+        return self._client.get(
+            msg.TaskRequest(dataset_name=dataset_name, node_id=self.node_id),
+            timeout=60,
+        )
+
+    def report_task_result(self, dataset_name: str, task_id: int, success: bool = True):
+        return self._client.report(
+            msg.TaskResult(
+                dataset_name=dataset_name,
+                task_id=task_id,
+                node_id=self.node_id,
+                success=success,
+            )
+        )
+
+    def get_shard_checkpoint(self, dataset_name: str) -> str:
+        resp = self._client.get(msg.ShardCheckpointRequest(dataset_name=dataset_name))
+        return resp.content
+
+    def report_shard_checkpoint(self, dataset_name: str, content: str):
+        return self._client.report(
+            msg.ShardCheckpointReport(dataset_name=dataset_name, content=content)
+        )
+
+    def get_dataset_epoch(self, dataset_name: str) -> int:
+        return self._client.get(
+            msg.DatasetEpochRequest(dataset_name=dataset_name)
+        ).epoch
+
+    # -- kv / sync ----------------------------------------------------------
+
+    def kv_store_set(self, key: str, value: bytes):
+        return self._client.report(msg.KVStoreSet(key=key, value=value))
+
+    def kv_store_get(self, key: str) -> bytes:
+        return self._client.get(msg.KVStoreGet(key=key)).value
+
+    def kv_store_multi_get(self, keys: List[str]) -> Dict[str, bytes]:
+        return self._client.get(msg.KVStoreMultiGet(keys=keys)).kvs
+
+    def kv_store_multi_set(self, kvs: Dict[str, bytes]):
+        return self._client.report(msg.KVStoreMultiSet(kvs=kvs))
+
+    def kv_store_add(self, key: str, amount: int = 1) -> int:
+        return self._client.get(msg.KVStoreAdd(key=key, amount=amount)).num
+
+    def join_sync(self, sync_name: str, node_rank: int) -> bool:
+        resp = self._client.report(
+            msg.SyncJoin(sync_name=sync_name, node_id=self.node_id, node_rank=node_rank)
+        )
+        return resp.success
+
+    def sync_finished(self, sync_name: str) -> bool:
+        return self._client.get(msg.SyncQuery(sync_name=sync_name)).success
+
+    def barrier(self, sync_name: str, timeout: float = 300, interval: float = 0.2) -> bool:
+        """Join a named barrier and wait for everyone (master decides)."""
+        self.join_sync(sync_name, self.node_id)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.sync_finished(sync_name):
+                return True
+            time.sleep(interval)
+        return False
+
+    # -- config / diagnosis -------------------------------------------------
+
+    def get_paral_config(self) -> msg.ParallelConfig:
+        return self._client.get(msg.ParallelConfigRequest(node_id=self.node_id))
+
+    def get_elastic_run_config(self) -> Dict:
+        return self._client.get(msg.ElasticRunConfigRequest()).configs
+
+    def report_diagnosis_data(self, data_cls: str, content: str, node_rank: int = -1):
+        return self._client.report(
+            msg.DiagnosisReportData(
+                data_cls=data_cls,
+                data_content=content,
+                node_id=self.node_id,
+                node_type=self.node_type,
+                node_rank=node_rank,
+            )
+        )
+
+    def report_ckpt_step(self, step: int, blocking_s: float, persist_s: float = 0.0):
+        return self._client.report(
+            msg.CheckpointStepReport(
+                node_id=self.node_id,
+                step=step,
+                blocking_s=blocking_s,
+                persist_s=persist_s,
+            )
+        )
+
+    def close(self):
+        self._client.close()
+
+
+def build_master_client(
+    master_addr: str = "", node_id: Optional[int] = None
+) -> MasterClient:
+    addr = master_addr or os.environ.get(NodeEnv.MASTER_ADDR, "")
+    nid = node_id if node_id is not None else int(os.environ.get(NodeEnv.NODE_ID, "0"))
+    client = MasterClient(addr, nid)
+    MasterClient.reset_singleton(client)
+    return client

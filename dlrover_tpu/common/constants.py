@@ -1,0 +1,166 @@
+"""Framework-wide constants.
+
+Capability parity with the reference's ``dlrover/python/common/constants.py``
+(node types/status/events, exit reasons, rendezvous names, timeouts), re-cast
+for TPU jobs: node types are TPU-host roles, exit reasons include slice
+preemption, and rendezvous names cover the elastic-training and network-check
+rounds.
+"""
+
+from __future__ import annotations
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "k8s"
+    RAY = "ray"
+
+
+class DistributionStrategy:
+    """How the job parallelizes.
+
+    The reference distinguishes ALLREDUCE (torch DDP-family) and PS (TF).
+    TPU-natively everything is SPMD over a device mesh; PS is kept as an
+    interface stub for parity.
+    """
+
+    SPMD = "spmd"  # jax pjit/shard_map over a Mesh (the native path)
+    ALLREDUCE = "allreduce"  # alias accepted for reference parity
+    PS = "ps"  # parameter-server stub (not a TPU-native path)
+    LOCAL = "local"
+
+
+class NodeType:
+    MASTER = "master"
+    WORKER = "worker"  # a TPU host (one JAX process per host)
+    CHIEF = "chief"
+    PS = "ps"
+    EVALUATOR = "evaluator"
+
+
+class NodeStatus:
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    BREAKDOWN = "breakdown"  # health-check failed
+    UNKNOWN = "unknown"
+
+    @classmethod
+    def terminal(cls) -> set:
+        return {cls.SUCCEEDED, cls.FAILED, cls.DELETED}
+
+
+class NodeEventType:
+    CREATED = "created"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+    ERROR = "error"
+
+
+class NodeExitReason:
+    """Why a node/worker process died; drives the relaunch policy."""
+
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"  # external kill (e.g. pod deleted)
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"  # user-code error: do not relaunch
+    HARDWARE_ERROR = "hardware_error"  # chip/host fault: relaunch elsewhere
+    PREEMPTED = "preempted"  # TPU slice/VM preemption: always relaunch
+    UNKNOWN_ERROR = "unknown_error"
+
+    RELAUNCHABLE = {KILLED, OOM, HARDWARE_ERROR, PREEMPTED, UNKNOWN_ERROR}
+
+
+class JobStage:
+    INIT = "init"
+    RENDEZVOUS = "rendezvous"
+    RUNNING = "running"
+    SUSPENDED = "suspended"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+
+
+class JobExitReason:
+    SUCCEEDED = "succeeded"
+    NODE_CHECK_FAILED = "node_check_failed"
+    PENDING_TIMEOUT = "pending_timeout"
+    INSUFFICIENT_WORKER = "insufficient_worker"
+    HANG = "hang"
+    ERROR = "error"
+
+
+class RendezvousName:
+    TRAINING = "training"
+    NETWORK_CHECK = "network-check"
+
+
+class NetworkFailureReason:
+    NO_INIT = "no_init"
+    NODE_FAILURE = "node_failure"
+    WAITING_NODE = "waiting_node"
+
+
+class TrainingExceptionLevel:
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class CheckpointConstant:
+    MODEL_STATES_NAME = "model_states"
+    TRACKER_FILE = "latest_step.txt"
+    STEP_DONE_DIR = "._step_done"
+    SHM_PREFIX = "dlrover_tpu_ckpt"
+
+
+class DefaultValues:
+    # Master-side timeouts (seconds)
+    SEC_HEARTBEAT_TIMEOUT = 600
+    SEC_RDZV_WAITING_TIMEOUT = 600
+    SEC_RDZV_PEND_TIMEOUT = 3600
+    SEC_NODE_START_TIMEOUT = 1800
+    SEC_MONITOR_INTERVAL = 5
+    SEC_MASTER_JOIN_TIMEOUT = 600
+    # Agent-side
+    SEC_AGENT_HEARTBEAT_INTERVAL = 15
+    SEC_WORKER_MONITOR_INTERVAL = 3
+    MAX_NODE_RESTARTS = 3
+    # Data sharding
+    TASK_TIMEOUT_SECS = 1800
+    # Speed monitor
+    SPEED_SAMPLE_WINDOW = 10
+
+
+class GraceWindow:
+    """TPU preemption notice is short; save-on-signal must fit inside it."""
+
+    SEC_SIGTERM_SAVE = 25
+
+
+class NodeEnv:
+    """Environment variables wired from master/agent into workers."""
+
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR_ADDR"
+    PROCESS_ID = "DLROVER_TPU_PROCESS_ID"
+    NUM_PROCESSES = "DLROVER_TPU_NUM_PROCESSES"
+    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    MONITOR_ENABLED = "DLROVER_TPU_MONITOR_ENABLED"
+
+
+class ConfigKeys:
+    """Keys of the runtime-mutable parallel config exchanged with the master."""
+
+    DATALOADER = "dataloader"
+    BATCH_SIZE = "batch_size"
+    NUM_WORKERS = "num_workers"
+    OPTIMIZER = "optimizer"
+    LEARNING_RATE = "learning_rate"
+    GRAD_ACCUM_STEPS = "grad_accum_steps"

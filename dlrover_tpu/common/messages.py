@@ -1,0 +1,450 @@
+"""Control-plane message vocabulary (master <-> agent/worker).
+
+Parity with the ~60 dataclass messages of the reference's
+``dlrover/python/common/grpc.py:161-528``, trimmed to the TPU-relevant set
+and re-grouped: rendezvous, node lifecycle, data sharding, KV/sync,
+checkpoint, diagnosis, autoscaling. All classes are wire-safe via
+:mod:`dlrover_tpu.common.serde`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.serde import message
+
+# ---------------------------------------------------------------------------
+# Generic envelopes
+# ---------------------------------------------------------------------------
+
+
+@message
+class BaseMessage:
+    node_type: str = ""
+    node_id: int = -1
+
+
+@message
+class SimpleResponse:
+    success: bool = True
+    reason: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous
+# ---------------------------------------------------------------------------
+
+
+@message
+class JoinRendezvousRequest:
+    node_id: int = -1
+    node_rank: int = -1
+    local_world_size: int = 1  # chips driven by this host process
+    rdzv_name: str = ""
+    node_ip: str = ""
+    node_port: int = 0
+    slice_name: str = ""
+    coords: Tuple = ()
+
+
+@message
+class JoinRendezvousResponse:
+    round: int = 0
+
+
+@message
+class CommWorldRequest:
+    node_id: int = -1
+    rdzv_name: str = ""
+
+
+@message
+class CommWorldResponse:
+    """The built world: rank assignment plus JAX bootstrap info.
+
+    ``world`` maps node_rank -> (node_id, local_world_size).
+    ``coordinator_addr`` feeds ``jax.distributed.initialize``.
+    """
+
+    rdzv_round: int = 0
+    group: int = 0
+    world: Dict = field(default_factory=dict)
+    coordinator_addr: str = ""
+    completed: bool = False
+
+
+@message
+class NumNodesWaitingRequest:
+    rdzv_name: str = ""
+
+
+@message
+class NumNodesWaitingResponse:
+    waiting_num: int = 0
+
+
+@message
+class NetworkReadyRequest:
+    pass
+
+
+@message
+class NetworkCheckResult:
+    node_id: int = -1
+    normal: bool = True
+    elapsed_time: float = 0.0
+
+
+@message
+class FaultNodesRequest:
+    pass
+
+
+@message
+class FaultNodesResponse:
+    nodes: List[int] = field(default_factory=list)
+    reason: str = ""
+
+
+@message
+class StragglersRequest:
+    pass
+
+
+@message
+class StragglersResponse:
+    nodes: List[int] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# Node lifecycle
+# ---------------------------------------------------------------------------
+
+
+@message
+class NodeMeta:
+    node_type: str = ""
+    node_id: int = -1
+    node_rank: int = -1
+    addr: str = ""
+    slice_name: str = ""
+    coords: Tuple = ()
+
+
+@message
+class NodeAddressReport:
+    node_type: str = ""
+    node_id: int = -1
+    addr: str = ""
+    port: int = 0
+    slice_name: str = ""
+    coords: Tuple = ()
+
+
+@message
+class HeartbeatReport:
+    node_type: str = ""
+    node_id: int = -1
+    timestamp: float = 0.0
+
+
+@message
+class HeartbeatResponse:
+    """Heartbeat ack optionally carrying diagnosis actions for the agent."""
+
+    actions: List = field(default_factory=list)
+
+
+@message
+class NodeFailureReport:
+    node_type: str = ""
+    node_id: int = -1
+    restart_count: int = 0
+    error_data: str = ""
+    level: str = "error"
+    exit_code: int = 0
+
+
+@message
+class NodeCheckStatusReport:
+    node_id: int = -1
+    status: str = ""
+
+
+@message
+class SucceededReport:
+    node_type: str = ""
+    node_id: int = -1
+
+
+@message
+class ResourceUsageReport:
+    node_type: str = ""
+    node_id: int = -1
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    tpu_duty_cycle: float = 0.0
+    tpu_hbm_used_mb: float = 0.0
+
+
+@message
+class GlobalStepReport:
+    node_id: int = -1
+    step: int = 0
+    timestamp: float = 0.0
+
+
+@message
+class ModelInfoReport:
+    node_id: int = -1
+    param_count: int = 0
+    flops_per_step: float = 0.0
+    batch_size: int = 0
+
+
+@message
+class RunningNodesRequest:
+    pass
+
+
+@message
+class RunningNodesResponse:
+    nodes: List[NodeMeta] = field(default_factory=list)
+
+
+@message
+class TrainingStatusRequest:
+    pass
+
+
+@message
+class TrainingStatusResponse:
+    status: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Data sharding
+# ---------------------------------------------------------------------------
+
+
+@message
+class DatasetShardParams:
+    """Register a dataset to shard (reference: DatasetShardParams)."""
+
+    dataset_name: str = ""
+    dataset_size: int = 0
+    shard_size: int = 0
+    num_epochs: int = 1
+    shuffle: bool = False
+    storage_type: str = "text"
+    num_minibatches_per_shard: int = 0
+
+
+@message
+class TaskRequest:
+    dataset_name: str = ""
+    node_id: int = -1
+
+
+@message
+class Task:
+    task_id: int = -1
+    task_type: str = ""  # "train" | "eval" | None
+    dataset_name: str = ""
+    shard_start: int = 0
+    shard_end: int = 0
+    shard_indices: List[int] = field(default_factory=list)
+    epoch: int = 0
+
+    @property
+    def empty(self) -> bool:
+        return self.task_id < 0
+
+
+@message
+class TaskResult:
+    dataset_name: str = ""
+    task_id: int = -1
+    node_id: int = -1
+    success: bool = True
+
+
+@message
+class ShardCheckpointRequest:
+    dataset_name: str = ""
+
+
+@message
+class ShardCheckpointResponse:
+    content: str = ""  # JSON-encoded DatasetShardCheckpoint
+
+
+@message
+class ShardCheckpointReport:
+    dataset_name: str = ""
+    content: str = ""
+
+
+@message
+class DatasetEpochRequest:
+    dataset_name: str = ""
+
+
+@message
+class DatasetEpochResponse:
+    epoch: int = 0
+
+
+# ---------------------------------------------------------------------------
+# KV store / sync barriers
+# ---------------------------------------------------------------------------
+
+
+@message
+class KVStoreSet:
+    key: str = ""
+    value: bytes = b""
+
+
+@message
+class KVStoreGet:
+    key: str = ""
+
+
+@message
+class KVStoreMultiGet:
+    keys: List[str] = field(default_factory=list)
+
+
+@message
+class KVStoreMultiSet:
+    kvs: Dict = field(default_factory=dict)
+
+
+@message
+class KVStoreAdd:
+    key: str = ""
+    amount: int = 1
+
+
+@message
+class KVStoreResponse:
+    found: bool = False
+    value: bytes = b""
+    kvs: Dict = field(default_factory=dict)
+    num: int = 0
+
+
+@message
+class SyncJoin:
+    sync_name: str = ""
+    node_id: int = -1
+    node_rank: int = -1
+
+
+@message
+class SyncFinish:
+    sync_name: str = ""
+
+
+@message
+class SyncQuery:
+    sync_name: str = ""
+
+
+@message
+class SyncResponse:
+    success: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Elastic run / parallel config
+# ---------------------------------------------------------------------------
+
+
+@message
+class ParallelConfig:
+    """Runtime-tunable knobs pushed master->worker (reference grpc.py:477)."""
+
+    dataloader_batch_size: int = 0
+    dataloader_num_workers: int = 0
+    dataloader_version: int = 0
+    optimizer_learning_rate: float = 0.0
+    grad_accum_steps: int = 0
+    optimizer_version: int = 0
+    restart: bool = False
+
+
+@message
+class ParallelConfigRequest:
+    node_id: int = -1
+
+
+@message
+class ElasticRunConfigRequest:
+    pass
+
+
+@message
+class ElasticRunConfigResponse:
+    configs: Dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint control
+# ---------------------------------------------------------------------------
+
+
+@message
+class CheckpointStepReport:
+    node_id: int = -1
+    step: int = 0
+    blocking_s: float = 0.0
+    persist_s: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Diagnosis
+# ---------------------------------------------------------------------------
+
+
+@message
+class DiagnosisReportData:
+    data_cls: str = ""
+    data_content: str = ""
+    node_id: int = -1
+    node_type: str = ""
+    node_rank: int = -1
+
+
+@message
+class DiagnosisAction:
+    """An action the master asks the agent (or itself) to take."""
+
+    action_cls: str = "NoAction"
+    action_content: str = ""
+    instance: int = -1  # target node id, -1 = any/master
+    expired_ts: float = 0.0
+
+
+@message
+class PreCheckRequest:
+    node_id: int = -1
+
+
+@message
+class PreCheckResponse:
+    status: str = "pass"
+
+
+# ---------------------------------------------------------------------------
+# Autoscale / scale plan
+# ---------------------------------------------------------------------------
+
+
+@message
+class ScalePlanMessage:
+    node_group_counts: Dict = field(default_factory=dict)  # type -> count
+    remove_nodes: List[int] = field(default_factory=list)
+    launch_nodes: List[int] = field(default_factory=list)

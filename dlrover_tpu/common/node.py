@@ -1,0 +1,166 @@
+"""In-memory node model held by the master.
+
+Parity with the reference's ``dlrover/python/common/node.py`` (``Node``,
+``NodeResource``, ``NodeGroupResource``), extended with TPU topology fields
+(slice name, torus coordinates) used for ICI-aware rank sorting.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+
+
+@dataclass
+class NodeResource:
+    """Resources of one node (TPU host)."""
+
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    tpu_chips: int = 0
+    tpu_type: str = ""  # e.g. "v5p"
+    gpu_num: int = 0  # parity field; unused on TPU
+
+    def to_dict(self) -> Dict:
+        return {
+            "cpu": self.cpu,
+            "memory_mb": self.memory_mb,
+            "tpu_chips": self.tpu_chips,
+            "tpu_type": self.tpu_type,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "NodeResource":
+        return cls(
+            cpu=d.get("cpu", 0.0),
+            memory_mb=d.get("memory_mb", 0.0),
+            tpu_chips=d.get("tpu_chips", 0),
+            tpu_type=d.get("tpu_type", ""),
+        )
+
+
+@dataclass
+class NodeGroupResource:
+    """Resource of a node group: replica count x per-node resource."""
+
+    count: int = 0
+    node_resource: NodeResource = field(default_factory=NodeResource)
+
+    def update(self, count: Optional[int] = None, resource: Optional[NodeResource] = None):
+        if count is not None and count >= 0:
+            self.count = count
+        if resource is not None:
+            self.node_resource = resource
+
+
+@dataclass
+class TpuTopology:
+    """Where the host sits in the TPU slice.
+
+    ``coords`` are the torus coordinates of the host's first chip; rank
+    sorting by these keeps collective rings on contiguous ICI links (the
+    reference sorts by access switch, ``net_topology.py:53-79``).
+    """
+
+    slice_name: str = ""
+    worker_index: int = -1  # host index inside the slice
+    coords: tuple = ()
+
+    def sort_key(self):
+        return (self.slice_name, self.coords, self.worker_index)
+
+
+class Node:
+    """One managed node (TPU host) and its lifecycle state."""
+
+    def __init__(
+        self,
+        node_type: str,
+        node_id: int,
+        rank_index: Optional[int] = None,
+        name: str = "",
+        status: str = NodeStatus.INITIAL,
+        config_resource: Optional[NodeResource] = None,
+        max_relaunch_count: int = 3,
+    ):
+        self.type = node_type
+        self.id = node_id
+        self.rank_index = rank_index if rank_index is not None else node_id
+        self.name = name or f"{node_type}-{node_id}"
+        self.status = status
+        self.config_resource = config_resource or NodeResource()
+        self.used_resource = NodeResource()
+        self.topology = TpuTopology()
+
+        self.host_addr: str = ""
+        self.host_port: int = 0
+
+        self.create_time: Optional[float] = None
+        self.start_time: Optional[float] = None
+        self.finish_time: Optional[float] = None
+        self.heartbeat_time: float = 0.0
+
+        self.exit_reason: str = ""
+        self.relaunch_count = 0
+        self.max_relaunch_count = max_relaunch_count
+        self.relaunchable = True
+        self.is_released = False
+        self.critical = False  # job fails if this node fails beyond budget
+        self.paral_config: Dict = {}
+        self.start_hang_time: float = 0.0
+        self.reported_status: str = ""
+
+    # -- state helpers ----------------------------------------------------
+
+    def update_status(self, status: str):
+        if status and status != NodeStatus.UNKNOWN:
+            self.status = status
+            if status == NodeStatus.RUNNING and self.start_time is None:
+                self.start_time = time.time()
+            if status in NodeStatus.terminal():
+                self.finish_time = time.time()
+
+    def update_heartbeat(self, ts: Optional[float] = None):
+        self.heartbeat_time = ts if ts is not None else time.time()
+
+    def is_unrecoverable_failure(self) -> bool:
+        if self.exit_reason == NodeExitReason.FATAL_ERROR:
+            return True
+        return self.relaunch_count >= self.max_relaunch_count
+
+    def inc_relaunch_count(self):
+        self.relaunch_count += 1
+
+    def get_relaunch_node_info(self, new_id: int) -> "Node":
+        """Build the replacement node for a relaunch."""
+        new_node = copy.copy(self)
+        new_node.id = new_id
+        new_node.name = f"{self.type}-{new_id}"
+        new_node.status = NodeStatus.INITIAL
+        new_node.start_time = None
+        new_node.create_time = None
+        new_node.finish_time = None
+        new_node.is_released = False
+        new_node.relaunchable = True
+        new_node.exit_reason = ""
+        new_node.heartbeat_time = 0.0
+        new_node.inc_relaunch_count()
+        return new_node
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (
+            f"Node(type={self.type}, id={self.id}, rank={self.rank_index}, "
+            f"status={self.status})"
+        )
+
+
+@dataclass
+class NodeEvent:
+    """A platform event about a node (watch stream or heartbeat-derived)."""
+
+    event_type: str
+    node: Node

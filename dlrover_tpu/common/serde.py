@@ -1,0 +1,91 @@
+"""Safe dataclass serialization for the control plane.
+
+The reference ships pickled dataclasses over two generic gRPC methods
+(``dlrover/python/common/grpc.py:147-161``). Pickle on a network port is an
+RCE hazard; here every message class registers itself and is encoded as
+``{"_t": <registered name>, ...fields}`` JSON, reconstructed recursively from
+dataclass type hints. Only registered classes can be instantiated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Dict, Type
+
+_REGISTRY: Dict[str, Type] = {}
+
+
+def message(cls=None):
+    """Class decorator: make a dataclass wire-serializable."""
+
+    def wrap(c):
+        c = dataclasses.dataclass(c)
+        _REGISTRY[c.__name__] = c
+        return c
+
+    if cls is None:
+        return wrap
+    return wrap(cls)
+
+
+def registered(name: str):
+    return _REGISTRY.get(name)
+
+
+def _encode(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {"_t": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            out[f.name] = _encode(getattr(obj, f.name))
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    if isinstance(obj, bytes):
+        return {"_t": "__bytes__", "hex": obj.hex()}
+    raise TypeError(f"unserializable control-plane value: {type(obj)}")
+
+
+def _decode(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        t = obj.get("_t")
+        if t == "__bytes__":
+            return bytes.fromhex(obj["hex"])
+        if t is not None:
+            cls = _REGISTRY.get(t)
+            if cls is None:
+                raise ValueError(f"unknown message type: {t}")
+            hints = typing.get_type_hints(cls)
+            kwargs = {}
+            for f in dataclasses.fields(cls):
+                if f.name in obj:
+                    val = _decode(obj[f.name])
+                    hint = hints.get(f.name)
+                    # Tuples arrive as lists; coerce from the hint.
+                    if (
+                        hint is not None
+                        and typing.get_origin(hint) is tuple
+                        and isinstance(val, list)
+                    ):
+                        val = tuple(val)
+                    kwargs[f.name] = val
+            return cls(**kwargs)
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+def serialize(msg: Any) -> bytes:
+    return json.dumps(_encode(msg), separators=(",", ":")).encode()
+
+
+def deserialize(data: bytes) -> Any:
+    if not data:
+        return None
+    return _decode(json.loads(data.decode()))

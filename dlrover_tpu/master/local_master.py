@@ -1,0 +1,118 @@
+"""Single-process job master for ``--standalone`` runs and tests.
+
+Parity: reference ``master/local_master.py:38`` (LocalJobMaster). Wires the
+servicer, task manager, local job manager, rendezvous managers, KV store and
+sync service onto one gRPC port.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import JobExitReason, RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+from dlrover_tpu.master.node.job_manager import LocalJobManager
+from dlrover_tpu.master.rendezvous.kv_store import KVStoreService
+from dlrover_tpu.master.rendezvous.manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.rendezvous.sync_service import SyncService
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.rpc.transport import RpcServer
+
+
+class LocalJobMaster:
+    def __init__(
+        self,
+        port: int = 0,
+        node_num: int = 1,
+        elastic_run_configs: Optional[Dict] = None,
+        heartbeat_timeout: float = 600,
+    ):
+        self.speed_monitor = SpeedMonitor()
+        self.speed_monitor.set_target_worker_num(node_num)
+        self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
+        self.job_manager = LocalJobManager(
+            speed_monitor=self.speed_monitor, heartbeat_timeout=heartbeat_timeout
+        )
+        self.rdzv_managers = {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        for mgr in self.rdzv_managers.values():
+            mgr.update_rdzv_params(
+                min_nodes=node_num,
+                max_nodes=node_num,
+                waiting_timeout=60,
+                node_unit=1,
+            )
+        self.kv_store = KVStoreService()
+        self.sync_service = SyncService(get_job_context())
+        self.diagnosis_manager = None
+        self.servicer = MasterServicer(
+            task_manager=self.task_manager,
+            job_manager=self.job_manager,
+            speed_monitor=self.speed_monitor,
+            rdzv_managers=self.rdzv_managers,
+            diagnosis_manager=self.diagnosis_manager,
+            kv_store=self.kv_store,
+            sync_service=self.sync_service,
+            elastic_run_configs=elastic_run_configs,
+        )
+        self._server = RpcServer(self.servicer, port=port)
+        self.port = self._server.port
+        self._exit_code = 0
+        self._exit_reason = ""
+
+    def prepare(self):
+        self._server.start()
+        self.task_manager.start()
+        self.job_manager.start()
+        logger.info("local master serving on port %s", self.port)
+
+    def run(self, poll_interval: float = 1.0) -> int:
+        """Block until all workers exit or training data is exhausted."""
+        try:
+            while True:
+                time.sleep(poll_interval)
+                if self.job_manager.all_workers_succeeded():
+                    self._exit_reason = JobExitReason.SUCCEEDED
+                    break
+                if self.job_manager.any_worker_failed_fatally():
+                    self._exit_reason = JobExitReason.ERROR
+                    self._exit_code = 1
+                    break
+                if self.job_manager.all_workers_exited():
+                    workers = get_job_context().workers()
+                    if workers:
+                        self._exit_reason = JobExitReason.SUCCEEDED
+                        break
+        finally:
+            self.stop()
+        logger.info("local master exiting: %s", self._exit_reason)
+        return self._exit_code
+
+    def stop(self):
+        self.task_manager.stop()
+        self.job_manager.stop()
+        self._server.stop(grace=1)
+
+
+def start_local_master(
+    port: int = 0, node_num: int = 1, **kw
+) -> LocalJobMaster:
+    """Test/standalone helper: boot a master, return it (already serving).
+
+    This is the in-process harness the reference builds its whole test suite
+    on (``python/tests/test_utils.py:337-349``).
+    """
+    JobContext.reset_singleton()
+    master = LocalJobMaster(port=port, node_num=node_num, **kw)
+    master.prepare()
+    return master

@@ -1,0 +1,135 @@
+"""Throughput + goodput accounting from worker step reports.
+
+Parity: reference ``master/monitor/speed_monitor.py:45-205`` (global-step
+samples -> throughput, straggler context). Extended with a goodput ledger —
+the reference's headline metric (README: 69%->95% goodput) — tracked from
+day one: productive time = steps x EMA step time; goodput = productive /
+wall since training start.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from dlrover_tpu.common.constants import DefaultValues
+
+
+@dataclass
+class GlobalStepRecord:
+    step: int
+    timestamp: float
+
+
+class SpeedMonitor:
+    def __init__(self, sample_window: int = DefaultValues.SPEED_SAMPLE_WINDOW):
+        self._lock = threading.Lock()
+        self._samples: List[GlobalStepRecord] = []
+        self._sample_window = sample_window
+        self._start_training_time: float = 0.0
+        self._global_step = 0
+        self._target_worker_num = 0
+        self._workers: Set[Tuple[str, int]] = set()
+        self._init_time = time.time()
+        # goodput ledger
+        self._downtime_start: float = 0.0
+        self._total_downtime: float = 0.0
+
+    # -- step samples -------------------------------------------------------
+
+    def collect_global_step(self, step: int, timestamp: Optional[float] = None):
+        ts = timestamp or time.time()
+        with self._lock:
+            if self._start_training_time == 0.0:
+                self._start_training_time = ts
+            if step <= self._global_step:
+                return
+            self._global_step = step
+            self._samples.append(GlobalStepRecord(step, ts))
+            if len(self._samples) > self._sample_window:
+                self._samples.pop(0)
+
+    @property
+    def completed_global_step(self) -> int:
+        return self._global_step
+
+    @property
+    def start_training_time(self) -> float:
+        return self._start_training_time
+
+    def running_speed(self) -> float:
+        """Steps/sec over the sample window."""
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            first, last = self._samples[0], self._samples[-1]
+            dt = last.timestamp - first.timestamp
+            if dt <= 0:
+                return 0.0
+            return (last.step - first.step) / dt
+
+    def secs_per_step(self) -> float:
+        speed = self.running_speed()
+        return 1.0 / speed if speed > 0 else 0.0
+
+    # -- worker membership ----------------------------------------------------
+
+    def set_target_worker_num(self, num: int):
+        self._target_worker_num = num
+
+    def add_running_worker(self, node_type: str, node_id: int):
+        with self._lock:
+            self._workers.add((node_type, node_id))
+
+    def remove_running_worker(self, node_type: str, node_id: int):
+        with self._lock:
+            self._workers.discard((node_type, node_id))
+
+    def all_worker_joined(self) -> bool:
+        with self._lock:
+            return 0 < self._target_worker_num <= len(self._workers)
+
+    @property
+    def running_workers(self) -> Set[Tuple[str, int]]:
+        with self._lock:
+            return set(self._workers)
+
+    # -- goodput ledger --------------------------------------------------------
+
+    def mark_downtime_start(self, ts: Optional[float] = None):
+        with self._lock:
+            if self._downtime_start == 0.0:
+                self._downtime_start = ts or time.time()
+
+    def mark_downtime_end(self, ts: Optional[float] = None):
+        with self._lock:
+            if self._downtime_start > 0.0:
+                self._total_downtime += (ts or time.time()) - self._downtime_start
+                self._downtime_start = 0.0
+
+    def goodput(self) -> float:
+        """Fraction of wall time (since first step) spent training."""
+        with self._lock:
+            if self._start_training_time == 0.0:
+                return 0.0
+            now = time.time()
+            wall = now - self._start_training_time
+            if wall <= 0:
+                return 0.0
+            down = self._total_downtime
+            if self._downtime_start > 0.0:
+                down += now - self._downtime_start
+            return max(0.0, min(1.0, (wall - down) / wall))
+
+    def total_downtime(self) -> float:
+        with self._lock:
+            down = self._total_downtime
+            if self._downtime_start > 0.0:
+                down += time.time() - self._downtime_start
+            return down
+
+    def reset_running_speed(self):
+        with self._lock:
+            self._samples.clear()

@@ -1,0 +1,140 @@
+"""In-memory DB of job nodes + diagnosis action queue.
+
+Parity: reference ``master/node/job_context.py:30`` (singleton JobContext).
+Thread-safe: the servicer, watcher thread, and autoscaler all touch it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.messages import DiagnosisAction
+from dlrover_tpu.common.node import Node
+
+
+class DiagnosisActionQueue:
+    """Per-instance queues of pending diagnosis actions with expiry."""
+
+    def __init__(self):
+        self._actions: Dict[int, List[DiagnosisAction]] = {}
+        self._lock = threading.Lock()
+
+    def add_action(self, action: DiagnosisAction):
+        with self._lock:
+            q = self._actions.setdefault(action.instance, [])
+            # dedupe identical pending actions
+            for a in q:
+                if (
+                    a.action_cls == action.action_cls
+                    and a.action_content == action.action_content
+                ):
+                    return
+            q.append(action)
+
+    def next_action(self, instance: int) -> Optional[DiagnosisAction]:
+        now = time.time()
+        with self._lock:
+            q = self._actions.get(instance, [])
+            while q:
+                action = q.pop(0)
+                if action.expired_ts <= 0 or action.expired_ts > now:
+                    return action
+            return None
+
+    def drain(self, instance: int) -> List[DiagnosisAction]:
+        out = []
+        while True:
+            a = self.next_action(instance)
+            if a is None:
+                return out
+            out.append(a)
+
+
+class JobContext:
+    """All mutable job state the master holds, keyed by (type, id)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._nodes: Dict[str, Dict[int, Node]] = {}
+        self._lock = threading.RLock()
+        self._action_queue = DiagnosisActionQueue()
+        self._failed_locating: set = set()
+        self.job_stage: str = ""
+
+    @classmethod
+    def singleton_instance(cls) -> "JobContext":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = JobContext()
+            return cls._instance
+
+    @classmethod
+    def reset_singleton(cls):
+        with cls._instance_lock:
+            cls._instance = None
+
+    # -- nodes ------------------------------------------------------------
+
+    def update_node(self, node: Node):
+        with self._lock:
+            self._nodes.setdefault(node.type, {})[node.id] = node
+
+    def remove_node(self, node_type: str, node_id: int):
+        with self._lock:
+            self._nodes.get(node_type, {}).pop(node_id, None)
+
+    def get_node(self, node_type: str, node_id: int) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_type, {}).get(node_id)
+
+    def job_nodes(self) -> Dict[str, Dict[int, Node]]:
+        with self._lock:
+            return {t: dict(nodes) for t, nodes in self._nodes.items()}
+
+    def nodes_of_type(self, node_type: str) -> Dict[int, Node]:
+        with self._lock:
+            return dict(self._nodes.get(node_type, {}))
+
+    def workers(self) -> Dict[int, Node]:
+        return self.nodes_of_type(NodeType.WORKER)
+
+    def running_nodes(self, node_type: str = NodeType.WORKER) -> List[Node]:
+        return [
+            n
+            for n in self.nodes_of_type(node_type).values()
+            if n.status == NodeStatus.RUNNING and not n.is_released
+        ]
+
+    def alive_nodes(self, node_type: str = NodeType.WORKER) -> List[Node]:
+        return [
+            n
+            for n in self.nodes_of_type(node_type).values()
+            if n.status in (NodeStatus.INITIAL, NodeStatus.PENDING, NodeStatus.RUNNING)
+            and not n.is_released
+        ]
+
+    def next_node_id(self, node_type: str) -> int:
+        with self._lock:
+            nodes = self._nodes.get(node_type, {})
+            return max(nodes.keys(), default=-1) + 1
+
+    def clear(self):
+        with self._lock:
+            self._nodes.clear()
+
+    # -- diagnosis actions -------------------------------------------------
+
+    def enqueue_action(self, action: DiagnosisAction):
+        self._action_queue.add_action(action)
+
+    def next_action(self, instance: int) -> Optional[DiagnosisAction]:
+        return self._action_queue.next_action(instance)
+
+
+def get_job_context() -> JobContext:
+    return JobContext.singleton_instance()

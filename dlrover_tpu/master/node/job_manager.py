@@ -1,0 +1,229 @@
+"""Job managers: node lifecycle management inside the master.
+
+Parity: reference ``master/node/job_manager.py`` (abstract) and
+``local_job_manager.py`` (single-node / standalone variant). The
+k8s-distributed variant lives in ``dist_job_manager.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import (
+    DefaultValues,
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import DiagnosisAction
+from dlrover_tpu.common.node import Node, NodeEvent, NodeResource
+from dlrover_tpu.master.node.job_context import get_job_context
+from dlrover_tpu.master.node.status_flow import get_node_state_flow
+
+
+class JobManager(ABC):
+    """Shared API the servicer and master loop program against."""
+
+    def __init__(self, job_args=None, speed_monitor=None):
+        self._job_args = job_args
+        self._speed_monitor = speed_monitor
+        self._job_context = get_job_context()
+        self._stopped = False
+
+    @abstractmethod
+    def start(self):
+        ...
+
+    @abstractmethod
+    def stop(self):
+        ...
+
+    # -- node reports -------------------------------------------------------
+
+    def update_node_resource_usage(
+        self, node_type: str, node_id: int, cpu: float, memory_mb: float, **kw
+    ):
+        node = self._job_context.get_node(node_type, node_id)
+        if node is None:
+            return
+        node.used_resource.cpu = cpu
+        node.used_resource.memory_mb = memory_mb
+
+    def collect_node_heartbeat(
+        self, node_type: str, node_id: int, ts: float
+    ) -> Optional[DiagnosisAction]:
+        node = self._job_context.get_node(node_type, node_id)
+        if node is not None:
+            node.update_heartbeat(ts)
+        return self._job_context.next_action(node_id)
+
+    def update_node_address(
+        self, node_type: str, node_id: int, addr: str, port: int = 0,
+        slice_name: str = "", coords=(),
+    ):
+        node = self._job_context.get_node(node_type, node_id)
+        if node is None:
+            return
+        node.host_addr = addr
+        node.host_port = port
+        node.topology.slice_name = slice_name
+        node.topology.coords = tuple(coords)
+        if node.status == NodeStatus.INITIAL:
+            node.update_status(NodeStatus.PENDING)
+
+    def update_node_reported_status(self, node_type: str, node_id: int, status: str):
+        node = self._job_context.get_node(node_type, node_id)
+        if node is not None:
+            node.reported_status = status
+
+    def handle_training_failure(
+        self,
+        node_type: str,
+        node_id: int,
+        restart_count: int = -1,
+        error_data: str = "",
+        level: str = TrainingExceptionLevel.ERROR,
+        exit_code: int = 1,
+    ):
+        node = self._job_context.get_node(node_type, node_id)
+        if node is None:
+            return
+        logger.warning(
+            "training failure on %s-%s (restart=%s, level=%s): %s",
+            node_type,
+            node_id,
+            restart_count,
+            level,
+            error_data[:500],
+        )
+        if level == TrainingExceptionLevel.ERROR:
+            node.exit_reason = _classify_error(error_data, exit_code)
+
+    def handle_node_succeeded(self, node_type: str, node_id: int):
+        node = self._job_context.get_node(node_type, node_id)
+        if node is not None:
+            node.update_status(NodeStatus.SUCCEEDED)
+
+    # -- queries --------------------------------------------------------------
+
+    def all_workers_exited(self) -> bool:
+        return not self._job_context.alive_nodes(NodeType.WORKER)
+
+    def all_workers_succeeded(self) -> bool:
+        workers = self._job_context.workers().values()
+        return bool(workers) and all(
+            n.status == NodeStatus.SUCCEEDED for n in workers
+        )
+
+    def any_worker_failed_fatally(self) -> bool:
+        return any(
+            n.status == NodeStatus.FAILED and n.is_unrecoverable_failure()
+            for n in self._job_context.workers().values()
+        )
+
+    def should_early_stop(self):
+        return False, "", ""
+
+
+def _classify_error(error_data: str, exit_code: int) -> str:
+    """Map a failure report to a NodeExitReason (drives relaunch policy)."""
+    text = (error_data or "").lower()
+    if "out of memory" in text or "oom" in text or "resource_exhausted" in text:
+        return NodeExitReason.OOM
+    if "preempt" in text or exit_code in (-15, 143):
+        return NodeExitReason.PREEMPTED
+    if any(
+        k in text
+        for k in ("hbm", "ici link", "chip failure", "data_loss", "internal: tpu")
+    ):
+        return NodeExitReason.HARDWARE_ERROR
+    if exit_code in (1, 2) and text:
+        return NodeExitReason.FATAL_ERROR
+    return NodeExitReason.UNKNOWN_ERROR
+
+
+class LocalJobManager(JobManager):
+    """Standalone-mode manager: the nodes are local agent processes.
+
+    No platform watcher; node death is detected by heartbeat timeout. Used
+    by ``--standalone`` runs and the in-process test harness.
+    """
+
+    def __init__(
+        self,
+        job_args=None,
+        speed_monitor=None,
+        heartbeat_timeout: float = DefaultValues.SEC_HEARTBEAT_TIMEOUT,
+    ):
+        super().__init__(job_args, speed_monitor)
+        self._heartbeat_timeout = heartbeat_timeout
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    def start(self):
+        self._stop_evt.clear()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_heartbeats, name="heartbeat-monitor", daemon=True
+        )
+        self._monitor_thread.start()
+
+    def stop(self):
+        self._stopped = True
+        self._stop_evt.set()
+
+    def add_node(self, node_type: str, node_id: int, **kw) -> Node:
+        node = Node(node_type, node_id, **kw)
+        node.update_status(NodeStatus.RUNNING)
+        node.update_heartbeat()
+        self._job_context.update_node(node)
+        if self._speed_monitor is not None:
+            self._speed_monitor.add_running_worker(node_type, node_id)
+        return node
+
+    def get_or_register_node(self, node_type: str, node_id: int) -> Node:
+        node = self._job_context.get_node(node_type, node_id)
+        if node is None:
+            node = self.add_node(node_type, node_id)
+        return node
+
+    def handle_node_event(self, event: NodeEvent):
+        node = self._job_context.get_node(event.node.type, event.node.id)
+        if node is None:
+            self._job_context.update_node(event.node)
+            return
+        flow = get_node_state_flow(node.status, event.event_type, event.node.status)
+        if flow is None:
+            return
+        node.update_status(flow.to_status)
+        if flow.to_status in (NodeStatus.FAILED, NodeStatus.DELETED):
+            if self._speed_monitor is not None:
+                self._speed_monitor.remove_running_worker(node.type, node.id)
+
+    def _monitor_heartbeats(self):
+        while not self._stop_evt.wait(DefaultValues.SEC_MONITOR_INTERVAL):
+            now = time.time()
+            for node in self._job_context.workers().values():
+                if (
+                    node.status == NodeStatus.RUNNING
+                    and node.heartbeat_time > 0
+                    and now - node.heartbeat_time > self._heartbeat_timeout
+                ):
+                    logger.warning(
+                        "node %s-%s heartbeat timeout (%.0fs); marking FAILED",
+                        node.type,
+                        node.id,
+                        now - node.heartbeat_time,
+                    )
+                    node.exit_reason = NodeExitReason.UNKNOWN_ERROR
+                    self.handle_node_event(
+                        NodeEvent(
+                            NodeEventType.MODIFIED,
+                            Node(node.type, node.id, status=NodeStatus.FAILED),
+                        )
+                    )

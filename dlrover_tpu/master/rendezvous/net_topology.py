@@ -1,0 +1,48 @@
+"""Topology-aware rank sorting.
+
+The reference sorts DP-ring members by access switch so ring traffic stays
+under one ASW (``net_topology.py:22-79``). The TPU analogue: sort hosts by
+(slice, torus coordinates, worker index) so neighbouring ranks are
+ICI-adjacent and DCN hops only occur at slice boundaries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class NodeTopologyMeta:
+    node_id: int = -1
+    node_rank: int = -1
+    process_num: int = 1  # local world size (chips per host process)
+    node_ip: str = ""
+    node_port: int = 0
+    slice_name: str = ""
+    coords: Tuple = field(default_factory=tuple)
+    join_time: float = 0.0
+
+
+class TpuTopologySorter:
+    """Assign ranks so ICI neighbours get adjacent ranks."""
+
+    def sort(self, nodes: Dict[int, NodeTopologyMeta]) -> Dict[int, NodeTopologyMeta]:
+        """Return {new_rank: meta} ordered by slice then torus coords.
+
+        Nodes without topology info keep join-order (stable by previous rank
+        then node_id) so the sort is deterministic either way.
+        """
+        metas: List[NodeTopologyMeta] = list(nodes.values())
+        metas.sort(
+            key=lambda m: (
+                m.slice_name,
+                tuple(m.coords) if m.coords else (),
+                m.node_rank if m.node_rank >= 0 else m.node_id,
+                m.node_id,
+            )
+        )
+        out: Dict[int, NodeTopologyMeta] = {}
+        for new_rank, m in enumerate(metas):
+            out[new_rank] = m
+        return out

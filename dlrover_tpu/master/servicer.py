@@ -1,0 +1,356 @@
+"""The master's control-plane API: one ``get`` + one ``report`` dispatch.
+
+Parity: reference ``master/servicer.py:69-717`` (``MasterServicer.get``
+:106-153 and ``.report`` :317-371), re-typed over the safe serde messages.
+Dispatch is a type->handler table instead of an if-chain.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import NodeType, RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.node.job_context import get_job_context
+from dlrover_tpu.master.rendezvous.kv_store import KVStoreService
+from dlrover_tpu.master.rendezvous.manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.rendezvous.net_topology import NodeTopologyMeta
+from dlrover_tpu.master.rendezvous.sync_service import SyncService
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        task_manager=None,
+        job_manager=None,
+        speed_monitor=None,
+        rdzv_managers: Optional[Dict] = None,
+        diagnosis_manager=None,
+        kv_store: Optional[KVStoreService] = None,
+        sync_service: Optional[SyncService] = None,
+        elastic_run_configs: Optional[Dict] = None,
+    ):
+        self._task_manager = task_manager
+        self._job_manager = job_manager
+        self._speed_monitor = speed_monitor
+        self._rdzv_managers = rdzv_managers or {
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+        }
+        self._diagnosis_manager = diagnosis_manager
+        self._kv_store = kv_store or KVStoreService()
+        self._sync_service = sync_service or SyncService(get_job_context())
+        self._elastic_run_configs = elastic_run_configs or {}
+        self._job_context = get_job_context()
+        self.start_training_time: float = 0.0
+
+        self._get_handlers = {
+            msg.TaskRequest: self._get_task,
+            msg.ShardCheckpointRequest: self._get_shard_checkpoint,
+            msg.DatasetEpochRequest: self._get_dataset_epoch,
+            msg.JoinRendezvousRequest: self._join_rendezvous,
+            msg.CommWorldRequest: self._get_comm_world,
+            msg.NumNodesWaitingRequest: self._num_nodes_waiting,
+            msg.NetworkReadyRequest: self._network_ready,
+            msg.FaultNodesRequest: self._get_fault_nodes,
+            msg.StragglersRequest: self._get_stragglers,
+            msg.KVStoreGet: self._kv_get,
+            msg.KVStoreMultiGet: self._kv_multi_get,
+            msg.KVStoreAdd: self._kv_add,
+            msg.RunningNodesRequest: self._running_nodes,
+            msg.TrainingStatusRequest: self._training_status,
+            msg.ParallelConfigRequest: self._get_paral_config,
+            msg.ElasticRunConfigRequest: self._get_elastic_run_config,
+            msg.SyncQuery: self._sync_query,
+            msg.PreCheckRequest: self._pre_check,
+        }
+        self._report_handlers = {
+            msg.DatasetShardParams: self._new_dataset,
+            msg.TaskResult: self._report_task_result,
+            msg.ShardCheckpointReport: self._restore_shard_checkpoint,
+            msg.NodeAddressReport: self._report_node_address,
+            msg.HeartbeatReport: self._report_heartbeat,
+            msg.NodeFailureReport: self._report_failure,
+            msg.SucceededReport: self._report_succeeded,
+            msg.ResourceUsageReport: self._report_resource,
+            msg.GlobalStepReport: self._report_global_step,
+            msg.ModelInfoReport: self._report_model_info,
+            msg.NetworkCheckResult: self._report_network_check,
+            msg.NodeCheckStatusReport: self._report_node_check_status,
+            msg.KVStoreSet: self._kv_set,
+            msg.KVStoreMultiSet: self._kv_multi_set,
+            msg.SyncJoin: self._sync_join,
+            msg.SyncFinish: self._sync_finish,
+            msg.DiagnosisReportData: self._report_diagnosis_data,
+            msg.CheckpointStepReport: self._report_ckpt_step,
+        }
+
+    # -- dispatch -----------------------------------------------------------
+
+    def get(self, request, context=None):
+        handler = self._get_handlers.get(type(request))
+        if handler is None:
+            logger.warning("no get handler for %s", type(request).__name__)
+            return msg.SimpleResponse(success=False, reason="unknown message")
+        return handler(request)
+
+    def report(self, request, context=None):
+        handler = self._report_handlers.get(type(request))
+        if handler is None:
+            logger.warning("no report handler for %s", type(request).__name__)
+            return msg.SimpleResponse(success=False, reason="unknown message")
+        return handler(request)
+
+    # -- data sharding ------------------------------------------------------
+
+    def _new_dataset(self, request: msg.DatasetShardParams):
+        self._task_manager.new_dataset(request)
+        return msg.SimpleResponse()
+
+    def _get_task(self, request: msg.TaskRequest):
+        return self._task_manager.get_dataset_task(
+            request.node_id, request.dataset_name
+        )
+
+    def _report_task_result(self, request: msg.TaskResult):
+        ok = self._task_manager.report_dataset_task(
+            request.dataset_name, request.task_id, request.success
+        )
+        return msg.SimpleResponse(success=ok)
+
+    def _get_shard_checkpoint(self, request: msg.ShardCheckpointRequest):
+        ckpt = self._task_manager.checkpoint_dataset(request.dataset_name)
+        return msg.ShardCheckpointResponse(content=ckpt.to_json() if ckpt else "")
+
+    def _restore_shard_checkpoint(self, request: msg.ShardCheckpointReport):
+        ok = self._task_manager.restore_dataset_checkpoint(request.content)
+        return msg.SimpleResponse(success=bool(ok))
+
+    def _get_dataset_epoch(self, request: msg.DatasetEpochRequest):
+        return msg.DatasetEpochResponse(
+            epoch=self._task_manager.get_epoch(request.dataset_name)
+        )
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def _join_rendezvous(self, request: msg.JoinRendezvousRequest):
+        mgr = self._rdzv_managers[request.rdzv_name or RendezvousName.TRAINING]
+        meta = NodeTopologyMeta(
+            node_id=request.node_id,
+            node_rank=request.node_rank,
+            process_num=request.local_world_size,
+            node_ip=request.node_ip,
+            node_port=request.node_port,
+            slice_name=request.slice_name,
+            coords=tuple(request.coords),
+        )
+        rdzv_round = mgr.join_rendezvous(request.node_id, request.node_rank, meta)
+        if self._job_manager is not None and hasattr(
+            self._job_manager, "get_or_register_node"
+        ):
+            self._job_manager.get_or_register_node(NodeType.WORKER, request.node_id)
+        return msg.JoinRendezvousResponse(round=rdzv_round)
+
+    def _get_comm_world(self, request: msg.CommWorldRequest):
+        mgr = self._rdzv_managers[request.rdzv_name or RendezvousName.TRAINING]
+        rdzv_round, group, world, coord = mgr.get_comm_world(request.node_id)
+        wire_world = {
+            str(rank): [m.node_id, m.process_num, m.node_ip, m.node_port]
+            for rank, m in world.items()
+        }
+        return msg.CommWorldResponse(
+            rdzv_round=rdzv_round,
+            group=group,
+            world=wire_world,
+            coordinator_addr=coord,
+            completed=bool(world),
+        )
+
+    def _num_nodes_waiting(self, request: msg.NumNodesWaitingRequest):
+        mgr = self._rdzv_managers[request.rdzv_name or RendezvousName.TRAINING]
+        return msg.NumNodesWaitingResponse(waiting_num=mgr.num_nodes_waiting())
+
+    def _network_ready(self, request: msg.NetworkReadyRequest):
+        mgr = self._rdzv_managers[RendezvousName.NETWORK_CHECK]
+        success, reason = mgr.network_check_success()
+        return msg.SimpleResponse(success=success, reason=reason)
+
+    def _get_fault_nodes(self, request: msg.FaultNodesRequest):
+        mgr = self._rdzv_managers[RendezvousName.NETWORK_CHECK]
+        nodes, reason = mgr.check_fault_node()
+        return msg.FaultNodesResponse(nodes=nodes, reason=reason)
+
+    def _get_stragglers(self, request: msg.StragglersRequest):
+        mgr = self._rdzv_managers[RendezvousName.NETWORK_CHECK]
+        nodes, _ = mgr.get_straggler()
+        return msg.StragglersResponse(nodes=nodes)
+
+    def _report_network_check(self, request: msg.NetworkCheckResult):
+        mgr = self._rdzv_managers[RendezvousName.NETWORK_CHECK]
+        mgr.report_network_check_result(
+            request.node_id, request.normal, request.elapsed_time
+        )
+        return msg.SimpleResponse()
+
+    # -- node lifecycle -----------------------------------------------------
+
+    def _report_node_address(self, request: msg.NodeAddressReport):
+        if self._job_manager is not None:
+            if hasattr(self._job_manager, "get_or_register_node"):
+                self._job_manager.get_or_register_node(
+                    request.node_type, request.node_id
+                )
+            self._job_manager.update_node_address(
+                request.node_type,
+                request.node_id,
+                request.addr,
+                request.port,
+                request.slice_name,
+                request.coords,
+            )
+        return msg.SimpleResponse()
+
+    def _report_heartbeat(self, request: msg.HeartbeatReport):
+        actions = []
+        if self._job_manager is not None:
+            action = self._job_manager.collect_node_heartbeat(
+                request.node_type, request.node_id, request.timestamp or time.time()
+            )
+            if action is not None:
+                actions.append(action)
+        return msg.HeartbeatResponse(actions=actions)
+
+    def _report_failure(self, request: msg.NodeFailureReport):
+        if self._job_manager is not None:
+            self._job_manager.handle_training_failure(
+                request.node_type,
+                request.node_id,
+                request.restart_count,
+                request.error_data,
+                request.level,
+                request.exit_code,
+            )
+        if self._task_manager is not None:
+            self._task_manager.remove_node_tasks(request.node_id)
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(request.node_id)
+        if self._speed_monitor is not None:
+            self._speed_monitor.mark_downtime_start()
+        return msg.SimpleResponse()
+
+    def _report_succeeded(self, request: msg.SucceededReport):
+        if self._job_manager is not None:
+            self._job_manager.handle_node_succeeded(
+                request.node_type or NodeType.WORKER, request.node_id
+            )
+        return msg.SimpleResponse()
+
+    def _report_resource(self, request: msg.ResourceUsageReport):
+        if self._job_manager is not None:
+            self._job_manager.update_node_resource_usage(
+                request.node_type,
+                request.node_id,
+                request.cpu_percent,
+                request.memory_mb,
+                tpu_duty_cycle=request.tpu_duty_cycle,
+            )
+        return msg.SimpleResponse()
+
+    def _report_global_step(self, request: msg.GlobalStepReport):
+        if self._speed_monitor is not None:
+            self._speed_monitor.collect_global_step(
+                request.step, request.timestamp or time.time()
+            )
+            self._speed_monitor.mark_downtime_end()
+        return msg.SimpleResponse()
+
+    def _report_model_info(self, request: msg.ModelInfoReport):
+        return msg.SimpleResponse()
+
+    def _report_node_check_status(self, request: msg.NodeCheckStatusReport):
+        if self._job_manager is not None:
+            self._job_manager.update_node_reported_status(
+                NodeType.WORKER, request.node_id, request.status
+            )
+        return msg.SimpleResponse()
+
+    def _running_nodes(self, request: msg.RunningNodesRequest):
+        nodes = []
+        for n in self._job_context.running_nodes():
+            nodes.append(
+                msg.NodeMeta(
+                    node_type=n.type,
+                    node_id=n.id,
+                    node_rank=n.rank_index,
+                    addr=n.host_addr,
+                    slice_name=n.topology.slice_name,
+                    coords=tuple(n.topology.coords),
+                )
+            )
+        return msg.RunningNodesResponse(nodes=nodes)
+
+    def _training_status(self, request: msg.TrainingStatusRequest):
+        status = "running" if self._speed_monitor and self._speed_monitor.completed_global_step > 0 else "pending"
+        return msg.TrainingStatusResponse(status=status)
+
+    # -- kv / sync ----------------------------------------------------------
+
+    def _kv_set(self, request: msg.KVStoreSet):
+        self._kv_store.set(request.key, request.value)
+        return msg.SimpleResponse()
+
+    def _kv_multi_set(self, request: msg.KVStoreMultiSet):
+        self._kv_store.multi_set(request.kvs)
+        return msg.SimpleResponse()
+
+    def _kv_get(self, request: msg.KVStoreGet):
+        value = self._kv_store.get(request.key)
+        return msg.KVStoreResponse(found=bool(value), value=value)
+
+    def _kv_multi_get(self, request: msg.KVStoreMultiGet):
+        kvs = self._kv_store.multi_get(request.keys)
+        return msg.KVStoreResponse(found=all(kvs.values()), kvs=kvs)
+
+    def _kv_add(self, request: msg.KVStoreAdd):
+        num = self._kv_store.add(request.key, request.amount)
+        return msg.KVStoreResponse(found=True, num=num)
+
+    def _sync_join(self, request: msg.SyncJoin):
+        ok = self._sync_service.join_sync(request.sync_name, request.node_rank)
+        return msg.SimpleResponse(success=ok)
+
+    def _sync_finish(self, request: msg.SyncFinish):
+        ok = self._sync_service.barrier(request.sync_name)
+        return msg.SimpleResponse(success=ok)
+
+    def _sync_query(self, request: msg.SyncQuery):
+        return msg.SyncResponse(
+            success=self._sync_service.sync_finished(request.sync_name)
+        )
+
+    # -- config / diagnosis -------------------------------------------------
+
+    def _get_paral_config(self, request: msg.ParallelConfigRequest):
+        node = self._job_context.get_node(NodeType.WORKER, request.node_id)
+        if node is not None and node.paral_config:
+            return msg.ParallelConfig(**node.paral_config)
+        return msg.ParallelConfig()
+
+    def _get_elastic_run_config(self, request: msg.ElasticRunConfigRequest):
+        return msg.ElasticRunConfigResponse(configs=dict(self._elastic_run_configs))
+
+    def _pre_check(self, request: msg.PreCheckRequest):
+        return msg.PreCheckResponse(status="pass")
+
+    def _report_diagnosis_data(self, request: msg.DiagnosisReportData):
+        if self._diagnosis_manager is not None:
+            self._diagnosis_manager.collect_diagnosis_data(request)
+        return msg.SimpleResponse()
+
+    def _report_ckpt_step(self, request: msg.CheckpointStepReport):
+        return msg.SimpleResponse()

@@ -1,0 +1,192 @@
+"""Per-dataset todo/doing task queues + shard checkpointing.
+
+Parity: reference ``master/shard/{base,batch,streaming}_dataset_manager.py``
+(todo/doing queues, completed-step bookkeeping, ``DatasetShardCheckpoint``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import Task
+from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter, Shard
+
+
+@dataclass
+class DoingTask:
+    task: Task
+    node_id: int
+    start_time: float
+
+
+@dataclass
+class DatasetShardCheckpoint:
+    """Resumable sharding state: epoch + undone shard ranges."""
+
+    dataset_name: str = ""
+    todo: List = field(default_factory=list)  # [[start, end], ...]
+    doing: List = field(default_factory=list)
+    epoch: int = 0
+    completed_records: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "dataset_name": self.dataset_name,
+                "todo": self.todo,
+                "doing": self.doing,
+                "epoch": self.epoch,
+                "completed_records": self.completed_records,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, content: str) -> "DatasetShardCheckpoint":
+        d = json.loads(content)
+        return cls(
+            dataset_name=d.get("dataset_name", ""),
+            todo=d.get("todo", []),
+            doing=d.get("doing", []),
+            epoch=d.get("epoch", 0),
+            completed_records=d.get("completed_records", 0),
+        )
+
+
+class BatchDatasetManager:
+    """Dispatches shards of a bounded dataset as tasks to workers."""
+
+    def __init__(self, task_type: str, splitter: DatasetSplitter):
+        self.task_type = task_type
+        self._splitter = splitter
+        self._todo: Deque[Task] = deque()
+        self._doing: Dict[int, DoingTask] = {}
+        self._task_id_seq = 0
+        self._completed_records = 0
+        self._lock = threading.Lock()
+
+    @property
+    def dataset_name(self) -> str:
+        return self._splitter.dataset_name
+
+    def _create_tasks_from_shards(self, shards: List[Shard], epoch: int):
+        for shard in shards:
+            task = Task(
+                task_id=self._task_id_seq,
+                task_type=self.task_type,
+                dataset_name=self._splitter.dataset_name,
+                shard_start=shard.start,
+                shard_end=shard.end,
+                shard_indices=shard.record_indices,
+                epoch=epoch,
+            )
+            self._task_id_seq += 1
+            self._todo.append(task)
+
+    def get_task(self, node_id: int) -> Task:
+        with self._lock:
+            if not self._todo:
+                if self._splitter.create_shards():
+                    self._create_tasks_from_shards(
+                        self._splitter.get_shards(), self._splitter.epoch
+                    )
+            if not self._todo:
+                return Task()  # empty: dataset exhausted
+            task = self._todo.popleft()
+            self._doing[task.task_id] = DoingTask(task, node_id, time.time())
+            return task
+
+    def report_task_status(self, task_id: int, success: bool) -> Tuple[bool, Optional[Task]]:
+        """Returns (known, task). Failure requeues the shard at the front."""
+        with self._lock:
+            doing = self._doing.pop(task_id, None)
+            if doing is None:
+                return False, None
+            if success:
+                self._completed_records += (
+                    doing.task.shard_end - doing.task.shard_start
+                )
+            else:
+                self._todo.appendleft(doing.task)
+            return True, doing.task
+
+    def reset_worker_tasks(self, node_id: int) -> int:
+        """Worker died: requeue all shards it was working on."""
+        with self._lock:
+            stale = [tid for tid, d in self._doing.items() if d.node_id == node_id]
+            for tid in stale:
+                self._todo.appendleft(self._doing.pop(tid).task)
+            if stale:
+                logger.info(
+                    "dataset %s: requeued %s tasks of dead node %s",
+                    self.dataset_name,
+                    len(stale),
+                    node_id,
+                )
+            return len(stale)
+
+    def reset_timeout_tasks(self, timeout_s: float) -> List[int]:
+        now = time.time()
+        with self._lock:
+            stale = [
+                tid
+                for tid, d in self._doing.items()
+                if now - d.start_time > timeout_s
+            ]
+            for tid in stale:
+                self._todo.appendleft(self._doing.pop(tid).task)
+            return stale
+
+    def completed(self) -> bool:
+        with self._lock:
+            return (
+                not self._todo
+                and not self._doing
+                and self._splitter.epoch_finished()
+            )
+
+    @property
+    def completed_records(self) -> int:
+        return self._completed_records
+
+    def get_epoch(self) -> int:
+        return self._splitter.epoch
+
+    # -- checkpoint -------------------------------------------------------
+
+    def checkpoint(self) -> DatasetShardCheckpoint:
+        with self._lock:
+            return DatasetShardCheckpoint(
+                dataset_name=self.dataset_name,
+                todo=[[t.shard_start, t.shard_end] for t in self._todo],
+                doing=[
+                    [d.task.shard_start, d.task.shard_end]
+                    for d in self._doing.values()
+                ],
+                epoch=self._splitter.epoch,
+                completed_records=self._completed_records,
+            )
+
+    def restore_checkpoint(self, ckpt: DatasetShardCheckpoint):
+        """Doing shards are treated as undone and go back to todo."""
+        with self._lock:
+            self._splitter.epoch = ckpt.epoch
+            self._todo.clear()
+            self._doing.clear()
+            self._completed_records = ckpt.completed_records
+            for start, end in list(ckpt.doing) + list(ckpt.todo):
+                task = Task(
+                    task_id=self._task_id_seq,
+                    task_type=self.task_type,
+                    dataset_name=self.dataset_name,
+                    shard_start=start,
+                    shard_end=end,
+                    epoch=ckpt.epoch,
+                )
+                self._task_id_seq += 1
+                self._todo.append(task)

@@ -1,0 +1,172 @@
+"""Dataset splitters: a shard is a record-index range.
+
+Parity: reference ``master/shard/dataset_splitter.py`` (Text/Table/Streaming
+splitters, huge-dataset sub-epochs, factory ``new_dataset_splitter`` :325).
+"""
+
+from __future__ import annotations
+
+import random
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from dlrover_tpu.common.log import logger
+
+_MAX_SHARDS_PER_EPOCH = 50_000_000
+
+
+@dataclass
+class Shard:
+    """A unit of data: records [start, end) of ``name``.
+
+    ``record_indices`` carries the shuffled sample indices when per-record
+    shuffle is on (reference keeps the same field).
+    """
+
+    name: str = ""
+    start: int = 0
+    end: int = 0
+    record_indices: List[int] = field(default_factory=list)
+
+
+class PartitionOffsets:
+    """Unbounded streaming partitions: partition -> consumed offset."""
+
+    def __init__(self, partition_offsets: dict):
+        self.partition_offsets = dict(partition_offsets)
+
+    def partitions(self):
+        return list(self.partition_offsets)
+
+
+class DatasetSplitter(ABC):
+    def __init__(self, dataset_name: str, dataset_size: int, shard_size: int, num_epochs: int):
+        self.dataset_name = dataset_name
+        self.dataset_size = dataset_size
+        self.shard_size = shard_size
+        self._num_epochs = num_epochs
+        self.epoch = 0
+
+    @abstractmethod
+    def create_shards(self) -> bool:
+        """Populate the next epoch's shards; False if no epochs remain."""
+
+    @abstractmethod
+    def get_shards(self) -> List[Shard]:
+        ...
+
+    def epoch_finished(self) -> bool:
+        return self.epoch >= self._num_epochs
+
+
+class TextDatasetSplitter(DatasetSplitter):
+    """Shards by record line-number ranges, with optional shuffle.
+
+    Reference: ``TextDatasetSplitter`` :257 (record-level shuffle inside
+    shards) — here shard-order shuffle plus optional per-record indices.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+        self._seed = seed
+        self._shards: List[Shard] = []
+
+    def create_shards(self) -> bool:
+        if self.epoch_finished():
+            return False
+        shards = []
+        for start in range(0, self.dataset_size, self.shard_size):
+            end = min(start + self.shard_size, self.dataset_size)
+            shards.append(Shard(name=self.dataset_name, start=start, end=end))
+        if self._shuffle:
+            rng = random.Random(self._seed + self.epoch)
+            rng.shuffle(shards)
+        self._shards = shards
+        self.epoch += 1
+        logger.info(
+            "dataset %s: epoch %s with %s shards",
+            self.dataset_name,
+            self.epoch,
+            len(shards),
+        )
+        return True
+
+    def get_shards(self) -> List[Shard]:
+        return list(self._shards)
+
+
+class TableDatasetSplitter(TextDatasetSplitter):
+    """Table (row-range) splitter; identical math, kept for API parity
+    (reference ``TableDatasetSplitter`` :144)."""
+
+
+class StreamingDatasetSplitter(DatasetSplitter):
+    """Splits unbounded streams by (partition, offset range).
+
+    Reference: ``StreamingDatasetSplitter`` :359. Each call to
+    ``create_shards`` emits up to ``max_shard_count`` new shards advancing
+    the per-partition offsets by ``shard_size``.
+    """
+
+    def __init__(
+        self,
+        dataset_name: str,
+        shard_size: int,
+        partition_offsets: PartitionOffsets,
+        dataset_size: int = -1,
+        max_shard_count: int = 1024,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs=1)
+        self._offsets = partition_offsets
+        self._max_shard_count = max_shard_count
+        self._shards: List[Shard] = []
+
+    def create_shards(self) -> bool:
+        shards = []
+        count = 0
+        for partition in self._offsets.partitions():
+            if count >= self._max_shard_count:
+                break
+            offset = self._offsets.partition_offsets[partition]
+            start, end = offset, offset + self.shard_size
+            shards.append(Shard(name=str(partition), start=start, end=end))
+            self._offsets.partition_offsets[partition] = end
+            count += 1
+        self._shards = shards
+        return bool(shards)
+
+    def get_shards(self) -> List[Shard]:
+        return list(self._shards)
+
+    def epoch_finished(self) -> bool:
+        return False
+
+
+def new_dataset_splitter(
+    splitter_type: str,
+    dataset_name: str,
+    dataset_size: int,
+    shard_size: int,
+    num_epochs: int = 1,
+    shuffle: bool = False,
+    partition_offsets: Optional[dict] = None,
+) -> DatasetSplitter:
+    if splitter_type in ("text", ""):
+        return TextDatasetSplitter(dataset_name, dataset_size, shard_size, num_epochs, shuffle)
+    if splitter_type == "table":
+        return TableDatasetSplitter(dataset_name, dataset_size, shard_size, num_epochs, shuffle)
+    if splitter_type == "streaming":
+        return StreamingDatasetSplitter(
+            dataset_name, shard_size, PartitionOffsets(partition_offsets or {})
+        )
+    raise ValueError(f"unknown splitter type: {splitter_type}")

@@ -1,0 +1,129 @@
+"""Dataset registry + task dispatch (parity: master/shard/task_manager.py).
+
+Holds one :class:`BatchDatasetManager` per registered dataset, hands shards
+("tasks") to workers, re-dispatches tasks of dead/timed-out workers, and
+exposes dataset checkpoint/restore for job-level resume.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import DefaultValues
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import DatasetShardParams, Task
+from dlrover_tpu.master.shard.dataset_manager import (
+    BatchDatasetManager,
+    DatasetShardCheckpoint,
+)
+from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
+
+
+class TaskManager:
+    def __init__(self, worker_restart_timeout: float = 0.0, speed_monitor=None):
+        self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._lock = threading.Lock()
+        self._worker_restart_timeout = worker_restart_timeout
+        self._speed_monitor = speed_monitor
+        self._task_timeout = DefaultValues.TASK_TIMEOUT_SECS
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def new_dataset(self, params: DatasetShardParams):
+        with self._lock:
+            if params.dataset_name in self._datasets:
+                return
+            splitter = new_dataset_splitter(
+                params.storage_type,
+                params.dataset_name,
+                params.dataset_size,
+                params.shard_size,
+                params.num_epochs,
+                params.shuffle,
+            )
+            task_type = "eval" if "eval" in params.dataset_name else "train"
+            self._datasets[params.dataset_name] = BatchDatasetManager(
+                task_type, splitter
+            )
+            logger.info(
+                "registered dataset %s: size=%s shard=%s epochs=%s",
+                params.dataset_name,
+                params.dataset_size,
+                params.shard_size,
+                params.num_epochs,
+            )
+
+    def has_dataset(self, name: str) -> bool:
+        return name in self._datasets
+
+    def get_dataset_task(self, node_id: int, dataset_name: str) -> Task:
+        ds = self._datasets.get(dataset_name)
+        if ds is None:
+            return Task()
+        return ds.get_task(node_id)
+
+    def report_dataset_task(self, dataset_name: str, task_id: int, success: bool):
+        ds = self._datasets.get(dataset_name)
+        if ds is None:
+            return False
+        known, _ = ds.report_task_status(task_id, success)
+        return known
+
+    def get_epoch(self, dataset_name: str) -> int:
+        ds = self._datasets.get(dataset_name)
+        return ds.get_epoch() if ds else 0
+
+    def completed_records(self, dataset_name: str) -> int:
+        ds = self._datasets.get(dataset_name)
+        return ds.completed_records if ds else 0
+
+    def finished(self) -> bool:
+        """All training datasets exhausted (empty registry = not finished)."""
+        with self._lock:
+            if not self._datasets:
+                return False
+            return all(ds.completed() for ds in self._datasets.values())
+
+    def remove_node_tasks(self, node_id: int):
+        for ds in self._datasets.values():
+            ds.reset_worker_tasks(node_id)
+
+    # -- checkpoint -------------------------------------------------------
+
+    def checkpoint_dataset(self, dataset_name: str) -> Optional[DatasetShardCheckpoint]:
+        ds = self._datasets.get(dataset_name)
+        return ds.checkpoint() if ds else None
+
+    def restore_dataset_checkpoint(self, content: str):
+        ckpt = DatasetShardCheckpoint.from_json(content)
+        ds = self._datasets.get(ckpt.dataset_name)
+        if ds is None:
+            logger.warning("restore for unknown dataset %s", ckpt.dataset_name)
+            return False
+        ds.restore_checkpoint(ckpt)
+        return True
+
+    # -- background timeout scan ------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._scan_loop, name="task-timeout-scan", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _scan_loop(self):
+        while not self._stop.wait(30):
+            for ds in list(self._datasets.values()):
+                stale = ds.reset_timeout_tasks(self._task_timeout)
+                if stale:
+                    logger.warning(
+                        "dataset %s: reassigned timed-out tasks %s",
+                        ds.dataset_name,
+                        stale,
+                    )

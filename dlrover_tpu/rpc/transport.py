@@ -1,0 +1,154 @@
+"""gRPC transport for the control plane.
+
+The reference exposes one gRPC service with two generic RPCs ``get`` and
+``report`` carrying pickled payloads (``dlrover/proto/elastic_training.proto:18-31``,
+``master/servicer.py:106-153``). We keep the two-generic-RPC shape — it makes
+the protocol evolvable without proto regeneration — but payloads are the safe
+JSON serde from :mod:`dlrover_tpu.common.serde`, and the methods are declared
+as raw-bytes unary RPCs so no generated stubs are needed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+from typing import Any, Callable, Optional
+
+import grpc
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.serde import deserialize, serialize
+
+SERVICE = "dlrover_tpu.Master"
+GET = f"/{SERVICE}/get"
+REPORT = f"/{SERVICE}/report"
+
+_identity = lambda b: b  # noqa: E731
+
+
+class _Handler(grpc.GenericRpcHandler):
+    def __init__(self, get_fn: Callable, report_fn: Callable):
+        self._get_fn = get_fn
+        self._report_fn = report_fn
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == GET:
+            return grpc.unary_unary_rpc_method_handler(
+                self._get_fn,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            )
+        if method == REPORT:
+            return grpc.unary_unary_rpc_method_handler(
+                self._report_fn,
+                request_deserializer=_identity,
+                response_serializer=_identity,
+            )
+        return None
+
+
+class RpcServer:
+    """Wraps a servicer object exposing ``get(msg)`` / ``report(msg)``."""
+
+    def __init__(self, servicer, port: int = 0, max_workers: int = 32):
+        self._servicer = servicer
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ],
+        )
+        self._server.add_generic_rpc_handlers(
+            [_Handler(self._handle_get, self._handle_report)]
+        )
+        self.port = self._server.add_insecure_port(f"0.0.0.0:{port}")
+
+    def _handle_get(self, request: bytes, context) -> bytes:
+        try:
+            msg = deserialize(request)
+            resp = self._servicer.get(msg, context)
+            return serialize(resp) if resp is not None else b""
+        except Exception:
+            logger.exception("error handling get RPC")
+            context.abort(grpc.StatusCode.INTERNAL, "get failed")
+
+    def _handle_report(self, request: bytes, context) -> bytes:
+        try:
+            msg = deserialize(request)
+            resp = self._servicer.report(msg, context)
+            return serialize(resp) if resp is not None else b""
+        except Exception:
+            logger.exception("error handling report RPC")
+            context.abort(grpc.StatusCode.INTERNAL, "report failed")
+
+    def start(self):
+        self._server.start()
+
+    def stop(self, grace: Optional[float] = None):
+        self._server.stop(grace)
+
+
+class RpcClient:
+    """Client side of the two generic RPCs, with retry."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self.addr = addr
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._channel = None
+        self._get = None
+        self._report = None
+        self._connect()
+
+    def _connect(self):
+        self._channel = grpc.insecure_channel(
+            self.addr,
+            options=[
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ("grpc.enable_retries", 1),
+            ],
+        )
+        self._get = self._channel.unary_unary(
+            GET, request_serializer=_identity, response_deserializer=_identity
+        )
+        self._report = self._channel.unary_unary(
+            REPORT, request_serializer=_identity, response_deserializer=_identity
+        )
+
+    def available(self, timeout: float = 5.0) -> bool:
+        try:
+            grpc.channel_ready_future(self._channel).result(timeout=timeout)
+            return True
+        except Exception:
+            return False
+
+    def _call(self, stub, msg: Any, retries: int, timeout: Optional[float]):
+        timeout = timeout or self._timeout
+        err = None
+        for i in range(retries):
+            try:
+                return deserialize(stub(serialize(msg), timeout=timeout))
+            except grpc.RpcError as e:
+                err = e
+                if e.code() in (
+                    grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                ):
+                    time.sleep(min(2**i, 8))
+                    continue
+                raise
+        raise err
+
+    def get(self, msg: Any, retries: int = 3, timeout: Optional[float] = None):
+        return self._call(self._get, msg, retries, timeout)
+
+    def report(self, msg: Any, retries: int = 3, timeout: Optional[float] = None):
+        return self._call(self._report, msg, retries, timeout)
+
+    def close(self):
+        if self._channel:
+            self._channel.close()

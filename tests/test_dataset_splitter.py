@@ -1,0 +1,49 @@
+from dlrover_tpu.master.shard.dataset_splitter import (
+    PartitionOffsets,
+    StreamingDatasetSplitter,
+    TextDatasetSplitter,
+    new_dataset_splitter,
+)
+
+
+def test_text_splitter_shards():
+    s = TextDatasetSplitter("ds", dataset_size=105, shard_size=10, num_epochs=2)
+    assert s.create_shards()
+    shards = s.get_shards()
+    assert len(shards) == 11
+    assert shards[-1].end - shards[-1].start == 5
+    assert s.epoch == 1
+    assert s.create_shards()  # epoch 2
+    assert not s.create_shards()  # exhausted
+    assert s.epoch_finished()
+
+
+def test_text_splitter_shuffle_deterministic():
+    a = TextDatasetSplitter("ds", 100, 10, 1, shuffle=True, seed=7)
+    b = TextDatasetSplitter("ds", 100, 10, 1, shuffle=True, seed=7)
+    a.create_shards()
+    b.create_shards()
+    assert [s.start for s in a.get_shards()] == [s.start for s in b.get_shards()]
+    # all shards present
+    assert sorted(s.start for s in a.get_shards()) == list(range(0, 100, 10))
+
+
+def test_streaming_splitter_advances_offsets():
+    s = StreamingDatasetSplitter(
+        "stream", shard_size=100, partition_offsets=PartitionOffsets({"p0": 0, "p1": 50})
+    )
+    assert s.create_shards()
+    shards = {sh.name: (sh.start, sh.end) for sh in s.get_shards()}
+    assert shards == {"p0": (0, 100), "p1": (50, 150)}
+    s.create_shards()
+    shards = {sh.name: (sh.start, sh.end) for sh in s.get_shards()}
+    assert shards == {"p0": (100, 200), "p1": (150, 250)}
+
+
+def test_factory():
+    s = new_dataset_splitter("text", "d", 10, 5)
+    assert isinstance(s, TextDatasetSplitter)
+    s = new_dataset_splitter(
+        "streaming", "d", -1, 5, partition_offsets={"p": 0}
+    )
+    assert isinstance(s, StreamingDatasetSplitter)

@@ -1,0 +1,133 @@
+"""Rendezvous manager tests: N simulated nodes, no cluster.
+
+Mirrors the reference's ``test_rdzv_manager.py:83-423`` technique of calling
+``join_rendezvous`` directly per simulated node.
+"""
+
+import time
+
+from dlrover_tpu.master.rendezvous.manager import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.rendezvous.net_topology import NodeTopologyMeta
+
+
+def _join(mgr, node_id, rank=None, ip="", port=0, slice_name="", coords=()):
+    meta = NodeTopologyMeta(
+        node_id=node_id,
+        node_rank=rank if rank is not None else node_id,
+        process_num=1,
+        node_ip=ip or f"10.0.0.{node_id}",
+        node_port=port or 7000 + node_id,
+        slice_name=slice_name,
+        coords=coords,
+    )
+    return mgr.join_rendezvous(node_id, meta.node_rank, meta)
+
+
+def test_completes_at_max_nodes():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=3, waiting_timeout=60, node_unit=1)
+    for i in range(3):
+        _join(mgr, i)
+    rnd, group, world, coord = mgr.get_comm_world(0)
+    assert len(world) == 3
+    assert rnd == 1
+    assert coord == "10.0.0.0:7000"
+    # every member can fetch the same world
+    _, _, world2, _ = mgr.get_comm_world(2)
+    assert {m.node_id for m in world2.values()} == {0, 1, 2}
+
+
+def test_waits_below_min_nodes():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=4, waiting_timeout=60, node_unit=1)
+    _join(mgr, 0)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert world == {}
+    assert mgr.num_nodes_waiting() == 1
+
+
+def test_completes_on_timeout_with_min_nodes():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=4, waiting_timeout=0.2, node_unit=1)
+    _join(mgr, 0)
+    _join(mgr, 1)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert world == {}  # not yet: below max, timer running
+    time.sleep(0.3)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert len(world) == 2
+
+
+def test_node_unit_rounding():
+    """5 nodes with unit=2 -> only 4 get seats; the 5th stays waiting."""
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=2, max_nodes=8, waiting_timeout=0.1, node_unit=2)
+    for i in range(5):
+        _join(mgr, i)
+    time.sleep(0.2)
+    _, _, world, _ = mgr.get_comm_world(0)
+    assert len(world) == 4
+    assert mgr.num_nodes_waiting() == 1
+
+
+def test_dead_node_removed_from_waiting():
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=3, max_nodes=3, waiting_timeout=60, node_unit=1)
+    _join(mgr, 0)
+    _join(mgr, 1)
+    mgr.remove_alive_node(1)
+    assert mgr.num_nodes_waiting() == 1
+
+
+def test_topology_sorted_ranks():
+    """Ranks follow (slice, coords): ICI neighbours get adjacent ranks."""
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=60, node_unit=1)
+    _join(mgr, 0, slice_name="s1", coords=(1, 0))
+    _join(mgr, 1, slice_name="s0", coords=(1, 0))
+    _join(mgr, 2, slice_name="s0", coords=(0, 0))
+    _join(mgr, 3, slice_name="s1", coords=(0, 0))
+    _, _, world, _ = mgr.get_comm_world(0)
+    order = [world[r].node_id for r in sorted(world)]
+    assert order == [2, 1, 3, 0]  # s0:(0,0), s0:(1,0), s1:(0,0), s1:(1,0)
+
+
+def test_network_check_pairs_and_fault_localization():
+    mgr = NetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=60, node_unit=1)
+    for i in range(4):
+        _join(mgr, i)
+    _, g0, world0, _ = mgr.get_comm_world(0)
+    assert len(world0) == 2  # paired
+    # round 1: node 3 fails
+    for i in range(4):
+        mgr.report_network_check_result(i, normal=(i != 3), elapsed=1.0)
+    ok, reason = mgr.network_check_success()
+    assert not ok
+    faults, _ = mgr.check_fault_node()
+    assert faults == [3]
+    # round 2 re-join: node 3 fails again in a different pair
+    for i in range(4):
+        _join(mgr, i)
+    mgr.get_comm_world(0)
+    for i in range(4):
+        mgr.report_network_check_result(i, normal=(i != 3), elapsed=1.0)
+    faults, _ = mgr.check_fault_node()
+    assert faults == [3]
+
+
+def test_straggler_detection_across_rounds():
+    mgr = NetworkCheckRendezvousManager()
+    mgr.update_rdzv_params(min_nodes=4, max_nodes=4, waiting_timeout=60, node_unit=1)
+    for rnd in range(2):
+        for i in range(4):
+            _join(mgr, i)
+        mgr.get_comm_world(0)
+        for i in range(4):
+            t = 10.0 if i == 2 else 1.0  # node 2 is consistently slow
+            mgr.report_network_check_result(i, normal=True, elapsed=t)
+    stragglers, _ = mgr.get_straggler()
+    assert stragglers == [2]

@@ -1,0 +1,50 @@
+"""Wire-format tests for the control-plane serde."""
+
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.serde import deserialize, serialize
+
+
+def test_roundtrip_simple():
+    m = msg.JoinRendezvousRequest(
+        node_id=3, node_rank=1, local_world_size=4, rdzv_name="training",
+        node_ip="10.0.0.1", node_port=4321, slice_name="s0", coords=(0, 1, 2),
+    )
+    out = deserialize(serialize(m))
+    assert out == m
+    assert isinstance(out.coords, tuple)
+
+
+def test_roundtrip_nested_list():
+    m = msg.RunningNodesResponse(
+        nodes=[
+            msg.NodeMeta(node_type="worker", node_id=0, addr="a"),
+            msg.NodeMeta(node_type="worker", node_id=1, addr="b"),
+        ]
+    )
+    out = deserialize(serialize(m))
+    assert len(out.nodes) == 2
+    assert out.nodes[1].addr == "b"
+    assert isinstance(out.nodes[0], msg.NodeMeta)
+
+
+def test_bytes_payload():
+    m = msg.KVStoreSet(key="k", value=b"\x00\xffbinary")
+    out = deserialize(serialize(m))
+    assert out.value == b"\x00\xffbinary"
+
+
+def test_unknown_type_rejected():
+    with pytest.raises(ValueError):
+        deserialize(b'{"_t": "os.system", "cmd": "rm -rf /"}')
+
+
+def test_dict_payload():
+    m = msg.CommWorldResponse(
+        rdzv_round=2, world={"0": [0, 4, "ip", 1], "1": [1, 4, "ip2", 2]},
+        coordinator_addr="ip:1", completed=True,
+    )
+    out = deserialize(serialize(m))
+    assert out.world["1"] == [1, 4, "ip2", 2]
+    assert out.completed

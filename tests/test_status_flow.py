@@ -1,0 +1,40 @@
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+from dlrover_tpu.master.node.status_flow import get_node_state_flow
+
+
+def test_legal_transitions():
+    flow = get_node_state_flow(
+        NodeStatus.PENDING, NodeEventType.MODIFIED, NodeStatus.RUNNING
+    )
+    assert flow is not None and not flow.should_relaunch
+
+    flow = get_node_state_flow(
+        NodeStatus.RUNNING, NodeEventType.MODIFIED, NodeStatus.FAILED
+    )
+    assert flow.should_relaunch
+
+
+def test_deleted_event_overrides_status():
+    flow = get_node_state_flow(
+        NodeStatus.RUNNING, NodeEventType.DELETED, NodeStatus.RUNNING
+    )
+    assert flow is not None
+    assert flow.to_status == NodeStatus.DELETED
+    assert flow.should_relaunch
+
+
+def test_stale_event_rejected():
+    # late PENDING after RUNNING must not regress
+    flow = get_node_state_flow(
+        NodeStatus.RUNNING, NodeEventType.MODIFIED, NodeStatus.PENDING
+    )
+    assert flow is None
+
+
+def test_noop_transition():
+    assert (
+        get_node_state_flow(
+            NodeStatus.RUNNING, NodeEventType.MODIFIED, NodeStatus.RUNNING
+        )
+        is None
+    )

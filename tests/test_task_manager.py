@@ -1,0 +1,54 @@
+from dlrover_tpu.common.messages import DatasetShardParams
+from dlrover_tpu.master.shard.task_manager import TaskManager
+
+
+def _params(name="ds", size=100, shard=25, epochs=1):
+    return DatasetShardParams(
+        dataset_name=name, dataset_size=size, shard_size=shard, num_epochs=epochs
+    )
+
+
+def test_task_dispatch_and_finish():
+    tm = TaskManager()
+    tm.new_dataset(_params())
+    assert not tm.finished()
+    done = 0
+    while True:
+        t = tm.get_dataset_task(node_id=0, dataset_name="ds")
+        if t.empty:
+            break
+        tm.report_dataset_task("ds", t.task_id, success=True)
+        done += 1
+    assert done == 4
+    assert tm.finished()
+    assert tm.completed_records("ds") == 100
+
+
+def test_failed_task_requeued():
+    tm = TaskManager()
+    tm.new_dataset(_params(size=50, shard=50))
+    t = tm.get_dataset_task(0, "ds")
+    tm.report_dataset_task("ds", t.task_id, success=False)
+    t2 = tm.get_dataset_task(0, "ds")
+    assert (t2.shard_start, t2.shard_end) == (t.shard_start, t.shard_end)
+
+
+def test_dead_node_tasks_reassigned():
+    tm = TaskManager()
+    tm.new_dataset(_params(size=100, shard=50))
+    t_dead = tm.get_dataset_task(node_id=7, dataset_name="ds")
+    assert not t_dead.empty
+    tm.remove_node_tasks(7)
+    # both shards still obtainable by the healthy node
+    spans = set()
+    while True:
+        t = tm.get_dataset_task(node_id=1, dataset_name="ds")
+        if t.empty:
+            break
+        spans.add((t.shard_start, t.shard_end))
+        tm.report_dataset_task("ds", t.task_id, True)
+    assert spans == {(0, 50), (50, 100)}
+
+
+def test_empty_registry_not_finished():
+    assert not TaskManager().finished()
