@@ -1,0 +1,52 @@
+"""Elastic launch configuration.
+
+Parity: reference ``ElasticLaunchConfig`` (``elastic_agent/torch/training.py:147-236``)
+minus torch-specific knobs, plus TPU ones (slice name, chips per host).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class ElasticLaunchConfig:
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1  # JAX processes per host (1 is TPU-canonical)
+    node_id: int = 0
+    job_name: str = "dlrover-tpu-job"
+    master_addr: str = ""
+
+    rdzv_join_timeout: float = 600.0
+    rdzv_waiting_timeout: float = 30.0
+    node_unit: int = 1
+
+    max_restarts: int = 3
+    monitor_interval: float = 2.0
+    network_check: bool = False
+    comm_perf_test: bool = False
+    exclude_straggler: bool = False
+    save_at_breakpoint: bool = False
+    accelerator: str = "tpu"  # "tpu" | "cpu" (cpu = gloo test mode)
+    training_port: int = 0  # coordinator port base; 0 = auto
+
+    # TPU topology hints (injected by the platform or discovered)
+    slice_name: str = ""
+    coords: tuple = ()
+
+    entrypoint: str = ""
+    entrypoint_args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+
+    def auto_configure(self):
+        """Fill in defaults from the environment (parity: auto_configure_params)."""
+        if not self.slice_name:
+            self.slice_name = os.environ.get("TPU_SLICE_NAME", "")
+        if not self.coords:
+            coords = os.environ.get("TPU_WORKER_COORDS", "")
+            if coords:
+                self.coords = tuple(int(c) for c in coords.replace(",", " ").split())
+        return self

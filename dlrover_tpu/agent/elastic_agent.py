@@ -1,0 +1,320 @@
+"""Per-host elastic agent: spawn, monitor, and restart JAX worker processes.
+
+Parity: reference ``ElasticTrainingAgent`` (``elastic_agent/torch/training.py:428-1212``):
+the ``_invoke_run`` monitor loop, membership-change restarts, failure
+reporting and restart-vs-relaunch decision. TPU-natively the agent owns the
+``jax.distributed`` bootstrap env (coordinator address, process ids) that it
+derives from the master rendezvous, replacing torchelastic's PContext/store.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from dlrover_tpu.agent.config import ElasticLaunchConfig
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.rendezvous import (
+    CommWorld,
+    MasterRendezvousHandler,
+    RendezvousTimeoutError,
+)
+from dlrover_tpu.common.constants import (
+    DefaultValues,
+    NodeEnv,
+    RendezvousName,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.utils.net import find_free_port, local_ip
+
+
+class RunResult(enum.Enum):
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    MEMBERSHIP_CHANGED = "membership_changed"
+    AGENT_STOPPED = "agent_stopped"
+
+
+@dataclass
+class WorkerProc:
+    local_rank: int
+    process_id: int
+    proc: subprocess.Popen
+    log_path: str
+
+
+class ElasticAgent:
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        client: Optional[MasterClient] = None,
+        log_dir: str = "",
+    ):
+        self._config = config
+        self._client = client or MasterClient.singleton_instance()
+        self._log_dir = log_dir or os.path.join(
+            "/tmp", "dlrover_tpu_logs", config.job_name, f"node-{config.node_id}"
+        )
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._node_ip = local_ip()
+        self._workers: List[WorkerProc] = []
+        self._restart_count = 0
+        self._stop_evt = threading.Event()
+        self._restart_requested = threading.Event()
+        self._relaunch_requested = False
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._current_world: Optional[CommWorld] = None
+        self._ckpt_saver = None  # wired by the flash-checkpoint layer
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> int:
+        self._client.report_node_address(
+            self._node_ip,
+            slice_name=self._config.slice_name,
+            coords=self._config.coords,
+        )
+        self._start_heartbeats()
+        self._install_signal_handlers()
+        try:
+            return self._invoke_run()
+        finally:
+            self._stop_evt.set()
+            self._stop_workers()
+
+    def _invoke_run(self) -> int:
+        while not self._stop_evt.is_set():
+            try:
+                world = self._rendezvous()
+            except RendezvousTimeoutError as e:
+                logger.error("rendezvous timed out: %s", e)
+                self._client.report_failure(str(e), self._restart_count)
+                return 1
+            self._start_workers(world)
+            result, exit_code, err = self._monitor_workers()
+            if result == RunResult.SUCCEEDED:
+                logger.info("node %s: workers succeeded", self._config.node_id)
+                self._client.report_succeeded()
+                return 0
+            if result == RunResult.AGENT_STOPPED:
+                # Stopped by a master action (relaunch) or a signal: exit
+                # nonzero so the platform replaces this node.
+                self._save_checkpoint_at_breakpoint()
+                self._stop_workers()
+                return 143 if self._relaunch_requested else 0
+            if result == RunResult.MEMBERSHIP_CHANGED:
+                logger.info(
+                    "node %s: membership changed; restarting workers",
+                    self._config.node_id,
+                )
+                self._save_checkpoint_at_breakpoint()
+                self._stop_workers()
+                continue
+            # FAILED
+            self._save_checkpoint_at_breakpoint()
+            self._stop_workers()
+            self._client.report_failure(
+                err, self._restart_count, TrainingExceptionLevel.ERROR, exit_code
+            )
+            if self._restart_count < self._config.max_restarts:
+                self._restart_count += 1
+                logger.warning(
+                    "node %s: worker failed (exit=%s); restart %s/%s",
+                    self._config.node_id,
+                    exit_code,
+                    self._restart_count,
+                    self._config.max_restarts,
+                )
+                continue
+            logger.error(
+                "node %s: restart budget exhausted; exiting", self._config.node_id
+            )
+            return exit_code or 1
+        return 0
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def _rendezvous(self) -> CommWorld:
+        coord_port = self._config.training_port or find_free_port()
+        handler = MasterRendezvousHandler(
+            self._client,
+            RendezvousName.TRAINING,
+            local_world_size=self._config.nproc_per_node,
+            node_ip=self._node_ip,
+            node_port=coord_port,
+            slice_name=self._config.slice_name,
+            coords=self._config.coords,
+            join_timeout=self._config.rdzv_join_timeout,
+        )
+        world = handler.next_rendezvous(node_rank_hint=self._config.node_id)
+        self._current_world = world
+        self._rdzv_handler = handler
+        return world
+
+    # -- workers ------------------------------------------------------------
+
+    def _worker_env(self, world: CommWorld, local_rank: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self._config.env)
+        process_id = world.process_id_base + local_rank
+        env.update(
+            {
+                NodeEnv.JOB_NAME: self._config.job_name,
+                NodeEnv.MASTER_ADDR: self._client.master_addr,
+                NodeEnv.NODE_ID: str(self._config.node_id),
+                NodeEnv.NODE_RANK: str(world.node_rank),
+                NodeEnv.NODE_NUM: str(world.world_size),
+                NodeEnv.COORDINATOR_ADDR: world.coordinator_addr,
+                NodeEnv.PROCESS_ID: str(process_id),
+                NodeEnv.NUM_PROCESSES: str(world.num_processes),
+                NodeEnv.RESTART_COUNT: str(self._restart_count),
+                "DLROVER_TPU_ACCELERATOR": self._config.accelerator,
+                "DLROVER_TPU_LOCAL_RANK": str(local_rank),
+            }
+        )
+        return env
+
+    def _start_workers(self, world: CommWorld):
+        self._workers = []
+        for local_rank in range(self._config.nproc_per_node):
+            process_id = world.process_id_base + local_rank
+            log_path = os.path.join(
+                self._log_dir,
+                f"worker-{process_id}-restart{self._restart_count}.log",
+            )
+            log_file = open(log_path, "ab")
+            cmd = [sys.executable, self._config.entrypoint] + list(
+                self._config.entrypoint_args
+            )
+            proc = subprocess.Popen(
+                cmd,
+                env=self._worker_env(world, local_rank),
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            log_file.close()
+            self._workers.append(WorkerProc(local_rank, process_id, proc, log_path))
+            logger.info(
+                "node %s: started worker process_id=%s pid=%s log=%s",
+                self._config.node_id,
+                process_id,
+                proc.pid,
+                log_path,
+            )
+
+    def _stop_workers(self, grace: float = 10.0):
+        for w in self._workers:
+            if w.proc.poll() is None:
+                try:
+                    os.killpg(w.proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + grace
+        for w in self._workers:
+            timeout = max(0.1, deadline - time.time())
+            try:
+                w.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(w.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                w.proc.wait()
+        self._workers = []
+
+    def _tail_log(self, path: str, max_bytes: int = 4096) -> str:
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    # -- monitoring ---------------------------------------------------------
+
+    def _membership_changed(self) -> bool:
+        """A node is waiting to (re)join -> the world must re-form."""
+        try:
+            return self._rdzv_handler.num_nodes_waiting() > 0
+        except Exception:
+            return False
+
+    def _monitor_workers(self):
+        """Returns (RunResult, exit_code, error_text)."""
+        while not self._stop_evt.is_set():
+            time.sleep(self._config.monitor_interval)
+            states = [(w, w.proc.poll()) for w in self._workers]
+            failed = next((s for s in states if s[1] not in (None, 0)), None)
+            if failed is not None:
+                err = self._tail_log(failed[0].log_path)
+                return RunResult.FAILED, failed[1] or 1, err
+            if all(code == 0 for _, code in states):
+                return RunResult.SUCCEEDED, 0, ""
+            if self._restart_requested.is_set():
+                self._restart_requested.clear()
+                return RunResult.MEMBERSHIP_CHANGED, 0, ""
+            if self._membership_changed():
+                return RunResult.MEMBERSHIP_CHANGED, 0, ""
+        return RunResult.AGENT_STOPPED, 0, ""
+
+    # -- heartbeats / signals ----------------------------------------------
+
+    def _start_heartbeats(self):
+        def loop():
+            while not self._stop_evt.wait(DefaultValues.SEC_AGENT_HEARTBEAT_INTERVAL):
+                try:
+                    actions = self._client.report_heartbeat()
+                    for action in actions:
+                        self._handle_action(action)
+                except Exception as e:  # master restartable
+                    logger.warning("heartbeat failed: %s", e)
+
+        self._heartbeat_thread = threading.Thread(
+            target=loop, name="agent-heartbeat", daemon=True
+        )
+        self._heartbeat_thread.start()
+
+    def _handle_action(self, action):
+        cls = getattr(action, "action_cls", "")
+        if cls == "RestartWorker":
+            self._restart_requested.set()
+        elif cls == "RelaunchWorker":
+            logger.warning("master requested node relaunch; stopping agent")
+            self._relaunch_requested = True
+            self._stop_evt.set()
+
+    def _install_signal_handlers(self):
+        if threading.current_thread() is not threading.main_thread():
+            return
+
+        def handle(signum, frame):
+            logger.warning("agent got signal %s; saving + stopping", signum)
+            self._save_checkpoint_at_breakpoint()
+            self._stop_evt.set()
+            self._stop_workers(grace=5)
+            raise SystemExit(143 if signum == signal.SIGTERM else 130)
+
+        signal.signal(signal.SIGTERM, handle)
+
+    # -- checkpoint hook (flash ckpt wires in) ------------------------------
+
+    def set_checkpoint_saver(self, saver):
+        self._ckpt_saver = saver
+
+    def _save_checkpoint_at_breakpoint(self):
+        if self._ckpt_saver is not None:
+            try:
+                self._ckpt_saver.save_shm_to_storage()
+            except Exception:
+                logger.exception("breakpoint checkpoint persist failed")

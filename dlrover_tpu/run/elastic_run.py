@@ -1,0 +1,169 @@
+"""``dlrover-tpu-run`` — the elastic launcher CLI.
+
+Parity: reference ``trainer/torch/elastic_run.py`` (torchrun-superset):
+``--standalone`` spawns a local master subprocess, then runs the per-host
+elastic agent which rendezvouses and supervises the JAX worker.
+
+Usage::
+
+    dlrover-tpu-run --standalone --nnodes=1 train.py --lr 3e-4
+    dlrover-tpu-run --master_addr=10.0.0.1:5555 --nnodes=2:4 --node_id=1 train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import List, Optional, Tuple
+
+from dlrover_tpu.agent.config import ElasticLaunchConfig
+from dlrover_tpu.agent.elastic_agent import ElasticAgent
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+
+
+def parse_nnodes(value: str) -> Tuple[int, int]:
+    if ":" in value:
+        lo, hi = value.split(":", 1)
+        return int(lo), int(hi)
+    n = int(value)
+    return n, n
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "dlrover-tpu-run", description="elastic launcher for JAX on TPU"
+    )
+    p.add_argument("--standalone", action="store_true",
+                   help="spawn a local job master for single-node runs")
+    p.add_argument("--master_addr", default=os.environ.get(NodeEnv.MASTER_ADDR, ""),
+                   help="host:port of the job master")
+    p.add_argument("--nnodes", default="1", help="N or MIN:MAX nodes")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="JAX processes per host (1 is TPU-canonical)")
+    p.add_argument("--node_id", type=int,
+                   default=int(os.environ.get(NodeEnv.NODE_ID, "0")))
+    p.add_argument("--job_name", default="dlrover-tpu-job")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--node_unit", type=int, default=1)
+    p.add_argument("--network-check", action="store_true", dest="network_check",
+                   help="run chip/ICI health check before training")
+    p.add_argument("--comm-perf-test", action="store_true", dest="comm_perf_test")
+    p.add_argument("--exclude-straggler", action="store_true", dest="exclude_straggler")
+    p.add_argument("--accelerator", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--monitor_interval", type=float, default=2.0)
+    p.add_argument("--rdzv_join_timeout", type=float, default=600.0)
+    p.add_argument("training_script", help="path to the JAX training script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _launch_local_master(node_num: int) -> Tuple[subprocess.Popen, str]:
+    """Spawn ``python -m dlrover_tpu.master.main`` and wait for its port."""
+    port_file = tempfile.mktemp(prefix="dlrover_tpu_master_port_")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.master.main",
+            "--platform",
+            "local",
+            "--node_num",
+            str(node_num),
+            "--port_file",
+            port_file,
+        ],
+        start_new_session=True,
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(port_file):
+            content = open(port_file).read().strip()
+            if content:
+                os.unlink(port_file)
+                return proc, f"127.0.0.1:{content}"
+        if proc.poll() is not None:
+            raise RuntimeError("local master exited during startup")
+        time.sleep(0.2)
+    raise RuntimeError("local master did not report its port in 60s")
+
+
+def _strip_leading_separator(script_args: List[str]) -> List[str]:
+    """Drop only a single leading ``--`` (launcher/script separator); any
+    later ``--`` belongs to the user's script."""
+    if script_args and script_args[0] == "--":
+        return list(script_args[1:])
+    return list(script_args)
+
+
+def config_from_args(args) -> ElasticLaunchConfig:
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    config = ElasticLaunchConfig(
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        nproc_per_node=args.nproc_per_node,
+        node_id=args.node_id,
+        job_name=args.job_name,
+        master_addr=args.master_addr,
+        max_restarts=args.max_restarts,
+        node_unit=args.node_unit,
+        network_check=args.network_check,
+        comm_perf_test=args.comm_perf_test,
+        exclude_straggler=args.exclude_straggler,
+        accelerator=args.accelerator,
+        monitor_interval=args.monitor_interval,
+        rdzv_join_timeout=args.rdzv_join_timeout,
+        entrypoint=args.training_script,
+        entrypoint_args=_strip_leading_separator(args.training_script_args),
+    )
+    return config.auto_configure()
+
+
+def run(args) -> int:
+    master_proc: Optional[subprocess.Popen] = None
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    try:
+        if args.standalone and not args.master_addr:
+            master_proc, args.master_addr = _launch_local_master(max_nodes)
+            logger.info("standalone master at %s", args.master_addr)
+        if not args.master_addr:
+            logger.error("--master_addr required (or use --standalone)")
+            return 2
+        config = config_from_args(args)
+        client = MasterClient(args.master_addr, config.node_id)
+        MasterClient.reset_singleton(client)
+        if not client.available(timeout=30):
+            logger.error("master %s not reachable", args.master_addr)
+            return 3
+
+        if config.network_check:
+            from dlrover_tpu.agent.node_check import run_network_check
+
+            ok = run_network_check(config, client)
+            if not ok:
+                logger.error("node failed network check; exiting for relaunch")
+                return 4
+
+        agent = ElasticAgent(config, client)
+        return agent.run()
+    finally:
+        if master_proc is not None and master_proc.poll() is None:
+            master_proc.terminate()
+            try:
+                master_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                master_proc.kill()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from dlrover_tpu.train.bootstrap import WorkerContext, get_context, init  # noqa: F401

@@ -1,0 +1,170 @@
+"""Worker-process bootstrap: the in-training-process face of the framework.
+
+A user script starts with::
+
+    import dlrover_tpu.train as dtrain
+    ctx = dtrain.init()          # jax.distributed up, master client connected
+
+which (a) reads the env the elastic agent injected, (b) runs
+``jax.distributed.initialize`` against the rendezvous-elected coordinator,
+and (c) connects the master client for sharding/steps/checkpoint RPCs.
+
+Parity: the reference reaches this point via torchelastic env + its
+trainer-SDK singletons; there is no single ``init`` — this is the
+TPU-native consolidation.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import logger
+
+
+@dataclass
+class WorkerEnv:
+    job_name: str = "local"
+    master_addr: str = ""
+    node_id: int = 0
+    node_rank: int = 0
+    node_num: int = 1
+    coordinator_addr: str = ""
+    process_id: int = 0
+    num_processes: int = 1
+    restart_count: int = 0
+    accelerator: str = "tpu"
+    local_rank: int = 0
+
+    @classmethod
+    def from_env(cls) -> "WorkerEnv":
+        e = os.environ
+        return cls(
+            job_name=e.get(NodeEnv.JOB_NAME, "local"),
+            master_addr=e.get(NodeEnv.MASTER_ADDR, ""),
+            node_id=int(e.get(NodeEnv.NODE_ID, "0")),
+            node_rank=int(e.get(NodeEnv.NODE_RANK, "0")),
+            node_num=int(e.get(NodeEnv.NODE_NUM, "1")),
+            coordinator_addr=e.get(NodeEnv.COORDINATOR_ADDR, ""),
+            process_id=int(e.get(NodeEnv.PROCESS_ID, "0")),
+            num_processes=int(e.get(NodeEnv.NUM_PROCESSES, "1")),
+            restart_count=int(e.get(NodeEnv.RESTART_COUNT, "0")),
+            accelerator=e.get("DLROVER_TPU_ACCELERATOR", "tpu"),
+            local_rank=int(e.get("DLROVER_TPU_LOCAL_RANK", "0")),
+        )
+
+
+class WorkerContext:
+    """What a training process holds after ``init()``."""
+
+    def __init__(self, env: WorkerEnv, client=None):
+        self.env = env
+        self.client = client
+        self._last_reported_step = 0
+        self._last_report_ts = 0.0
+        self.step_report_interval = 15.0
+
+    @property
+    def process_id(self) -> int:
+        return self.env.process_id
+
+    @property
+    def num_processes(self) -> int:
+        return self.env.num_processes
+
+    @property
+    def is_chief(self) -> bool:
+        return self.env.process_id == 0
+
+    @property
+    def restart_count(self) -> int:
+        return self.env.restart_count
+
+    def report_step(self, step: int, force: bool = False):
+        """Throttled global-step report feeding the master's SpeedMonitor."""
+        if self.client is None:
+            return
+        now = time.time()
+        if not force and now - self._last_report_ts < self.step_report_interval:
+            return
+        try:
+            self.client.report_global_step(step)
+            self._last_reported_step = step
+            self._last_report_ts = now
+        except Exception as e:
+            logger.warning("step report failed: %s", e)
+
+
+_context: Optional[WorkerContext] = None
+
+
+def init(
+    connect_master: bool = True,
+    init_distributed: bool = True,
+    local_device_count: Optional[int] = None,
+) -> WorkerContext:
+    """Bootstrap this training process; idempotent."""
+    global _context
+    if _context is not None:
+        return _context
+    env = WorkerEnv.from_env()
+
+    import jax
+
+    if env.accelerator == "cpu":
+        # Test mode: virtual CPU devices + gloo cross-process collectives.
+        # (The axon image overrides JAX_PLATFORMS; config update wins.)
+        if local_device_count:
+            import re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags
+            ).strip()
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_device_count}"
+            ).strip()
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    if init_distributed and env.num_processes > 1 and env.coordinator_addr:
+        logger.info(
+            "process %s/%s: jax.distributed.initialize(coordinator=%s)",
+            env.process_id,
+            env.num_processes,
+            env.coordinator_addr,
+        )
+        init_timeout = int(
+            os.environ.get("DLROVER_TPU_DIST_INIT_TIMEOUT", "120")
+        )
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator_addr,
+            num_processes=env.num_processes,
+            process_id=env.process_id,
+            initialization_timeout=init_timeout,
+        )
+
+    client = None
+    if connect_master and env.master_addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(env.master_addr, env.node_id)
+        MasterClient.reset_singleton(client)
+
+    _context = WorkerContext(env, client)
+    atexit.register(_shutdown)
+    return _context
+
+
+def get_context() -> Optional[WorkerContext]:
+    return _context
+
+
+def _shutdown():
+    global _context
+    _context = None

@@ -1,0 +1,203 @@
+"""Worker-side elastic data plumbing.
+
+Parity: reference ``elastic_agent/sharding/client.py`` (ShardingClient /
+IndexShardingClient) and ``trainer/torch/elastic/sampler.py``
+(ElasticDistributedSampler). Re-designed for SPMD: under ``pjit`` every
+process must execute the same jitted steps in lockstep, so dynamic shard
+dispatch is **chief-driven**: process 0 fetches tasks from the master and
+broadcasts them to all processes (one tiny collective per shard), keeping
+collective schedules identical across the world.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.messages import DatasetShardParams, Task
+
+
+def _broadcast_tuple(values: Tuple[int, ...], is_source: bool) -> Tuple[int, ...]:
+    """Broadcast small ints from process 0 to all (no-op single process)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+
+    arr = np.array(values, dtype=np.int64)
+    out = multihost_utils.broadcast_one_to_all(arr, is_source=is_source)
+    return tuple(int(v) for v in np.asarray(out))
+
+
+class ShardingClient:
+    """Lockstep-safe dynamic shard consumption for SPMD workers."""
+
+    def __init__(self, dataset_name: str, master_client=None):
+        import jax
+
+        self.dataset_name = dataset_name
+        self._client = master_client
+        self._is_chief = jax.process_index() == 0
+        self._current_task: Optional[Task] = None
+        self._lock = threading.Lock()
+
+    def register_dataset(
+        self,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        storage_type: str = "text",
+    ):
+        if self._is_chief and self._client is not None:
+            self._client.report_dataset_shard_params(
+                DatasetShardParams(
+                    dataset_name=self.dataset_name,
+                    dataset_size=dataset_size,
+                    shard_size=shard_size,
+                    num_epochs=num_epochs,
+                    shuffle=shuffle,
+                    storage_type=storage_type,
+                )
+            )
+
+    def fetch_task(self) -> Optional[Task]:
+        """Chief fetches; everyone receives the same task (or None at end)."""
+        task_tuple: Tuple[int, ...]
+        if self._is_chief:
+            task = (
+                self._client.get_task(self.dataset_name)
+                if self._client is not None
+                else Task()
+            )
+            task_tuple = (
+                task.task_id,
+                task.shard_start,
+                task.shard_end,
+                task.epoch,
+            )
+        else:
+            task_tuple = (-1, 0, 0, 0)
+        task_tuple = _broadcast_tuple(task_tuple, is_source=self._is_chief)
+        task_id, start, end, epoch = task_tuple
+        if task_id < 0:
+            self._current_task = None
+            return None
+        self._current_task = Task(
+            task_id=task_id,
+            dataset_name=self.dataset_name,
+            shard_start=start,
+            shard_end=end,
+            epoch=epoch,
+        )
+        return self._current_task
+
+    def report_task_done(self, success: bool = True):
+        if (
+            self._is_chief
+            and self._client is not None
+            and self._current_task is not None
+        ):
+            self._client.report_task_result(
+                self.dataset_name, self._current_task.task_id, success
+            )
+        self._current_task = None
+
+    def iter_tasks(self) -> Iterator[Task]:
+        while True:
+            task = self.fetch_task()
+            if task is None:
+                return
+            yield task
+            self.report_task_done()
+
+    # -- shard checkpoint (mid-epoch resume) --------------------------------
+
+    def checkpoint_shards(self) -> str:
+        if self._is_chief and self._client is not None:
+            return self._client.get_shard_checkpoint(self.dataset_name)
+        return ""
+
+    def restore_shards(self, content: str):
+        if self._is_chief and self._client is not None and content:
+            self._client.report_shard_checkpoint(self.dataset_name, content)
+
+
+@dataclass
+class SamplerState:
+    epoch: int = 0
+    completed_samples: int = 0
+
+
+class ElasticDistributedSampler:
+    """Deterministic per-process sample indices with mid-epoch resume.
+
+    Parity: reference ``ElasticDistributedSampler`` (``sampler.py:25-175``):
+    ``state_dict/load_state_dict`` carry the completed-sample offset so a
+    restarted (possibly resized) world resumes where it left off.
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        batch_size: int,
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        import jax
+
+        self.dataset_size = dataset_size
+        self.batch_size = batch_size  # per-replica batch
+        self.num_replicas = (
+            num_replicas if num_replicas is not None else jax.process_count()
+        )
+        self.rank = rank if rank is not None else jax.process_index()
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.state = SamplerState()
+
+    def _global_order(self) -> np.ndarray:
+        order = np.arange(self.dataset_size)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.state.epoch)
+            rng.shuffle(order)
+        return order
+
+    def __iter__(self) -> Iterator[List[int]]:
+        order = self._global_order()
+        global_batch = self.batch_size * self.num_replicas
+        start = self.state.completed_samples
+        for gstart in range(start, self.dataset_size, global_batch):
+            gbatch = order[gstart : gstart + global_batch]
+            if len(gbatch) < global_batch and self.drop_last:
+                break
+            local = gbatch[self.rank :: self.num_replicas][: self.batch_size]
+            self.state.completed_samples = min(
+                gstart + global_batch, self.dataset_size
+            )
+            yield local.tolist()
+        # Epoch exhausted (including a drop_last partial tail): advance.
+        self.state.epoch += 1
+        self.state.completed_samples = 0
+
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self.state.epoch,
+            "completed_samples": self.state.completed_samples,
+        }
+
+    def load_state_dict(self, state: dict):
+        self.state.epoch = int(state.get("epoch", 0))
+        completed = int(state.get("completed_samples", 0))
+        # Align to the *new* global batch so a resized world resumes cleanly.
+        global_batch = self.batch_size * self.num_replicas
+        self.state.completed_samples = (completed // global_batch) * global_batch

@@ -1,0 +1,45 @@
+"""Small network helpers (free-port finding, local addr discovery)."""
+
+from __future__ import annotations
+
+import socket
+from contextlib import closing
+
+
+def find_free_port(host: str = "") -> int:
+    with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def local_ip(probe_addr: str = "8.8.8.8") -> str:
+    """Best-effort local IP.
+
+    Order: explicit env override (set by the platform/operator), hostname
+    resolution, UDP-probe route discovery, loopback. The env override matters
+    on TPU pods where the right interface is the one libtpu/ICI uses.
+    """
+    import os
+
+    override = os.environ.get("DLROVER_TPU_NODE_IP", "")
+    if override:
+        return override
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        ip = ""
+    try:
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
+            s.settimeout(0.5)
+            s.connect((probe_addr, 80))
+            probed = s.getsockname()[0]
+            # 192.0.2.0/24 is TEST-NET (seen in zero-egress sandboxes): not
+            # a reachable interface; fall through to loopback/hostname.
+            if not probed.startswith("192.0.2."):
+                return probed
+    except OSError:
+        pass
+    return ip or "127.0.0.1"

@@ -7,13 +7,19 @@ and collectives are exercised for real.
 
 import os
 
-# Must be set before jax is imported anywhere in the test session.
+# Must be set before jax initializes its backend. NOTE: this image's axon
+# sitecustomize overrides JAX_PLATFORMS, so the env var alone is not enough —
+# jax.config.update("jax_platforms", "cpu") below is what actually wins.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

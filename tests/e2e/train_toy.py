@@ -1,0 +1,81 @@
+"""Toy elastic JAX training script used by the e2e launcher tests.
+
+Linear regression on synthetic data, data-parallel over ALL devices of the
+(possibly multi-process) world; shards fetched via the lockstep-safe
+ShardingClient. Fault injection: DLROVER_TPU_TEST_CRASH_STEP crashes the
+chief at that step when restart_count==0.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import dlrover_tpu.train as dtrain
+
+ctx = dtrain.init(local_device_count=2)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.train.data import ShardingClient
+
+DATASET = "toy-train"
+DATASET_SIZE = 64
+SHARD_SIZE = 16
+GLOBAL_BATCH = 8
+
+crash_step = int(os.environ.get("DLROVER_TPU_TEST_CRASH_STEP", "-1"))
+
+sharding_client = ShardingClient(DATASET, ctx.client)
+sharding_client.register_dataset(DATASET_SIZE, SHARD_SIZE, num_epochs=1)
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+batch_sharding = NamedSharding(mesh, P("dp"))
+
+true_w = jnp.arange(4.0)
+w = jnp.zeros((4,), dtype=jnp.float32)
+
+
+@jax.jit
+def train_step(w, x, y):
+    def loss_fn(w):
+        pred = x @ w
+        return jnp.mean((pred - y) ** 2)
+
+    loss, grad = jax.value_and_grad(loss_fn)(w)
+    return w - 0.1 * grad, loss
+
+
+def make_global_batch(record_start: int):
+    """Each process builds its local slice of the global batch."""
+    per_proc = GLOBAL_BATCH // ctx.num_processes
+    seed = record_start * ctx.num_processes + ctx.process_id
+    rng = np.random.RandomState(seed)
+    x_local = rng.randn(per_proc, 4).astype(np.float32)
+    y_local = x_local @ np.asarray(true_w)
+    x = jax.make_array_from_process_local_data(batch_sharding, x_local)
+    y = jax.make_array_from_process_local_data(batch_sharding, y_local)
+    return x, y
+
+
+step = 0
+for task in sharding_client.iter_tasks():
+    n = task.shard_end - task.shard_start
+    for start in range(0, n, GLOBAL_BATCH):
+        x, y = make_global_batch(task.shard_start + start)
+        w, loss = train_step(w, x, y)
+        step += 1
+        if step == crash_step and ctx.restart_count == 0 and ctx.is_chief:
+            print(f"[toy] injected crash at step {step}", flush=True)
+            os._exit(17)
+        ctx.report_step(step, force=True)
+
+err = float(jnp.sum((w - true_w) ** 2))
+print(f"[toy] done: steps={step} param_err={err:.4f}", flush=True)
+assert err < 1.0, f"model did not learn (err={err})"
