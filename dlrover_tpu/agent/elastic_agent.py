@@ -82,6 +82,7 @@ class ElasticAgent:
             slice_name=self._config.slice_name,
             coords=self._config.coords,
         )
+        self._start_ckpt_saver()
         self._start_heartbeats()
         self._install_signal_handlers()
         try:
@@ -89,6 +90,23 @@ class ElasticAgent:
         finally:
             self._stop_evt.set()
             self._stop_workers()
+            if self._ckpt_saver is not None:
+                self._ckpt_saver.stop()
+
+    def _start_ckpt_saver(self):
+        """Host the flash-checkpoint saver so staged state survives worker
+        crashes (reference: AsyncCheckpointSaver.start_async_saving_ckpt)."""
+        from dlrover_tpu.checkpoint.saver import AsyncCheckpointSaver
+
+        try:
+            self._ckpt_saver = AsyncCheckpointSaver(
+                job_name=self._config.job_name,
+                node_id=self._config.node_id,
+            )
+            self._ckpt_saver.start()
+        except Exception:
+            logger.exception("checkpoint saver failed to start; continuing")
+            self._ckpt_saver = None
 
     def _invoke_run(self) -> int:
         while not self._stop_evt.is_set():
@@ -103,6 +121,8 @@ class ElasticAgent:
             if result == RunResult.SUCCEEDED:
                 logger.info("node %s: workers succeeded", self._config.node_id)
                 self._client.report_succeeded()
+                if self._ckpt_saver is not None:
+                    self._ckpt_saver.cleanup_shm()
                 return 0
             if result == RunResult.AGENT_STOPPED:
                 # Stopped by a master action (relaunch) or a signal: exit
@@ -157,6 +177,15 @@ class ElasticAgent:
         world = handler.next_rendezvous(node_rank_hint=self._config.node_id)
         self._current_world = world
         self._rdzv_handler = handler
+        if self._ckpt_saver is not None:
+            self._ckpt_saver.update_topology(
+                node_rank=world.node_rank,
+                num_nodes=world.world_size,
+                process_ids=[
+                    world.process_id_base + i
+                    for i in range(self._config.nproc_per_node)
+                ],
+            )
         return world
 
     # -- workers ------------------------------------------------------------

@@ -1,0 +1,6 @@
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer, StorageType  # noqa: F401
+from dlrover_tpu.checkpoint.engine import CheckpointEngine  # noqa: F401
+from dlrover_tpu.checkpoint.saver import (  # noqa: F401
+    AsyncCheckpointSaver,
+    CheckpointPersister,
+)

@@ -1,0 +1,429 @@
+"""Training-process side of flash checkpoint.
+
+Parity: reference ``CheckpointEngine`` (``flash_checkpoint/engine.py:155-502``)
++ the sharded FSDP/Megatron engines, unified for JAX: every process stages
+its *addressable unique shards* (with global index metadata) into its own
+shm segment — the blocking cost of a save is one ``jax.device_get`` of local
+shards plus a host memcpy. Persist/commit happens asynchronously in the
+agent's saver.
+
+Restore:
+- same-world restart -> reassemble from this process's shm (seconds);
+- resized world      -> read the committed step from shared storage and
+  reshard via ``jax.make_array_from_callback`` (the reference only supports
+  same-world memory restore; mesh-aware resharding is TPU-native new work).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.checkpoint.saver import (
+    CKPT_EVENT_QUEUE,
+    SHM_LOCK,
+    CheckpointEvent,
+    TRACKER_FILE,
+    step_dir,
+)
+from dlrover_tpu.checkpoint.shm_handler import (
+    CheckpointMeta,
+    SharedMemoryHandler,
+    flatten_state,
+    shm_name,
+    unflatten_state,
+)
+from dlrover_tpu.common.ipc import SharedLock, SharedQueue, default_socket_path
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
+
+
+def _index_to_ranges(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a jax shard index (tuple of slices) to (start, stop) pairs."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+class CheckpointEngine:
+    def __init__(
+        self,
+        ckpt_dir: str,
+        job_name: str = "",
+        node_id: Optional[int] = None,
+        process_id: Optional[int] = None,
+        storage: Optional[CheckpointStorage] = None,
+        socket_path: str = "",
+        master_client=None,
+    ):
+        from dlrover_tpu.common.constants import NodeEnv
+
+        self.ckpt_dir = ckpt_dir
+        self.job_name = job_name or os.environ.get(NodeEnv.JOB_NAME, "local")
+        self.node_id = (
+            node_id
+            if node_id is not None
+            else int(os.environ.get(NodeEnv.NODE_ID, "0"))
+        )
+        self.process_id = (
+            process_id
+            if process_id is not None
+            else int(os.environ.get(NodeEnv.PROCESS_ID, "0"))
+        )
+        self._storage = storage or PosixDiskStorage()
+        self._shm = SharedMemoryHandler(
+            shm_name(self.job_name, self.node_id, self.process_id), create=True
+        )
+        self._socket_path = socket_path or default_socket_path(
+            self.job_name, self.node_id
+        )
+        self._event_queue: Optional[SharedQueue] = None
+        self._shm_lock: Optional[SharedLock] = None
+        self._master_client = master_client
+        self.latest_saved_step = -1
+
+    # -- IPC (lazy: standalone use without an agent works too) --------------
+
+    def _ipc_available(self) -> bool:
+        return os.path.exists(self._socket_path)
+
+    def _queue(self) -> Optional[SharedQueue]:
+        if self._event_queue is None and self._ipc_available():
+            self._event_queue = SharedQueue(CKPT_EVENT_QUEUE, self._socket_path)
+        return self._event_queue
+
+    def _lock(self) -> Optional[SharedLock]:
+        if self._shm_lock is None and self._ipc_available():
+            self._shm_lock = SharedLock(SHM_LOCK, self._socket_path)
+        return self._shm_lock
+
+    # -- save ---------------------------------------------------------------
+
+    def _gather_local_shards(self, state):
+        """device_get each leaf's unique addressable shards.
+
+        Returns (named_leaves, shard_info, host_state_leaves) where
+        named_leaves are (path#k, np array) entries for the shm segment.
+        """
+        import jax
+
+        flat, treedef_bytes = flatten_state_lazy(state)
+        named_leaves: List[Tuple[str, np.ndarray]] = []
+        shard_info: Dict[str, Tuple[Tuple[int, ...], Tuple]] = {}
+        for path, leaf in flat:
+            if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+                seen = set()
+                k = 0
+                for shard in leaf.addressable_shards:
+                    ranges = _index_to_ranges(shard.index, leaf.shape)
+                    if ranges in seen:
+                        continue
+                    seen.add(ranges)
+                    name = f"{path}#s{k}"
+                    extent = tuple(e - s for s, e in ranges)
+                    arr = np.asarray(shard.data).reshape(extent)
+                    named_leaves.append((name, arr))
+                    shard_info[name] = (tuple(leaf.shape), ranges)
+                    k += 1
+            else:
+                arr = np.asarray(leaf)
+                name = f"{path}#s0"
+                named_leaves.append((name, arr))
+                shard_info[name] = (
+                    tuple(arr.shape),
+                    tuple((0, d) for d in arr.shape),
+                )
+        return named_leaves, shard_info, treedef_bytes
+
+    def save_to_memory(self, step: int, state: Any) -> float:
+        """Stage into shm; returns the blocking seconds (the training pause)."""
+        import jax
+
+        t0 = time.time()
+        named_leaves, shard_info, treedef_bytes = self._gather_local_shards(state)
+        lock = self._lock()
+        if lock is not None and not lock.acquire(timeout=120):
+            logger.warning(
+                "shm lock not acquired in 120s; skipping memory save of "
+                "step %s",
+                step,
+            )
+            return time.time() - t0
+        try:
+            self._shm.save_state(
+                step,
+                named_leaves,
+                treedef_bytes,
+                shard_info=shard_info,
+                world_size=jax.process_count(),
+                process_id=self.process_id,
+            )
+        finally:
+            if lock is not None:
+                lock.release()
+        self.latest_saved_step = step
+        blocking = time.time() - t0
+        if self._master_client is not None:
+            try:
+                self._master_client.report_ckpt_step(step, blocking)
+            except Exception:
+                pass
+        return blocking
+
+    def save_to_storage(self, step: int, state: Any) -> float:
+        """Stage + hand persistence to the agent saver (async)."""
+        blocking = self.save_to_memory(step, state)
+        q = self._queue()
+        if q is not None:
+            q.put(
+                CheckpointEvent(
+                    "save", step=step, persist=True, ckpt_dir=self.ckpt_dir
+                ).to_wire()
+            )
+        else:
+            # no agent (bare run): persist synchronously in-process
+            self._persist_inline(step)
+        return blocking
+
+    def _persist_inline(self, step: int):
+        import jax
+
+        from dlrover_tpu.checkpoint.saver import CheckpointPersister
+
+        persister = CheckpointPersister(
+            job_name=self.job_name,
+            node_id=self.node_id,
+            node_rank=jax.process_index(),
+            num_nodes=jax.process_count(),
+            local_process_ids=[self.process_id],
+            storage=self._storage,
+        )
+        persister.persist_step(self.ckpt_dir, step)
+
+    # -- load ---------------------------------------------------------------
+
+    def load(self, target: Any = None) -> Optional[Tuple[int, Any]]:
+        """Restore (step, state). shm first, storage fallback."""
+        result = self._load_from_memory(target)
+        if result is not None:
+            logger.info("restored step %s from shared memory", result[0])
+            return result
+        return self._load_from_storage(target)
+
+    def _load_from_memory(self, target: Any = None):
+        import jax
+
+        meta = self._shm.read_meta()
+        if meta is None:
+            return None
+        if meta.world_size != jax.process_count():
+            # The world resized: this process's staged shards no longer
+            # cover what the new mesh assigns it. Storage has all shards.
+            logger.info(
+                "staged shm is from a %s-process world (now %s); "
+                "falling back to storage restore",
+                meta.world_size,
+                jax.process_count(),
+            )
+            return None
+        pieces = self._read_pieces_from_shm(meta)
+        return self._assemble(meta.step, pieces, target, full_data=False)
+
+    def _load_from_storage(self, target: Any = None):
+        step = self.committed_step()
+        if step < 0:
+            return None
+        sdir = step_dir(self.ckpt_dir, step)
+        pieces: Dict[str, List[Tuple[Tuple, np.ndarray, Tuple[int, ...]]]] = {}
+        treedef_hex = ""
+        for name in self._storage.listdir(sdir):
+            if not name.startswith("proc-"):
+                continue
+            proc_dir = os.path.join(sdir, name)
+            try:
+                meta = CheckpointMeta.from_json(
+                    self._storage.read(os.path.join(proc_dir, "meta.json")).decode()
+                )
+            except FileNotFoundError:
+                continue
+            treedef_hex = treedef_hex or meta.treedef_hex
+            import io
+
+            for i, leaf_meta in enumerate(meta.leaves):
+                data = self._storage.read(os.path.join(proc_dir, f"leaf-{i}.npy"))
+                arr = np.load(io.BytesIO(data), allow_pickle=False)
+                base = leaf_meta.path.rsplit("#", 1)[0]
+                pieces.setdefault(base, []).append(
+                    (leaf_meta.index, arr, leaf_meta.global_shape)
+                )
+        if not pieces:
+            return None
+        result = self._assemble(
+            step, (treedef_hex, pieces), target, full_data=True
+        )
+        if result is not None:
+            logger.info("restored step %s from storage %s", step, sdir)
+        return result
+
+    def _read_pieces_from_shm(self, meta: CheckpointMeta):
+        pieces: Dict[str, List[Tuple[Tuple, np.ndarray, Tuple[int, ...]]]] = {}
+        for leaf_meta in meta.leaves:
+            arr = self._shm.read_leaf(leaf_meta, copy=True)
+            base = leaf_meta.path.rsplit("#", 1)[0]
+            pieces.setdefault(base, []).append(
+                (leaf_meta.index, arr, leaf_meta.global_shape)
+            )
+        return meta.treedef_hex, pieces
+
+    def _assemble(self, step, treedef_and_pieces, target, full_data: bool):
+        """Rebuild the pytree. With a ``target`` (pytree of jax.Arrays or
+        ShapeDtypeStructs with shardings) arrays are placed per the target's
+        sharding; otherwise plain numpy arrays are returned."""
+        import jax
+
+        treedef_hex, pieces = treedef_and_pieces
+
+        def build_full(path: str) -> Optional[np.ndarray]:
+            plist = pieces.get(path)
+            if not plist:
+                return None
+            _, first_arr, gshape = plist[0]
+            # global_shape is always recorded at stage time; () is a
+            # legitimate 0-d shape, not "absent".
+            gshape = tuple(gshape)
+            if len(plist) == 1 and tuple(first_arr.shape) == gshape:
+                return plist[0][1]
+            out = np.zeros(gshape, dtype=first_arr.dtype)
+            for index, arr, _ in plist:
+                sl = tuple(slice(s, e) for s, e in index)
+                out[sl] = arr.reshape(tuple(e - s for s, e in index))
+            return out
+
+        def covers_target(t_leaf, path: str) -> bool:
+            """Partial (shm) data must cover every region the target's
+            sharding assigns locally — else zero-fill would corrupt state."""
+            if full_data:
+                return True
+            plist = pieces.get(path)
+            if not plist:
+                return False
+            if not (isinstance(t_leaf, jax.Array) or hasattr(t_leaf, "sharding")):
+                return True
+            shape = tuple(t_leaf.shape)
+            for shard_index in set(
+                t_leaf.sharding.addressable_devices_indices_map(shape).values()
+            ):
+                needed = _index_to_ranges(shard_index, shape)
+                contained = any(
+                    all(
+                        ps <= ns and ne <= pe
+                        for (ns, ne), (ps, pe) in zip(needed, p_index)
+                    )
+                    for p_index, _, _ in plist
+                )
+                if not contained:
+                    return False
+            return True
+
+        if target is not None:
+            flat_t, treedef = jax.tree_util.tree_flatten_with_path(target)
+            out_leaves = []
+            for path, t_leaf in flat_t:
+                key = jax.tree_util.keystr(path)
+                full = build_full(key)
+                if full is None:
+                    logger.warning("checkpoint missing leaf %s; keeping target", key)
+                    out_leaves.append(t_leaf)
+                    continue
+                if not covers_target(t_leaf, key):
+                    logger.info(
+                        "staged shards do not cover leaf %s for the current "
+                        "sharding; falling back to storage",
+                        key,
+                    )
+                    return None
+                out_leaves.append(_place_like(t_leaf, full))
+            return step, jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+        # no target: numpy pytree via stored treedef
+        full_leaves = []
+        paths = list(pieces.keys())
+        # stored leaf order == flatten order (paths recorded in order)
+        for path in paths:
+            plist = pieces[path]
+            if not full_data:
+                # partial (shm) data: pieces must tile the whole array
+                _, first_arr, gshape = plist[0]
+                gvol = int(np.prod(tuple(gshape))) if gshape else first_arr.size
+                vol = sum(int(a.size) for _, a, _ in plist)
+                if vol < gvol:
+                    logger.info(
+                        "staged shards cover %s/%s of %s; need storage restore",
+                        vol,
+                        gvol,
+                        path,
+                    )
+                    return None
+            full = build_full(path)
+            if full is None:
+                return None
+            full_leaves.append(full)
+        try:
+            state = unflatten_state(bytes.fromhex(treedef_hex), full_leaves)
+        except Exception as e:
+            logger.warning("treedef restore failed (%s); returning dict", e)
+            state = dict(zip(paths, full_leaves))
+        return step, state
+
+    # -- misc ---------------------------------------------------------------
+
+    def committed_step(self) -> int:
+        try:
+            return int(
+                self._storage.read(os.path.join(self.ckpt_dir, TRACKER_FILE))
+            )
+        except (FileNotFoundError, ValueError):
+            return -1
+
+    def close(self):
+        if self._event_queue is not None:
+            self._event_queue.close()
+        if self._shm_lock is not None:
+            self._shm_lock.close()
+        self._shm.close()
+
+
+def _place_like(t_leaf, full: np.ndarray):
+    """Place a host array according to the target leaf's sharding/dtype."""
+    import jax
+
+    if isinstance(t_leaf, jax.Array) or hasattr(t_leaf, "sharding"):
+        sharding = t_leaf.sharding
+        dtype = t_leaf.dtype
+        full = full.astype(dtype) if full.dtype != dtype else full
+        if full.ndim == 0:
+            return jax.device_put(full, sharding)
+        return jax.make_array_from_callback(
+            tuple(t_leaf.shape), sharding, lambda idx: np.ascontiguousarray(full[idx])
+        )
+    if hasattr(t_leaf, "shape") and hasattr(t_leaf, "dtype"):
+        return full.astype(t_leaf.dtype)
+    return full
+
+
+def flatten_state_lazy(state):
+    """flatten_state but without forcing device transfer (arrays stay jax)."""
+    import jax
+    import pickle
+    import pickletools
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(state)
+    flat = [(jax.tree_util.keystr(p), leaf) for p, leaf in leaves_with_path]
+    treedef_bytes = pickletools.optimize(pickle.dumps(treedef))
+    return flat, treedef_bytes

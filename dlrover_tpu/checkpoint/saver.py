@@ -1,0 +1,375 @@
+"""Agent-resident async checkpoint saver.
+
+Parity: reference ``AsyncCheckpointSaver`` (``ckpt_saver.py:406-1394``):
+lives in the agent process so checkpoints survive training-process crashes;
+listens for save events on a SharedQueue, copies shm -> storage, commits
+via per-node done-files + a tracker file, and persists the latest staged
+shm checkpoint when the node is about to die (save-on-failure /
+save-on-SIGTERM).
+
+Storage layout::
+
+    <ckpt_dir>/
+      latest_step.txt                  # tracker: last committed step
+      step-<N>/
+        node-<node_rank>.done          # commit votes
+        proc-<pid>/
+          meta.json                    # CheckpointMeta (incl. shard index)
+          leaf-<i>.npy                 # raw array per staged shard
+
+``CheckpointPersister`` is the storage-side logic; ``AsyncCheckpointSaver``
+adds the IPC server + event loop the agent hosts.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.ipc import IpcServer, SharedQueue, default_socket_path
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.storage import (
+    CheckpointDeletionStrategy,
+    CheckpointStorage,
+    KeepLatestStepStrategy,
+    PosixDiskStorage,
+)
+from dlrover_tpu.checkpoint.shm_handler import (
+    CheckpointMeta,
+    SharedMemoryHandler,
+    shm_name,
+)
+
+CKPT_EVENT_QUEUE = "ckpt-events"
+SHM_LOCK = "shm-ckpt-lock"
+TRACKER_FILE = CheckpointConstant.TRACKER_FILE
+
+
+@dataclass
+class CheckpointEvent:
+    event_type: str  # "save" | "exit"
+    step: int = -1
+    persist: bool = False  # False = memory-only snapshot
+    ckpt_dir: str = ""
+
+    def to_wire(self) -> Dict:
+        return {
+            "event_type": self.event_type,
+            "step": self.step,
+            "persist": self.persist,
+            "ckpt_dir": self.ckpt_dir,
+        }
+
+    @classmethod
+    def from_wire(cls, d: Dict) -> "CheckpointEvent":
+        return cls(
+            event_type=d.get("event_type", ""),
+            step=d.get("step", -1),
+            persist=d.get("persist", False),
+            ckpt_dir=d.get("ckpt_dir", ""),
+        )
+
+
+def step_dir(ckpt_dir: str, step: int) -> str:
+    return os.path.join(ckpt_dir, f"step-{step}")
+
+
+class CheckpointPersister:
+    """shm -> storage persistence + the commit/tracker protocol."""
+
+    def __init__(
+        self,
+        job_name: str,
+        node_id: int,
+        node_rank: int = 0,
+        num_nodes: int = 1,
+        local_process_ids: Optional[List[int]] = None,
+        storage: Optional[CheckpointStorage] = None,
+        deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+        commit_timeout: float = 600.0,
+    ):
+        self.job_name = job_name
+        self.node_id = node_id
+        self.node_rank = node_rank
+        self.num_nodes = num_nodes
+        self.local_process_ids = local_process_ids or [0]
+        self._storage = storage or PosixDiskStorage()
+        self._deletion = deletion_strategy or KeepLatestStepStrategy(3)
+        self._commit_timeout = commit_timeout
+        self._stop_evt = threading.Event()
+        self._persisted_steps: set = set()
+        self.last_persist_dir = ""
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def local_handlers(self) -> List[SharedMemoryHandler]:
+        out = []
+        for pid in self.local_process_ids:
+            h = SharedMemoryHandler(shm_name(self.job_name, self.node_id, pid))
+            if h.attach():
+                out.append(h)
+        return out
+
+    def copy_step_to_storage(self, ckpt_dir: str, step: int = -1) -> List[int]:
+        """Copy staged shm checkpoints to storage (NO commit wait).
+
+        Groups local handlers by their staged step; a node votes "done" for
+        a step only when EVERY local process has that step staged (a
+        partial vote would let a step missing some processes' shards get
+        committed). Returns the steps fully persisted by this node.
+        """
+        t0 = time.time()
+        self.last_persist_dir = ckpt_dir
+        handlers = self.local_handlers()
+        try:
+            by_step: Dict[int, List] = {}
+            for h in handlers:
+                meta = h.read_meta()
+                if meta is None:
+                    continue
+                if meta.step in self._persisted_steps:
+                    continue
+                if step >= 0 and meta.step != step:
+                    logger.warning(
+                        "shm %s holds step %s, requested %s; persisting staged",
+                        h.name,
+                        meta.step,
+                        step,
+                    )
+                by_step.setdefault(meta.step, []).append((meta, h))
+            if not by_step:
+                return []
+            complete_steps = []
+            for s, pairs in sorted(by_step.items()):
+                for meta, h in pairs:
+                    self._write_process_ckpt(ckpt_dir, meta, h)
+                if len(pairs) == len(self.local_process_ids):
+                    done_path = os.path.join(
+                        step_dir(ckpt_dir, s), f"node-{self.node_rank}.done"
+                    )
+                    self._storage.write(b"1", done_path)
+                    self._persisted_steps.add(s)
+                    complete_steps.append(s)
+                else:
+                    logger.warning(
+                        "step %s staged by %s/%s local processes; no vote yet",
+                        s,
+                        len(pairs),
+                        len(self.local_process_ids),
+                    )
+            if complete_steps:
+                logger.info(
+                    "persisted steps %s shm->%s in %.2fs",
+                    complete_steps,
+                    ckpt_dir,
+                    time.time() - t0,
+                )
+            return complete_steps
+        finally:
+            for h in handlers:
+                h.close()
+
+    def persist_step(self, ckpt_dir: str, step: int = -1) -> bool:
+        """Copy + commit (commit waits for other nodes; call off the shm
+        lock — see AsyncCheckpointSaver's event loop)."""
+        steps = self.copy_step_to_storage(ckpt_dir, step)
+        for s in steps:
+            self._maybe_commit(ckpt_dir, s)
+        return bool(steps)
+
+    def _write_process_ckpt(
+        self, ckpt_dir: str, meta: CheckpointMeta, handler: SharedMemoryHandler
+    ):
+        proc_dir = os.path.join(
+            step_dir(ckpt_dir, meta.step), f"proc-{meta.process_id}"
+        )
+        self._storage.makedirs(proc_dir)
+        for i, leaf_meta in enumerate(meta.leaves):
+            arr = handler.read_leaf(leaf_meta, copy=False)
+            buf = io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            self._storage.write(
+                buf.getvalue(), os.path.join(proc_dir, f"leaf-{i}.npy")
+            )
+        self._storage.write(
+            meta.to_json().encode(), os.path.join(proc_dir, "meta.json")
+        )
+
+    def _maybe_commit(self, ckpt_dir: str, step: int):
+        """Node-rank-0's saver waits for all nodes' votes then commits."""
+        if self.node_rank != 0:
+            return
+        sdir = step_dir(ckpt_dir, step)
+        deadline = time.time() + self._commit_timeout
+        while time.time() < deadline and not self._stop_evt.is_set():
+            done = [
+                f
+                for f in self._storage.listdir(sdir)
+                if f.startswith("node-") and f.endswith(".done")
+            ]
+            if len(done) >= self.num_nodes:
+                self._storage.write(
+                    str(step).encode(), os.path.join(ckpt_dir, TRACKER_FILE)
+                )
+                logger.info("checkpoint step %s committed", step)
+                self._apply_deletion(ckpt_dir)
+                return
+            time.sleep(0.5)
+        logger.warning("step %s: only partial commit votes after timeout", step)
+
+    def _apply_deletion(self, ckpt_dir: str):
+        steps = []
+        for name in self._storage.listdir(ckpt_dir):
+            if name.startswith("step-"):
+                try:
+                    steps.append(int(name.split("-", 1)[1]))
+                except ValueError:
+                    continue
+        committed = self.committed_step(ckpt_dir)
+        removable = [s for s in self._deletion.to_delete(steps) if s != committed]
+        for s in removable:
+            self._storage.delete(step_dir(ckpt_dir, s))
+            logger.info("deleted old checkpoint step %s", s)
+
+    def save_shm_to_storage(self, ckpt_dir: str = "") -> bool:
+        """Persist whatever is staged in shm right now (failure/SIGTERM).
+
+        The reference's save-at-breakpoint guarantee (``training.py:1098``,
+        ``ckpt_saver.py:786``).
+        """
+        ckpt_dir = ckpt_dir or self.last_persist_dir
+        handlers = self.local_handlers()
+        try:
+            metas = [h.read_meta() for h in handlers]
+        finally:
+            for h in handlers:
+                h.close()
+        steps = {m.step for m in metas if m is not None}
+        if not steps:
+            return False
+        if not ckpt_dir:
+            logger.warning(
+                "staged shm checkpoint exists but no ckpt_dir known; "
+                "cannot persist"
+            )
+            return False
+        if steps <= self._persisted_steps:
+            return True
+        return self.persist_step(ckpt_dir)
+
+    def committed_step(self, ckpt_dir: str) -> int:
+        try:
+            return int(self._storage.read(os.path.join(ckpt_dir, TRACKER_FILE)))
+        except (FileNotFoundError, ValueError):
+            return -1
+
+
+class AsyncCheckpointSaver:
+    """One per agent/node: IPC server + async persist event loop."""
+
+    def __init__(
+        self,
+        job_name: str,
+        node_id: int,
+        node_rank: int = 0,
+        num_nodes: int = 1,
+        local_process_ids: Optional[List[int]] = None,
+        storage: Optional[CheckpointStorage] = None,
+        deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+        socket_path: str = "",
+    ):
+        self.persister = CheckpointPersister(
+            job_name=job_name,
+            node_id=node_id,
+            node_rank=node_rank,
+            num_nodes=num_nodes,
+            local_process_ids=local_process_ids,
+            storage=storage,
+            deletion_strategy=deletion_strategy,
+        )
+        self.socket_path = socket_path or default_socket_path(job_name, node_id)
+        self._ipc = IpcServer(self.socket_path)
+        self._event_queue: Optional[SharedQueue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    def start(self):
+        self._ipc.start()
+        self._event_queue = SharedQueue(CKPT_EVENT_QUEUE, self.socket_path)
+        self._thread = threading.Thread(
+            target=self._event_loop, name="ckpt-saver", daemon=True
+        )
+        self._thread.start()
+        logger.info(
+            "checkpoint saver started (node %s, ipc %s)",
+            self.persister.node_id,
+            self.socket_path,
+        )
+
+    def stop(self):
+        self._stop_evt.set()
+        self.persister.stop()
+        self._ipc.stop()
+
+    def update_topology(self, node_rank: int, num_nodes: int, process_ids: List[int]):
+        """Called by the agent after each rendezvous round."""
+        self.persister.node_rank = node_rank
+        self.persister.num_nodes = num_nodes
+        self.persister.local_process_ids = list(process_ids)
+
+    def save_shm_to_storage(self, ckpt_dir: str = "") -> bool:
+        """Breakpoint persist, guarded by the same shm lock the trainer
+        takes (bounded wait: a dying trainer's connection drop auto-releases
+        its lock, so this cannot wedge)."""
+        lock = self._ipc.state.get_lock(SHM_LOCK)
+        acquired = lock.acquire(timeout=30)
+        try:
+            return self.persister.save_shm_to_storage(ckpt_dir)
+        finally:
+            if acquired:
+                lock.release()
+
+    def cleanup_shm(self):
+        """Unlink staged segments (only after a successful job end)."""
+        for h in self.persister.local_handlers():
+            h.close(unlink=True)
+
+    def _event_loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                raw = self._event_queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            except Exception:
+                if self._stop_evt.is_set():
+                    return
+                logger.exception("ckpt event queue read failed")
+                time.sleep(1)
+                continue
+            event = CheckpointEvent.from_wire(raw)
+            if event.event_type == "exit":
+                return
+            if event.event_type == "save" and event.persist:
+                # Hold the shm lock only for the shm->storage copy (the
+                # trainer takes the same lock for staging); the commit wait
+                # on other nodes happens OUTSIDE the lock so it can never
+                # stall the trainer's next save.
+                lock = self._ipc.state.get_lock(SHM_LOCK)
+                try:
+                    with lock:
+                        steps = self.persister.copy_step_to_storage(
+                            event.ckpt_dir, event.step
+                        )
+                    for s in steps:
+                        self.persister._maybe_commit(event.ckpt_dir, s)
+                except Exception:
+                    logger.exception("persist of step %s failed", event.step)

@@ -1,0 +1,352 @@
+"""Pytree <-> POSIX shared memory staging.
+
+Parity: reference ``SharedMemoryHandler`` (``ckpt_saver.py:219-404``), which
+stages torch state-dicts; here the unit is a JAX pytree whose leaves are
+host numpy arrays (produced by ``jax.device_get`` of addressable shards).
+
+Segment layout::
+
+    [8B header_len][header JSON][... data at HEADER_SPACE ...]
+
+``header_len`` is written LAST so a crash mid-write leaves the previous
+checkpoint readable (header_len==0 or stale header -> previous step).
+
+The tree structure is stored as a recursive JSON skeleton for plain
+containers (dict/list/tuple/None/scalars); arbitrary pytree nodes
+(flax/optax states, NamedTuples) are handled via their registered pytree
+flattening with a restricted-unpickler treedef fallback.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import pickletools
+import struct
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory, resource_tracker
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import logger
+
+HEADER_SPACE = 4 << 20  # 4 MiB for metadata
+_LEN_FMT = "<Q"
+_LEN_SIZE = 8
+
+_SAFE_PICKLE_MODULES = (
+    "jax",
+    "jaxlib",
+    "flax",
+    "optax",
+    "chex",
+    "numpy",
+    "builtins",
+    "collections",
+    "dlrover_tpu",
+)
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Treedef unpickling restricted to ML-library modules."""
+
+    def find_class(self, module, name):
+        if any(
+            module == m or module.startswith(m + ".")
+            for m in _SAFE_PICKLE_MODULES
+        ):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"treedef references disallowed module {module}.{name}"
+        )
+
+
+def _loads_restricted(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+@dataclass
+class TensorMeta:
+    path: str
+    dtype: str
+    shape: Tuple[int, ...]
+    offset: int
+    nbytes: int
+    # sharded-save metadata: where this local shard sits in the global array
+    global_shape: Tuple[int, ...] = ()
+    index: Tuple[Tuple[int, int], ...] = ()  # (start, stop) per dim
+
+    def to_dict(self) -> Dict:
+        return {
+            "path": self.path,
+            "dtype": self.dtype,
+            "shape": list(self.shape),
+            "offset": self.offset,
+            "nbytes": self.nbytes,
+            "global_shape": list(self.global_shape),
+            "index": [list(p) for p in self.index],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "TensorMeta":
+        return cls(
+            path=d["path"],
+            dtype=d["dtype"],
+            shape=tuple(d["shape"]),
+            offset=d["offset"],
+            nbytes=d["nbytes"],
+            global_shape=tuple(d.get("global_shape", [])),
+            index=tuple(tuple(p) for p in d.get("index", [])),
+        )
+
+
+@dataclass
+class CheckpointMeta:
+    step: int = -1
+    leaves: List[TensorMeta] = field(default_factory=list)
+    treedef_hex: str = ""
+    timestamp: float = 0.0
+    world_size: int = 1
+    process_id: int = 0
+    total_bytes: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "step": self.step,
+                "leaves": [m.to_dict() for m in self.leaves],
+                "treedef_hex": self.treedef_hex,
+                "timestamp": self.timestamp,
+                "world_size": self.world_size,
+                "process_id": self.process_id,
+                "total_bytes": self.total_bytes,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, content: str) -> "CheckpointMeta":
+        d = json.loads(content)
+        return cls(
+            step=d["step"],
+            leaves=[TensorMeta.from_dict(m) for m in d["leaves"]],
+            treedef_hex=d.get("treedef_hex", ""),
+            timestamp=d.get("timestamp", 0.0),
+            world_size=d.get("world_size", 1),
+            process_id=d.get("process_id", 0),
+            total_bytes=d.get("total_bytes", 0),
+        )
+
+
+def _keystr(path) -> str:
+    import jax
+
+    return jax.tree_util.keystr(path)
+
+
+def flatten_state(state) -> Tuple[List[Tuple[str, np.ndarray]], bytes]:
+    """Flatten a pytree into (path, host-array) leaves + pickled treedef."""
+    import jax
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(state)
+    out = []
+    for path, leaf in leaves_with_path:
+        arr = np.asarray(leaf)
+        out.append((_keystr(path), arr))
+    treedef_bytes = pickletools.optimize(pickle.dumps(treedef))
+    return out, treedef_bytes
+
+
+def unflatten_state(treedef_bytes: bytes, leaves: List[np.ndarray]):
+    treedef = _loads_restricted(treedef_bytes)
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def shm_name(job_name: str, node_id: int, process_id: int) -> str:
+    safe_job = job_name.replace("/", "_")
+    return f"dlrover_tpu_ckpt_{safe_job}_{node_id}_{process_id}"
+
+
+class SharedMemoryHandler:
+    """One shm segment per training process, reused across steps."""
+
+    def __init__(self, name: str, create: bool = False, size: int = 0):
+        self.name = name
+        self._create = create
+        self._size = size
+        self._shm: Optional[shared_memory.SharedMemory] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _ensure(self, needed_bytes: int = 0):
+        total = HEADER_SPACE + needed_bytes
+        if self._shm is not None and self._shm.size >= total:
+            return
+        if self._shm is not None:
+            self._shm.close()
+            if self._create:
+                try:
+                    self._shm.unlink()
+                except FileNotFoundError:
+                    pass
+            self._shm = None
+        if self._create:
+            size = max(total, self._size)
+            try:
+                self._shm = shared_memory.SharedMemory(
+                    name=self.name, create=True, size=size
+                )
+                # zero the length word so readers see "empty"
+                struct.pack_into(_LEN_FMT, self._shm.buf, 0, 0)
+            except FileExistsError:
+                # A previous (restarted) incarnation left the segment: reuse
+                # it if large enough — its staged step is still restorable —
+                # else replace it.
+                existing = shared_memory.SharedMemory(name=self.name)
+                if existing.size >= total:
+                    self._shm = existing
+                else:
+                    existing.close()
+                    existing.unlink()
+                    self._shm = shared_memory.SharedMemory(
+                        name=self.name, create=True, size=size
+                    )
+                    struct.pack_into(_LEN_FMT, self._shm.buf, 0, 0)
+            # The segment must outlive this (crashing) process: the agent's
+            # saver owns cleanup, so keep python's resource tracker away.
+            _unregister_from_resource_tracker(self.name)
+        else:
+            self._shm = shared_memory.SharedMemory(name=self.name)
+            _unregister_from_resource_tracker(self.name)
+
+    def attach(self) -> bool:
+        """Attach to an existing segment (saver side). False if absent."""
+        if self._shm is not None:
+            return True
+        try:
+            self._shm = shared_memory.SharedMemory(name=self.name)
+            _unregister_from_resource_tracker(self.name)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def close(self, unlink: bool = False):
+        if self._shm is not None:
+            self._shm.close()
+            if unlink:
+                try:
+                    self._shm.unlink()
+                except FileNotFoundError:
+                    pass
+            self._shm = None
+
+    @property
+    def buf(self):
+        return self._shm.buf if self._shm else None
+
+    # -- write --------------------------------------------------------------
+
+    def save_state(
+        self,
+        step: int,
+        named_leaves: List[Tuple[str, np.ndarray]],
+        treedef_bytes: bytes,
+        shard_info: Optional[Dict[str, Tuple[Tuple[int, ...], Tuple]]] = None,
+        world_size: int = 1,
+        process_id: int = 0,
+    ):
+        """Copy leaves into shm and publish the header."""
+        total = sum(int(a.nbytes) for _, a in named_leaves)
+        self._ensure(total)
+        buf = self._shm.buf
+        # invalidate while writing
+        struct.pack_into(_LEN_FMT, buf, 0, 0)
+        metas: List[TensorMeta] = []
+        offset = HEADER_SPACE
+        for path, arr in named_leaves:
+            arr = np.ascontiguousarray(arr)
+            n = int(arr.nbytes)
+            dest = np.frombuffer(buf, dtype=np.uint8, count=n, offset=offset)
+            dest[:] = arr.view(np.uint8).reshape(-1)
+            gshape: Tuple[int, ...] = ()
+            index: Tuple = ()
+            if shard_info and path in shard_info:
+                gshape, index = shard_info[path]
+            metas.append(
+                TensorMeta(
+                    path=path,
+                    dtype=str(arr.dtype),
+                    shape=tuple(arr.shape),
+                    offset=offset,
+                    nbytes=n,
+                    global_shape=tuple(gshape),
+                    index=tuple(index),
+                )
+            )
+            offset += n
+        meta = CheckpointMeta(
+            step=step,
+            leaves=metas,
+            treedef_hex=treedef_bytes.hex(),
+            timestamp=time.time(),
+            world_size=world_size,
+            process_id=process_id,
+            total_bytes=offset - HEADER_SPACE,
+        )
+        header = meta.to_json().encode()
+        if _LEN_SIZE + len(header) > HEADER_SPACE:
+            raise ValueError(
+                f"checkpoint meta too large: {len(header)} bytes "
+                f"(> {HEADER_SPACE - _LEN_SIZE})"
+            )
+        buf[_LEN_SIZE : _LEN_SIZE + len(header)] = header
+        # publish: length word last
+        struct.pack_into(_LEN_FMT, buf, 0, len(header))
+
+    # -- read ---------------------------------------------------------------
+
+    def read_meta(self) -> Optional[CheckpointMeta]:
+        if self._shm is None and not self.attach():
+            return None
+        buf = self._shm.buf
+        (hlen,) = struct.unpack_from(_LEN_FMT, buf, 0)
+        if hlen == 0 or hlen > HEADER_SPACE - _LEN_SIZE:
+            return None
+        try:
+            return CheckpointMeta.from_json(
+                bytes(buf[_LEN_SIZE : _LEN_SIZE + hlen]).decode()
+            )
+        except (json.JSONDecodeError, KeyError) as e:
+            logger.warning("corrupt shm checkpoint header: %s", e)
+            return None
+
+    def read_leaf(self, meta: TensorMeta, copy: bool = False) -> np.ndarray:
+        buf = self._shm.buf
+        # np.prod(()) == 1.0 handles scalars; 0-size arrays keep count 0.
+        count = int(np.prod(meta.shape))
+        arr = np.frombuffer(
+            buf, dtype=np.dtype(meta.dtype), count=count, offset=meta.offset
+        ).reshape(meta.shape)
+        return arr.copy() if copy else arr
+
+    def load_state(self, copy: bool = True):
+        """Rebuild (step, pytree) from shm; None if nothing staged."""
+        meta = self.read_meta()
+        if meta is None:
+            return None
+        leaves = [self.read_leaf(m, copy=copy) for m in meta.leaves]
+        state = unflatten_state(bytes.fromhex(meta.treedef_hex), leaves)
+        return meta.step, state
+
+
+def _unregister_from_resource_tracker(name: str):
+    """Attaching processes must not let the resource tracker unlink the
+    segment at their exit (reference fights the same leak, multi_process.py)."""
+    try:
+        resource_tracker.unregister(f"/{name}", "shared_memory")
+    except Exception:
+        pass

@@ -1,0 +1,77 @@
+"""E2E flash-checkpoint script: crash mid-training, resume from checkpoint.
+
+Trains a counter + params for TOTAL_STEPS, staging a memory checkpoint every
+step and persisting every 4 steps. Crashes at CRASH_STEP on the first
+incarnation. After the agent restarts it, training must resume from the
+staged (shm) checkpoint — NOT from zero — and finish.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import dlrover_tpu.train as dtrain
+
+ctx = dtrain.init(local_device_count=2)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.checkpoint import Checkpointer, StorageType
+
+TOTAL_STEPS = 12
+CRASH_STEP = int(os.environ.get("DLROVER_TPU_TEST_CRASH_STEP", "-1"))
+CKPT_DIR = os.environ["DLROVER_TPU_TEST_CKPT_DIR"]
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+sharded = NamedSharding(mesh, P("dp"))
+repl = NamedSharding(mesh, P())
+
+state = {
+    "w": jax.device_put(jnp.zeros(8), sharded),
+    "step": jax.device_put(jnp.array(0), repl),
+}
+
+ckpt = Checkpointer(CKPT_DIR)
+restored = ckpt.load(target=state)
+start_step = 0
+if restored is not None:
+    start_step, state = restored
+    print(f"[ckpt-e2e] resumed from step {start_step}", flush=True)
+else:
+    print("[ckpt-e2e] cold start", flush=True)
+
+
+@jax.jit
+def train_step(state):
+    return {"w": state["w"] + 1.0, "step": state["step"] + 1}
+
+
+step_sleep = float(os.environ.get("DLROVER_TPU_TEST_STEP_SLEEP", "0"))
+
+for step in range(start_step + 1, TOTAL_STEPS + 1):
+    if step_sleep:
+        import time
+
+        time.sleep(step_sleep)
+    state = train_step(state)
+    persist = step % 4 == 0
+    ckpt.save(
+        step, state, StorageType.DISK if persist else StorageType.MEMORY
+    )
+    if step == CRASH_STEP and ctx.restart_count == 0:
+        print(f"[ckpt-e2e] injected crash at step {step}", flush=True)
+        os._exit(23)
+    ctx.report_step(step, force=True)
+
+w = np.asarray(jax.device_get(state["w"]))
+final_step = int(state["step"])
+print(f"[ckpt-e2e] done: step={final_step} w0={w[0]}", flush=True)
+assert final_step == TOTAL_STEPS, f"bad final step {final_step}"
+assert w[0] == TOTAL_STEPS, f"params lost: w0={w[0]} != {TOTAL_STEPS}"

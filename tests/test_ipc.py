@@ -1,0 +1,82 @@
+"""IPC server/queue/lock/dict tests across threads and processes."""
+
+import multiprocessing as mp
+import queue
+import tempfile
+
+import pytest
+
+from dlrover_tpu.common.ipc import (
+    IpcServer,
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+)
+
+
+@pytest.fixture
+def ipc_server():
+    path = tempfile.mktemp(suffix=".sock")
+    server = IpcServer(path)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_queue_roundtrip(ipc_server):
+    q = SharedQueue("q1", ipc_server.socket_path)
+    q.put({"step": 5, "persist": True})
+    assert q.qsize() == 1
+    assert q.get(timeout=1) == {"step": 5, "persist": True}
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.1)
+
+
+def test_lock_mutual_exclusion(ipc_server):
+    l1 = SharedLock("lk", ipc_server.socket_path, owner="a")
+    l2 = SharedLock("lk", ipc_server.socket_path, owner="b")
+    assert l1.acquire()
+    assert not l2.acquire(blocking=False)
+    assert l1.release()
+    assert l2.acquire(blocking=False)
+    l2.release()
+
+
+def test_dict_ops(ipc_server):
+    d = SharedDict("cfg", ipc_server.socket_path)
+    d.set("k", [1, 2, 3])
+    assert d.get("k") == [1, 2, 3]
+    assert d.get() == {"k": [1, 2, 3]}
+    assert d.pop("k") == [1, 2, 3]
+    assert d.get("k") is None
+
+
+def _child_put(path):
+    q = SharedQueue("xproc", path)
+    q.put("from-child")
+
+
+def test_queue_across_processes(ipc_server):
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_child_put, args=(ipc_server.socket_path,))
+    p.start()
+    q = SharedQueue("xproc", ipc_server.socket_path)
+    assert q.get(timeout=10) == "from-child"
+    p.join()
+    assert p.exitcode == 0
+
+
+def _child_acquire_and_die(path):
+    l = SharedLock("abandoned", path)
+    l.acquire()
+    # die without releasing (simulates SIGKILL mid-critical-section)
+
+
+def test_dead_client_lock_released(ipc_server):
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_child_acquire_and_die, args=(ipc_server.socket_path,))
+    p.start()
+    p.join()
+    l2 = SharedLock("abandoned", ipc_server.socket_path)
+    assert l2.acquire(timeout=10), "abandoned lock was not auto-released"
+    l2.release()

@@ -1,0 +1,109 @@
+"""Shared-memory pytree staging tests."""
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.shm_handler import (
+    SharedMemoryHandler,
+    flatten_state,
+    shm_name,
+    unflatten_state,
+)
+
+
+@pytest.fixture
+def handler():
+    name = shm_name("test-job", 0, 0)
+    h = SharedMemoryHandler(name, create=True)
+    yield h
+    h.close(unlink=True)
+
+
+def _stage(h, step, state):
+    named, treedef = flatten_state(state)
+    h.save_state(step, named, treedef)
+
+
+def test_roundtrip_nested_pytree(handler):
+    state = {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4)},
+        "opt": [np.ones(5), np.zeros((2, 2), dtype=np.int32)],
+        "step_count": np.array(7),
+    }
+    _stage(handler, 10, state)
+    step, restored = handler.load_state()
+    assert step == 10
+    np.testing.assert_array_equal(restored["params"]["w"], state["params"]["w"])
+    np.testing.assert_array_equal(restored["opt"][1], state["opt"][1])
+    assert restored["step_count"] == 7
+
+
+def test_overwrite_with_larger_state(handler):
+    _stage(handler, 1, {"a": np.ones(4)})
+    _stage(handler, 2, {"a": np.ones(4), "b": np.zeros((1000, 100))})
+    step, restored = handler.load_state()
+    assert step == 2
+    assert restored["b"].shape == (1000, 100)
+
+
+def test_reader_attaches_by_name(handler):
+    _stage(handler, 3, {"x": np.full(8, 3.0)})
+    reader = SharedMemoryHandler(handler.name)
+    assert reader.attach()
+    step, restored = reader.load_state()
+    assert step == 3
+    np.testing.assert_array_equal(restored["x"], np.full(8, 3.0))
+    reader.close()
+
+
+def test_empty_segment_reads_none(handler):
+    handler._ensure(0)
+    assert handler.read_meta() is None
+    assert handler.load_state() is None
+
+
+def test_jax_train_state_roundtrip(handler):
+    """Real-world tree: flax TrainState with optax adam state."""
+    import jax.numpy as jnp
+    import optax
+    from flax.training.train_state import TrainState
+
+    def apply_fn(params, x):
+        return x @ params["w"]
+
+    state = TrainState.create(
+        apply_fn=apply_fn,
+        params={"w": jnp.ones((4, 2))},
+        tx=optax.adam(1e-3),
+    )
+    named, treedef = flatten_state(
+        {"params": state.params, "opt_state": state.opt_state, "step": state.step}
+    )
+    handler.save_state(5, named, treedef)
+    step, restored = handler.load_state()
+    assert step == 5
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.ones((4, 2))
+    )
+    # optax adam state (ScaleByAdamState namedtuple) survives the treedef
+    assert restored["opt_state"][0].count == 0
+
+
+def test_treedef_unpickler_rejects_evil():
+    import pickle
+
+    evil = pickle.dumps(print)  # builtins.print is allowed... use os.system
+    import os as _os
+
+    evil = pickle.dumps(_os.system)
+    with pytest.raises(Exception):
+        unflatten_state(evil, [])
+
+
+def test_zero_size_leaf(handler):
+    state = {"empty": np.zeros((0,), dtype=np.float32), "x": np.ones(3)}
+    _stage(handler, 4, state)
+    step, restored = handler.load_state()
+    assert step == 4
+    assert restored["empty"].shape == (0,)
+    np.testing.assert_array_equal(restored["x"], np.ones(3))
