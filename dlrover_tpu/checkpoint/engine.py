@@ -33,6 +33,7 @@ from dlrover_tpu.checkpoint.shm_handler import (
     CheckpointMeta,
     SharedMemoryHandler,
     flatten_state,
+    resolve_dtype,
     shm_name,
     unflatten_state,
 )
@@ -179,6 +180,14 @@ class CheckpointEngine:
     def save_to_storage(self, step: int, state: Any) -> float:
         """Stage + hand persistence to the agent saver (async)."""
         blocking = self.save_to_memory(step, state)
+        if self.latest_saved_step != step:
+            # staging was skipped (shm lock timeout): queuing a persist
+            # event would make the saver persist a stale step as if it were
+            # this one — surface the failure instead
+            logger.error(
+                "step %s was not staged to shm; skipping persist", step
+            )
+            return blocking
         q = self._queue()
         if q is not None:
             q.put(
@@ -253,11 +262,11 @@ class CheckpointEngine:
             except FileNotFoundError:
                 continue
             treedef_hex = treedef_hex or meta.treedef_hex
-            import io
-
             for i, leaf_meta in enumerate(meta.leaves):
-                data = self._storage.read(os.path.join(proc_dir, f"leaf-{i}.npy"))
-                arr = np.load(io.BytesIO(data), allow_pickle=False)
+                data = self._storage.read(os.path.join(proc_dir, f"leaf-{i}.bin"))
+                arr = np.frombuffer(
+                    data, dtype=resolve_dtype(leaf_meta.dtype)
+                ).reshape(leaf_meta.shape)
                 base = leaf_meta.path.rsplit("#", 1)[0]
                 pieces.setdefault(base, []).append(
                     (leaf_meta.index, arr, leaf_meta.global_shape)

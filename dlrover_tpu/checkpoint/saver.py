@@ -15,7 +15,9 @@ Storage layout::
         node-<node_rank>.done          # commit votes
         proc-<pid>/
           meta.json                    # CheckpointMeta (incl. shard index)
-          leaf-<i>.npy                 # raw array per staged shard
+          leaf-<i>.bin                 # raw little-endian bytes per staged
+                                       # shard (dtype/shape in meta.json —
+                                       # np.save can't round-trip bfloat16)
 
 ``CheckpointPersister`` is the storage-side logic; ``AsyncCheckpointSaver``
 adds the IPC server + event loop the agent hosts.
@@ -23,7 +25,6 @@ adds the IPC server + event loop the agent hosts.
 
 from __future__ import annotations
 
-import io
 import os
 import queue
 import threading
@@ -139,12 +140,18 @@ class CheckpointPersister:
                 if meta.step in self._persisted_steps:
                     continue
                 if step >= 0 and meta.step != step:
+                    # Persist ONLY the requested step: staging (by the
+                    # trainer) may already have moved on to a newer step;
+                    # persisting whatever is staged would make nodes vote
+                    # for different steps and no step would ever collect
+                    # num_nodes votes. The newer step's own event follows.
                     logger.warning(
-                        "shm %s holds step %s, requested %s; persisting staged",
+                        "shm %s holds step %s, requested %s; skipping",
                         h.name,
                         meta.step,
                         step,
                     )
+                    continue
                 by_step.setdefault(meta.step, []).append((meta, h))
             if not by_step:
                 return []
@@ -178,12 +185,15 @@ class CheckpointPersister:
             for h in handlers:
                 h.close()
 
-    def persist_step(self, ckpt_dir: str, step: int = -1) -> bool:
+    def persist_step(
+        self, ckpt_dir: str, step: int = -1,
+        commit_timeout: Optional[float] = None,
+    ) -> bool:
         """Copy + commit (commit waits for other nodes; call off the shm
         lock — see AsyncCheckpointSaver's event loop)."""
         steps = self.copy_step_to_storage(ckpt_dir, step)
         for s in steps:
-            self._maybe_commit(ckpt_dir, s)
+            self._maybe_commit(ckpt_dir, s, timeout=commit_timeout)
         return bool(steps)
 
     def _write_process_ckpt(
@@ -195,21 +205,27 @@ class CheckpointPersister:
         self._storage.makedirs(proc_dir)
         for i, leaf_meta in enumerate(meta.leaves):
             arr = handler.read_leaf(leaf_meta, copy=False)
-            buf = io.BytesIO()
-            np.save(buf, arr, allow_pickle=False)
+            # raw bytes, not np.save: extended dtypes (bfloat16 etc.) do not
+            # survive a .npy round-trip (they come back as void); dtype and
+            # shape live in meta.json
             self._storage.write(
-                buf.getvalue(), os.path.join(proc_dir, f"leaf-{i}.npy")
+                np.ascontiguousarray(arr).tobytes(),
+                os.path.join(proc_dir, f"leaf-{i}.bin"),
             )
         self._storage.write(
             meta.to_json().encode(), os.path.join(proc_dir, "meta.json")
         )
 
-    def _maybe_commit(self, ckpt_dir: str, step: int):
+    def _maybe_commit(
+        self, ckpt_dir: str, step: int, timeout: Optional[float] = None
+    ):
         """Node-rank-0's saver waits for all nodes' votes then commits."""
         if self.node_rank != 0:
             return
         sdir = step_dir(ckpt_dir, step)
-        deadline = time.time() + self._commit_timeout
+        deadline = time.time() + (
+            timeout if timeout is not None else self._commit_timeout
+        )
         while time.time() < deadline and not self._stop_evt.is_set():
             done = [
                 f
@@ -240,12 +256,16 @@ class CheckpointPersister:
             self._storage.delete(step_dir(ckpt_dir, s))
             logger.info("deleted old checkpoint step %s", s)
 
-    def save_shm_to_storage(self, ckpt_dir: str = "") -> bool:
+    def save_shm_to_storage(
+        self, ckpt_dir: str = "", commit_timeout: Optional[float] = None
+    ) -> bool:
         """Persist whatever is staged in shm right now (failure/SIGTERM).
 
         The reference's save-at-breakpoint guarantee (``training.py:1098``,
-        ``ckpt_saver.py:786``).
-        """
+        ``ckpt_saver.py:786``). Runs from failure paths and signal
+        handlers, so callers pass a short ``commit_timeout`` — a dying node
+        must not spend the preemption grace period polling other nodes'
+        votes."""
         ckpt_dir = ckpt_dir or self.last_persist_dir
         handlers = self.local_handlers()
         try:
@@ -264,7 +284,7 @@ class CheckpointPersister:
             return False
         if steps <= self._persisted_steps:
             return True
-        return self.persist_step(ckpt_dir)
+        return self.persist_step(ckpt_dir, commit_timeout=commit_timeout)
 
     def committed_step(self, ckpt_dir: str) -> int:
         try:
@@ -326,17 +346,32 @@ class AsyncCheckpointSaver:
         self.persister.num_nodes = num_nodes
         self.persister.local_process_ids = list(process_ids)
 
+    # Bounded commit wait for failure-path persists: a dying node writes its
+    # shards + vote and gives peers only this long to show up before it gets
+    # on with shutdown (GKE preemption grace is short).
+    BREAKPOINT_COMMIT_TIMEOUT = 30.0
+
     def save_shm_to_storage(self, ckpt_dir: str = "") -> bool:
         """Breakpoint persist, guarded by the same shm lock the trainer
         takes (bounded wait: a dying trainer's connection drop auto-releases
         its lock, so this cannot wedge)."""
         lock = self._ipc.state.get_lock(SHM_LOCK)
         acquired = lock.acquire(timeout=30)
+        if not acquired:
+            # A trainer is (still) mid-stage after 30s: the shm region may
+            # be torn mid-overwrite. Persisting it could commit garbage —
+            # the previously committed step stays the restore point.
+            logger.error(
+                "breakpoint persist: shm lock not acquired in 30s; "
+                "refusing to persist a possibly-torn checkpoint"
+            )
+            return False
         try:
-            return self.persister.save_shm_to_storage(ckpt_dir)
+            return self.persister.save_shm_to_storage(
+                ckpt_dir, commit_timeout=self.BREAKPOINT_COMMIT_TIMEOUT
+            )
         finally:
-            if acquired:
-                lock.release()
+            lock.release()
 
     def cleanup_shm(self):
         """Unlink staged segments (only after a successful job end)."""

@@ -140,6 +140,17 @@ class CheckpointMeta:
         )
 
 
+def resolve_dtype(name: str) -> np.dtype:
+    """Dtype from its string name, including extended ml_dtypes (bfloat16,
+    float8_*…) that plain ``np.dtype(name)`` cannot parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _keystr(path) -> str:
     import jax
 
@@ -329,7 +340,8 @@ class SharedMemoryHandler:
         # np.prod(()) == 1.0 handles scalars; 0-size arrays keep count 0.
         count = int(np.prod(meta.shape))
         arr = np.frombuffer(
-            buf, dtype=np.dtype(meta.dtype), count=count, offset=meta.offset
+            buf, dtype=resolve_dtype(meta.dtype), count=count,
+            offset=meta.offset
         ).reshape(meta.shape)
         return arr.copy() if copy else arr
 

@@ -1,0 +1,11 @@
+"""Model families. Flagship: Llama-3 decoder (BASELINE.json north star)."""
+
+from dlrover_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    abstract_params,
+    forward,
+    init_params,
+    loss_fn,
+    param_count,
+    param_specs,
+)

@@ -1,0 +1,273 @@
+"""Llama-3-family decoder, TPU-first.
+
+The BASELINE.json north star is a Llama-3-8B JAX run on v5p; this is that
+model, built for the XLA compilation model rather than translated from any
+torch layout:
+
+- **scan-over-layers**: per-layer params are stacked on a leading axis and
+  the decoder is one `lax.scan` — O(1) HLO size, fast compiles at 8B scale,
+  and the natural shape for per-layer remat (`jax.checkpoint`) which is how
+  fsdp param gathers stay overlapped with compute.
+- **explicit PartitionSpecs** (`param_specs`): megatron-style tp layout
+  (column-parallel wq/wk/wv/w_gate/w_up, row-parallel wo/w_down) with fsdp
+  on the opposite dim; XLA's SPMD partitioner inserts the all-gathers /
+  reduce-scatters.
+- **sequence parallelism**: when the mesh has sp>1 the attention runs as
+  `ring_attention` inside a `shard_map` island (kv chunks rotate over ICI);
+  otherwise the Pallas `flash_attention` path.
+- bfloat16 compute / float32 params + optimizer, f32 logits for the loss.
+
+The reference has no model code at all (it orchestrates wrapped trainers,
+SURVEY.md §2.8); configs here mirror the public Llama-3 shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.ops import (
+    apply_rope,
+    flash_attention,
+    mha_reference,
+    ring_attention,
+    rms_norm,
+    rope_frequencies,
+)
+from dlrover_tpu.parallel.mesh import BATCH_AXES, FSDP, SP, TP
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16          # activation/compute dtype
+    param_dtype: Any = jnp.float32     # master params
+    remat: bool = True
+    attn_impl: str = "auto"            # auto | flash | reference | ring
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # ---- presets -------------------------------------------------------
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(
+            dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672
+        )
+
+    @staticmethod
+    def gpt2_xl_class() -> "LlamaConfig":
+        """~1.5B-param config matching the reference's flash-ckpt benchmark
+        subject (GPT-2 xl, `docs/blogs/flash_checkpoint.md` there)."""
+        return LlamaConfig(
+            vocab_size=50304, dim=1600, n_layers=48, n_heads=25,
+            n_kv_heads=25, ffn_dim=3712, max_seq_len=1024, rope_theta=10000.0
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        base = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=128, dtype=jnp.float32, remat=False,
+        )
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Params: init + sharding specs
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, rng: jax.Array) -> Params:
+    """Random init. For large models call under jit with
+    ``out_shardings=named_shardings(mesh, param_specs(cfg))`` so params are
+    born sharded, never materialized on one host."""
+    pd = cfg.param_dtype
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+    std = 0.02
+    L, D, H, KV, F = (cfg.n_layers, cfg.dim, cfg.n_heads * cfg.head_dim,
+                      cfg.n_kv_heads * cfg.head_dim, cfg.ffn_dim)
+
+    def norm_init(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(pd)
+
+    ks = jax.random.split(k_layers, 7)
+    out_scale = std / (2 * cfg.n_layers) ** 0.5  # gpt-2 residual scaling
+    layers = {
+        "attn_norm": jnp.ones((L, D), pd),
+        "wq": norm_init(ks[0], (L, D, H), std),
+        "wk": norm_init(ks[1], (L, D, KV), std),
+        "wv": norm_init(ks[2], (L, D, KV), std),
+        "wo": norm_init(ks[3], (L, H, D), out_scale),
+        "mlp_norm": jnp.ones((L, D), pd),
+        "w_gate": norm_init(ks[4], (L, D, F), std),
+        "w_up": norm_init(ks[5], (L, D, F), std),
+        "w_down": norm_init(ks[6], (L, F, D), out_scale),
+    }
+    return {
+        "embed": norm_init(k_embed, (cfg.vocab_size, D), std),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), pd),
+        "lm_head": norm_init(k_head, (D, cfg.vocab_size), std),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Params:
+    """PartitionSpec pytree mirroring `init_params` (leading axis of every
+    layer leaf is the scan/layer axis, never sharded)."""
+    return {
+        "embed": P(TP, FSDP),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, FSDP, TP),
+            "wk": P(None, FSDP, TP),
+            "wv": P(None, FSDP, TP),
+            "wo": P(None, TP, FSDP),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, FSDP, TP),
+            "w_up": P(None, FSDP, TP),
+            "w_down": P(None, TP, FSDP),
+        },
+        "final_norm": P(None),
+        "lm_head": P(FSDP, TP),
+    }
+
+
+def abstract_params(cfg: LlamaConfig) -> Params:
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    import math
+
+    return sum(
+        math.prod(l.shape) for l in jax.tree.leaves(abstract_params(cfg))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
+    impl = cfg.attn_impl
+    sp_size = mesh.shape[SP] if mesh is not None and SP in mesh.shape else 1
+    if impl == "auto":
+        impl = "ring" if sp_size > 1 else "flash"
+    if impl == "ring" and sp_size > 1:
+        assert mesh is not None
+        from jax import shard_map
+
+        qspec = P(BATCH_AXES, SP, TP, None)
+        ring = shard_map(
+            functools.partial(ring_attention, axis_name=SP, causal=True),
+            mesh=mesh,
+            in_specs=(qspec, qspec, qspec),
+            out_specs=qspec,
+            check_vma=False,
+        )
+        return ring(q, k, v)
+    if impl == "reference":
+        return mha_reference(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True)
+
+
+def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x):
+    """One block: pre-norm attention + pre-norm swiglu, residual adds."""
+    dt = cfg.dtype
+    b, s, d = x.shape
+    h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (y @ lp["wq"].astype(dt)).reshape(b, s, h, hd)
+    k = (y @ lp["wk"].astype(dt)).reshape(b, s, kvh, hd)
+    v = (y @ lp["wv"].astype(dt)).reshape(b, s, kvh, hd)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    attn = _attention(cfg, mesh, q, k, v).reshape(b, s, h * hd)
+    x = x + attn @ lp["wo"].astype(dt)
+
+    y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(y @ lp["w_gate"].astype(dt))
+    up = y @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(BATCH_AXES, SP, None))
+        )
+    return x
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # (b, s) int32
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """Logits (b, s, vocab) in float32."""
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(BATCH_AXES, SP, None))
+        )
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
+
+    layer_fn = functools.partial(_decoder_layer, cfg, mesh, inv_freq, positions)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    def scan_body(x, lp):
+        return layer_fn(lp, x), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits
+
+
+def loss_fn(
+    params: Params,
+    tokens: jnp.ndarray,  # (b, s) int32; next-token targets derived inside
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy (pad tokens < 0 are ignored)."""
+    logits = forward(params, tokens, cfg, mesh)[:, :-1]
+    targets = tokens[:, 1:]
+    valid = (targets >= 0).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, jnp.maximum(targets, 0)[..., None], axis=-1
+    )[..., 0]
+    nll = (logz - gold) * valid
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1.0)

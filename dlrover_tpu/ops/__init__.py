@@ -1,0 +1,10 @@
+"""TPU compute ops: norms, rotary, flash attention (Pallas), ring attention.
+
+Green-field relative to the reference, which owns no kernels (SURVEY.md
+§2.8) — its compute path is whatever torch framework it launches.
+"""
+
+from dlrover_tpu.ops.attention import flash_attention, mha_reference  # noqa: F401
+from dlrover_tpu.ops.norms import rms_norm  # noqa: F401
+from dlrover_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from dlrover_tpu.ops.rotary import apply_rope, rope_frequencies  # noqa: F401

@@ -1,0 +1,205 @@
+"""Attention: jnp reference + Pallas TPU flash-attention forward.
+
+Layout convention everywhere: ``(batch, seq, n_heads, head_dim)``; GQA via
+``n_kv_heads <= n_heads`` (kv head ``h // group`` serves query head ``h``
+— resolved in the kernel's BlockSpec index_map, never materialized).
+
+`flash_attention` is a `jax.custom_vjp`: the forward pass runs a Pallas
+online-softmax kernel on TPU (O(seq) memory, MXU-tiled 128-blocks, never
+materializing the s×s matrix); the backward recomputes attention with the
+jnp reference under XLA — flash-backward is a later-round kernel. On
+non-TPU backends the forward falls back to the reference, so the same model
+code runs in CPU tests.
+
+The reference framework has no attention op at all (it launches
+Megatron/DeepSpeed which own the math, SURVEY.md §2.8) — this is part of
+the green-field TPU compute path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas imports fail on some backends; the reference path still works
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+_NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jnp.ndarray,  # (b, sq, h, d)
+    k: jnp.ndarray,  # (b, sk, hkv, d)
+    v: jnp.ndarray,  # (b, sk, hkv, d)
+    causal: bool = True,
+    q_offset=0,
+    k_offset=0,
+) -> jnp.ndarray:
+    """Stable-softmax attention in float32, GQA-aware. ``q_offset`` /
+    ``k_offset`` are *global* positions of element 0 — this is what lets
+    ring-attention chunks mask causally against each other."""
+    b, sq, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    if causal:
+        qpos = q_offset + jnp.arange(sq)
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, block_q: int, block_k: int, n_kblocks: int, causal: bool, scale: float
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Entire k block above the causal diagonal → skip all compute.
+    if causal:
+        block_needed = ki * block_k <= qi * block_q + block_q - 1
+    else:
+        block_needed = qi >= 0  # always true, traced
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+        k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0, 0].astype(jnp.float32)                  # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                     # (bq, bk)
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev = m_ref[:, 0]                                  # (bq,)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])
+        corr = jnp.exp(m_prev - m_cur)
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:, 0] = m_cur
+
+    @pl.when(ki == n_kblocks - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
+                      interpret: bool = False):
+    b, sq, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    n_q, n_k = sq // block_q, sk // block_k
+    scale = 1.0 / math.sqrt(d)
+
+    # (b, s, h, d) → (b, h, s, d) so the contiguous minor dims tile cleanly.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, h, n_q, n_k)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_q=block_q, block_k=block_k, n_kblocks=n_k,
+        causal=causal, scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, qi, ki, _g=group: (bi, hi // _g, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, qi, ki, _g=group: (bi, hi // _g, ki, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128):
+    return _flash_attention_fwd(q, k, v, causal, block_q, block_k)[0]
+
+
+def _flash_attention_fwd(q, k, v, causal, block_q, block_k):
+    if _HAS_PALLAS and _on_tpu():
+        out = _flash_fwd_pallas(q, k, v, causal, block_q, block_k)
+    else:
+        out = mha_reference(q, k, v, causal=causal)
+    return out, (q, k, v)
+
+
+def _flash_attention_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

@@ -1,0 +1,21 @@
+"""Normalization ops.
+
+Pure jnp — XLA fuses the reduction + rescale into the surrounding matmuls'
+epilogues on TPU, so a Pallas kernel buys nothing here (HBM-bound elementwise
+work is exactly what the XLA fuser exists for). Computation is done in
+float32 regardless of input dtype for numerical parity with the usual
+bfloat16 training recipe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,87 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §2.8 — it
+delegates context parallelism to Megatron/DeepSpeed). TPU-native design:
+
+- the sequence dim is sharded over the mesh ``sp`` axis;
+- each device holds one q/k/v chunk; kv chunks rotate around the ring with
+  `lax.ppermute` (single-hop ICI neighbor exchange — the torus makes this
+  free-ish) while every device accumulates online-softmax partials;
+- compute and the next kv transfer overlap naturally: XLA schedules the
+  ppermute DMA concurrently with the chunk matmuls.
+
+Must be called inside `shard_map` with ``axis_name`` bound (see
+`models/llama.py` for the wiring). Differentiable through `lax.scan` +
+`ppermute`; the per-step chunk attention is rematerialized under
+`jax.checkpoint` so the backward does not keep every rotated kv copy.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dlrover_tpu.ops.attention import _NEG_INF
+
+
+def ring_attention(
+    q: jnp.ndarray,  # (b, s_local, h, d)
+    k: jnp.ndarray,  # (b, s_local, hkv, d)
+    v: jnp.ndarray,  # (b, s_local, hkv, d)
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    b, s_local, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(d)
+
+    qf = q.astype(jnp.float32) * scale
+    # einsum layout: (b, h, sq, sk) blocks
+    qb = qf.transpose(0, 2, 1, 3)  # (b, h, s, d)
+
+    def chunk_scores(kc):  # kc: (b, s, hkv, d) → (b, h, sq, sk) f32
+        kb = kc.astype(jnp.float32).transpose(0, 2, 1, 3)
+        if group > 1:
+            kb = jnp.repeat(kb, group, axis=1)
+        return jnp.einsum("bhqd,bhkd->bhqk", qb, kb)
+
+    def step_fn(carry, _):
+        m, l, acc, kc, vc, src = carry
+        s = chunk_scores(kc)
+        if causal:
+            qpos = my_idx * s_local + jnp.arange(s_local)
+            kpos = src * s_local + jnp.arange(s_local)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_cur = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_cur[..., None])
+        corr = jnp.exp(m - m_cur)
+        l = l * corr + jnp.sum(p, axis=-1)
+        vb = vc.astype(jnp.float32).transpose(0, 2, 1, 3)
+        if group > 1:
+            vb = jnp.repeat(vb, group, axis=1)
+        acc = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        # rotate kv to the next ring position (device i → i+1)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = (src - 1) % n
+        return (m_cur, l, acc, kc, vc, src), None
+
+    m0 = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    carry0 = (m0, l0, acc0, k, v, my_idx)
+    (m, l, acc, *_), _ = lax.scan(
+        jax.checkpoint(step_fn), carry0, None, length=n
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l[..., None]).transpose(0, 2, 1, 3)  # (b, s, h, d)
+    return out.astype(q.dtype)

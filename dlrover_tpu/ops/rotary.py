@@ -1,0 +1,30 @@
+"""Rotary position embeddings (RoPE), llama-3 style.
+
+Frequencies are computed once per (seq_len, head_dim) and closed over by the
+jitted step — static shapes, no per-step host work. ``positions`` is passed
+explicitly so sequence-parallel shards (ring attention) can rotate with
+their *global* positions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 500000.0) -> jnp.ndarray:
+    """Inverse frequencies, shape (head_dim // 2,), float32."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponents)
+
+
+def apply_rope(
+    x: jnp.ndarray,          # (..., seq, n_heads, head_dim)
+    positions: jnp.ndarray,  # (..., seq) int32 global positions
+    inv_freq: jnp.ndarray,   # (head_dim // 2,)
+) -> jnp.ndarray:
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # (...,s,d/2)
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,28 @@
+"""Parallelism layer: device meshes, sharding rules, sequence parallelism.
+
+TPU-native replacement for the worlds the reference delegates to
+torch.distributed/NCCL (SURVEY.md §2.8): one `jax.sharding.Mesh` with
+dp/fsdp/ep/sp/tp axes, XLA collectives over ICI/DCN, and elastic re-meshing
+on membership change.
+"""
+
+from dlrover_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    BATCH_AXES,
+    DP,
+    EP,
+    FSDP,
+    MeshConfig,
+    SP,
+    TP,
+    build_mesh,
+    remesh,
+)
+from dlrover_tpu.parallel.sharding import (  # noqa: F401
+    batch_spec,
+    named_shardings,
+    pad_batch_to,
+    shard_pytree,
+    spec_for_resize,
+    with_constraints,
+)

@@ -1,0 +1,177 @@
+"""Device-mesh construction for elastic TPU training.
+
+The reference (DLRover) never owns a parallelism mesh — it manages
+torch.distributed worlds formed by NCCL (SURVEY.md §2.8). TPU-native, the
+mesh IS the world: every parallel strategy (dp / fsdp / sp / tp / ep) is an
+axis of one `jax.sharding.Mesh`, XLA inserts the collectives, and an elastic
+membership change means *re-building the mesh* and resharding state.
+
+Axis convention (outermost → innermost):
+
+    dp    pure data parallelism (gradient psum; rides DCN across slices)
+    fsdp  data parallelism with parameter/optimizer sharding (ZeRO-3 style)
+    ep    expert parallelism for MoE layers (experts split across this axis)
+    sp    sequence/context parallelism (ring attention over this axis)
+    tp    tensor parallelism (innermost — highest-bandwidth ICI neighbors)
+
+Innermost axes map to physically adjacent TPU cores (JAX device order is
+torus-major), so tp/sp collectives ride single-hop ICI while dp gradient
+reductions tolerate DCN latency. This mirrors the reference's ASW/PSW
+topology sort (`net_topology.py:22-79` there) at mesh-construction time
+instead of rendezvous time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names, outermost first.
+DP = "dp"
+FSDP = "fsdp"
+EP = "ep"
+SP = "sp"
+TP = "tp"
+AXIS_ORDER = (DP, FSDP, EP, SP, TP)
+
+# Axes over which a data batch is split (sharding of the batch dimension).
+BATCH_AXES = (DP, FSDP, EP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. ``-1`` for dp means "absorb remaining devices"
+    so the same config survives elastic resizes: tp/sp/ep/fsdp are model
+    properties, dp is whatever the current world provides."""
+
+    dp: int = -1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        fixed = self.fsdp * self.ep * self.sp * self.tp
+        if self.dp == -1:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by "
+                    f"fsdp*ep*sp*tp={fixed}"
+                )
+            return dataclasses.replace(self, dp=n_devices // fixed)
+        if self.dp * fixed != n_devices:
+            raise ValueError(
+                f"mesh {self.shape()} wants {self.dp * fixed} devices, "
+                f"got {n_devices}"
+            )
+        return self
+
+    def shape(self) -> dict:
+        return {
+            DP: self.dp,
+            FSDP: self.fsdp,
+            EP: self.ep,
+            SP: self.sp,
+            TP: self.tp,
+        }
+
+    @property
+    def data_parallel_size(self) -> int:
+        """Number of independent batch shards (for global-batch math)."""
+        return self.dp * self.fsdp * self.ep
+
+    @staticmethod
+    def auto(
+        n_devices: int,
+        tp: int = 1,
+        sp: int = 1,
+        ep: int = 1,
+        prefer_fsdp: bool = True,
+    ) -> "MeshConfig":
+        """Pick a mesh for ``n_devices``: model axes given, the data axes
+        inferred. With ``prefer_fsdp`` the whole data dimension is fsdp
+        (ZeRO-style, the usual choice for large models); otherwise pure dp."""
+        model = tp * sp * ep
+        if n_devices % model:
+            raise ValueError(
+                f"{n_devices} devices not divisible by tp*sp*ep={model}"
+            )
+        data = n_devices // model
+        if prefer_fsdp:
+            return MeshConfig(dp=1, fsdp=data, ep=ep, sp=sp, tp=tp)
+        return MeshConfig(dp=data, fsdp=1, ep=ep, sp=sp, tp=tp)
+
+
+def build_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the Mesh. Uses `mesh_utils.create_device_mesh` when the whole
+    process's device set is used (it knows TPU torus topology); falls back
+    to a plain reshape for explicit device subsets."""
+    if devices is None:
+        devices = jax.devices()
+    config = config.resolve(len(devices))
+    shape = tuple(config.shape()[a] for a in AXIS_ORDER)
+    try:
+        from jax.experimental import mesh_utils
+
+        if len(devices) == len(jax.devices()):
+            arr = mesh_utils.create_device_mesh(shape, devices=list(devices))
+        else:
+            arr = np.array(list(devices)).reshape(shape)
+    except Exception:
+        arr = np.array(list(devices)).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def remesh(config: MeshConfig, n_devices: int) -> MeshConfig:
+    """Re-fit a mesh config after an elastic membership change.
+
+    Model axes (tp/sp/ep) are preserved — they are baked into checkpoint
+    layouts and kernel choices. The data axes absorb the new world size,
+    keeping the fsdp:dp preference of the original config. Raises if the
+    new world cannot host the model axes at all (caller then falls back to
+    a smaller tp/sp — a *resharding* restore, reference-equivalent of
+    storage restore on world change, SURVEY.md §7 'hard parts')."""
+    model = config.tp * config.sp * config.ep
+    if n_devices % model:
+        raise ValueError(
+            f"cannot remesh: {n_devices} devices vs model axes {model}"
+        )
+    data = n_devices // model
+    if config.fsdp > 1 and config.dp > 1:
+        # keep fsdp fixed if possible, scale dp
+        if data % config.fsdp == 0:
+            return dataclasses.replace(
+                config, dp=data // config.fsdp
+            )
+        # else collapse to fsdp-only
+        return dataclasses.replace(config, dp=1, fsdp=data)
+    if config.fsdp > 1 or (config.dp == 1 and config.fsdp == 1):
+        return dataclasses.replace(config, dp=1, fsdp=data)
+    return dataclasses.replace(config, dp=data, fsdp=1)
+
+
+def largest_power_of_two_leq(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n > 0 else 0
+
+
+def validate_divisibility(config: MeshConfig, *, n_heads: int,
+                          n_kv_heads: int, seq_len: int, vocab: int) -> None:
+    """Fail fast (before tracing) on shape/mesh mismatches."""
+    if n_heads % config.tp:
+        raise ValueError(f"n_heads={n_heads} not divisible by tp={config.tp}")
+    if n_kv_heads % config.tp:
+        raise ValueError(
+            f"n_kv_heads={n_kv_heads} not divisible by tp={config.tp} "
+            "(kv-head replication across tp is not supported)"
+        )
+    if seq_len % max(config.sp, 1):
+        raise ValueError(f"seq_len={seq_len} not divisible by sp={config.sp}")
+    if vocab % max(config.tp, 1):
+        raise ValueError(f"vocab={vocab} not divisible by tp={config.tp}")

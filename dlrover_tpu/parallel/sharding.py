@@ -1,0 +1,95 @@
+"""Sharding helpers: PartitionSpec pytrees → NamedShardings → device arrays.
+
+Models in this framework publish a ``param_specs(config)`` pytree of
+`PartitionSpec` mirroring their parameter pytree (see
+`dlrover_tpu/models/llama.py`). These helpers turn those into
+`NamedSharding`s on a mesh and move/constrain pytrees accordingly.
+
+The reference has no analogue — parameter placement there belongs to
+torch DDP/FSDP/Megatron (SURVEY.md §2.8). Here placement is explicit and
+mesh-driven, which is also what makes elastic *resharded* restore possible:
+the checkpoint stores the logical pytree; on resume we place it onto
+whatever mesh the new world supports.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.parallel.mesh import BATCH_AXES, SP
+
+PyTree = Any
+
+
+def named_shardings(mesh: Mesh, specs: PyTree) -> PyTree:
+    """PartitionSpec pytree → NamedSharding pytree on ``mesh``."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_spec(shard_sequence: bool = False) -> P:
+    """Sharding for a (batch, seq, ...) input batch: batch dim over all
+    data axes; sequence dim over sp when sequence parallelism is on."""
+    if shard_sequence:
+        return P(BATCH_AXES, SP)
+    return P(BATCH_AXES)
+
+
+def shard_pytree(mesh: Mesh, specs: PyTree, tree: PyTree) -> PyTree:
+    """Place ``tree`` onto ``mesh`` per ``specs`` (host → device)."""
+    sh = named_shardings(mesh, specs)
+    return jax.device_put(tree, sh)
+
+
+def with_constraints(tree: PyTree, specs: PyTree) -> PyTree:
+    """Apply `lax.with_sharding_constraint` leaf-wise inside jit."""
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, s), tree, specs
+    )
+
+
+def pad_batch_to(batch: PyTree, multiple: int) -> PyTree:
+    """Pad the leading dim of every leaf up to ``multiple`` (elastic worlds
+    can leave batch % data_axes != 0 right after a resize)."""
+    import jax.numpy as jnp
+
+    def _pad(x):
+        b = x.shape[0]
+        rem = (-b) % multiple
+        if rem == 0:
+            return x
+        pad = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, pad)
+
+    return jax.tree.map(_pad, batch)
+
+
+def spec_for_resize(
+    spec: P, mesh: Mesh, shape: tuple, *, keep: Optional[set] = None
+) -> P:
+    """Drop mesh axes from a spec that no longer divide the array shape —
+    used when restoring a checkpoint onto a smaller/odd-shaped mesh."""
+    keep = keep or set(mesh.axis_names)
+    out = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        axes = tuple(
+            a for a in axes
+            if a in keep and shape[dim] % mesh.shape[a] == 0
+        )
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    return P(*out)

@@ -1,0 +1,187 @@
+"""ElasticTrainer: sharded train step with elastic gradient accumulation.
+
+Parity target: the reference's `ElasticTrainer`
+(`dlrover/trainer/torch/elastic/trainer.py:181-336` there) keeps the
+*global* batch size fixed as the world grows/shrinks by re-deriving the
+gradient-accumulation count and stepping the optimizer only at sync
+boundaries. TPU-native version:
+
+- the "world" is the mesh; accumulation count =
+  ``global_batch // (micro_batch * data_parallel_size)`` re-derived on each
+  re-mesh (`ElasticTrainer.accum_steps`);
+- accumulation is a `lax.scan` over microbatches *inside one jitted step*
+  (no eager loop, no grad hooks) — gradients live in one sharded f32
+  accumulator, XLA overlaps the dp/fsdp reduce with backward compute;
+- optimizer is optax (adamw + cosine), optimizer state sharded like the
+  params (ZeRO by construction — optimizer state inherits the fsdp specs);
+- the step reports to the master's SpeedMonitor via the worker context
+  (`report_step`), which feeds goodput accounting and autoscaling exactly
+  like the reference's `report_global_step` path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.parallel.mesh import MeshConfig
+from dlrover_tpu.parallel.sharding import batch_spec
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    global_batch_size: int = 32
+    micro_batch_size: int = 4          # per data-parallel shard
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10000
+    weight_decay: float = 0.1
+    grad_clip: float = 1.0
+    b1: float = 0.9
+    b2: float = 0.95
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    if tc.warmup_steps > 0:
+        sched = optax.warmup_cosine_decay_schedule(
+            0.0, tc.learning_rate, tc.warmup_steps,
+            max(tc.total_steps, tc.warmup_steps + 1), tc.learning_rate * 0.1,
+        )
+    else:
+        sched = optax.cosine_decay_schedule(
+            tc.learning_rate, max(tc.total_steps, 1), 0.1
+        )
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(sched, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay),
+    )
+
+
+class ElasticTrainer:
+    """Builds and owns the jitted, sharded train step."""
+
+    def __init__(
+        self,
+        loss_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+        p_specs: PyTree,
+        mesh: Mesh,
+        mesh_config: MeshConfig,
+        train_config: TrainConfig,
+        worker_ctx=None,
+    ):
+        self.loss_fn = loss_fn
+        self.p_specs = p_specs
+        self.mesh = mesh
+        self.mesh_config = mesh_config
+        self.tc = train_config
+        self.optimizer = make_optimizer(train_config)
+        self.worker_ctx = worker_ctx
+        self._step_fn = None
+        self._host_step = 0
+
+    # ---- elastic global-batch math (reference trainer.py:307-327) ------
+    @property
+    def accum_steps(self) -> int:
+        dp = self.mesh_config.resolve(self.mesh.size).data_parallel_size
+        denom = self.tc.micro_batch_size * dp
+        if self.tc.global_batch_size % denom:
+            raise ValueError(
+                f"global_batch={self.tc.global_batch_size} not divisible by "
+                f"micro_batch*dp={denom}"
+            )
+        return self.tc.global_batch_size // denom
+
+    @property
+    def step_batch_shape(self) -> Tuple[int, int]:
+        """(accum_steps, global_batch_per_accum) — how callers should shape
+        the token batch fed to `step`."""
+        dp = self.mesh_config.resolve(self.mesh.size).data_parallel_size
+        return self.accum_steps, self.tc.micro_batch_size * dp
+
+    def init_state(self, params: PyTree) -> dict:
+        # jit so adam's mu/nu are born with the params' shardings (XLA
+        # propagates input shardings — optimizer state is ZeRO-sharded for
+        # free whenever params carry fsdp specs).
+        opt_state = jax.jit(self.optimizer.init)(params)
+        return {
+            "params": params,
+            "opt": opt_state,
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def _build_step(self):
+        accum = self.accum_steps
+        bspec = batch_spec()
+
+        def step(state, batch):
+            # batch: (accum, micro*dp, seq) int32
+            def micro_grads(carry, micro):
+                loss_sum, grads = carry
+                loss, g = jax.value_and_grad(self.loss_fn)(
+                    state["params"], micro
+                )
+                grads = jax.tree.map(jnp.add, grads, g)
+                return (loss_sum + loss, grads), None
+
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+            )
+            (loss_sum, grads), _ = jax.lax.scan(
+                micro_grads, (jnp.zeros((), jnp.float32), zero), batch
+            )
+            scale = 1.0 / accum
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            updates, opt_state = self.optimizer.update(
+                grads, state["opt"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+            return {
+                "params": params,
+                "opt": opt_state,
+                "step": state["step"] + 1,
+            }, loss_sum * scale
+
+        # state keeps the shardings its arrays already carry (params placed
+        # by the caller, opt state born sharded in init_state).
+        batch_sh = NamedSharding(self.mesh, P(None, *bspec))
+        return jax.jit(
+            step,
+            in_shardings=(None, batch_sh),
+            donate_argnums=(0,),
+        )
+
+    def step(self, state: dict, batch) -> Tuple[dict, jnp.ndarray]:
+        """One optimizer step = ``accum_steps`` microbatches.
+
+        ``batch``: int32 tokens shaped (accum_steps, micro*dp, seq)."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        new_state, loss = self._step_fn(state, batch)
+        # host-side step counter: reading new_state["step"] would block on
+        # the just-dispatched computation and kill async dispatch
+        self._host_step += 1
+        if self.worker_ctx is not None:
+            self.worker_ctx.report_step(self._host_step)
+        return new_state, loss
+
+    # ---- elasticity ----------------------------------------------------
+    def remesh(self, mesh: Mesh, mesh_config: MeshConfig):
+        """After a membership change: adopt the new mesh; the jitted step is
+        rebuilt (recompiled) lazily; accumulation re-derives so the global
+        batch is unchanged (the reference's core elasticity invariant)."""
+        old = self.accum_steps
+        self.mesh = mesh
+        self.mesh_config = mesh_config
+        self._step_fn = None
+        logger.info(
+            "remesh: world=%d accum %d→%d (global batch fixed at %d)",
+            mesh.size, old, self.accum_steps, self.tc.global_batch_size,
+        )

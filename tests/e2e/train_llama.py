@@ -1,0 +1,62 @@
+"""E2e script: tiny-Llama + ElasticTrainer + flash checkpoint under the
+elastic agent. Exercises the new compute path (sharded mesh, attention,
+optax step, ckpt save/restore) inside the real launch stack."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import dlrover_tpu.train as dtrain
+
+ctx = dtrain.init(local_device_count=4)
+
+import jax
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+CKPT_DIR = os.environ["DLROVER_TPU_TEST_CKPT_DIR"]
+N_STEPS = int(os.environ.get("DLROVER_TPU_TEST_STEPS", "4"))
+
+cfg = llama.LlamaConfig.tiny()
+mc = MeshConfig(dp=2, fsdp=1, sp=1, tp=2).resolve(len(jax.devices()))
+mesh = build_mesh(mc)
+specs = llama.param_specs(cfg)
+params = jax.jit(
+    lambda k: llama.init_params(cfg, k),
+    out_shardings=named_shardings(mesh, specs),
+)(jax.random.key(0))
+
+tc = TrainConfig(global_batch_size=8, micro_batch_size=2, warmup_steps=0,
+                 total_steps=N_STEPS, learning_rate=1e-2)
+trainer = ElasticTrainer(
+    lambda p, t: llama.loss_fn(p, t, cfg, mesh), specs, mesh, mc, tc,
+    worker_ctx=ctx,
+)
+state = trainer.init_state(params)
+
+ckpt = Checkpointer(CKPT_DIR)
+restored = ckpt.load(target=state)
+start = 0
+if restored is not None:
+    start, state = restored
+    print(f"restored from step {start}", flush=True)
+
+a, b = trainer.step_batch_shape
+for step in range(start, N_STEPS):
+    batch = jax.random.randint(
+        jax.random.fold_in(jax.random.key(7), step), (a, b, 16), 0,
+        cfg.vocab_size,
+    )
+    state, loss = trainer.step(state, batch)
+    print(f"step {step + 1} loss {float(loss):.4f}", flush=True)
+    ckpt.save(step + 1, state)
+
+ckpt.close()
+print("LLAMA_E2E_DONE", flush=True)

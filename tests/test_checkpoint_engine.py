@@ -181,3 +181,40 @@ def test_train_state_checkpoint(job_env):
         np.asarray(restored["params"]["w"]), np.arange(8.0)
     )
     ckpt.close()
+
+
+def test_storage_roundtrip_bfloat16(tmp_path):
+    """bf16 leaves must survive disk persist + restore (np.save can't
+    round-trip ml_dtypes — the raw-bytes leaf format can)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+
+    state = {
+        "w": jnp.arange(16, dtype=jnp.bfloat16).reshape(4, 4) / 7,
+        "b": jnp.ones((3,), jnp.float32),
+    }
+    eng = CheckpointEngine(str(tmp_path), job_name="bf16rt", node_id=91,
+                           process_id=0)
+    try:
+        eng.save_to_storage(5, state)
+        # wipe shm so the load exercises the storage path
+        eng._shm.close(unlink=True)
+        eng2 = CheckpointEngine(str(tmp_path), job_name="bf16rt-other",
+                                node_id=92, process_id=0)
+        try:
+            step, restored = eng2.load()
+            assert step == 5
+            assert restored["w"].dtype == jnp.bfloat16
+            import numpy as np
+
+            np.testing.assert_array_equal(
+                np.asarray(restored["w"], dtype=np.float32),
+                np.asarray(state["w"], dtype=np.float32),
+            )
+        finally:
+            eng2._shm.close(unlink=True)
+            eng2.close()
+    finally:
+        eng.close()
