@@ -1,0 +1,106 @@
+"""Agent-side diagnosis: observe worker health, decide restart vs relaunch.
+
+Parity: reference ``elastic_agent/diagnosis/diagnosis_agent.py:60-302``
+(periodic observe loop + ``diagnose_training_failure``). The agent-side
+decision matters because it is the one place that knows the restart budget
+and sees the worker log before the master does.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.diagnosis.operators import classify_log
+
+
+class WorkerAction:
+    RESTART_WORKER = "restart"  # respawn processes on this host
+    RELAUNCH_WORKER = "relaunch"  # exit; platform replaces this host
+
+
+@dataclass
+class WorkerFailure:
+    node_id: int
+    restart_count: int
+    max_restarts: int
+    exit_code: int = 1
+    log_tail: str = ""
+
+
+class DiagnosisAgent:
+    """Runs inside the elastic agent process on every host."""
+
+    def __init__(self, client=None, node_id: int = -1, interval_secs: float = 60.0):
+        self._client = client
+        self._node_id = node_id
+        self._interval = interval_secs
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._log_source = None  # callable -> str (worker log tail)
+        self._metrics_source = None  # callable -> dict (tpu_timer scrape)
+
+    def set_log_source(self, fn):
+        self._log_source = fn
+
+    def set_metrics_source(self, fn):
+        self._metrics_source = fn
+
+    # -- failure-time decision ---------------------------------------------
+
+    def diagnose_training_failure(self, failure: WorkerFailure) -> str:
+        """Reference semantics (``training.py:1016-1027``): retryable errors
+        restart in place while budget remains; fatal user errors also retry
+        (the log may be incidental) but exhaust the budget faster is not
+        replicated — budget exhaustion or hardware/preemption signatures
+        relaunch the node."""
+        kind = classify_log(failure.log_tail)
+        budget_left = failure.restart_count < failure.max_restarts
+        if kind == "hardware":
+            logger.warning(
+                "node %s: hardware/preemption failure -> relaunch",
+                failure.node_id,
+            )
+            return WorkerAction.RELAUNCH_WORKER
+        # retryable, fatal or unclassified: restart while budget lasts
+        # (transient corruption is common), then hand back to the platform
+        if budget_left:
+            return WorkerAction.RESTART_WORKER
+        return WorkerAction.RELAUNCH_WORKER
+
+    # -- periodic observation ----------------------------------------------
+
+    def start(self):
+        if self._client is None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._observe_loop, name="diagnosis-agent", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def _observe_loop(self):
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self.report_once()
+            except Exception as e:
+                logger.warning("diagnosis report failed: %s", e)
+
+    def report_once(self):
+        if self._log_source is not None:
+            tail = self._log_source()
+            if tail:
+                self._client.report_diagnosis_data("TrainingLogRecord", tail)
+        if self._metrics_source is not None:
+            metrics = self._metrics_source()
+            if metrics:
+                import json
+
+                self._client.report_diagnosis_data(
+                    "TpuMetricsRecord", json.dumps(metrics)
+                )

@@ -20,6 +20,11 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from dlrover_tpu.agent.config import ElasticLaunchConfig
+from dlrover_tpu.agent.diagnosis_agent import (
+    DiagnosisAgent,
+    WorkerAction,
+    WorkerFailure,
+)
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.agent.rendezvous import (
     CommWorld,
@@ -73,6 +78,10 @@ class ElasticAgent:
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._current_world: Optional[CommWorld] = None
         self._ckpt_saver = None  # wired by the flash-checkpoint layer
+        self._diagnosis = DiagnosisAgent(
+            client=self._client, node_id=config.node_id
+        )
+        self._diagnosis.set_log_source(self._last_worker_log_tail)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -85,10 +94,12 @@ class ElasticAgent:
         self._start_ckpt_saver()
         self._start_heartbeats()
         self._install_signal_handlers()
+        self._diagnosis.start()
         try:
             return self._invoke_run()
         finally:
             self._stop_evt.set()
+            self._diagnosis.stop()
             self._stop_workers()
             if self._ckpt_saver is not None:
                 self._ckpt_saver.stop()
@@ -138,13 +149,23 @@ class ElasticAgent:
                 self._save_checkpoint_at_breakpoint()
                 self._stop_workers()
                 continue
-            # FAILED
+            # FAILED: the diagnostician decides restart-in-place vs handing
+            # the node back to the platform (reference training.py:1016-1027)
             self._save_checkpoint_at_breakpoint()
             self._stop_workers()
             self._client.report_failure(
                 err, self._restart_count, TrainingExceptionLevel.ERROR, exit_code
             )
-            if self._restart_count < self._config.max_restarts:
+            action = self._diagnosis.diagnose_training_failure(
+                WorkerFailure(
+                    node_id=self._config.node_id,
+                    restart_count=self._restart_count,
+                    max_restarts=self._config.max_restarts,
+                    exit_code=exit_code,
+                    log_tail=err,
+                )
+            )
+            if action == WorkerAction.RESTART_WORKER:
                 self._restart_count += 1
                 logger.warning(
                     "node %s: worker failed (exit=%s); restart %s/%s",
@@ -155,7 +176,9 @@ class ElasticAgent:
                 )
                 continue
             logger.error(
-                "node %s: restart budget exhausted; exiting", self._config.node_id
+                "node %s: diagnosis says relaunch (exit=%s); exiting",
+                self._config.node_id,
+                exit_code,
             )
             return exit_code or 1
         return 0
@@ -259,6 +282,17 @@ class ElasticAgent:
                     pass
                 w.proc.wait()
         self._workers = []
+
+    def _last_worker_log_tail(self, max_bytes: int = 4096) -> str:
+        """Concatenated log tails across all local workers (any process on
+        this host may carry the failure signature)."""
+        workers = list(self._workers)
+        if not workers:
+            return ""
+        per = max(512, max_bytes // len(workers))
+        return "\n".join(
+            t for t in (self._tail_log(w.log_path, per) for w in workers) if t
+        )
 
     def _tail_log(self, path: str, max_bytes: int = 4096) -> str:
         try:

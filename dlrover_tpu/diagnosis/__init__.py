@@ -1,0 +1,37 @@
+"""Diagnosis: inference-chain reasoning over runtime observations.
+
+Parity target: reference ``dlrover/python/diagnosis/`` (inference chain,
+observers/resolvers, actions, data records).
+"""
+
+from dlrover_tpu.diagnosis import actions
+from dlrover_tpu.diagnosis.data import (
+    DiagnosisData,
+    DiagnosisDataManager,
+    DiagnosisDataType,
+    TpuMetricsRecord,
+    TrainingLogRecord,
+)
+from dlrover_tpu.diagnosis.inference import (
+    Inference,
+    InferenceAttribute,
+    InferenceChain,
+    InferenceDescription,
+    InferenceName,
+    InferenceOperator,
+)
+
+__all__ = [
+    "actions",
+    "DiagnosisData",
+    "DiagnosisDataManager",
+    "DiagnosisDataType",
+    "TpuMetricsRecord",
+    "TrainingLogRecord",
+    "Inference",
+    "InferenceAttribute",
+    "InferenceChain",
+    "InferenceDescription",
+    "InferenceName",
+    "InferenceOperator",
+]

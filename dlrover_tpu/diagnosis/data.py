@@ -1,0 +1,169 @@
+"""Diagnosis data: what the master/agent reason over.
+
+Parity: reference ``dlrover/python/diagnosis/common/diagnosis_data.py``
+(DiagnosisData / TrainingLog / XPUTimerMetric) re-cast for TPU jobs: the
+profiler metrics come from the native ``tpu_timer`` interposer (per-program
+execute latency, hang flags) instead of CUDA-kernel hooks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Type
+
+
+class DiagnosisDataType:
+    GENERIC = "generic"
+    TRAINING_LOG = "training_log"
+    TPU_METRICS = "tpu_metrics"
+    RESOURCE_USAGE = "resource_usage"
+
+
+class DiagnosisData:
+    """One observation shipped agent->master (or collected in-master)."""
+
+    def __init__(
+        self,
+        data_type: str = DiagnosisDataType.GENERIC,
+        data_content: str = "",
+        node_id: int = -1,
+        node_type: str = "",
+        node_rank: int = -1,
+        timestamp: float = 0.0,
+    ):
+        self.data_type = data_type
+        self.data_content = data_content
+        self.node_id = node_id
+        self.node_type = node_type
+        self.node_rank = node_rank
+        self.timestamp = timestamp or time.time()
+
+    def to_json(self) -> str:
+        return json.dumps(self.__dict__)
+
+    @classmethod
+    def from_json(cls, text: str) -> "DiagnosisData":
+        data = cls()
+        try:
+            data.__dict__.update(json.loads(text))
+        except (ValueError, TypeError):
+            data.data_content = text
+        return data
+
+
+class TrainingLogRecord(DiagnosisData):
+    """Tail of a worker's log, scanned for failure signatures."""
+
+    def __init__(self, logs: Optional[List[str]] = None, **kw):
+        kw.setdefault("data_type", DiagnosisDataType.TRAINING_LOG)
+        super().__init__(**kw)
+        if logs is not None:
+            self.data_content = "\n".join(logs)
+
+    @property
+    def logs(self) -> List[str]:
+        return self.data_content.splitlines()
+
+
+class TpuMetricsRecord(DiagnosisData):
+    """Metrics scraped from the native tpu_timer profiler on one host.
+
+    ``hang`` means the profiler saw no program completion within its
+    timeout window (reference analogue: xpu_timer hang flag).
+    """
+
+    def __init__(
+        self,
+        hang: bool = False,
+        step_latency_ms: float = 0.0,
+        device_duty_cycle: float = 0.0,
+        **kw,
+    ):
+        kw.setdefault("data_type", DiagnosisDataType.TPU_METRICS)
+        super().__init__(**kw)
+        self.hang = hang
+        self.step_latency_ms = step_latency_ms
+        self.device_duty_cycle = device_duty_cycle
+        if not self.data_content:
+            self.data_content = json.dumps(
+                {
+                    "hang": hang,
+                    "step_latency_ms": step_latency_ms,
+                    "device_duty_cycle": device_duty_cycle,
+                }
+            )
+
+    @classmethod
+    def from_json(cls, text: str) -> "TpuMetricsRecord":
+        rec = cls()
+        try:
+            payload = json.loads(text)
+        except (ValueError, TypeError):
+            return rec
+        if isinstance(payload, dict):
+            for k, v in payload.items():
+                setattr(rec, k, v)
+            content = payload.get("data_content")
+            if isinstance(content, str) and content:
+                try:
+                    inner = json.loads(content)
+                    rec.hang = bool(inner.get("hang", rec.hang))
+                    rec.step_latency_ms = inner.get(
+                        "step_latency_ms", rec.step_latency_ms
+                    )
+                    rec.device_duty_cycle = inner.get(
+                        "device_duty_cycle", rec.device_duty_cycle
+                    )
+                except (ValueError, TypeError):
+                    pass
+        return rec
+
+
+_DATA_CLASSES: Dict[str, Type[DiagnosisData]] = {
+    "DiagnosisData": DiagnosisData,
+    "TrainingLogRecord": TrainingLogRecord,
+    "TpuMetricsRecord": TpuMetricsRecord,
+}
+
+
+def parse_report(data_cls: str, content: str, **kw) -> DiagnosisData:
+    """Decode a DiagnosisReportData message into a typed record."""
+    cls = _DATA_CLASSES.get(data_cls, DiagnosisData)
+    rec = cls.from_json(content)
+    for key, value in kw.items():
+        if value not in ("", -1, None):
+            setattr(rec, key, value)
+    return rec
+
+
+class DiagnosisDataManager:
+    """Sliding-window store of observations (reference: DiagnosisDataManager)."""
+
+    def __init__(self, expire_time_secs: float = 600.0, max_records: int = 512):
+        self._expire = expire_time_secs
+        self._max_records = max_records
+        self._data: Dict[str, List[DiagnosisData]] = {}
+        self._lock = threading.Lock()
+
+    def store_data(self, record: DiagnosisData):
+        with self._lock:
+            q = self._data.setdefault(record.data_type, [])
+            q.append(record)
+            cutoff = time.time() - self._expire
+            while q and (q[0].timestamp < cutoff or len(q) > self._max_records):
+                q.pop(0)
+
+    def get_data(self, data_type: str) -> List[DiagnosisData]:
+        cutoff = time.time() - self._expire
+        with self._lock:
+            return [r for r in self._data.get(data_type, []) if r.timestamp >= cutoff]
+
+    def latest_per_node(self, data_type: str) -> Dict[int, DiagnosisData]:
+        out: Dict[int, DiagnosisData] = {}
+        for rec in self.get_data(data_type):
+            cur = out.get(rec.node_id)
+            if cur is None or rec.timestamp >= cur.timestamp:
+                out[rec.node_id] = rec
+        return out

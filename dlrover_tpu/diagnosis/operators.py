@@ -1,0 +1,176 @@
+"""Concrete inference operators: hang check, failure-node check, resolvers.
+
+Parity: reference ``diagnosis/inferencechain/inferenceoperator/{observer,
+resolver}/*.py`` — CheckTrainingHangOperator (xpu-timer metrics),
+CheckFailureNodeOperator (log scan), and the resolution operators that turn
+confirmed problems into follow-up facts carrying actions.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import List, Optional
+
+from dlrover_tpu.diagnosis.data import (
+    DiagnosisDataManager,
+    DiagnosisDataType,
+    TpuMetricsRecord,
+)
+from dlrover_tpu.diagnosis.inference import (
+    Inference,
+    InferenceAttribute,
+    InferenceDescription,
+    InferenceName,
+    InferenceOperator,
+)
+
+#: the "is the training hanging?" problem the master periodically poses
+HANG_PROBLEM = Inference(
+    InferenceName.TRAINING, InferenceAttribute.ISORNOT, InferenceDescription.HANG
+)
+#: the "did a node fail?" problem
+FAILURE_PROBLEM = Inference(
+    InferenceName.NODE, InferenceAttribute.ISORNOT, InferenceDescription.FAILURE
+)
+
+# Failure signatures scanned from worker logs (TPU/JAX flavored).
+FATAL_PATTERNS = (
+    r"Traceback \(most recent call last\)",
+    r"FATAL|Fatal Python error",
+    r"XlaRuntimeError",
+)
+RETRYABLE_PATTERNS = (
+    r"RESOURCE_EXHAUSTED|out of memory|OOM",
+    r"UNAVAILABLE|DEADLINE_EXCEEDED",
+    r"coordination service|heartbeat",
+)
+HARDWARE_PATTERNS = (
+    r"preempt|SIGTERM",
+    r"ici link|chip failure|DATA_LOSS|hbm (ecc|parity|uncorrectable)",
+)
+
+
+class CheckTrainingHangOperator(InferenceOperator):
+    """Hang iff every reporting node's latest tpu_timer metrics say hang,
+    and the fleet has been silent for `silence_secs` of step reports."""
+
+    def __init__(self, data_manager: DiagnosisDataManager, speed_monitor=None,
+                 silence_secs: float = 300.0):
+        super().__init__(data_manager)
+        self._speed_monitor = speed_monitor
+        self._silence_secs = silence_secs
+
+    def is_compatible(self, inference: Inference) -> bool:
+        return inference == HANG_PROBLEM
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        latest = self._data_manager.latest_per_node(DiagnosisDataType.TPU_METRICS)
+        records = [
+            r for r in latest.values() if isinstance(r, TpuMetricsRecord)
+        ]
+        hang = bool(records) and all(r.hang for r in records)
+        if hang and self._speed_monitor is not None:
+            # corroborate with step-report silence
+            sm = self._speed_monitor
+            last_sample = getattr(sm, "_samples", None)
+            if sm.completed_global_step > 0 and last_sample:
+                silent = time.time() - last_sample[-1].timestamp
+                hang = silent >= self._silence_secs
+        attr = InferenceAttribute.IS if hang else InferenceAttribute.NOT
+        return [Inference(InferenceName.TRAINING, attr, InferenceDescription.HANG)]
+
+
+class CheckFailureNodeOperator(InferenceOperator):
+    """Scan reported training logs for failure signatures per node."""
+
+    def is_compatible(self, inference: Inference) -> bool:
+        return inference == FAILURE_PROBLEM
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        out: List[Inference] = []
+        for node_id, rec in self._data_manager.latest_per_node(
+            DiagnosisDataType.TRAINING_LOG
+        ).items():
+            kind = classify_log(rec.data_content)
+            if kind is None:
+                continue
+            out.append(
+                Inference(
+                    InferenceName.NODE,
+                    InferenceAttribute.IS,
+                    InferenceDescription.FAILURE,
+                ).with_config(node_id=node_id, kind=kind)
+            )
+        if not out:
+            out.append(
+                Inference(
+                    InferenceName.NODE,
+                    InferenceAttribute.NOT,
+                    InferenceDescription.FAILURE,
+                )
+            )
+        return out
+
+
+def classify_log(text: str) -> Optional[str]:
+    """'hardware' | 'retryable' | 'fatal' | None from a worker log tail.
+
+    hardware/preemption signatures win (the node must be replaced), then
+    transient retryables, then generic fatal tracebacks.
+    """
+    if not text:
+        return None
+    for pat in HARDWARE_PATTERNS:
+        if re.search(pat, text, re.IGNORECASE):
+            return "hardware"
+    for pat in RETRYABLE_PATTERNS:
+        if re.search(pat, text, re.IGNORECASE):
+            return "retryable"
+    for pat in FATAL_PATTERNS:
+        if re.search(pat, text):
+            return "fatal"
+    return None
+
+
+class ResolveTrainingHangOperator(InferenceOperator):
+    """Confirmed hang -> action fact (restart all workers to break it)."""
+
+    def is_compatible(self, inference: Inference) -> bool:
+        return inference == Inference(
+            InferenceName.TRAINING, InferenceAttribute.IS, InferenceDescription.HANG
+        )
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        return [
+            Inference(
+                InferenceName.ACTION, InferenceAttribute.IS, "restart_all"
+            ).with_config(reason="training_hang")
+        ]
+
+
+class ResolveFailureNodeOperator(InferenceOperator):
+    """Confirmed node failure -> restart (retryable) or relaunch (fatal on
+    repeated restarts is decided by the agent's restart budget; hardware or
+    preemption kinds relaunch immediately)."""
+
+    def is_compatible(self, inference: Inference) -> bool:
+        return (
+            inference.name == InferenceName.NODE
+            and inference.attribution == InferenceAttribute.IS
+            and inference.description == InferenceDescription.FAILURE
+        )
+
+    def infer(self, inferences: List[Inference]) -> List[Inference]:
+        out = []
+        for inf in inferences:
+            cfg = inf.config()
+            # hardware/preemption: the host is suspect -> replace it;
+            # everything else restarts in place (agent budget governs)
+            action = "relaunch" if cfg.get("kind") == "hardware" else "restart"
+            out.append(
+                Inference(
+                    InferenceName.ACTION, InferenceAttribute.IS, action
+                ).with_config(**cfg)
+            )
+        return out

@@ -1,0 +1,3 @@
+from dlrover_tpu.master.diagnosis.manager import DiagnosisManager
+
+__all__ = ["DiagnosisManager"]

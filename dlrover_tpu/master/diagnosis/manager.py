@@ -1,0 +1,126 @@
+"""Master-side diagnosis: collect observations, run the chain, emit actions.
+
+Parity: reference ``master/diagnosis/diagnosis_manager.py:39-108``
+(DiagnosisManager.start_observing / _diagnose) + DiagnosisDataManager.
+Actions land in the JobContext action queue and ride back to agents on
+heartbeat responses (``servicer._report_heartbeat``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.diagnosis import actions
+from dlrover_tpu.diagnosis.data import DiagnosisDataManager, parse_report
+from dlrover_tpu.diagnosis.inference import (
+    Inference,
+    InferenceAttribute,
+    InferenceChain,
+    InferenceName,
+)
+from dlrover_tpu.diagnosis.operators import (
+    HANG_PROBLEM,
+    FAILURE_PROBLEM,
+    CheckFailureNodeOperator,
+    CheckTrainingHangOperator,
+    ResolveFailureNodeOperator,
+    ResolveTrainingHangOperator,
+)
+from dlrover_tpu.master.node.job_context import get_job_context
+
+
+class DiagnosisManager:
+    def __init__(
+        self,
+        speed_monitor=None,
+        interval_secs: float = 60.0,
+        data_expire_secs: float = 600.0,
+    ):
+        self._job_context = get_job_context()
+        self._data_manager = DiagnosisDataManager(data_expire_secs)
+        self._speed_monitor = speed_monitor
+        self._interval = interval_secs
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._operators = [
+            CheckTrainingHangOperator(self._data_manager, speed_monitor),
+            CheckFailureNodeOperator(self._data_manager),
+            ResolveTrainingHangOperator(self._data_manager),
+            ResolveFailureNodeOperator(self._data_manager),
+        ]
+
+    @property
+    def data_manager(self) -> DiagnosisDataManager:
+        return self._data_manager
+
+    # -- ingestion (called by the servicer) --------------------------------
+
+    def collect_diagnosis_data(self, report: msg.DiagnosisReportData):
+        rec = parse_report(
+            report.data_cls,
+            report.data_content,
+            node_id=report.node_id,
+            node_type=report.node_type,
+            node_rank=report.node_rank,
+        )
+        self._data_manager.store_data(rec)
+
+    # -- pre-check hook -----------------------------------------------------
+
+    def pre_check(self) -> str:
+        """Hook run before training starts (reference: pre-check). The
+        TPU build gates on the network-check rendezvous instead; always
+        passes here unless a subclass overrides."""
+        return "pass"
+
+    # -- periodic observe+resolve ------------------------------------------
+
+    def start_observing(self):
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._observe_loop, name="diagnosis-manager", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def _observe_loop(self):
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self.diagnose_once()
+            except Exception:
+                logger.exception("diagnosis cycle failed")
+
+    def diagnose_once(self) -> List[Inference]:
+        """One observe+resolve cycle; returns terminal facts (for tests)."""
+        chain = InferenceChain([HANG_PROBLEM, FAILURE_PROBLEM], self._operators)
+        facts = chain.infer()
+        for fact in facts:
+            self._act_on(fact)
+        return facts
+
+    def _act_on(self, fact: Inference):
+        if fact.name != InferenceName.ACTION or fact.attribution != InferenceAttribute.IS:
+            return
+        cfg = fact.config()
+        if fact.description == "restart_all":
+            for node in self._job_context.workers().values():
+                self._job_context.enqueue_action(
+                    actions.restart_worker(node.id, reason=cfg.get("reason", "hang"))
+                )
+            logger.warning("diagnosis: training hang -> restart all workers")
+        elif fact.description == "restart":
+            node_id = int(cfg.get("node_id", -1))
+            self._job_context.enqueue_action(
+                actions.restart_worker(node_id, reason=cfg.get("kind", ""))
+            )
+        elif fact.description == "relaunch":
+            node_id = int(cfg.get("node_id", -1))
+            self._job_context.enqueue_action(
+                actions.relaunch_worker(node_id, reason=cfg.get("kind", ""))
+            )
+            logger.warning("diagnosis: node %s -> relaunch", node_id)

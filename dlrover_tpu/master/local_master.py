@@ -54,7 +54,11 @@ class LocalJobMaster:
             )
         self.kv_store = KVStoreService()
         self.sync_service = SyncService(get_job_context())
-        self.diagnosis_manager = None
+        from dlrover_tpu.master.diagnosis.manager import DiagnosisManager
+
+        self.diagnosis_manager = DiagnosisManager(
+            speed_monitor=self.speed_monitor
+        )
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -74,6 +78,7 @@ class LocalJobMaster:
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
+        self.diagnosis_manager.start_observing()
         logger.info("local master serving on port %s", self.port)
 
     def run(self, poll_interval: float = 1.0) -> int:
@@ -101,6 +106,8 @@ class LocalJobMaster:
     def stop(self):
         self.task_manager.stop()
         self.job_manager.stop()
+        if self.diagnosis_manager is not None:
+            self.diagnosis_manager.stop()
         self._server.stop(grace=1)
 
 

@@ -1,0 +1,181 @@
+"""Diagnosis subsystem: inference chain, operators, manager, agent decision.
+
+Mirrors the reference's canned-data approach
+(``python/tests/test_inference_chain.py``, ``test_diagnosis_agent.py``).
+"""
+
+import json
+import time
+
+from dlrover_tpu.agent.diagnosis_agent import (
+    DiagnosisAgent,
+    WorkerAction,
+    WorkerFailure,
+)
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.diagnosis import actions
+from dlrover_tpu.diagnosis.data import (
+    DiagnosisDataManager,
+    DiagnosisDataType,
+    TpuMetricsRecord,
+    TrainingLogRecord,
+    parse_report,
+)
+from dlrover_tpu.diagnosis.inference import (
+    Inference,
+    InferenceAttribute,
+    InferenceChain,
+    InferenceDescription,
+    InferenceName,
+)
+from dlrover_tpu.diagnosis.operators import (
+    FAILURE_PROBLEM,
+    HANG_PROBLEM,
+    CheckFailureNodeOperator,
+    CheckTrainingHangOperator,
+    ResolveFailureNodeOperator,
+    ResolveTrainingHangOperator,
+    classify_log,
+)
+from dlrover_tpu.master.diagnosis.manager import DiagnosisManager
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+
+
+def make_manager():
+    JobContext.reset_singleton()
+    return DiagnosisManager(interval_secs=3600)
+
+
+def test_classify_log():
+    assert classify_log("") is None
+    assert classify_log("RESOURCE_EXHAUSTED: HBM OOM") == "retryable"
+    assert classify_log("worker preempted, SIGTERM") == "hardware"
+    assert classify_log("hbm ecc error on chip 3") == "hardware"
+    assert (
+        classify_log("Traceback (most recent call last):\n  ValueError") == "fatal"
+    )
+    assert classify_log("all good, step 100 loss 2.3") is None
+
+
+def test_data_manager_window_and_latest():
+    dm = DiagnosisDataManager(expire_time_secs=60)
+    dm.store_data(TrainingLogRecord(node_id=0, logs=["a"]))
+    dm.store_data(TrainingLogRecord(node_id=0, logs=["b"]))
+    dm.store_data(TrainingLogRecord(node_id=1, logs=["c"]))
+    assert len(dm.get_data(DiagnosisDataType.TRAINING_LOG)) == 3
+    latest = dm.latest_per_node(DiagnosisDataType.TRAINING_LOG)
+    assert latest[0].data_content == "b"
+    assert latest[1].data_content == "c"
+    # expiry
+    old = TrainingLogRecord(node_id=2, logs=["old"])
+    old.timestamp = time.time() - 120
+    dm.store_data(old)
+    assert 2 not in dm.latest_per_node(DiagnosisDataType.TRAINING_LOG)
+
+
+def test_hang_operator_confirms_and_denies():
+    dm = DiagnosisDataManager()
+    op = CheckTrainingHangOperator(dm)
+    # no data -> not hang
+    (fact,) = op.infer([HANG_PROBLEM])
+    assert fact.attribution == InferenceAttribute.NOT
+    dm.store_data(TpuMetricsRecord(node_id=0, hang=True))
+    dm.store_data(TpuMetricsRecord(node_id=1, hang=True))
+    (fact,) = op.infer([HANG_PROBLEM])
+    assert fact.attribution == InferenceAttribute.IS
+    # one healthy node vetoes the hang verdict
+    dm.store_data(TpuMetricsRecord(node_id=1, hang=False))
+    (fact,) = op.infer([HANG_PROBLEM])
+    assert fact.attribution == InferenceAttribute.NOT
+
+
+def test_full_chain_failure_to_action():
+    dm = DiagnosisDataManager()
+    dm.store_data(
+        TrainingLogRecord(node_id=3, logs=["XlaRuntimeError: RESOURCE_EXHAUSTED"])
+    )
+    ops = [
+        CheckTrainingHangOperator(dm),
+        CheckFailureNodeOperator(dm),
+        ResolveTrainingHangOperator(dm),
+        ResolveFailureNodeOperator(dm),
+    ]
+    facts = InferenceChain([HANG_PROBLEM, FAILURE_PROBLEM], ops).infer()
+    action_facts = [f for f in facts if f.name == InferenceName.ACTION]
+    assert len(action_facts) == 1
+    assert action_facts[0].description == "restart"
+    assert action_facts[0].config()["node_id"] == "3"
+
+
+def test_manager_enqueues_actions_for_heartbeat():
+    mgr = make_manager()
+    ctx = get_job_context()
+    node = Node(NodeType.WORKER, 5, status=NodeStatus.RUNNING)
+    ctx.update_node(node)
+    mgr.collect_diagnosis_data(
+        msg.DiagnosisReportData(
+            data_cls="TrainingLogRecord",
+            data_content=TrainingLogRecord(node_id=5, logs=["chip failure on host"]).to_json(),
+            node_id=5,
+        )
+    )
+    facts = mgr.diagnose_once()
+    assert any(f.description == "relaunch" for f in facts)
+    action = ctx.next_action(5)
+    assert action is not None
+    assert action.action_cls == actions.ActionCls.RELAUNCH_WORKER
+
+
+def test_manager_hang_restarts_all():
+    mgr = make_manager()
+    ctx = get_job_context()
+    for i in range(2):
+        ctx.update_node(Node(NodeType.WORKER, i, status=NodeStatus.RUNNING))
+        mgr.collect_diagnosis_data(
+            msg.DiagnosisReportData(
+                data_cls="TpuMetricsRecord",
+                data_content=json.dumps({"hang": True}),
+                node_id=i,
+            )
+        )
+    mgr.diagnose_once()
+    for i in range(2):
+        action = ctx.next_action(i)
+        assert action is not None and action.action_cls == actions.ActionCls.RESTART_WORKER
+
+
+def test_parse_report_types():
+    rec = parse_report("TpuMetricsRecord", json.dumps({"hang": True}), node_id=7)
+    assert isinstance(rec, TpuMetricsRecord)
+    assert rec.node_id == 7
+    rec2 = parse_report("Unknown", "free text", node_id=1)
+    assert rec2.data_content == "free text"
+
+
+def test_agent_failure_decision():
+    agent = DiagnosisAgent()
+    # retryable with budget -> restart
+    f = WorkerFailure(0, restart_count=0, max_restarts=3, log_tail="OOM on step")
+    assert agent.diagnose_training_failure(f) == WorkerAction.RESTART_WORKER
+    # hardware signature -> relaunch even with budget
+    f = WorkerFailure(0, 0, 3, log_tail="ICI link down; DATA_LOSS")
+    assert agent.diagnose_training_failure(f) == WorkerAction.RELAUNCH_WORKER
+    # budget exhausted -> relaunch
+    f = WorkerFailure(0, 3, 3, log_tail="Traceback (most recent call last)")
+    assert agent.diagnose_training_failure(f) == WorkerAction.RELAUNCH_WORKER
+    # fatal with budget -> restart (transient corruption retried)
+    f = WorkerFailure(0, 1, 3, log_tail="Traceback (most recent call last)")
+    assert agent.diagnose_training_failure(f) == WorkerAction.RESTART_WORKER
+
+
+def test_action_expiry():
+    ctx = JobContext()
+    a = actions.restart_worker(1, expiry=-5)  # already expired
+    a.expired_ts = time.time() - 1
+    ctx.enqueue_action(a)
+    assert ctx.next_action(1) is None
+    ctx.enqueue_action(actions.restart_worker(1, reason="x"))
+    got = ctx.next_action(1)
+    assert got is not None and got.action_cls == "RestartWorker"
