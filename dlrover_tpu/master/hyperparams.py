@@ -1,0 +1,64 @@
+"""Initial hyperparameter strategy suggestion.
+
+Parity: reference ``master/hyperparams/simple_strategy_generator.py:40``
+(initial DataLoader/optimizer config). TPU-natively the suggestion targets
+the trainer's micro-batch and grad-accum so the MXU stays fed: micro-batch
+is sized from HBM per chip and model bytes, accum fills the global batch,
+and the linear-scaling rule adjusts learning rate with world size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class StrategySuggestion:
+    micro_batch_size: int
+    grad_accum_steps: int
+    learning_rate: float
+    dataloader_workers: int
+
+    def to_paral_config(self) -> Dict:
+        return {
+            "dataloader_batch_size": self.micro_batch_size,
+            "dataloader_num_workers": self.dataloader_workers,
+            "optimizer_learning_rate": self.learning_rate,
+            "grad_accum_steps": self.grad_accum_steps,
+        }
+
+
+class SimpleStrategyGenerator:
+    def __init__(
+        self,
+        hbm_per_chip_gb: float = 95.0,  # v5p
+        chips_per_host: int = 4,
+    ):
+        self._hbm_gb = hbm_per_chip_gb
+        self._chips_per_host = chips_per_host
+
+    def generate_opt_strategy(
+        self,
+        global_batch_size: int,
+        world_hosts: int,
+        base_lr: float = 3e-4,
+        base_world: int = 1,
+        model_bytes_per_sample: float = 0.0,
+    ) -> StrategySuggestion:
+        chips = max(1, world_hosts * self._chips_per_host)
+        per_chip_batch = max(1, global_batch_size // chips)
+        if model_bytes_per_sample > 0:
+            # keep activations under ~1/4 of HBM
+            cap = max(1, int(self._hbm_gb * 1e9 * 0.25 / model_bytes_per_sample))
+            per_chip_batch = min(per_chip_batch, cap)
+        micro = per_chip_batch * self._chips_per_host  # per-host micro batch
+        accum = max(1, global_batch_size // max(1, micro * world_hosts))
+        # linear scaling rule for lr with world growth
+        lr = base_lr * (world_hosts / max(1, base_world)) ** 0.5
+        return StrategySuggestion(
+            micro_batch_size=micro,
+            grad_accum_steps=accum,
+            learning_rate=lr,
+            dataloader_workers=min(8, max(2, self._chips_per_host)),
+        )

@@ -1,0 +1,133 @@
+"""JobAutoScaler: the periodic optimize->plan->scale loop in the master.
+
+Parity: reference ``master/node/job_auto_scaler.py:41-375``
+(AllreduceTrainingAutoScaler periodic worker adjustment; the PS variant is
+out of scope on TPU). Wires SpeedMonitor observations into the
+LocalOptimizer and executes the resulting plans through a Scaler; also
+handles OOM recovery plans triggered by node failures.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.node.job_context import get_job_context
+from dlrover_tpu.master.resource.optimizer import (
+    JobOptStage,
+    LocalOptimizer,
+    WorkerStats,
+)
+from dlrover_tpu.master.resource.plan import ResourcePlan, ScalePlan
+
+
+class JobAutoScaler:
+    def __init__(
+        self,
+        optimizer: LocalOptimizer,
+        scaler,
+        speed_monitor=None,
+        interval_secs: float = 300.0,
+        sample_after_steps: int = 10,
+    ):
+        self._optimizer = optimizer
+        self._scaler = scaler
+        self._speed_monitor = speed_monitor
+        self._interval = interval_secs
+        self._sample_after_steps = sample_after_steps
+        self._job_context = get_job_context()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._autoscale_enabled = True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start_auto_scaling(self):
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="job-auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop_auto_scaling(self):
+        self._stop_evt.set()
+
+    def _loop(self):
+        while not self._stop_evt.wait(self._interval):
+            if not self._autoscale_enabled:
+                continue
+            try:
+                self.optimize_once()
+            except Exception:
+                logger.exception("auto-scale cycle failed")
+
+    # -- one optimization cycle -------------------------------------------
+
+    def _current_stage(self) -> str:
+        step = (
+            self._speed_monitor.completed_global_step
+            if self._speed_monitor is not None
+            else 0
+        )
+        if step <= 0:
+            return JobOptStage.CREATE
+        if step < self._sample_after_steps:
+            return JobOptStage.SAMPLE
+        return JobOptStage.RUNNING
+
+    def _collect_stats(self) -> WorkerStats:
+        workers = self._job_context.running_nodes(NodeType.WORKER)
+        stats = WorkerStats(worker_num=len(workers))
+        for node in workers:
+            if node.used_resource.cpu:
+                stats.cpu_percents.append(node.used_resource.cpu)
+            if node.used_resource.memory_mb:
+                stats.memory_mbs.append(node.used_resource.memory_mb)
+        if self._speed_monitor is not None:
+            stats.speed_steps_per_sec = self._speed_monitor.running_speed()
+            self._optimizer.observe_speed(
+                stats.worker_num, stats.speed_steps_per_sec
+            )
+        return stats
+
+    def optimize_once(self) -> ScalePlan:
+        stats = self._collect_stats()
+        stage = self._current_stage()
+        plan = self._optimizer.generate_opt_plan(stage, stats)
+        scale_plan = self.execute_job_optimization_plan(plan)
+        return scale_plan
+
+    def execute_job_optimization_plan(self, plan: ResourcePlan) -> ScalePlan:
+        scale_plan = ScalePlan()
+        if plan is None or plan.empty() and not plan.paral_config:
+            return scale_plan
+        scale_plan.node_group_resources = dict(plan.node_group_resources)
+        scale_plan.paral_config = dict(plan.paral_config)
+        if plan.paral_config:
+            self._push_paral_config(plan.paral_config)
+        if not scale_plan.empty():
+            self._scaler.scale(scale_plan)
+        return scale_plan
+
+    def _push_paral_config(self, cfg: dict):
+        for node in self._job_context.workers().values():
+            node.paral_config = dict(cfg)
+
+    # -- failure hooks -----------------------------------------------------
+
+    def handle_node_failure(self, node_type: str, node_id: int):
+        """OOM-aware recovery (reference event_callback -> adjust_oom_resource)."""
+        node = self._job_context.get_node(node_type, node_id)
+        if node is None or node.exit_reason != NodeExitReason.OOM:
+            return
+        host_oom = "host" in (node.reported_status or "")
+        plan = self._optimizer.generate_oom_recovery_plan(
+            [node.name], self._current_stage(), host_oom=host_oom
+        )
+        logger.warning(
+            "OOM recovery for %s-%s: %s", node_type, node_id,
+            "host memory x2" if host_oom else "micro-batch/2 accum x2",
+        )
+        self.execute_job_optimization_plan(plan)

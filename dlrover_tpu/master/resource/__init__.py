@@ -1,0 +1,18 @@
+from dlrover_tpu.master.resource.optimizer import (
+    JobOptStage,
+    LocalOptimizer,
+    OptimizeMode,
+    ResourceOptimizer,
+    WorkerStats,
+)
+from dlrover_tpu.master.resource.plan import ResourcePlan, ScalePlan
+
+__all__ = [
+    "JobOptStage",
+    "LocalOptimizer",
+    "OptimizeMode",
+    "ResourceOptimizer",
+    "WorkerStats",
+    "ResourcePlan",
+    "ScalePlan",
+]

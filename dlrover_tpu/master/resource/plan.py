@@ -1,0 +1,59 @@
+"""Resource and scale plans the optimizer produces and scalers execute.
+
+Parity: reference ``master/resource/plan.py`` (ResourcePlan) and
+``master/scaler/base_scaler.py:21`` (ScalePlan). On TPU the scaling unit is
+a *host group* of a slice type (e.g. 4 hosts of v5p-32); chip count per host
+is fixed by the slice topology, so plans move host counts and host-level
+CPU/memory, never per-chip resources.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+
+
+@dataclass
+class ResourcePlan:
+    """What the job *should* have: per-type group resources + tunables."""
+
+    node_group_resources: Dict[str, NodeGroupResource] = field(default_factory=dict)
+    node_resources: Dict[str, NodeResource] = field(default_factory=dict)  # per-node overrides, keyed by node name
+    paral_config: Dict = field(default_factory=dict)  # runtime tunables (batch, accum)
+    comment: str = ""
+
+    def empty(self) -> bool:
+        return not self.node_group_resources and not self.node_resources
+
+    def merge(self, other: "ResourcePlan") -> "ResourcePlan":
+        merged = ResourcePlan(
+            node_group_resources=dict(self.node_group_resources),
+            node_resources=dict(self.node_resources),
+            paral_config=dict(self.paral_config),
+            comment=self.comment or other.comment,
+        )
+        merged.node_group_resources.update(other.node_group_resources)
+        merged.node_resources.update(other.node_resources)
+        merged.paral_config.update(other.paral_config)
+        return merged
+
+
+@dataclass
+class ScalePlan:
+    """The concrete delta a scaler executes."""
+
+    node_group_resources: Dict[str, NodeGroupResource] = field(default_factory=dict)
+    launch_nodes: List[Node] = field(default_factory=list)
+    remove_nodes: List[Node] = field(default_factory=list)
+    migrate_nodes: Dict[str, NodeResource] = field(default_factory=dict)
+    paral_config: Dict = field(default_factory=dict)
+
+    def empty(self) -> bool:
+        return (
+            not self.node_group_resources
+            and not self.launch_nodes
+            and not self.remove_nodes
+            and not self.migrate_nodes
+        )

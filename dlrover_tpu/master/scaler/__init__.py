@@ -1,0 +1,3 @@
+from dlrover_tpu.master.scaler.base import LocalScaler, Scaler
+
+__all__ = ["LocalScaler", "Scaler"]

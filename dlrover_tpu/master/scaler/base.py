@@ -1,0 +1,85 @@
+"""Scalers: execute a ScalePlan against the platform.
+
+Parity: reference ``master/scaler/base_scaler.py`` (Scaler ABC) and the
+in-process analogue of ``pod_scaler.py`` used by local mode and tests. The
+k8s TPU-slice scaler lives in ``dlrover_tpu.scheduler.k8s``.
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.node.job_context import get_job_context
+from dlrover_tpu.master.resource.plan import ScalePlan
+
+
+class Scaler(ABC):
+    """Takes ScalePlans and makes the platform converge to them."""
+
+    def __init__(self, job_name: str = ""):
+        self._job_name = job_name
+        self._lock = threading.Lock()
+
+    @abstractmethod
+    def scale(self, plan: ScalePlan):
+        ...
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+class LocalScaler(Scaler):
+    """Standalone/test scaler: applies plans to the JobContext only.
+
+    Node launches register INITIAL nodes (an external harness or test then
+    brings agents up); removals mark nodes released. Records every plan so
+    tests can assert on scaling decisions.
+    """
+
+    def __init__(self, job_name: str = "", node_type: str = NodeType.WORKER):
+        super().__init__(job_name)
+        self._node_type = node_type
+        self.executed_plans: List[ScalePlan] = []
+        self._job_context = get_job_context()
+
+    def scale(self, plan: ScalePlan):
+        if plan.empty():
+            return
+        with self._lock:
+            self.executed_plans.append(plan)
+            for node in plan.launch_nodes:
+                self._job_context.update_node(node)
+            for node in plan.remove_nodes:
+                tracked = self._job_context.get_node(node.type, node.id)
+                if tracked is not None:
+                    tracked.is_released = True
+                    tracked.relaunchable = False
+            group = plan.node_group_resources.get(self._node_type)
+            if group is not None and group.count > 0:
+                self._converge_count(group.count)
+
+    def _converge_count(self, target: int):
+        alive = self._job_context.alive_nodes(self._node_type)
+        if len(alive) > target:
+            # shed highest-rank nodes first (keeps ranks dense)
+            for node in sorted(alive, key=lambda n: -n.rank_index)[
+                : len(alive) - target
+            ]:
+                node.relaunchable = False
+                node.is_released = True
+                logger.info("local scaler: releasing node %s", node.id)
+        elif len(alive) < target:
+            for _ in range(target - len(alive)):
+                node_id = self._job_context.next_node_id(self._node_type)
+                self._job_context.update_node(
+                    Node(self._node_type, node_id, status=NodeStatus.INITIAL)
+                )
+                logger.info("local scaler: requested node %s", node_id)

@@ -1,0 +1,125 @@
+"""Autoscaling: optimizer stages, knee detection, OOM recovery, scaler.
+
+Mirrors the reference's hermetic optimizer tests
+(``python/tests/test_job_auto_scaler.py``, ``test_local_optimizer.py``).
+"""
+
+import time
+
+from dlrover_tpu.common.constants import NodeExitReason, NodeStatus, NodeType
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+from dlrover_tpu.master.resource.optimizer import (
+    JobOptStage,
+    LocalOptimizer,
+    WorkerStats,
+)
+from dlrover_tpu.master.resource.plan import ResourcePlan, ScalePlan
+from dlrover_tpu.master.scaler.base import LocalScaler
+
+
+def setup_function(_):
+    JobContext.reset_singleton()
+
+
+def add_workers(n, status=NodeStatus.RUNNING):
+    ctx = get_job_context()
+    for i in range(n):
+        ctx.update_node(Node(NodeType.WORKER, i, status=status))
+    return ctx
+
+
+def test_create_plan_rounds_to_node_unit():
+    opt = LocalOptimizer(min_workers=2, max_workers=10, node_unit=4)
+    plan = opt.generate_opt_plan(JobOptStage.CREATE, WorkerStats())
+    assert plan.node_group_resources[NodeType.WORKER].count == 8  # 10 -> 8
+
+
+def test_sample_plan_sizes_from_usage():
+    opt = LocalOptimizer(min_workers=1, max_workers=4)
+    stats = WorkerStats(
+        cpu_percents=[50, 80], memory_mbs=[1000, 2000], worker_num=4
+    )
+    plan = opt.generate_opt_plan(JobOptStage.SAMPLE, stats)
+    group = plan.node_group_resources[NodeType.WORKER]
+    assert group.node_resource.memory_mb == 3000  # max * 1.5
+    assert group.count == 4
+
+
+def test_running_plan_shrinks_at_knee():
+    opt = LocalOptimizer(min_workers=2, max_workers=16, node_unit=2)
+    # 4 workers -> 10 steps/s; 8 workers -> only 11 steps/s (10% marginal)
+    for _ in range(3):
+        opt.observe_speed(4, 10.0)
+        opt.observe_speed(8, 11.0)
+    plan = opt.generate_opt_plan(
+        JobOptStage.RUNNING, WorkerStats(worker_num=8)
+    )
+    assert plan.node_group_resources[NodeType.WORKER].count == 4
+    assert "shrink" in plan.comment
+
+
+def test_running_plan_grows_when_linear():
+    opt = LocalOptimizer(min_workers=2, max_workers=16, node_unit=2)
+    for _ in range(3):
+        opt.observe_speed(4, 10.0)
+        opt.observe_speed(8, 19.0)  # ~90% marginal
+    plan = opt.generate_opt_plan(
+        JobOptStage.RUNNING, WorkerStats(worker_num=8)
+    )
+    assert plan.node_group_resources[NodeType.WORKER].count == 10
+    assert "grow" in plan.comment
+
+
+def test_oom_recovery_hbm_vs_host():
+    opt = LocalOptimizer(host_memory_mb=4096)
+    hbm = opt.generate_oom_recovery_plan(["worker-0"], JobOptStage.RUNNING, False)
+    assert hbm.paral_config["micro_batch_scale"] == 0.5
+    assert hbm.paral_config["grad_accum_scale"] == 2.0
+    host = opt.generate_oom_recovery_plan(["worker-0"], JobOptStage.RUNNING, True)
+    assert host.node_resources["worker-0"].memory_mb == 8192
+
+
+def test_local_scaler_converges_count():
+    ctx = add_workers(4)
+    scaler = LocalScaler()
+    plan = ScalePlan()
+    from dlrover_tpu.common.node import NodeGroupResource
+
+    plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(count=2)
+    scaler.scale(plan)
+    assert len(ctx.alive_nodes(NodeType.WORKER)) == 2
+    # grow back to 3: new INITIAL node appears
+    plan2 = ScalePlan()
+    plan2.node_group_resources[NodeType.WORKER] = NodeGroupResource(count=3)
+    scaler.scale(plan2)
+    assert len(ctx.alive_nodes(NodeType.WORKER)) == 3
+
+
+def test_autoscaler_cycle_and_oom_hook():
+    ctx = add_workers(4)
+    sm = SpeedMonitor()
+    sm.collect_global_step(100, time.time() - 10)
+    sm.collect_global_step(200, time.time())
+    opt = LocalOptimizer(min_workers=2, max_workers=8, node_unit=2)
+    scaler = LocalScaler()
+    autoscaler = JobAutoScaler(opt, scaler, speed_monitor=sm, interval_secs=3600)
+    plan = autoscaler.optimize_once()  # RUNNING stage, 1 obs -> no change or grow
+    # OOM on node 1 (HBM): paral config pushed to workers
+    node = ctx.get_node(NodeType.WORKER, 1)
+    node.exit_reason = NodeExitReason.OOM
+    autoscaler.handle_node_failure(NodeType.WORKER, 1)
+    for n in ctx.workers().values():
+        assert n.paral_config.get("micro_batch_scale") == 0.5
+
+
+def test_strategy_generator():
+    gen = SimpleStrategyGenerator(hbm_per_chip_gb=95, chips_per_host=4)
+    s = gen.generate_opt_strategy(global_batch_size=512, world_hosts=4)
+    assert s.micro_batch_size * 4 * s.grad_accum_steps >= 512
+    assert s.learning_rate > 3e-4  # scaled up with world size
+    cfg = s.to_paral_config()
+    assert cfg["grad_accum_steps"] == s.grad_accum_steps
