@@ -222,6 +222,26 @@ def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x):
     return x
 
 
+def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
+    """Fail fast (trace time) on model-shape / mesh-axis mismatches instead
+    of a cryptic shard_map partition error deep in the stack."""
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.parallel.mesh import validate_divisibility
+
+    shape = dict(mesh.shape)
+    mc = MeshConfig(
+        dp=shape.get("dp", 1), fsdp=shape.get("fsdp", 1),
+        ep=shape.get("ep", 1), sp=shape.get("sp", 1), tp=shape.get("tp", 1),
+    )
+    validate_divisibility(
+        mc,
+        n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads,
+        seq_len=seq_len or cfg.max_seq_len,
+        vocab=cfg.vocab_size,
+    )
+
+
 def forward(
     params: Params,
     tokens: jnp.ndarray,  # (b, s) int32
@@ -230,6 +250,8 @@ def forward(
 ) -> jnp.ndarray:
     """Logits (b, s, vocab) in float32."""
     b, s = tokens.shape
+    if mesh is not None:
+        validate_for_mesh(cfg, mesh, seq_len=s)
     x = params["embed"].astype(cfg.dtype)[tokens]
     if mesh is not None:
         from jax.sharding import NamedSharding

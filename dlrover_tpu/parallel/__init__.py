@@ -17,6 +17,7 @@ from dlrover_tpu.parallel.mesh import (  # noqa: F401
     TP,
     build_mesh,
     remesh,
+    validate_divisibility,
 )
 from dlrover_tpu.parallel.sharding import (  # noqa: F401
     batch_spec,

@@ -157,10 +157,6 @@ def remesh(config: MeshConfig, n_devices: int) -> MeshConfig:
     return dataclasses.replace(config, dp=data, fsdp=1)
 
 
-def largest_power_of_two_leq(n: int) -> int:
-    return 1 << (n.bit_length() - 1) if n > 0 else 0
-
-
 def validate_divisibility(config: MeshConfig, *, n_heads: int,
                           n_kv_heads: int, seq_len: int, vocab: int) -> None:
     """Fail fast (before tracing) on shape/mesh mismatches."""

@@ -56,8 +56,14 @@ def with_constraints(tree: PyTree, specs: PyTree) -> PyTree:
 
 def pad_batch_to(batch: PyTree, multiple: int) -> PyTree:
     """Pad the leading dim of every leaf up to ``multiple`` (elastic worlds
-    can leave batch % data_axes != 0 right after a resize)."""
+    can leave batch % data_axes != 0 right after a resize).
+
+    Integer leaves (token ids) pad with -1 — the loss-mask sentinel every
+    model's ``loss_fn`` ignores — so fake rows contribute no gradient;
+    float leaves pad with 0.
+    """
     import jax.numpy as jnp
+    import numpy as np
 
     def _pad(x):
         b = x.shape[0]
@@ -65,7 +71,8 @@ def pad_batch_to(batch: PyTree, multiple: int) -> PyTree:
         if rem == 0:
             return x
         pad = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
-        return jnp.pad(x, pad)
+        fill = -1 if np.issubdtype(x.dtype, np.integer) else 0
+        return jnp.pad(x, pad, constant_values=fill)
 
     return jax.tree.map(_pad, batch)
 

@@ -178,6 +178,14 @@ class ElasticTrainer:
         rebuilt (recompiled) lazily; accumulation re-derives so the global
         batch is unchanged (the reference's core elasticity invariant)."""
         old = self.accum_steps
+        dp = mesh_config.resolve(mesh.size).data_parallel_size
+        denom = self.tc.micro_batch_size * dp
+        if self.tc.global_batch_size % denom:
+            raise ValueError(
+                f"cannot remesh to world={mesh.size}: global_batch="
+                f"{self.tc.global_batch_size} not divisible by "
+                f"micro_batch*dp={denom}; trainer left on the old mesh"
+            )
         self.mesh = mesh
         self.mesh_config = mesh_config
         self._step_fn = None
