@@ -40,6 +40,17 @@ FATAL_PATTERNS = (
     r"FATAL|Fatal Python error",
     r"XlaRuntimeError",
 )
+# A *peer* died and the coordination service tore this process down. The
+# local host is healthy: restart and re-rendezvous. These must be checked
+# before HARDWARE_PATTERNS because JAX's generic peer-death message contains
+# the words "preempted/died/restarted" which would otherwise read as a local
+# preemption and make every surviving node exit.
+PEER_FAILURE_PATTERNS = (
+    r"JAX distributed service detected fatal errors",
+    r"another task died",
+    r"leader task was preempted",
+    r"Failed to send RPC to coordination service",
+)
 RETRYABLE_PATTERNS = (
     r"RESOURCE_EXHAUSTED|out of memory|OOM",
     r"UNAVAILABLE|DEADLINE_EXCEEDED",
@@ -116,11 +127,15 @@ class CheckFailureNodeOperator(InferenceOperator):
 def classify_log(text: str) -> Optional[str]:
     """'hardware' | 'retryable' | 'fatal' | None from a worker log tail.
 
-    hardware/preemption signatures win (the node must be replaced), then
-    transient retryables, then generic fatal tracebacks.
+    Peer-death signatures win (the local host is fine — restart in place),
+    then hardware/preemption (the node must be replaced), then transient
+    retryables, then generic fatal tracebacks.
     """
     if not text:
         return None
+    for pat in PEER_FAILURE_PATTERNS:
+        if re.search(pat, text, re.IGNORECASE):
+            return "retryable"
     for pat in HARDWARE_PATTERNS:
         if re.search(pat, text, re.IGNORECASE):
             return "hardware"

@@ -57,6 +57,28 @@ def test_classify_log():
         classify_log("Traceback (most recent call last):\n  ValueError") == "fatal"
     )
     assert classify_log("all good, step 100 loss 2.3") is None
+    # JAX's coordination-service peer-death text mentions "preempted" but the
+    # local host is healthy: it must classify retryable, not hardware.
+    peer_death = (
+        "Terminating process because the JAX distributed service detected "
+        "fatal errors. This most likely indicates that another task died; "
+        "Either the leader task was preempted/died/restarted unexpectedly"
+    )
+    assert classify_log(peer_death) == "retryable"
+    # ...but a genuine local preemption notice must still read as hardware
+    assert (
+        classify_log("SIGTERM received, reporting preemption notice")
+        == "hardware"
+    )
+    # and a real hardware fault alongside routine teardown chatter stays
+    # hardware (peer patterns are message-specific, not component names)
+    assert (
+        classify_log(
+            "hbm ecc uncorrectable error\n"
+            "coordination_service_agent.cc: agent shutting down"
+        )
+        == "hardware"
+    )
 
 
 def test_data_manager_window_and_latest():
