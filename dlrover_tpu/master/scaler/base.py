@@ -18,6 +18,13 @@ from dlrover_tpu.master.node.job_context import get_job_context
 from dlrover_tpu.master.resource.plan import ScalePlan
 
 
+def shed_victims(nodes: List[Node], n: int) -> List[Node]:
+    """Scale-down victim policy shared by every scaler/manager: shed the
+    highest ranks first so low ranks keep stable seats (dense ranks keep
+    the TPU mesh contiguous after re-formation)."""
+    return sorted(nodes, key=lambda node: -node.rank_index)[:n]
+
+
 class Scaler(ABC):
     """Takes ScalePlans and makes the platform converge to them."""
 
@@ -69,10 +76,7 @@ class LocalScaler(Scaler):
     def _converge_count(self, target: int):
         alive = self._job_context.alive_nodes(self._node_type)
         if len(alive) > target:
-            # shed highest-rank nodes first (keeps ranks dense)
-            for node in sorted(alive, key=lambda n: -n.rank_index)[
-                : len(alive) - target
-            ]:
+            for node in shed_victims(alive, len(alive) - target):
                 node.relaunchable = False
                 node.is_released = True
                 logger.info("local scaler: releasing node %s", node.id)

@@ -1,0 +1,304 @@
+"""PodScaler: drive worker pods on k8s directly from the master.
+
+Parity: reference ``master/scaler/pod_scaler.py:80-717`` — a create queue
+drained by a periodic thread (``_periodic_create_pod`` :417), per-pod env
+injection, owner references to the job, and delete/migrate handling. The
+TPU flavor: every worker pod is one *host* of a TPU slice, so the pod spec
+carries the GKE TPU node selectors from the replica template and the env
+the elastic agent bootstrap expects (master addr, node id/rank); chips per
+host come from the template's ``google.com/tpu`` resource.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv, NodeStatus, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.resource.plan import ScalePlan
+from dlrover_tpu.master.scaler.base import Scaler
+from dlrover_tpu.scheduler.job import JobArgs
+from dlrover_tpu.scheduler.k8s_client import K8sClient
+
+#: labels stamped on every pod we create; the watcher selects on these
+LABEL_JOB_KEY = "elastic.dlrover-tpu.org/job-name"
+LABEL_TYPE_KEY = "elastic.dlrover-tpu.org/replica-type"
+LABEL_ID_KEY = "elastic.dlrover-tpu.org/replica-id"
+LABEL_RANK_KEY = "elastic.dlrover-tpu.org/rank-index"
+LABEL_RELAUNCH_KEY = "elastic.dlrover-tpu.org/relaunch-count"
+
+
+class PodScaler(Scaler):
+    def __init__(
+        self,
+        job_args: JobArgs,
+        client: K8sClient,
+        master_addr: str = "",
+        create_interval: float = 3.0,
+    ):
+        super().__init__(job_args.job_name)
+        self._job_args = job_args
+        self._client = client
+        self._master_addr = master_addr
+        self._create_interval = create_interval
+        self._create_queue: "queue.Queue[Node]" = queue.Queue()
+        self._stop_evt = threading.Event()
+        self._create_thread: Optional[threading.Thread] = None
+
+    def set_master_addr(self, addr: str):
+        """Must be a reachable address before any pod is created; the
+        composition root calls this once the RPC server has bound."""
+        self._master_addr = addr
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        self._stop_evt.clear()
+        self._create_thread = threading.Thread(
+            target=self._periodic_create_pod, name="pod-creator", daemon=True
+        )
+        self._create_thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    # -- scaling ------------------------------------------------------------
+
+    def scale(self, plan: ScalePlan):
+        if plan.empty():
+            return
+        with self._lock:
+            for node in plan.launch_nodes:
+                self._create_queue.put(node)
+            for node in plan.remove_nodes:
+                self._remove_node(node)
+            for group_name, group in plan.node_group_resources.items():
+                # group deltas are resolved by the job manager into concrete
+                # launch/remove nodes before reaching us; log for audit
+                logger.info(
+                    "scale plan group %s -> count=%s", group_name, group.count
+                )
+
+    def _remove_node(self, node: Node):
+        name = self.pod_name(node)
+        deleted = self._client.delete_pod(name)
+        logger.info("delete pod %s: %s", name, "ok" if deleted else "absent")
+
+    # -- pod creation -------------------------------------------------------
+
+    def _periodic_create_pod(self):
+        while not self._stop_evt.wait(self._create_interval):
+            self._drain_create_queue()
+
+    def _drain_create_queue(self):
+        pending: List[Node] = []
+        while True:
+            try:
+                pending.append(self._create_queue.get_nowait())
+            except queue.Empty:
+                break
+        for i, node in enumerate(pending):
+            try:
+                self._create_pod(node)
+            except Exception:
+                logger.exception(
+                    "create pod for %s-%s failed; requeueing %s nodes",
+                    node.type,
+                    node.id,
+                    len(pending) - i,
+                )
+                # requeue this node AND everything not yet attempted,
+                # else a transient API error silently drops hosts
+                for retry in pending[i:]:
+                    self._create_queue.put(retry)
+                break
+
+    def pod_name(self, node: Node) -> str:
+        return f"{self._job_name}-{node.type}-{node.id}"
+
+    def _create_pod(self, node: Node) -> Dict:
+        spec = self._job_args.replicas.get(node.type)
+        template = copy.deepcopy(spec.pod_template) if spec else {}
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": self._pod_metadata(node, template),
+            "spec": template.get("spec", {"containers": [{}]}),
+        }
+        self._inject_env(pod["spec"], node)
+        pod["spec"].setdefault("restartPolicy", "Never")
+        created = self._client.create_pod(pod)
+        node.create_time = time.time()
+        logger.info(
+            "created pod %s (rank=%s relaunch=%s)",
+            pod["metadata"]["name"],
+            node.rank_index,
+            node.relaunch_count,
+        )
+        return created
+
+    def _pod_metadata(self, node: Node, template: Dict) -> Dict:
+        meta = copy.deepcopy(template.get("metadata", {}))
+        labels = meta.setdefault("labels", {})
+        labels.update(
+            {
+                LABEL_JOB_KEY: self._job_name,
+                LABEL_TYPE_KEY: node.type,
+                LABEL_ID_KEY: str(node.id),
+                LABEL_RANK_KEY: str(node.rank_index),
+                LABEL_RELAUNCH_KEY: str(node.relaunch_count),
+            }
+        )
+        meta["name"] = self.pod_name(node)
+        if self._job_args.job_uid:
+            meta["ownerReferences"] = [
+                {
+                    "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
+                    "kind": "ElasticJob",
+                    "name": self._job_name,
+                    "uid": self._job_args.job_uid,
+                    "controller": True,
+                    "blockOwnerDeletion": True,
+                }
+            ]
+        return meta
+
+    def _inject_env(self, pod_spec: Dict, node: Node):
+        env = [
+            {"name": NodeEnv.JOB_NAME, "value": self._job_name},
+            {"name": NodeEnv.MASTER_ADDR, "value": self._master_addr},
+            {"name": NodeEnv.NODE_ID, "value": str(node.id)},
+            {"name": NodeEnv.NODE_RANK, "value": str(node.rank_index)},
+            {
+                "name": NodeEnv.NODE_NUM,
+                "value": str(self._job_args.worker_spec.group.count),
+            },
+            {"name": NodeEnv.RESTART_COUNT, "value": str(node.relaunch_count)},
+        ]
+        for container in pod_spec.setdefault("containers", [{}]):
+            existing = {e.get("name") for e in container.get("env", [])}
+            container.setdefault("env", []).extend(
+                e for e in env if e["name"] not in existing
+            )
+
+    # -- master service -----------------------------------------------------
+
+    def create_master_service(self, master_port: int) -> str:
+        """Expose the master pod so worker agents find it by stable DNS."""
+        name = f"elasticjob-{self._job_name}-master"
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "labels": {LABEL_JOB_KEY: self._job_name},
+            },
+            "spec": {
+                "selector": {
+                    LABEL_JOB_KEY: self._job_name,
+                    LABEL_TYPE_KEY: NodeType.MASTER,
+                },
+                "ports": [{"port": master_port, "targetPort": master_port}],
+            },
+        }
+        if self._client.get_service(name) is None:
+            self._client.create_service(svc)
+        return f"{name}.{self._client.namespace}:{master_port}"
+
+
+class ElasticJobScaler(Scaler):
+    """Write ScalePlan CRs for an external operator to apply.
+
+    Parity: reference ``master/scaler/elasticjob_scaler.py:153-190``. Used
+    when ``scale_plan_mode == "crd"``: the master records intent, the
+    operator (or an admin) owns pod mutation.
+    """
+
+    def __init__(self, job_args: JobArgs, client: K8sClient):
+        super().__init__(job_args.job_name)
+        self._job_args = job_args
+        self._client = client
+        self._plan_index = self._recover_plan_index()
+
+    def _recover_plan_index(self) -> int:
+        """Survive master restarts: resume numbering after existing CRs."""
+        from dlrover_tpu.scheduler.k8s_client import SCALEPLAN_PLURAL
+
+        prefix = f"{self._job_name}-scaleplan-"
+        index = 0
+        try:
+            for cr in self._client.list_custom_resources(
+                SCALEPLAN_PLURAL, f"{LABEL_JOB_KEY}={self._job_name}"
+            ):
+                name = cr.get("metadata", {}).get("name", "")
+                if name.startswith(prefix) and name[len(prefix):].isdigit():
+                    index = max(index, int(name[len(prefix):]))
+        except Exception:
+            logger.exception("listing existing scaleplans failed; start at 0")
+        return index
+
+    def scale(self, plan: ScalePlan):
+        if plan.empty():
+            return
+        from dlrover_tpu.scheduler.k8s_client import SCALEPLAN_PLURAL
+
+        with self._lock:
+            self._plan_index += 1
+            name = f"{self._job_name}-scaleplan-{self._plan_index}"
+        cr = {
+            "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
+            "kind": "ScalePlan",
+            "metadata": {
+                "name": name,
+                "labels": {
+                    LABEL_JOB_KEY: self._job_name,
+                    "scale-type": "auto",
+                },
+            },
+            "spec": {
+                "ownerJob": self._job_name,
+                "replicaResourceSpecs": {
+                    rtype: {
+                        "replicas": group.count,
+                        "resource": group.node_resource.to_dict(),
+                    }
+                    for rtype, group in plan.node_group_resources.items()
+                },
+                "createPods": [
+                    {
+                        "name": f"{self._job_name}-{n.type}-{n.id}",
+                        "type": n.type,
+                        "id": n.id,
+                        "rankIndex": n.rank_index,
+                    }
+                    for n in plan.launch_nodes
+                ],
+                "removePods": [
+                    f"{self._job_name}-{n.type}-{n.id}"
+                    for n in plan.remove_nodes
+                ],
+                "migratePods": [
+                    {"name": name_, "resource": res.to_dict()}
+                    for name_, res in plan.migrate_nodes.items()
+                ],
+            },
+        }
+        for _ in range(3):
+            try:
+                self._client.create_custom_resource(SCALEPLAN_PLURAL, cr)
+                break
+            except Exception as e:
+                status = getattr(e, "status", 0)
+                if status != 409:  # only name conflicts are retryable here
+                    raise
+                with self._lock:
+                    self._plan_index += 1
+                    name = f"{self._job_name}-scaleplan-{self._plan_index}"
+                cr["metadata"]["name"] = name
+        logger.info("wrote scaleplan %s: %s", name, json.dumps(cr["spec"])[:400])

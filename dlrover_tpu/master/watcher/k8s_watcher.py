@@ -1,0 +1,240 @@
+"""K8s watchers: pods -> NodeEvents; ScalePlan CRs -> manual scale requests.
+
+Parity: reference ``master/watcher/k8s_watcher.py`` (``PodWatcher`` :164,
+``K8sScalePlanWatcher`` :261). Pod phase + container state map onto our
+NodeStatus; TPU extras (slice name, host index) are read from the GKE TPU
+pod labels so topology-aware rank sorting works without a separate
+discovery step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEventType, NodeExitReason, NodeStatus
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node, NodeEvent, NodeResource
+from dlrover_tpu.master.scaler.pod_scaler import (
+    LABEL_ID_KEY,
+    LABEL_JOB_KEY,
+    LABEL_RANK_KEY,
+    LABEL_RELAUNCH_KEY,
+    LABEL_TYPE_KEY,
+)
+from dlrover_tpu.scheduler.k8s_client import SCALEPLAN_PLURAL, K8sClient
+
+#: GKE sets these on TPU pods; we read them for ICI-aware sorting
+TPU_SLICE_LABEL = "job-name"  # same-slice pods share the jobset/job name
+TPU_WORKER_INDEX_LABEL = "batch.kubernetes.io/job-completion-index"
+
+_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.UNKNOWN,
+}
+
+_EVENT_TYPES = {
+    "ADDED": NodeEventType.CREATED,
+    "MODIFIED": NodeEventType.MODIFIED,
+    "DELETED": NodeEventType.DELETED,
+}
+
+
+def pod_to_node(pod: Dict) -> Optional[Node]:
+    labels = pod.get("metadata", {}).get("labels", {})
+    if LABEL_TYPE_KEY not in labels or LABEL_ID_KEY not in labels:
+        return None
+    status = pod.get("status", {})
+    node = Node(
+        node_type=labels[LABEL_TYPE_KEY],
+        node_id=int(labels[LABEL_ID_KEY]),
+        rank_index=int(labels.get(LABEL_RANK_KEY, labels[LABEL_ID_KEY])),
+        name=pod.get("metadata", {}).get("name", ""),
+        status=_PHASE_TO_STATUS.get(status.get("phase", ""), NodeStatus.UNKNOWN),
+    )
+    node.relaunch_count = int(labels.get(LABEL_RELAUNCH_KEY, 0))
+    node.host_addr = status.get("podIP", "")
+    node.topology.slice_name = labels.get(TPU_SLICE_LABEL, "")
+    try:
+        node.topology.worker_index = int(labels.get(TPU_WORKER_INDEX_LABEL, -1))
+    except ValueError:
+        node.topology.worker_index = -1
+    node.exit_reason = _exit_reason_from_pod(pod)
+    return node
+
+
+def _exit_reason_from_pod(pod: Dict) -> str:
+    """Map terminated-container state to a NodeExitReason."""
+    status = pod.get("status", {})
+    if status.get("phase") != "Failed":
+        return ""
+    reason = status.get("reason", "")
+    if "Preempt" in reason or "Shutdown" in reason or "Evict" in reason:
+        return NodeExitReason.PREEMPTED
+    for cs in status.get("containerStatuses", []):
+        term = cs.get("state", {}).get("terminated") or cs.get(
+            "lastState", {}
+        ).get("terminated")
+        if not term:
+            continue
+        if term.get("reason") == "OOMKilled":
+            return NodeExitReason.OOM
+        code = term.get("exitCode", 0)
+        if code == 137:  # SIGKILL: external kill / node reclaim
+            return NodeExitReason.KILLED
+        if code in (143, 15):
+            return NodeExitReason.PREEMPTED
+        if code not in (0, None):
+            return NodeExitReason.FATAL_ERROR
+    return NodeExitReason.UNKNOWN_ERROR
+
+
+class PodWatcher:
+    """list + watch pods of this job, feeding NodeEvents to a callback."""
+
+    def __init__(
+        self,
+        job_name: str,
+        client: K8sClient,
+        event_cb: Callable[[NodeEvent], None],
+    ):
+        self._job_name = job_name
+        self._client = client
+        self._event_cb = event_cb
+        self._selector = f"{LABEL_JOB_KEY}={job_name}"
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: last Node seen per pod name — lets a re-list synthesize DELETED
+        #: events for pods that vanished while the watch stream was down
+        self._known: Dict[str, Node] = {}
+
+    def list(self) -> List[Node]:
+        nodes = []
+        for pod in self._client.list_pods(self._selector):
+            node = pod_to_node(pod)
+            if node is not None:
+                nodes.append(node)
+        return nodes
+
+    def start(self):
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="pod-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def _watch_loop(self):
+        """watch → (stream expires or breaks) → reconcile by re-list → watch.
+
+        A k8s watch stream ends *normally* every timeoutSeconds; events
+        landing in the reconnect gap are lost, so every re-watch is
+        preceded by a reconciling list (the reference re-lists the same
+        way, ``k8s_watcher.py:164``).
+        """
+        first = True
+        while not self._stop_evt.is_set():
+            try:
+                if not first:
+                    self._reconcile()
+                first = False
+                for etype, pod in self._client.watch_pods(self._selector):
+                    if self._stop_evt.is_set():
+                        return
+                    self._dispatch(etype, pod)
+            except Exception as e:
+                if self._stop_evt.is_set():
+                    return
+                logger.warning("pod watch broke (%s); will re-list", e)
+                self._stop_evt.wait(3)
+
+    def _reconcile(self):
+        try:
+            listed = {node.name: node for node in self.list()}
+        except Exception:
+            logger.exception("pod re-list failed")
+            return
+        for name, node in list(self._known.items()):
+            if name not in listed and node.status not in NodeStatus.terminal():
+                node.update_status(NodeStatus.DELETED)
+                self._event_cb(NodeEvent(NodeEventType.DELETED, node))
+                del self._known[name]
+        for name, node in listed.items():
+            self._known[name] = node
+            self._event_cb(NodeEvent(NodeEventType.MODIFIED, node))
+
+    def _dispatch(self, etype: str, pod: Dict):
+        node = pod_to_node(pod)
+        if node is None:
+            return
+        event_type = _EVENT_TYPES.get(etype)
+        if event_type is None:
+            return
+        if event_type == NodeEventType.DELETED:
+            node.update_status(NodeStatus.DELETED)
+            self._known.pop(node.name, None)
+        else:
+            self._known[node.name] = node
+        self._event_cb(NodeEvent(event_type, node))
+
+
+class ScalePlanWatcher:
+    """Watch manually-applied ScalePlan CRs and hand them to the manager.
+
+    The reference routes these through the same execute path as auto plans
+    (``dist_job_manager.py:575``); so do we via ``plan_cb``.
+    """
+
+    def __init__(
+        self,
+        job_name: str,
+        client: K8sClient,
+        plan_cb: Callable[[Dict], None],
+    ):
+        self._job_name = job_name
+        self._client = client
+        self._plan_cb = plan_cb
+        self._selector = f"{LABEL_JOB_KEY}={job_name},scale-type=manual"
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seen: set = set()
+
+    def start(self):
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="scaleplan-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def _watch_loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                for etype, cr in self._client.watch_custom_resources(
+                    SCALEPLAN_PLURAL, self._selector
+                ):
+                    if self._stop_evt.is_set():
+                        return
+                    if etype not in ("ADDED", "MODIFIED"):
+                        continue
+                    uid = cr.get("metadata", {}).get("uid") or cr.get(
+                        "metadata", {}
+                    ).get("name")
+                    version = cr.get("metadata", {}).get("resourceVersion", "")
+                    key = (uid, version)
+                    if key in self._seen:
+                        continue
+                    self._seen.add(key)
+                    self._plan_cb(cr)
+            except Exception as e:
+                if self._stop_evt.is_set():
+                    return
+                logger.warning("scaleplan watch broke (%s); retrying", e)
+                self._stop_evt.wait(3)

@@ -1,0 +1,156 @@
+"""Job arguments the master derives from the platform.
+
+Parity: reference ``dlrover/python/scheduler/job.py:1-116`` (JobArgs) and
+``kubernetes.py:400-489`` (``K8sJobArgs.initilize`` parsing the ElasticJob
+CR). TPU-natively a replica group describes *hosts of a slice type*: the
+chip count per host and the slice topology come from the TPU accelerator
+selectors on the pod template, so plans scale host counts while topology
+stays a property of the slice type.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import DistributionStrategy, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.scheduler.k8s_client import ELASTICJOB_PLURAL, get_k8s_client
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica group (e.g. ``worker``) of the job."""
+
+    group: NodeGroupResource = field(default_factory=NodeGroupResource)
+    min_nodes: int = 0
+    max_nodes: int = 0
+    restart_count: int = 3
+    pod_template: Dict = field(default_factory=dict)
+    priority: str = ""
+
+
+@dataclass
+class JobArgs:
+    """Everything the master needs to manage one job."""
+
+    platform: str = "k8s"
+    namespace: str = "default"
+    job_name: str = ""
+    job_uid: str = ""
+    distribution_strategy: str = DistributionStrategy.ALLREDUCE
+    replicas: Dict[str, ReplicaSpec] = field(default_factory=dict)
+    node_unit: int = 1
+    relaunch_on_worker_failure: int = 3
+    remove_exited_node: bool = True
+    cordon_fault_node: bool = False
+    tpu_type: str = ""  # e.g. v5p-32; informs chips/host + topology
+    scale_plan_mode: str = "direct"  # direct pod ops | "crd" (operator applies)
+
+    @property
+    def worker_spec(self) -> ReplicaSpec:
+        return self.replicas.get(NodeType.WORKER, ReplicaSpec())
+
+    @classmethod
+    def from_elasticjob_cr(cls, cr: Dict) -> "JobArgs":
+        meta = cr.get("metadata", {})
+        spec = cr.get("spec", {})
+        args = cls(
+            namespace=meta.get("namespace", "default"),
+            job_name=meta.get("name", ""),
+            job_uid=meta.get("uid", ""),
+            distribution_strategy=spec.get(
+                "distributionStrategy", DistributionStrategy.ALLREDUCE
+            ),
+            node_unit=int(spec.get("nodeUnit", 1)),
+            tpu_type=spec.get("tpuType", ""),
+            scale_plan_mode=spec.get("scalePlanMode", "direct"),
+        )
+        for rtype, rspec in spec.get("replicaSpecs", {}).items():
+            template = rspec.get("template", {})
+            resource = _resource_from_pod_template(template)
+            count = int(rspec.get("replicas", 0))
+            args.replicas[rtype] = ReplicaSpec(
+                group=NodeGroupResource(count=count, node_resource=resource),
+                min_nodes=int(rspec.get("minReplicas", count)),
+                max_nodes=int(rspec.get("maxReplicas", count)),
+                restart_count=int(rspec.get("restartCount", 3)),
+                pod_template=template,
+                priority=rspec.get("priority", ""),
+            )
+        if not args.tpu_type:
+            worker = args.replicas.get(NodeType.WORKER)
+            if worker is not None:
+                args.tpu_type = _tpu_type_from_template(worker.pod_template)
+        return args
+
+    @classmethod
+    def from_k8s_env(cls, job_name: str = "", namespace: str = "") -> "JobArgs":
+        """Master-pod entry: read our ElasticJob CR from the API server."""
+        job_name = job_name or os.getenv("ELASTICJOB_NAME", "")
+        namespace = namespace or os.getenv("POD_NAMESPACE", "default")
+        client = get_k8s_client(namespace)
+        cr = client.get_custom_resource(ELASTICJOB_PLURAL, job_name)
+        if cr is None:
+            logger.warning(
+                "elasticjob %s/%s not found; using env-only args",
+                namespace,
+                job_name,
+            )
+            return cls(namespace=namespace, job_name=job_name)
+        return cls.from_elasticjob_cr(cr)
+
+
+def _parse_quantity(q) -> float:
+    """k8s quantity -> float (cpu cores or bytes-ish units to MB for memory
+    when the caller divides). Supports m, Ki/Mi/Gi/Ti, K/M/G/T."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    units = {
+        "m": 1e-3,
+        "Ki": 1024,
+        "Mi": 1024**2,
+        "Gi": 1024**3,
+        "Ti": 1024**4,
+        "K": 1e3,
+        "M": 1e6,
+        "G": 1e9,
+        "T": 1e12,
+    }
+    for suffix in sorted(units, key=len, reverse=True):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    logger.warning("unparseable k8s quantity %r", q)
+    return 0.0
+
+
+def _resource_from_pod_template(template: Dict) -> NodeResource:
+    containers = template.get("spec", {}).get("containers", [])
+    if not containers:
+        return NodeResource()
+    requests = containers[0].get("resources", {}).get("requests", {})
+    limits = containers[0].get("resources", {}).get("limits", {})
+    merged = {**requests, **limits}
+    memory = _parse_quantity(merged.get("memory", 0))
+    return NodeResource(
+        cpu=_parse_quantity(merged.get("cpu", 0)),
+        memory_mb=memory / (1024**2) if memory else 0.0,
+        tpu_chips=int(_parse_quantity(merged.get("google.com/tpu", 0))),
+        tpu_type=_tpu_type_from_template(template),
+    )
+
+
+def _tpu_type_from_template(template: Dict) -> str:
+    sel = template.get("spec", {}).get("nodeSelector", {})
+    accel = sel.get("cloud.google.com/gke-tpu-accelerator", "")
+    topo = sel.get("cloud.google.com/gke-tpu-topology", "")
+    if accel and topo:
+        return f"{accel}:{topo}"
+    return accel

@@ -1,0 +1,283 @@
+"""Minimal Kubernetes API client over the cluster REST endpoint.
+
+Parity: reference ``dlrover/python/scheduler/kubernetes.py:122-592``
+(``k8sClient`` wrapping the official python client). We talk to the API
+server directly with the standard library instead: inside a pod the service
+account token + CA bundle are mounted at a fixed path, and everything the
+master needs (pods, services, events, our CRs, watch streams) is a handful
+of REST verbs. That keeps the framework dependency-free and lets tests
+inject a fake transport, mirroring the reference's mocked-client strategy
+(``tests/test_utils.py:314-335``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, Generator, List, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+GROUP = "elastic.dlrover-tpu.org"
+VERSION = "v1alpha1"
+ELASTICJOB_PLURAL = "elasticjobs"
+SCALEPLAN_PLURAL = "scaleplans"
+
+
+class K8sApiError(Exception):
+    def __init__(self, status: int, reason: str, body: str = ""):
+        super().__init__(f"k8s api {status} {reason}: {body[:300]}")
+        self.status = status
+        self.reason = reason
+
+
+class ApiServerTransport:
+    """HTTPS transport to the in-cluster API server (stdlib only)."""
+
+    def __init__(
+        self,
+        host: str = "",
+        token: str = "",
+        ca_file: str = "",
+        timeout: float = 30.0,
+    ):
+        host = host or os.getenv("KUBERNETES_SERVICE_HOST", "")
+        port = os.getenv("KUBERNETES_SERVICE_PORT", "443")
+        self.base_url = host if "://" in host else f"https://{host}:{port}"
+        self._timeout = timeout
+        token_file = os.path.join(SA_DIR, "token")
+        if not token and os.path.exists(token_file):
+            token = open(token_file).read().strip()
+        self._token = token
+        ca_file = ca_file or os.path.join(SA_DIR, "ca.crt")
+        if os.path.exists(ca_file):
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+        else:  # out-of-cluster dev setups
+            self._ctx = ssl.create_default_context()
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = ssl.CERT_NONE
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict] = None,
+        params: Optional[Dict] = None,
+        stream: bool = False,
+        timeout: Optional[float] = None,
+    ):
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Authorization", f"Bearer {self._token}")
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            content_type = (
+                "application/merge-patch+json"
+                if method == "PATCH"
+                else "application/json"
+            )
+            req.add_header("Content-Type", content_type)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout or self._timeout, context=self._ctx
+            )
+        except urllib.error.HTTPError as e:
+            raise K8sApiError(e.code, e.reason, e.read().decode(errors="replace"))
+        if stream:
+            return resp  # caller iterates lines
+        payload = resp.read().decode()
+        return json.loads(payload) if payload else {}
+
+
+class K8sClient:
+    """Typed operations the master/scaler/watcher need.
+
+    ``transport`` must expose ``request(method, path, body, params, stream,
+    timeout)``; tests pass a fake.
+    """
+
+    def __init__(self, namespace: str, transport=None):
+        self.namespace = namespace
+        self._transport = transport or ApiServerTransport()
+
+    # -- pods ---------------------------------------------------------------
+
+    def _pods_path(self, name: str = "") -> str:
+        base = f"/api/v1/namespaces/{self.namespace}/pods"
+        return f"{base}/{name}" if name else base
+
+    def create_pod(self, pod: Dict) -> Dict:
+        return self._transport.request("POST", self._pods_path(), body=pod)
+
+    def get_pod(self, name: str) -> Optional[Dict]:
+        try:
+            return self._transport.request("GET", self._pods_path(name))
+        except K8sApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def delete_pod(self, name: str, grace_seconds: int = 30) -> bool:
+        try:
+            self._transport.request(
+                "DELETE",
+                self._pods_path(name),
+                body={"gracePeriodSeconds": grace_seconds},
+            )
+            return True
+        except K8sApiError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def list_pods(self, label_selector: str = "") -> List[Dict]:
+        params = {"labelSelector": label_selector} if label_selector else None
+        out = self._transport.request("GET", self._pods_path(), params=params)
+        return out.get("items", [])
+
+    def _watch(
+        self,
+        path: str,
+        label_selector: str = "",
+        resource_version: str = "",
+        timeout_seconds: int = 300,
+    ) -> Generator[Tuple[str, Dict], None, None]:
+        """Yields (event_type, object) from a chunked watch stream."""
+        params = {"watch": "true", "timeoutSeconds": str(timeout_seconds)}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        resp = self._transport.request(
+            "GET",
+            path,
+            params=params,
+            stream=True,
+            timeout=timeout_seconds + 10,
+        )
+        for line in resp:
+            if not line.strip():
+                continue
+            evt = json.loads(line)
+            yield evt.get("type", ""), evt.get("object", {})
+
+    def watch_pods(
+        self,
+        label_selector: str = "",
+        resource_version: str = "",
+        timeout_seconds: int = 300,
+    ) -> Generator[Tuple[str, Dict], None, None]:
+        return self._watch(
+            self._pods_path(), label_selector, resource_version, timeout_seconds
+        )
+
+    # -- services -----------------------------------------------------------
+
+    def create_service(self, svc: Dict) -> Dict:
+        path = f"/api/v1/namespaces/{self.namespace}/services"
+        return self._transport.request("POST", path, body=svc)
+
+    def get_service(self, name: str) -> Optional[Dict]:
+        path = f"/api/v1/namespaces/{self.namespace}/services/{name}"
+        try:
+            return self._transport.request("GET", path)
+        except K8sApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    # -- events -------------------------------------------------------------
+
+    def create_event(self, event: Dict) -> Dict:
+        path = f"/api/v1/namespaces/{self.namespace}/events"
+        return self._transport.request("POST", path, body=event)
+
+    # -- custom resources (ElasticJob / ScalePlan) --------------------------
+
+    def _cr_path(self, plural: str, name: str = "") -> str:
+        base = (
+            f"/apis/{GROUP}/{VERSION}/namespaces/{self.namespace}/{plural}"
+        )
+        return f"{base}/{name}" if name else base
+
+    def get_custom_resource(self, plural: str, name: str) -> Optional[Dict]:
+        try:
+            return self._transport.request("GET", self._cr_path(plural, name))
+        except K8sApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def list_custom_resources(
+        self, plural: str, label_selector: str = ""
+    ) -> List[Dict]:
+        params = {"labelSelector": label_selector} if label_selector else None
+        out = self._transport.request(
+            "GET", self._cr_path(plural), params=params
+        )
+        return out.get("items", [])
+
+    def create_custom_resource(self, plural: str, cr: Dict) -> Dict:
+        return self._transport.request("POST", self._cr_path(plural), body=cr)
+
+    def patch_custom_resource_status(
+        self, plural: str, name: str, status: Dict
+    ) -> Dict:
+        return self._transport.request(
+            "PATCH",
+            self._cr_path(plural, name) + "/status",
+            body={"status": status},
+        )
+
+    def delete_custom_resource(self, plural: str, name: str) -> bool:
+        try:
+            self._transport.request("DELETE", self._cr_path(plural, name))
+            return True
+        except K8sApiError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def watch_custom_resources(
+        self,
+        plural: str,
+        label_selector: str = "",
+        resource_version: str = "",
+        timeout_seconds: int = 300,
+    ) -> Generator[Tuple[str, Dict], None, None]:
+        return self._watch(
+            self._cr_path(plural),
+            label_selector,
+            resource_version,
+            timeout_seconds,
+        )
+
+
+_singleton_lock = threading.Lock()
+_singleton: Optional[K8sClient] = None
+
+
+def get_k8s_client(namespace: str = "", transport=None) -> K8sClient:
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            namespace = namespace or os.getenv("POD_NAMESPACE", "default")
+            _singleton = K8sClient(namespace, transport=transport)
+        return _singleton
+
+
+def reset_k8s_client():
+    """Test helper: drop the singleton so fixtures can re-inject."""
+    global _singleton
+    with _singleton_lock:
+        _singleton = None

@@ -1,0 +1,182 @@
+"""Fake k8s transport/client for tests (reference test_utils.py:314-335
+mocks its k8sClient the same way: no kubeconfig, no cluster)."""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.scheduler.k8s_client import K8sApiError, K8sClient
+
+
+class FakeTransport:
+    """In-memory API server: stores pods/services/CRs, records requests."""
+
+    def __init__(self):
+        self.requests: List[Tuple[str, str, Optional[dict]]] = []
+        self.pods: Dict[str, dict] = {}
+        self.services: Dict[str, dict] = {}
+        self.crs: Dict[str, Dict[str, dict]] = {}  # plural -> name -> cr
+        self.events: List[dict] = []
+        self._watch_queues: Dict[str, "queue.Queue"] = {}
+
+    def _watch_queue(self, resource: str) -> "queue.Queue":
+        return self._watch_queues.setdefault(resource, queue.Queue())
+
+    def request(self, method, path, body=None, params=None, stream=False, timeout=None):
+        self.requests.append((method, path, body))
+        parts = [p for p in path.split("/") if p]
+        if stream:
+            resource = "pods" if "/pods" in path else parts[5]
+            return self._stream(resource)
+        if "/pods" in path:
+            return self._handle(self.pods, method, parts, body, "pods")
+        if "/services" in path:
+            return self._handle(self.services, method, parts, body, "services")
+        if "/events" in path:
+            self.events.append(body)
+            return body
+        # custom resources: /apis/<group>/<ver>/namespaces/<ns>/<plural>[/name]
+        plural = parts[5] if len(parts) > 5 else ""
+        store = self.crs.setdefault(plural, {})
+        return self._handle(store, method, parts, body, plural)
+
+    def _handle(self, store, method, parts, body, kind_key):
+        idx = parts.index(kind_key)
+        name = parts[idx + 1] if len(parts) > idx + 1 else ""
+        if method == "GET" and not name:
+            return {"items": list(store.values())}
+        if method == "GET":
+            if name not in store:
+                raise K8sApiError(404, "NotFound")
+            return store[name]
+        if method == "POST":
+            obj_name = body.get("metadata", {}).get("name", "")
+            store[obj_name] = body
+            return body
+        if method == "DELETE":
+            if name not in store:
+                raise K8sApiError(404, "NotFound")
+            del store[name]
+            return {}
+        if method == "PATCH":
+            if name not in store and parts[-1] != "status":
+                raise K8sApiError(404, "NotFound")
+            target = store.setdefault(name, {})
+            target.update(body or {})
+            return target
+        raise K8sApiError(405, "MethodNotAllowed")
+
+    def _stream(self, resource: str):
+        """Iterate watch lines pushed by the test until a None sentinel."""
+        q = self._watch_queue(resource)
+        while True:
+            line = q.get()
+            if line is None:
+                return
+            yield line
+
+    def push_watch_event(self, etype: str, obj: dict, resource: str = "pods"):
+        self._watch_queue(resource).put(
+            json.dumps({"type": etype, "object": obj}).encode()
+        )
+
+    def end_watch(self, resource: str = "pods"):
+        self._watch_queue(resource).put(None)
+
+
+def make_fake_client(namespace: str = "dlrover") -> Tuple[K8sClient, FakeTransport]:
+    transport = FakeTransport()
+    return K8sClient(namespace, transport=transport), transport
+
+
+def make_pod(
+    job: str,
+    node_type: str = "worker",
+    node_id: int = 0,
+    phase: str = "Running",
+    rank: Optional[int] = None,
+    exit_code: Optional[int] = None,
+    reason: str = "",
+    oom: bool = False,
+) -> dict:
+    """Pod fixture builder (reference ``create_pod`` test_utils.py:175-233)."""
+    from dlrover_tpu.master.scaler.pod_scaler import (
+        LABEL_ID_KEY,
+        LABEL_JOB_KEY,
+        LABEL_RANK_KEY,
+        LABEL_TYPE_KEY,
+    )
+
+    status: dict = {"phase": phase, "podIP": f"10.0.0.{node_id + 1}"}
+    if reason:
+        status["reason"] = reason
+    if exit_code is not None or oom:
+        status["containerStatuses"] = [
+            {
+                "state": {
+                    "terminated": {
+                        "exitCode": 137 if oom else (exit_code or 0),
+                        "reason": "OOMKilled" if oom else "Error",
+                    }
+                }
+            }
+        ]
+    return {
+        "metadata": {
+            "name": f"{job}-{node_type}-{node_id}",
+            "labels": {
+                LABEL_JOB_KEY: job,
+                LABEL_TYPE_KEY: node_type,
+                LABEL_ID_KEY: str(node_id),
+                LABEL_RANK_KEY: str(rank if rank is not None else node_id),
+            },
+        },
+        "status": status,
+    }
+
+
+ELASTICJOB_CR = {
+    "metadata": {
+        "name": "llama-elastic",
+        "namespace": "dlrover",
+        "uid": "uid-123",
+    },
+    "spec": {
+        "distributionStrategy": "allreduce",
+        "nodeUnit": 2,
+        "scalePlanMode": "direct",
+        "replicaSpecs": {
+            "worker": {
+                "replicas": 4,
+                "minReplicas": 2,
+                "maxReplicas": 6,
+                "restartCount": 3,
+                "template": {
+                    "metadata": {"labels": {"app": "llama"}},
+                    "spec": {
+                        "nodeSelector": {
+                            "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+                            "cloud.google.com/gke-tpu-topology": "2x2x1",
+                        },
+                        "containers": [
+                            {
+                                "name": "worker",
+                                "image": "img",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "8",
+                                        "memory": "16Gi",
+                                        "google.com/tpu": "4",
+                                    }
+                                },
+                            }
+                        ],
+                    },
+                },
+            }
+        },
+    },
+}

@@ -1,0 +1,449 @@
+"""K8s platform layer tests: client, JobArgs, PodScaler, watcher,
+DistributedJobManager — all against the fake transport (no cluster),
+mirroring the reference's mocked-k8s test strategy (SURVEY.md §4.2)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeEvent
+from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+from dlrover_tpu.master.resource.plan import ScalePlan
+from dlrover_tpu.master.scaler.pod_scaler import (
+    LABEL_JOB_KEY,
+    ElasticJobScaler,
+    PodScaler,
+)
+from dlrover_tpu.master.watcher.k8s_watcher import PodWatcher, pod_to_node
+from dlrover_tpu.scheduler.job import JobArgs, _parse_quantity
+from dlrover_tpu.scheduler.k8s_client import SCALEPLAN_PLURAL
+from tests.k8s_fakes import ELASTICJOB_CR, make_fake_client, make_pod
+
+
+@pytest.fixture(autouse=True)
+def fresh_context():
+    JobContext.reset_singleton()
+    yield
+    JobContext.reset_singleton()
+
+
+def make_job_args() -> JobArgs:
+    return JobArgs.from_elasticjob_cr(ELASTICJOB_CR)
+
+
+# -- JobArgs ----------------------------------------------------------------
+
+
+def test_job_args_from_cr():
+    args = make_job_args()
+    assert args.job_name == "llama-elastic"
+    assert args.job_uid == "uid-123"
+    assert args.node_unit == 2
+    spec = args.worker_spec
+    assert spec.group.count == 4
+    assert spec.min_nodes == 2 and spec.max_nodes == 6
+    res = spec.group.node_resource
+    assert res.cpu == 8
+    assert res.memory_mb == 16 * 1024
+    assert res.tpu_chips == 4
+    assert res.tpu_type == "tpu-v5p-slice:2x2x1"
+    assert args.tpu_type == "tpu-v5p-slice:2x2x1"
+
+
+def test_parse_quantity():
+    assert _parse_quantity("500m") == 0.5
+    assert _parse_quantity("2") == 2
+    assert _parse_quantity("1Gi") == 1024**2 * 1024
+    assert _parse_quantity("100M") == 1e8
+    assert _parse_quantity(4) == 4
+
+
+# -- K8s client -------------------------------------------------------------
+
+
+def test_k8s_client_pod_roundtrip():
+    client, transport = make_fake_client()
+    pod = make_pod("j1", node_id=0)
+    client.create_pod(pod)
+    assert client.get_pod("j1-worker-0") == pod
+    assert len(client.list_pods(f"{LABEL_JOB_KEY}=j1")) == 1
+    assert client.delete_pod("j1-worker-0") is True
+    assert client.delete_pod("j1-worker-0") is False  # 404 -> False
+    assert client.get_pod("j1-worker-0") is None
+
+
+def test_k8s_client_watch_stream():
+    client, transport = make_fake_client()
+    transport.push_watch_event("ADDED", make_pod("j1", node_id=3))
+    transport.end_watch()
+    events = list(client.watch_pods())
+    assert events[0][0] == "ADDED"
+    assert events[0][1]["metadata"]["name"] == "j1-worker-3"
+
+
+# -- PodScaler --------------------------------------------------------------
+
+
+def test_pod_scaler_creates_pods_with_env_and_labels():
+    args = make_job_args()
+    client, transport = make_fake_client()
+    scaler = PodScaler(args, client, master_addr="master-svc:50001")
+    node = Node(NodeType.WORKER, 7, rank_index=3)
+    node.relaunch_count = 2
+    plan = ScalePlan(launch_nodes=[node])
+    scaler.scale(plan)
+    # drain the queue synchronously instead of waiting for the thread
+    scaler._create_pod(scaler._create_queue.get_nowait())
+    pod = transport.pods["llama-elastic-worker-7"]
+    labels = pod["metadata"]["labels"]
+    assert labels[LABEL_JOB_KEY] == "llama-elastic"
+    assert labels["elastic.dlrover-tpu.org/replica-id"] == "7"
+    assert labels["elastic.dlrover-tpu.org/rank-index"] == "3"
+    assert labels["app"] == "llama"  # template labels preserved
+    env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+    assert env["DLROVER_TPU_MASTER_ADDR"] == "master-svc:50001"
+    assert env["DLROVER_TPU_NODE_ID"] == "7"
+    assert env["DLROVER_TPU_NODE_RANK"] == "3"
+    assert env["DLROVER_TPU_RESTART_COUNT"] == "2"
+    assert pod["metadata"]["ownerReferences"][0]["uid"] == "uid-123"
+    # TPU selectors came through from the template
+    assert (
+        pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+        == "2x2x1"
+    )
+
+
+def test_pod_scaler_remove_and_service():
+    args = make_job_args()
+    client, transport = make_fake_client()
+    scaler = PodScaler(args, client)
+    node = Node(NodeType.WORKER, 1)
+    scaler._create_pod(node)
+    scaler.scale(ScalePlan(remove_nodes=[node]))
+    assert "llama-elastic-worker-1" not in transport.pods
+    addr = scaler.create_master_service(50001)
+    assert addr == "elasticjob-llama-elastic-master.dlrover:50001"
+    assert "elasticjob-llama-elastic-master" in transport.services
+
+
+def test_pod_scaler_requeues_all_pending_on_failure():
+    args = make_job_args()
+    client, transport = make_fake_client()
+    scaler = PodScaler(args, client)
+    real_request = transport.request
+    calls = {"n": 0}
+
+    def flaky(method, path, body=None, **kw):
+        if method == "POST" and "/pods" in path:
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient api error")
+        return real_request(method, path, body=body, **kw)
+
+    transport.request = flaky
+    nodes = [Node(NodeType.WORKER, i) for i in range(3)]
+    scaler.scale(ScalePlan(launch_nodes=nodes))
+    # first drain: node 0 fails -> all 3 requeued; second drain: all created
+    scaler._drain_create_queue()
+    scaler._drain_create_queue()
+    assert len(transport.pods) == 3
+
+
+def test_elasticjob_scaler_recovers_plan_index():
+    args = make_job_args()
+    client, transport = make_fake_client()
+    transport.crs[SCALEPLAN_PLURAL] = {
+        "llama-elastic-scaleplan-7": {
+            "metadata": {"name": "llama-elastic-scaleplan-7"}
+        }
+    }
+    scaler = ElasticJobScaler(args, client)
+    scaler.scale(ScalePlan(launch_nodes=[Node(NodeType.WORKER, 0)]))
+    assert "llama-elastic-scaleplan-8" in transport.crs[SCALEPLAN_PLURAL]
+
+
+def test_pod_watcher_reconcile_synthesizes_deletes():
+    client, transport = make_fake_client()
+    got = []
+    watcher = PodWatcher("j1", client, got.append)
+    pod = make_pod("j1", node_id=0)
+    transport.pods[pod["metadata"]["name"]] = pod
+    watcher._reconcile()  # learns pod
+    assert got and got[-1].event_type == NodeEventType.MODIFIED
+    del transport.pods[pod["metadata"]["name"]]
+    got.clear()
+    watcher._reconcile()  # pod vanished in the gap -> DELETED event
+    assert [e.event_type for e in got] == [NodeEventType.DELETED]
+    assert got[0].node.status == NodeStatus.DELETED
+
+
+def test_elasticjob_scaler_writes_cr():
+    args = make_job_args()
+    client, transport = make_fake_client()
+    scaler = ElasticJobScaler(args, client)
+    node = Node(NodeType.WORKER, 5)
+    scaler.scale(ScalePlan(launch_nodes=[node]))
+    crs = transport.crs[SCALEPLAN_PLURAL]
+    assert len(crs) == 1
+    cr = next(iter(crs.values()))
+    assert cr["spec"]["ownerJob"] == "llama-elastic"
+    assert cr["spec"]["createPods"][0]["name"] == "llama-elastic-worker-5"
+
+
+# -- watcher mapping --------------------------------------------------------
+
+
+def test_pod_to_node_phases_and_exit_reasons():
+    node = pod_to_node(make_pod("j", node_id=0, phase="Running"))
+    assert node.status == NodeStatus.RUNNING
+    assert node.host_addr == "10.0.0.1"
+
+    oom = pod_to_node(make_pod("j", node_id=1, phase="Failed", oom=True))
+    assert oom.exit_reason == NodeExitReason.OOM
+
+    evicted = pod_to_node(
+        make_pod("j", node_id=2, phase="Failed", reason="Preempting")
+    )
+    assert evicted.exit_reason == NodeExitReason.PREEMPTED
+
+    crashed = pod_to_node(make_pod("j", node_id=3, phase="Failed", exit_code=1))
+    assert crashed.exit_reason == NodeExitReason.FATAL_ERROR
+
+    killed = pod_to_node(make_pod("j", node_id=4, phase="Failed", exit_code=137))
+    assert killed.exit_reason == NodeExitReason.KILLED
+
+    assert pod_to_node({"metadata": {"labels": {}}}) is None
+
+
+def test_pod_watcher_dispatches_events():
+    client, transport = make_fake_client()
+    got = []
+    watcher = PodWatcher("j1", client, got.append)
+    transport.push_watch_event("ADDED", make_pod("j1", node_id=0, phase="Pending"))
+    transport.push_watch_event("DELETED", make_pod("j1", node_id=0))
+    transport.end_watch()
+    watcher.start()
+    deadline = time.time() + 5
+    while len(got) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    watcher.stop()
+    transport.end_watch()
+    assert [e.event_type for e in got] == [
+        NodeEventType.CREATED,
+        NodeEventType.DELETED,
+    ]
+    assert got[1].node.status == NodeStatus.DELETED
+
+
+# -- DistributedJobManager --------------------------------------------------
+
+
+class RecordingScaler:
+    def __init__(self):
+        self.plans = []
+
+    def scale(self, plan):
+        self.plans.append(plan)
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+def make_manager(**kw):
+    args = make_job_args()
+    scaler = RecordingScaler()
+    mgr = DistributedJobManager(job_args=args, scaler=scaler, **kw)
+    return mgr, scaler
+
+
+def test_init_nodes_creates_initial_plan():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    assert len(scaler.plans) == 1
+    assert len(scaler.plans[0].launch_nodes) == 4
+    assert len(get_job_context().workers()) == 4
+
+
+def run_event(mgr, node_id, status, exit_reason=""):
+    node = Node(NodeType.WORKER, node_id, status=status)
+    node.exit_reason = exit_reason
+    mgr.handle_node_event(NodeEvent(NodeEventType.MODIFIED, node))
+
+
+def test_failed_node_is_relaunched_with_new_id():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
+    plan = scaler.plans[-1]
+    assert len(plan.launch_nodes) == 1
+    new_node = plan.launch_nodes[0]
+    assert new_node.id == 4  # next id after 0..3
+    assert new_node.relaunch_count == 1
+    assert plan.remove_nodes[0].id == 0
+    old = get_job_context().get_node(NodeType.WORKER, 0)
+    assert old.is_released
+
+
+def test_fatal_error_not_relaunched():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    n_plans = len(scaler.plans)
+    run_event(mgr, 1, NodeStatus.RUNNING)
+    run_event(mgr, 1, NodeStatus.FAILED, NodeExitReason.FATAL_ERROR)
+    assert len(scaler.plans) == n_plans  # no relaunch plan
+
+
+def test_preemption_does_not_consume_relaunch_budget():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    node = get_job_context().get_node(NodeType.WORKER, 2)
+    node.relaunch_count = 3  # budget exhausted
+    run_event(mgr, 2, NodeStatus.RUNNING)
+    run_event(mgr, 2, NodeStatus.FAILED, NodeExitReason.PREEMPTED)
+    plan = scaler.plans[-1]
+    assert plan.launch_nodes and plan.launch_nodes[0].relaunch_count == 3
+
+
+def test_relaunch_budget_exhausted_stops():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    node = get_job_context().get_node(NodeType.WORKER, 3)
+    node.relaunch_count = 3
+    n_plans = len(scaler.plans)
+    run_event(mgr, 3, NodeStatus.RUNNING)
+    run_event(mgr, 3, NodeStatus.FAILED, NodeExitReason.OOM)
+    assert len(scaler.plans) == n_plans
+
+
+def test_dead_node_removed_from_rendezvous():
+    class FakeRdzv:
+        def __init__(self):
+            self.removed = []
+
+        def remove_alive_node(self, node_id):
+            self.removed.append(node_id)
+
+    rdzv = FakeRdzv()
+    mgr, scaler = make_manager(rdzv_managers={"training": rdzv})
+    mgr._init_nodes()
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
+    assert rdzv.removed == [0]
+
+
+def test_heartbeat_timeout_marks_failed_and_relaunches():
+    mgr, scaler = make_manager(heartbeat_timeout=1)
+    mgr._init_nodes()
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    node = get_job_context().get_node(NodeType.WORKER, 0)
+    node.update_heartbeat(time.time() - 10)
+    mgr._check_heartbeats()
+    assert node.status == NodeStatus.FAILED
+    assert scaler.plans[-1].launch_nodes[0].id == 4
+
+
+def test_adjust_worker_count_scale_up_and_down():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    mgr.adjust_worker_count(6)
+    plan = scaler.plans[-1]
+    assert len(plan.launch_nodes) == 2
+    assert {n.id for n in plan.launch_nodes} == {4, 5}
+    mgr.adjust_worker_count(3)
+    plan = scaler.plans[-1]
+    assert len(plan.remove_nodes) == 3
+
+
+def test_apply_scale_plan_cr():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    mgr.apply_scale_plan_cr(
+        {"spec": {"replicaResourceSpecs": {"worker": {"replicas": 5}}}}
+    )
+    assert len(scaler.plans[-1].launch_nodes) == 1
+
+
+def test_early_stop_pending_timeout():
+    mgr, scaler = make_manager(pending_timeout=0.1)
+    mgr._init_nodes()
+    mgr._start_ts = time.time() - 10
+    for node in get_job_context().workers().values():
+        node.create_time = time.time() - 10
+    stop, reason, _ = mgr.should_early_stop()
+    assert stop and reason == "pending_timeout"
+
+
+def test_early_stop_insufficient_workers():
+    mgr, scaler = make_manager(pending_timeout=0.1)
+    mgr._init_nodes()
+    mgr._start_ts = time.time() - 10
+    ctx = get_job_context()
+    for node_id in range(4):
+        node = ctx.get_node(NodeType.WORKER, node_id)
+        node.create_time = time.time()
+        run_event(mgr, node_id, NodeStatus.RUNNING)
+    # kill 3 of 4 fatally (no relaunch): alive=1 < min=2
+    for node_id in range(3):
+        run_event(mgr, node_id, NodeStatus.FAILED, NodeExitReason.FATAL_ERROR)
+        ctx.get_node(NodeType.WORKER, node_id).is_released = True
+    stop, reason, _ = mgr.should_early_stop()
+    assert stop and reason == "insufficient_worker"
+
+
+def test_no_early_stop_while_healthy():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    for node_id in range(4):
+        run_event(mgr, node_id, NodeStatus.RUNNING)
+    stop, _, _ = mgr.should_early_stop()
+    assert not stop
+
+
+# -- DistributedJobMaster composition ---------------------------------------
+
+
+def test_dist_master_boots_creates_pods_and_stops():
+    from dlrover_tpu.master.dist_master import DistributedJobMaster
+
+    client, transport = make_fake_client()
+    args = make_job_args()
+    master = DistributedJobMaster(args, k8s_client=client)
+    try:
+        master.prepare()
+        deadline = time.time() + 10
+        while len(transport.pods) < 4 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(transport.pods) == 4  # initial worker set created
+        assert master.port > 0
+        # worker pods must carry a reachable master address (service DNS)
+        pod = next(iter(transport.pods.values()))
+        env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+        assert env["DLROVER_TPU_MASTER_ADDR"] == (
+            f"elasticjob-llama-elastic-master.dlrover:{master.port}"
+        )
+        # a worker pod failing with preemption gets replaced
+        transport.push_watch_event(
+            "MODIFIED",
+            make_pod(
+                "llama-elastic", node_id=0, phase="Failed", reason="Preempting"
+            ),
+        )
+        deadline = time.time() + 10
+        while "llama-elastic-worker-4" not in transport.pods and time.time() < deadline:
+            time.sleep(0.05)
+        assert "llama-elastic-worker-4" in transport.pods
+    finally:
+        master.stop()
+        transport.end_watch("pods")
+        transport.end_watch("scaleplans")
