@@ -10,6 +10,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List
 
+from dlrover_tpu.common.constants import TpuTimerConsts
+
 
 @dataclass
 class ElasticLaunchConfig:
@@ -32,6 +34,8 @@ class ElasticLaunchConfig:
     save_at_breakpoint: bool = False
     accelerator: str = "tpu"  # "tpu" | "cpu" (cpu = gloo test mode)
     training_port: int = 0  # coordinator port base; 0 = auto
+    tpu_timer: bool = False  # interpose the native PJRT profiler
+    tpu_timer_port: int = TpuTimerConsts.DEFAULT_PORT
 
     # TPU topology hints (injected by the platform or discovered)
     slice_name: str = ""

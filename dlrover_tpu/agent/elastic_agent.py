@@ -82,6 +82,40 @@ class ElasticAgent:
             client=self._client, node_id=config.node_id
         )
         self._diagnosis.set_log_source(self._last_worker_log_tail)
+        self._tpu_timer_env: Dict[str, str] = {}
+        if config.tpu_timer:
+            self._setup_tpu_timer()
+
+    def _setup_tpu_timer(self):
+        """Route workers' PJRT plugin loading through the native profiler
+        and scrape its metrics into diagnosis (reference: xpu_timer launch
+        wrapper + XpuTimerMetricsCollector). Each local rank gets its own
+        metrics port (base + local_rank) so servers never collide."""
+        import subprocess
+
+        from dlrover_tpu.profiler import TpuTimerMetricsSource, interposer_env
+
+        try:
+            self._tpu_timer_env = interposer_env(
+                port=self._config.tpu_timer_port
+            )
+        except subprocess.CalledProcessError as e:
+            logger.error(
+                "tpu_timer native build failed; disabled:\n%s",
+                (e.stderr or b"").decode(errors="replace")[-2000:],
+            )
+            self._tpu_timer_env = {}
+            return
+        except Exception:
+            logger.exception("tpu_timer setup failed; disabled")
+            self._tpu_timer_env = {}
+            return
+        if self._tpu_timer_env:
+            ports = [
+                self._config.tpu_timer_port + i
+                for i in range(self._config.nproc_per_node)
+            ]
+            self._diagnosis.set_metrics_source(TpuTimerMetricsSource(ports))
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -216,6 +250,12 @@ class ElasticAgent:
     def _worker_env(self, world: CommWorld, local_rank: int) -> Dict[str, str]:
         env = dict(os.environ)
         env.update(self._config.env)
+        if self._tpu_timer_env:
+            env.update(self._tpu_timer_env)
+            # one metrics server per local rank
+            env["DLROVER_TPU_TIMER_PORT"] = str(
+                self._config.tpu_timer_port + local_rank
+            )
         process_id = world.process_id_base + local_rank
         env.update(
             {

@@ -134,6 +134,13 @@ class DefaultValues:
     SPEED_SAMPLE_WINDOW = 10
 
 
+class TpuTimerConsts:
+    """Native PJRT profiler (native/tpu_timer) integration."""
+
+    DEFAULT_PORT = 18890
+    DEFAULT_HANG_SECS = 300
+
+
 class GraceWindow:
     """TPU preemption notice is short; save-on-signal must fit inside it."""
 

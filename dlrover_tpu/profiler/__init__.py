@@ -1,0 +1,8 @@
+from dlrover_tpu.profiler.tpu_timer import (  # noqa: F401
+    TpuTimerMetricsSource,
+    build_native,
+    dump_timeline,
+    interposer_env,
+    native_build_dir,
+    scrape_metrics,
+)

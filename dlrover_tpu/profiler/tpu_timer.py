@@ -1,0 +1,176 @@
+"""Python side of the native tpu_timer profiler.
+
+Parity: reference ``xpu_timer/py_xpu_timer`` tooling (``xpu_timer_launch``
+env setup, ``dump_timeline.py`` perfetto export) and the agent-side metric
+collector (``diagnosis/datacollector/xpu_timer_metric_collector.py:1-69``).
+The native interposer (``native/tpu_timer/interposer.cc``) wraps the PJRT
+plugin; this module enables it per-process, scrapes its Prometheus
+endpoint, and feeds the diagnosis pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import urllib.request
+from typing import Dict
+
+from dlrover_tpu.common.constants import TpuTimerConsts
+from dlrover_tpu.common.log import logger
+
+DEFAULT_PORT = TpuTimerConsts.DEFAULT_PORT
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "tpu_timer",
+)
+
+
+def native_build_dir() -> str:
+    return os.path.join(NATIVE_DIR, "build")
+
+
+def build_native(force: bool = False) -> str:
+    """Build the interposer (idempotent); returns the .so path."""
+    lib = os.path.join(native_build_dir(), "libdlrover_tpu_timer.so")
+    if force or not os.path.exists(lib):
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR], check=True, capture_output=True
+        )
+    return lib
+
+
+def find_libtpu() -> str:
+    """Locate the real libtpu the interposer should delegate to."""
+    explicit = os.getenv("TPU_LIBRARY_PATH", "")
+    if explicit and "dlrover_tpu_timer" not in explicit:
+        return explicit
+    try:
+        import libtpu  # type: ignore
+
+        return os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+    except ImportError:
+        return ""
+
+
+def interposer_env(
+    real_plugin: str = "",
+    port: int = DEFAULT_PORT,
+    hang_timeout_secs: int = 300,
+) -> Dict[str, str]:
+    """Env vars that route JAX's TPU plugin loading through the interposer.
+
+    JAX resolves libtpu via ``TPU_LIBRARY_PATH``; pointing it at the shim
+    and telling the shim where the real plugin lives is the whole trick —
+    the TPU-native analogue of the reference's LD_PRELOAD launch wrapper.
+    """
+    real_plugin = real_plugin or find_libtpu()
+    if not real_plugin:
+        logger.warning("libtpu not found; tpu_timer interposer disabled")
+        return {}
+    lib = build_native()
+    return {
+        "TPU_LIBRARY_PATH": lib,
+        "DLROVER_TPU_TIMER_REAL_PLUGIN": real_plugin,
+        "DLROVER_TPU_TIMER_PORT": str(port),
+        "DLROVER_TPU_TIMER_HANG_SECS": str(hang_timeout_secs),
+    }
+
+
+def _http_get(port: int, path: str, timeout: float = 2.0) -> str:
+    url = f"http://127.0.0.1:{port}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def scrape_metrics(port: int = DEFAULT_PORT) -> Dict:
+    """Prometheus text -> {plain: value, per_program: {name: {...}}}."""
+    try:
+        text = _http_get(port, "/metrics")
+    except OSError:
+        return {}
+    out: Dict = {"programs": {}}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        try:
+            num = float(value)
+        except ValueError:
+            continue
+        if "{" in key:
+            metric, label = key.split("{", 1)
+            name = label.split('"')[1]
+            short = metric.replace("dlrover_tpu_timer_", "")
+            out["programs"].setdefault(name, {})[short] = num
+        else:
+            out[key.replace("dlrover_tpu_timer_", "")] = num
+    return out
+
+
+def dump_timeline(path: str, port: int = DEFAULT_PORT) -> bool:
+    """Write the chrome-trace timeline (open in Perfetto / chrome://tracing)."""
+    try:
+        text = _http_get(port, "/timeline", timeout=10.0)
+    except OSError as e:
+        logger.warning("timeline fetch failed: %s", e)
+        return False
+    with open(path, "w") as f:
+        f.write(text)
+    logger.info("timeline written to %s", path)
+    return True
+
+
+class TpuTimerMetricsSource:
+    """Callable for ``DiagnosisAgent.set_metrics_source``: condenses the
+    scrape into the TpuMetricsRecord shape the master's hang-check operator
+    consumes (reference XpuTimerMetricsCollector). Accepts one port or a
+    list (one metrics server per local rank); a hang in ANY rank flags the
+    host."""
+
+    def __init__(self, ports=DEFAULT_PORT):
+        self._ports = [ports] if isinstance(ports, int) else list(ports)
+
+    def __call__(self) -> Dict:
+        scrapes = [m for m in (scrape_metrics(p) for p in self._ports) if m]
+        if not scrapes:
+            return {}
+        exec_total = 0.0
+        exec_us = 0.0
+        for m in scrapes:
+            for p in m["programs"].values():
+                exec_total += p.get("execute_total", 0)
+                exec_us += p.get("execute_us_sum", 0)
+        avg_ms = (exec_us / exec_total / 1000.0) if exec_total else 0.0
+        return {
+            "hang": any(bool(m.get("hang", 0)) for m in scrapes),
+            "step_latency_ms": avg_ms,
+            "pending": int(sum(m.get("pending", 0) for m in scrapes)),
+            "oldest_pending_us": int(
+                max(m.get("oldest_pending_us", 0) for m in scrapes)
+            ),
+            "execute_total": int(exec_total),
+        }
+
+
+def main(argv=None) -> int:
+    """``python -m dlrover_tpu.profiler.tpu_timer dump-timeline out.json``"""
+    import argparse
+
+    p = argparse.ArgumentParser("tpu_timer")
+    p.add_argument("command", choices=["dump-timeline", "metrics", "build"])
+    p.add_argument("output", nargs="?", default="timeline.json")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = p.parse_args(argv)
+    if args.command == "build":
+        print(build_native(force=True))
+        return 0
+    if args.command == "metrics":
+        print(json.dumps(scrape_metrics(args.port), indent=2))
+        return 0
+    return 0 if dump_timeline(args.output, args.port) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

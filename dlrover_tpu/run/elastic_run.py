@@ -23,7 +23,7 @@ from typing import List, Optional, Tuple
 from dlrover_tpu.agent.config import ElasticLaunchConfig
 from dlrover_tpu.agent.elastic_agent import ElasticAgent
 from dlrover_tpu.agent.master_client import MasterClient
-from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.constants import NodeEnv, TpuTimerConsts
 from dlrover_tpu.common.log import logger
 
 
@@ -56,6 +56,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--comm-perf-test", action="store_true", dest="comm_perf_test")
     p.add_argument("--exclude-straggler", action="store_true", dest="exclude_straggler")
     p.add_argument("--accelerator", default="tpu", choices=["tpu", "cpu"])
+    p.add_argument("--tpu-timer", action="store_true", dest="tpu_timer",
+                   help="interpose the native PJRT profiler into workers")
+    p.add_argument("--tpu-timer-port", type=int,
+                   default=TpuTimerConsts.DEFAULT_PORT, dest="tpu_timer_port")
     p.add_argument("--monitor_interval", type=float, default=2.0)
     p.add_argument("--rdzv_join_timeout", type=float, default=600.0)
     p.add_argument("training_script", help="path to the JAX training script")
@@ -116,6 +120,8 @@ def config_from_args(args) -> ElasticLaunchConfig:
         comm_perf_test=args.comm_perf_test,
         exclude_straggler=args.exclude_straggler,
         accelerator=args.accelerator,
+        tpu_timer=args.tpu_timer,
+        tpu_timer_port=args.tpu_timer_port,
         monitor_interval=args.monitor_interval,
         rdzv_join_timeout=args.rdzv_join_timeout,
         entrypoint=args.training_script,

@@ -1,0 +1,32 @@
+// Minimal blocking HTTP/1.0 server for /metrics, /timeline, /healthz.
+//
+// Parity: reference xpu_timer exports bvar metrics through a brpc server on
+// :18889 (xpu_timer/common/bvar_prometheus.cc); we serve the same payloads
+// with plain sockets so the interposer has zero dependencies.
+#ifndef DLROVER_TPU_TIMER_HTTP_SERVER_H_
+#define DLROVER_TPU_TIMER_HTTP_SERVER_H_
+
+#include <atomic>
+#include <thread>
+
+namespace dlrover_tpu {
+
+class MetricsHttpServer {
+ public:
+  // port 0 disables the server. Returns the bound port (0 when disabled).
+  int Start(int port);
+  void Stop();
+  int port() const { return port_; }
+  static MetricsHttpServer& Get();
+
+ private:
+  void Serve();
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stop_{false};
+  std::thread thread_;
+};
+
+}  // namespace dlrover_tpu
+
+#endif  // DLROVER_TPU_TIMER_HTTP_SERVER_H_

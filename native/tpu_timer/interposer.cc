@@ -1,0 +1,269 @@
+// PJRT C-API interposer: a shim PJRT plugin that delegates to the real one
+// (libtpu) while timing compilations and executions.
+//
+// Parity: reference xpu_timer hooks CUDA/cuBLAS/NCCL entry points via
+// LD_PRELOAD symbol interposition (xpu_timer/nvidia/hook.cc:54-121). On TPU
+// there are no per-kernel launch symbols — libtpu is driven through the
+// PJRT C API — so the equivalent seam is the PJRT_Api function-pointer
+// table: we export GetPjrtApi(), dlopen the real plugin (env
+// DLROVER_TPU_TIMER_REAL_PLUGIN), copy its PJRT_Api struct and replace
+// Compile/Execute/Destroy entries with timing wrappers. Device-side
+// completion is observed by attaching PJRT_Event_OnReady to the first
+// output buffer's ReadyEvent, which also powers hang detection (reference
+// doHang, xpu_timer/common/manager.cc:393-414).
+//
+// Usage (see dlrover_tpu/profiler/tpu_timer.py):
+//   TPU_LIBRARY_PATH=libdlrover_tpu_timer.so
+//   DLROVER_TPU_TIMER_REAL_PLUGIN=/path/to/libtpu.so
+//   DLROVER_TPU_TIMER_PORT=18890
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "http_server.h"
+#include "timer_manager.h"
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace dlrover_tpu {
+namespace {
+
+const PJRT_Api* g_real = nullptr;
+PJRT_Api g_wrapped;
+
+std::mutex g_info_mu;
+struct ExecInfo {
+  std::string name;
+  int num_outputs = 0;
+};
+std::unordered_map<PJRT_LoadedExecutable*, ExecInfo> g_exec_info;
+
+void FreeError(PJRT_Error* err) {
+  if (err == nullptr) return;
+  PJRT_Error_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_real->PJRT_Error_Destroy(&d);
+}
+
+// Look up name + output count of a freshly compiled/loaded executable.
+ExecInfo DescribeExecutable(PJRT_LoadedExecutable* loaded) {
+  ExecInfo info;
+  info.name = "unknown";
+  PJRT_LoadedExecutable_GetExecutable_Args ge;
+  memset(&ge, 0, sizeof(ge));
+  ge.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ge.loaded_executable = loaded;
+  if (PJRT_Error* err = g_real->PJRT_LoadedExecutable_GetExecutable(&ge)) {
+    FreeError(err);
+    return info;
+  }
+  PJRT_Executable_Name_Args na;
+  memset(&na, 0, sizeof(na));
+  na.struct_size = PJRT_Executable_Name_Args_STRUCT_SIZE;
+  na.executable = ge.executable;
+  if (PJRT_Error* err = g_real->PJRT_Executable_Name(&na)) {
+    FreeError(err);
+  } else if (na.executable_name != nullptr) {
+    info.name.assign(na.executable_name, na.executable_name_size);
+  }
+  PJRT_Executable_NumOutputs_Args no;
+  memset(&no, 0, sizeof(no));
+  no.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+  no.executable = ge.executable;
+  if (PJRT_Error* err = g_real->PJRT_Executable_NumOutputs(&no)) {
+    FreeError(err);
+  } else {
+    info.num_outputs = (int)no.num_outputs;
+  }
+  return info;
+}
+
+PJRT_Error* WrappedCompile(PJRT_Client_Compile_Args* args) {
+  auto& mgr = TimerManager::Get();
+  int64_t start = mgr.NowUs();
+  PJRT_Error* err = g_real->PJRT_Client_Compile(args);
+  int64_t dur = mgr.NowUs() - start;
+  if (err == nullptr && args->executable != nullptr) {
+    ExecInfo info = DescribeExecutable(args->executable);
+    mgr.RecordCompile(info.name, dur);
+    std::lock_guard<std::mutex> lock(g_info_mu);
+    g_exec_info[args->executable] = std::move(info);
+  } else {
+    mgr.RecordCompile("compile_error", dur);
+  }
+  return err;
+}
+
+PJRT_Error* WrappedDeserializeAndLoad(
+    PJRT_Executable_DeserializeAndLoad_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Executable_DeserializeAndLoad(args);
+  if (err == nullptr && args->loaded_executable != nullptr) {
+    ExecInfo info = DescribeExecutable(args->loaded_executable);
+    std::lock_guard<std::mutex> lock(g_info_mu);
+    g_exec_info[args->loaded_executable] = std::move(info);
+  }
+  return err;
+}
+
+PJRT_Error* WrappedExecutableDestroy(PJRT_LoadedExecutable_Destroy_Args* args) {
+  {
+    std::lock_guard<std::mutex> lock(g_info_mu);
+    g_exec_info.erase(args->executable);
+  }
+  return g_real->PJRT_LoadedExecutable_Destroy(args);
+}
+
+struct DoneCtx {
+  uint64_t token;
+  PJRT_Event* event;
+};
+
+void OnExecDone(PJRT_Error* error, void* user_arg) {
+  DoneCtx* ctx = static_cast<DoneCtx*>(user_arg);
+  TimerManager::Get().EndExecute(ctx->token, error != nullptr);
+  FreeError(error);
+  if (ctx->event != nullptr) {
+    PJRT_Event_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    d.event = ctx->event;
+    FreeError(g_real->PJRT_Event_Destroy(&d));
+  }
+  delete ctx;
+}
+
+// Attach completion tracking to the first output buffer. Returns false if
+// no hook could be attached (caller then closes the timing span itself).
+bool TrackCompletion(PJRT_LoadedExecutable_Execute_Args* args,
+                     uint64_t token) {
+  if (args->output_lists == nullptr || args->num_devices == 0) return false;
+  PJRT_Buffer* out0 =
+      args->output_lists[0] != nullptr ? args->output_lists[0][0] : nullptr;
+  if (out0 == nullptr) return false;
+  PJRT_Buffer_ReadyEvent_Args re;
+  memset(&re, 0, sizeof(re));
+  re.struct_size = PJRT_Buffer_ReadyEvent_Args_STRUCT_SIZE;
+  re.buffer = out0;
+  if (PJRT_Error* err = g_real->PJRT_Buffer_ReadyEvent(&re)) {
+    FreeError(err);
+    return false;
+  }
+  DoneCtx* ctx = new DoneCtx{token, re.event};
+  PJRT_Event_OnReady_Args oa;
+  memset(&oa, 0, sizeof(oa));
+  oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+  oa.event = re.event;
+  oa.callback = &OnExecDone;
+  oa.user_arg = ctx;
+  if (PJRT_Error* err = g_real->PJRT_Event_OnReady(&oa)) {
+    FreeError(err);
+    // still own the event; release it and fall back to host timing
+    PJRT_Event_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    d.event = re.event;
+    FreeError(g_real->PJRT_Event_Destroy(&d));
+    delete ctx;
+    return false;
+  }
+  return true;
+}
+
+PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
+  auto& mgr = TimerManager::Get();
+  std::string name;
+  int num_outputs = 0;
+  {
+    std::lock_guard<std::mutex> lock(g_info_mu);
+    auto it = g_exec_info.find(args->executable);
+    if (it != g_exec_info.end()) {
+      name = it->second.name;
+      num_outputs = it->second.num_outputs;
+    }
+  }
+  if (name.empty()) {
+    ExecInfo info = DescribeExecutable(args->executable);
+    name = info.name;
+    num_outputs = info.num_outputs;
+    std::lock_guard<std::mutex> lock(g_info_mu);
+    g_exec_info[args->executable] = std::move(info);
+  }
+  uint64_t token = mgr.BeginExecute(name);
+  PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
+  if (err != nullptr) {
+    mgr.EndExecute(token, /*error=*/true);
+    return err;
+  }
+  if (num_outputs == 0 || !TrackCompletion(args, token)) {
+    // no output to hook (e.g. tuple-less program): close at host return
+    mgr.EndExecute(token, /*error=*/false);
+  }
+  return nullptr;
+}
+
+const PJRT_Api* LoadReal() {
+  const char* path = std::getenv("DLROVER_TPU_TIMER_REAL_PLUGIN");
+  if (path == nullptr || path[0] == 0) {
+    fprintf(stderr,
+            "[dlrover_tpu_timer] DLROVER_TPU_TIMER_REAL_PLUGIN not set\n");
+    return nullptr;
+  }
+  void* handle = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+  if (handle == nullptr) {
+    fprintf(stderr, "[dlrover_tpu_timer] dlopen(%s) failed: %s\n", path,
+            dlerror());
+    return nullptr;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    fprintf(stderr, "[dlrover_tpu_timer] %s has no GetPjrtApi\n", path);
+    return nullptr;
+  }
+  return get_api();
+}
+
+const PJRT_Api* BuildWrapped() {
+  static std::once_flag once;
+  static bool ok = false;
+  std::call_once(once, [] {
+    g_real = LoadReal();
+    if (g_real == nullptr) return;
+    memset(&g_wrapped, 0, sizeof(g_wrapped));
+    size_t copy = g_real->struct_size < sizeof(g_wrapped)
+                      ? g_real->struct_size
+                      : sizeof(g_wrapped);
+    memcpy(&g_wrapped, g_real, copy);
+    g_wrapped.struct_size = copy;
+    g_wrapped.PJRT_Client_Compile = &WrappedCompile;
+    g_wrapped.PJRT_LoadedExecutable_Execute = &WrappedExecute;
+    g_wrapped.PJRT_LoadedExecutable_Destroy = &WrappedExecutableDestroy;
+    if (g_real->struct_size >=
+        PJRT_STRUCT_SIZE(PJRT_Api, PJRT_Executable_DeserializeAndLoad))
+      g_wrapped.PJRT_Executable_DeserializeAndLoad =
+          &WrappedDeserializeAndLoad;
+    const char* port_env = std::getenv("DLROVER_TPU_TIMER_PORT");
+    int port = port_env ? std::atoi(port_env) : 18890;
+    MetricsHttpServer::Get().Start(port);
+    TimerManager::Get();  // starts the hang watcher
+    ok = true;
+    fprintf(stderr, "[dlrover_tpu_timer] interposing PJRT plugin (v%d.%d)\n",
+            g_real->pjrt_api_version.major_version,
+            g_real->pjrt_api_version.minor_version);
+  });
+  return ok ? &g_wrapped : nullptr;
+}
+
+}  // namespace
+}  // namespace dlrover_tpu
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  return dlrover_tpu::BuildWrapped();
+}

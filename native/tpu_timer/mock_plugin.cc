@@ -1,0 +1,123 @@
+// Test-only PJRT plugin implementing just enough of the C API for the
+// interposer to be exercised hermetically (no TPU, no libtpu): compile
+// returns an opaque executable named "mock_program", execute completes
+// asynchronously after MOCK_PJRT_EXEC_US (or never, with MOCK_PJRT_HANG=1,
+// to drive the hang detector). This mirrors the reference's strategy of
+// testing the hook layer against fakes (xpu_timer/test/).
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct MockExecutable {
+  int magic = 0x7A7A;
+};
+
+struct MockEvent {
+  PJRT_Event_OnReadyCallback callback = nullptr;
+  void* user_arg = nullptr;
+};
+
+int64_t EnvInt(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  return v ? std::atoll(v) : dflt;
+}
+
+PJRT_Error* MockCompile(PJRT_Client_Compile_Args* args) {
+  usleep(EnvInt("MOCK_PJRT_COMPILE_US", 2000));
+  args->executable =
+      reinterpret_cast<PJRT_LoadedExecutable*>(new MockExecutable());
+  return nullptr;
+}
+
+PJRT_Error* MockGetExecutable(PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  args->executable =
+      reinterpret_cast<PJRT_Executable*>(args->loaded_executable);
+  return nullptr;
+}
+
+PJRT_Error* MockName(PJRT_Executable_Name_Args* args) {
+  static const char kName[] = "mock_program";
+  args->executable_name = kName;
+  args->executable_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* MockNumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs = 1;
+  return nullptr;
+}
+
+PJRT_Error* MockExecDestroy(PJRT_LoadedExecutable_Destroy_Args* args) {
+  delete reinterpret_cast<MockExecutable*>(args->executable);
+  return nullptr;
+}
+
+PJRT_Error* MockExecute(PJRT_LoadedExecutable_Execute_Args* args) {
+  usleep(EnvInt("MOCK_PJRT_HOST_US", 100));
+  return nullptr;  // outputs: caller-allocated handles stay as-is
+}
+
+PJRT_Error* MockReadyEvent(PJRT_Buffer_ReadyEvent_Args* args) {
+  args->event = reinterpret_cast<PJRT_Event*>(new MockEvent());
+  return nullptr;
+}
+
+PJRT_Error* MockOnReady(PJRT_Event_OnReady_Args* args) {
+  auto* ev = reinterpret_cast<MockEvent*>(args->event);
+  ev->callback = args->callback;
+  ev->user_arg = args->user_arg;
+  if (EnvInt("MOCK_PJRT_HANG", 0)) return nullptr;  // never completes
+  auto cb = args->callback;
+  auto ua = args->user_arg;
+  std::thread([cb, ua] {
+    usleep(EnvInt("MOCK_PJRT_EXEC_US", 5000));
+    cb(nullptr, ua);
+  }).detach();
+  return nullptr;
+}
+
+PJRT_Error* MockEventDestroy(PJRT_Event_Destroy_Args* args) {
+  delete reinterpret_cast<MockEvent*>(args->event);
+  return nullptr;
+}
+
+void MockErrorDestroy(PJRT_Error_Destroy_Args*) {}
+void MockErrorMessage(PJRT_Error_Message_Args* args) {
+  args->message = "mock error";
+  args->message_size = 10;
+}
+
+PJRT_Api g_api;
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static bool init = [] {
+    memset(&g_api, 0, sizeof(g_api));
+    g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+    g_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+    g_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+    g_api.PJRT_Error_Destroy = &MockErrorDestroy;
+    g_api.PJRT_Error_Message = &MockErrorMessage;
+    g_api.PJRT_Event_Destroy = &MockEventDestroy;
+    g_api.PJRT_Event_OnReady = &MockOnReady;
+    g_api.PJRT_Client_Compile = &MockCompile;
+    g_api.PJRT_LoadedExecutable_GetExecutable = &MockGetExecutable;
+    g_api.PJRT_Executable_Name = &MockName;
+    g_api.PJRT_Executable_NumOutputs = &MockNumOutputs;
+    g_api.PJRT_LoadedExecutable_Destroy = &MockExecDestroy;
+    g_api.PJRT_LoadedExecutable_Execute = &MockExecute;
+    g_api.PJRT_Buffer_ReadyEvent = &MockReadyEvent;
+    return true;
+  }();
+  (void)init;
+  return &g_api;
+}

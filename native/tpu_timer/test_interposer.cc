@@ -1,0 +1,99 @@
+// Harness: loads the interposer (which loads the mock plugin via
+// DLROVER_TPU_TIMER_REAL_PLUGIN), drives compile + executes through the
+// wrapped PJRT_Api, then fetches /metrics over loopback and prints it so
+// the pytest wrapper can assert on the content.
+//
+//   test_interposer <interposer.so> <num_executes> <settle_ms>
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+static std::string HttpGet(int port, const char* path) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (connect(fd, (struct sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return "CONNECT_FAILED";
+  }
+  char req[256];
+  int n = snprintf(req, sizeof(req), "GET %s HTTP/1.0\r\n\r\n", path);
+  (void)!write(fd, req, n);
+  std::string out;
+  char buf[4096];
+  ssize_t r;
+  while ((r = read(fd, buf, sizeof(buf))) > 0) out.append(buf, r);
+  close(fd);
+  return out;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <interposer.so> <execs> <settle_ms>\n", argv[0]);
+    return 2;
+  }
+  void* handle = dlopen(argv[1], RTLD_NOW);
+  if (!handle) {
+    fprintf(stderr, "dlopen failed: %s\n", dlerror());
+    return 2;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetApiFn>(dlsym(handle, "GetPjrtApi"));
+  const PJRT_Api* api = get_api ? get_api() : nullptr;
+  if (!api) {
+    fprintf(stderr, "GetPjrtApi returned null\n");
+    return 2;
+  }
+
+  PJRT_Client_Compile_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  if (api->PJRT_Client_Compile(&ca) != nullptr || ca.executable == nullptr) {
+    fprintf(stderr, "compile failed\n");
+    return 2;
+  }
+
+  int execs = atoi(argv[2]);
+  // fake output buffer handles: the mock never dereferences them
+  int fake_buffer;
+  PJRT_Buffer* out_row[1] = {reinterpret_cast<PJRT_Buffer*>(&fake_buffer)};
+  PJRT_Buffer** output_lists[1] = {out_row};
+  for (int i = 0; i < execs; i++) {
+    PJRT_LoadedExecutable_Execute_Args ea;
+    memset(&ea, 0, sizeof(ea));
+    ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ea.executable = ca.executable;
+    ea.num_devices = 1;
+    ea.output_lists = output_lists;
+    if (api->PJRT_LoadedExecutable_Execute(&ea) != nullptr) {
+      fprintf(stderr, "execute failed\n");
+      return 2;
+    }
+  }
+  usleep(atoi(argv[3]) * 1000);
+
+  const char* port_env = getenv("DLROVER_TPU_TIMER_PORT");
+  int port = port_env ? atoi(port_env) : 18890;
+  printf("==METRICS==\n%s\n", HttpGet(port, "/metrics").c_str());
+  printf("==TIMELINE==\n%s\n", HttpGet(port, "/timeline").c_str());
+
+  PJRT_LoadedExecutable_Destroy_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  da.executable = ca.executable;
+  api->PJRT_LoadedExecutable_Destroy(&da);
+  return 0;
+}

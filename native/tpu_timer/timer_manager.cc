@@ -1,0 +1,183 @@
+#include "timer_manager.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <sstream>
+
+namespace dlrover_tpu {
+
+static int64_t MonotonicNs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+TimerManager& TimerManager::Get() {
+  static TimerManager* mgr = new TimerManager();  // leaked: outlive plugin
+  return *mgr;
+}
+
+TimerManager::TimerManager() : t0_ns_(MonotonicNs()) {
+  const char* env = std::getenv("DLROVER_TPU_TIMER_HANG_SECS");
+  int64_t secs = env ? std::atoll(env) : 300;
+  if (secs <= 0) secs = 300;
+  hang_timeout_us_ = secs * 1000000LL;
+  watcher_ = std::thread([this] { WatchLoop(); });
+}
+
+TimerManager::~TimerManager() {
+  stop_ = true;
+  if (watcher_.joinable()) watcher_.join();
+}
+
+int64_t TimerManager::NowUs() const { return (MonotonicNs() - t0_ns_) / 1000; }
+
+void TimerManager::RecordCompile(const std::string& name, int64_t dur_us) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& s = compile_stats_[name];
+  s.count++;
+  s.total_us += dur_us;
+  if ((uint64_t)dur_us > s.max_us) s.max_us = dur_us;
+  trace_.push_back({name, "compile", NowUs() - dur_us, dur_us});
+  if (trace_.size() > trace_cap_) trace_.pop_front();
+}
+
+uint64_t TimerManager::BeginExecute(const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
+  uint64_t token = next_token_++;
+  pending_[token] = {name, NowUs()};
+  return token;
+}
+
+void TimerManager::EndExecute(uint64_t token, bool error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = pending_.find(token);
+  if (it == pending_.end()) return;
+  int64_t dur = NowUs() - it->second.start_us;
+  auto& s = exec_stats_[it->second.name];
+  s.count++;
+  s.total_us += dur;
+  if ((uint64_t)dur > s.max_us) s.max_us = dur;
+  if (error) s.errors++;
+  trace_.push_back({it->second.name, "execute", it->second.start_us, dur});
+  if (trace_.size() > trace_cap_) trace_.pop_front();
+  pending_.erase(it);
+  if (pending_.empty()) hang_ = false;
+}
+
+size_t TimerManager::PendingCount() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return pending_.size();
+}
+
+int64_t TimerManager::OldestPendingUs() {
+  std::lock_guard<std::mutex> lock(mu_);
+  int64_t now = NowUs();
+  int64_t oldest = 0;
+  for (const auto& kv : pending_) {
+    int64_t age = now - kv.second.start_us;
+    if (age > oldest) oldest = age;
+  }
+  return oldest;
+}
+
+bool TimerManager::HangDetected() { return hang_.load(); }
+
+void TimerManager::WatchLoop() {
+  // Reference doHang (manager.cc:393-414): the queue head aging past the
+  // timeout flags a hang; we additionally log the pending programs once.
+  bool reported = false;
+  while (!stop_) {
+    struct timespec ts = {0, 200 * 1000000};  // 200ms
+    nanosleep(&ts, nullptr);
+    int64_t oldest = OldestPendingUs();
+    if (oldest > hang_timeout_us_.load()) {
+      hang_ = true;
+      if (!reported) {
+        reported = true;
+        std::lock_guard<std::mutex> lock(mu_);
+        fprintf(stderr,
+                "[dlrover_tpu_timer] HANG: %zu executions pending, oldest "
+                "%.1fs; pending programs:\n",
+                pending_.size(), oldest / 1e6);
+        for (const auto& kv : pending_)
+          fprintf(stderr, "[dlrover_tpu_timer]   %s (%.1fs)\n",
+                  kv.second.name.c_str(),
+                  (NowUs() - kv.second.start_us) / 1e6);
+      }
+    } else if (hang_ && oldest == 0) {
+      hang_ = false;
+      reported = false;
+    }
+  }
+}
+
+static void AppendStats(
+    std::ostringstream& out, const char* metric,
+    const std::unordered_map<std::string, ProgramStats>& stats) {
+  for (const auto& kv : stats) {
+    const auto& s = kv.second;
+    out << metric << "_total{program=\"" << kv.first << "\"} " << s.count
+        << "\n";
+    out << metric << "_us_sum{program=\"" << kv.first << "\"} " << s.total_us
+        << "\n";
+    out << metric << "_us_max{program=\"" << kv.first << "\"} " << s.max_us
+        << "\n";
+    if (s.errors)
+      out << metric << "_errors{program=\"" << kv.first << "\"} " << s.errors
+          << "\n";
+  }
+}
+
+std::string TimerManager::PrometheusText() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::ostringstream out;
+  out << "# dlrover_tpu_timer metrics\n";
+  out << "dlrover_tpu_timer_uptime_us " << NowUs() << "\n";
+  out << "dlrover_tpu_timer_pending " << pending_.size() << "\n";
+  out << "dlrover_tpu_timer_hang " << (hang_ ? 1 : 0) << "\n";
+  int64_t now = NowUs();
+  int64_t oldest = 0;
+  for (const auto& kv : pending_) {
+    int64_t age = now - kv.second.start_us;
+    if (age > oldest) oldest = age;
+  }
+  out << "dlrover_tpu_timer_oldest_pending_us " << oldest << "\n";
+  AppendStats(out, "dlrover_tpu_timer_execute", exec_stats_);
+  AppendStats(out, "dlrover_tpu_timer_compile", compile_stats_);
+  return out.str();
+}
+
+static void JsonEscape(std::ostringstream& out, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\')
+      out << '\\' << c;
+    else if ((unsigned char)c < 0x20)
+      out << ' ';
+    else
+      out << c;
+  }
+}
+
+std::string TimerManager::TimelineJson() {
+  // Chrome trace-event format; loadable in Perfetto (reference
+  // py_xpu_timer/dump_timeline.py emits the same shape).
+  std::lock_guard<std::mutex> lock(mu_);
+  std::ostringstream out;
+  out << "{\"traceEvents\":[";
+  bool first = true;
+  for (const auto& ev : trace_) {
+    if (!first) out << ",";
+    first = false;
+    out << "{\"name\":\"";
+    JsonEscape(out, ev.name);
+    out << "\",\"cat\":\"" << ev.kind << "\",\"ph\":\"X\",\"ts\":"
+        << ev.start_us << ",\"dur\":" << ev.dur_us
+        << ",\"pid\":1,\"tid\":" << (ev.kind[0] == 'c' ? 2 : 1) << "}";
+  }
+  out << "]}";
+  return out.str();
+}
+
+}  // namespace dlrover_tpu

@@ -1,0 +1,89 @@
+// TimerManager: per-program timing records, stats, hang detection.
+//
+// Parity: reference xpu_timer GpuTimerManager (xpu_timer/common/manager.h:
+// 106-197) — event pool + worker thread computing latency and detecting a
+// hang when the queue head exceeds a timeout. TPU-natively the "events" are
+// PJRT execution completions delivered by PJRT_Event_OnReady callbacks, so
+// there is no polling of device events; the worker thread only ages the
+// pending set for hang detection.
+#ifndef DLROVER_TPU_TIMER_MANAGER_H_
+#define DLROVER_TPU_TIMER_MANAGER_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace dlrover_tpu {
+
+struct TraceEvent {
+  std::string name;
+  const char* kind;  // "compile" | "execute"
+  int64_t start_us;  // since manager start
+  int64_t dur_us;
+};
+
+struct ProgramStats {
+  uint64_t count = 0;
+  uint64_t total_us = 0;
+  uint64_t max_us = 0;
+  uint64_t errors = 0;
+};
+
+class TimerManager {
+ public:
+  static TimerManager& Get();
+
+  // -- recording ------------------------------------------------------------
+  void RecordCompile(const std::string& name, int64_t dur_us);
+  // Returns a token identifying the pending execution.
+  uint64_t BeginExecute(const std::string& name);
+  void EndExecute(uint64_t token, bool error);
+
+  // -- introspection --------------------------------------------------------
+  size_t PendingCount();
+  bool HangDetected();
+  // Oldest pending execution age in us (0 when none pending).
+  int64_t OldestPendingUs();
+  std::string PrometheusText();
+  std::string TimelineJson();
+
+  int64_t NowUs() const;
+
+  // Test hook: shrink the hang timeout (normally from env
+  // DLROVER_TPU_TIMER_HANG_SECS, default 300).
+  void SetHangTimeoutUs(int64_t us) { hang_timeout_us_ = us; }
+
+ private:
+  TimerManager();
+  ~TimerManager();
+  void WatchLoop();
+
+  struct Pending {
+    std::string name;
+    int64_t start_us;
+  };
+
+  std::mutex mu_;
+  std::unordered_map<uint64_t, Pending> pending_;
+  std::unordered_map<std::string, ProgramStats> exec_stats_;
+  std::unordered_map<std::string, ProgramStats> compile_stats_;
+  std::deque<TraceEvent> trace_;  // bounded ring
+  uint64_t next_token_ = 1;
+  size_t trace_cap_ = 100000;
+
+  std::atomic<bool> hang_{false};
+  std::atomic<int64_t> hang_timeout_us_;
+  std::atomic<bool> stop_{false};
+  int64_t t0_ns_;
+  std::thread watcher_;
+};
+
+}  // namespace dlrover_tpu
+
+#endif  // DLROVER_TPU_TIMER_MANAGER_H_

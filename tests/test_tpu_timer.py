@@ -1,0 +1,135 @@
+"""Native tpu_timer profiler tests: build the interposer + mock PJRT
+plugin, drive compile/execute through the wrapped PJRT_Api in a subprocess,
+and assert on the scraped metrics/timeline (reference xpu_timer tests the
+hook layer against fakes the same way, ``xpu_timer/test/``)."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+from dlrover_tpu.profiler.tpu_timer import (
+    NATIVE_DIR,
+    TpuTimerMetricsSource,
+    build_native,
+    native_build_dir,
+    scrape_metrics,
+)
+from dlrover_tpu.utils.net import find_free_port
+
+
+@pytest.fixture(scope="module")
+def native():
+    build_native()
+    build = native_build_dir()
+    return {
+        "interposer": os.path.join(build, "libdlrover_tpu_timer.so"),
+        "mock": os.path.join(build, "libmock_pjrt.so"),
+        "harness": os.path.join(build, "test_interposer"),
+    }
+
+
+def run_harness(native, port, execs=5, settle_ms=300, extra_env=None):
+    env = dict(os.environ)
+    env.update(
+        {
+            "DLROVER_TPU_TIMER_REAL_PLUGIN": native["mock"],
+            "DLROVER_TPU_TIMER_PORT": str(port),
+            "MOCK_PJRT_EXEC_US": "20000",
+        }
+    )
+    env.update(extra_env or {})
+    return subprocess.run(
+        [native["harness"], native["interposer"], str(execs), str(settle_ms)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+
+
+def test_interposer_times_compile_and_async_execute(native):
+    port = find_free_port()
+    r = run_harness(native, port, execs=5)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert 'dlrover_tpu_timer_execute_total{program="mock_program"} 5' in out
+    assert 'dlrover_tpu_timer_compile_total{program="mock_program"} 1' in out
+    assert "dlrover_tpu_timer_hang 0" in out
+    # async completion: measured duration must reflect the 20ms device
+    # delay, not the 100us host-side return
+    sum_line = next(
+        l for l in out.splitlines() if "execute_us_sum" in l
+    )
+    assert float(sum_line.rsplit(" ", 1)[1]) > 5 * 15000
+    # timeline is valid chrome-trace JSON with both categories
+    timeline = out.split("==TIMELINE==")[1]
+    body = timeline[timeline.index("{") :]
+    trace = json.loads(body[: body.rindex("}") + 1])
+    cats = {e["cat"] for e in trace["traceEvents"]}
+    assert cats == {"compile", "execute"}
+
+
+def test_interposer_detects_hang(native):
+    port = find_free_port()
+    r = run_harness(
+        native,
+        port,
+        execs=2,
+        settle_ms=1600,
+        extra_env={"MOCK_PJRT_HANG": "1", "DLROVER_TPU_TIMER_HANG_SECS": "1"},
+    )
+    assert r.returncode == 0, r.stderr
+    assert "dlrover_tpu_timer_hang 1" in r.stdout
+    assert "dlrover_tpu_timer_pending 2" in r.stdout
+    assert "HANG: 2 executions pending" in r.stderr
+
+
+def test_scrape_metrics_and_diagnosis_source(native):
+    """Scrape a live interposer process from Python (the agent-side path)."""
+    port = find_free_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "DLROVER_TPU_TIMER_REAL_PLUGIN": native["mock"],
+            "DLROVER_TPU_TIMER_PORT": str(port),
+            "MOCK_PJRT_EXEC_US": "1000",
+        }
+    )
+    # settle_ms=2500 keeps the harness (and its http server) alive while we
+    # scrape from this process
+    proc = subprocess.Popen(
+        [native["harness"], native["interposer"], "3", "2500"],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        import time
+
+        metrics = {}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            metrics = scrape_metrics(port)
+            if metrics.get("programs", {}).get("mock_program", {}).get(
+                "execute_total"
+            ) == 3:
+                break
+            time.sleep(0.1)
+        assert metrics["programs"]["mock_program"]["execute_total"] == 3
+        source = TpuTimerMetricsSource(port)
+        snapshot = source()
+        assert snapshot["hang"] is False
+        assert snapshot["execute_total"] == 3
+        assert snapshot["step_latency_ms"] > 0
+        # multi-port source (one per local rank): dead ports are skipped
+        multi = TpuTimerMetricsSource([port, find_free_port()])
+        snapshot = multi()
+        assert snapshot["execute_total"] == 3
+    finally:
+        proc.wait(timeout=30)
+
+
+def test_scrape_metrics_absent_endpoint_returns_empty():
+    assert scrape_metrics(find_free_port()) == {}
