@@ -36,6 +36,7 @@ class ElasticLaunchConfig:
     training_port: int = 0  # coordinator port base; 0 = auto
     tpu_timer: bool = False  # interpose the native PJRT profiler
     tpu_timer_port: int = TpuTimerConsts.DEFAULT_PORT
+    ckpt_replica: bool = False  # cross-host backup of staged checkpoints
 
     # TPU topology hints (injected by the platform or discovered)
     slice_name: str = ""

@@ -147,8 +147,17 @@ class ElasticAgent:
             self._ckpt_saver = AsyncCheckpointSaver(
                 job_name=self._config.job_name,
                 node_id=self._config.node_id,
+                replica=self._config.ckpt_replica,
             )
             self._ckpt_saver.start()
+            if self._ckpt_saver.replica_port:
+                # publish the replica server so peers can reach it
+                self._client.report_node_address(
+                    self._node_ip,
+                    port=self._ckpt_saver.replica_port,
+                    slice_name=self._config.slice_name,
+                    coords=self._config.coords,
+                )
         except Exception:
             logger.exception("checkpoint saver failed to start; continuing")
             self._ckpt_saver = None
@@ -243,13 +252,70 @@ class ElasticAgent:
                     for i in range(self._config.nproc_per_node)
                 ],
             )
+            if self._config.ckpt_replica:
+                self._sync_replica_peers(world)
         return world
+
+    def _replica_token(self, world: CommWorld) -> str:
+        """Shared secret for the cross-host replica servers, minted by the
+        round's rank-0 agent and distributed through the master KV store
+        (the replica port is reachable cross-host, unlike the node-local
+        IPC socket, so requests must be authenticated)."""
+        key = "ckpt-replica-token"
+        if world.node_rank == 0:
+            token = self._client.kv_store_get(key)
+            if not token:
+                import secrets
+
+                token = secrets.token_hex(16).encode()
+                self._client.kv_store_set(key, token)
+            return bytes(token).decode()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            token = self._client.kv_store_get(key)
+            if token:
+                return bytes(token).decode()
+            time.sleep(0.5)
+        logger.warning("replica token not available; replica push disabled")
+        return ""
+
+    def _sync_replica_peers(self, world: CommWorld):
+        """Map rendezvous ranks to peers' replica servers, then pull this
+        seat's backup if nothing is staged locally (node replacement)."""
+        try:
+            token = self._replica_token(world)
+            if token:
+                self._ckpt_saver.set_replica_token(token)
+            by_id = {
+                m.node_id: m
+                for m in self._client.get_running_nodes()
+                if m.port
+            }
+            peers = {}
+            for rank, (node_id, _lws, ip, _port) in world.members.items():
+                meta = by_id.get(node_id)
+                if meta is not None:
+                    peers[rank] = (meta.addr or ip, meta.port)
+            self._ckpt_saver.update_replica_peers(
+                peers, world.node_rank, world.world_size
+            )
+            step = self._ckpt_saver.maybe_fetch_replica()
+            if step >= 0:
+                logger.info(
+                    "node %s: staged step %s recovered from peer replica",
+                    self._config.node_id,
+                    step,
+                )
+        except Exception:
+            logger.exception("replica peer sync failed")
 
     # -- workers ------------------------------------------------------------
 
     def _worker_env(self, world: CommWorld, local_rank: int) -> Dict[str, str]:
         env = dict(os.environ)
         env.update(self._config.env)
+        if self._config.ckpt_replica:
+            env["DLROVER_TPU_CKPT_REPLICA"] = "1"
         if self._tpu_timer_env:
             env.update(self._tpu_timer_env)
             # one metrics server per local rank

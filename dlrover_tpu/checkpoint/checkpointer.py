@@ -80,9 +80,29 @@ class Checkpointer:
         return blocking
 
     def load(self, target: Any = None) -> Optional[Tuple[int, Any]]:
-        """(step, state) from shm if fresh, else committed storage; None if
-        nothing exists."""
-        return self._engine.load(target)
+        """(step, state) from shm if fresh, else committed storage, else an
+        Orbax checkpoint in the same directory (migration path from vanilla
+        Orbax jobs); None if nothing exists."""
+        result = self._engine.load(target)
+        if result is not None:
+            return result
+        try:
+            from dlrover_tpu.checkpoint.orbax_interop import (
+                OrbaxCheckpointer,
+                orbax_available,
+            )
+
+            if orbax_available():
+                ckpt = OrbaxCheckpointer(self._engine.ckpt_dir)
+                restored = ckpt.restore(target)
+                if restored is not None:
+                    logger.info(
+                        "restored step %s from orbax checkpoint", restored[0]
+                    )
+                    return restored
+        except Exception:
+            logger.exception("orbax fallback restore failed")
+        return None
 
     def committed_step(self) -> int:
         return self._engine.committed_step()

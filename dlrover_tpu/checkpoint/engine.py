@@ -24,6 +24,7 @@ import numpy as np
 
 from dlrover_tpu.checkpoint.saver import (
     CKPT_EVENT_QUEUE,
+    PERSIST_STATE_DICT,
     SHM_LOCK,
     CheckpointEvent,
     TRACKER_FILE,
@@ -37,7 +38,12 @@ from dlrover_tpu.checkpoint.shm_handler import (
     shm_name,
     unflatten_state,
 )
-from dlrover_tpu.common.ipc import SharedLock, SharedQueue, default_socket_path
+from dlrover_tpu.common.ipc import (
+    SharedDict,
+    SharedLock,
+    SharedQueue,
+    default_socket_path,
+)
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
 
@@ -86,6 +92,8 @@ class CheckpointEngine:
         )
         self._event_queue: Optional[SharedQueue] = None
         self._shm_lock: Optional[SharedLock] = None
+        self._persist_state: Optional[SharedDict] = None
+        self._awaiting_persist = -1
         self._master_client = master_client
         self.latest_saved_step = -1
 
@@ -103,6 +111,44 @@ class CheckpointEngine:
         if self._shm_lock is None and self._ipc_available():
             self._shm_lock = SharedLock(SHM_LOCK, self._socket_path)
         return self._shm_lock
+
+    def _persist_dict(self) -> Optional[SharedDict]:
+        if self._persist_state is None and self._ipc_available():
+            self._persist_state = SharedDict(
+                PERSIST_STATE_DICT, self._socket_path
+            )
+        return self._persist_state
+
+    def _wait_pending_persist(self, timeout: float = 120.0):
+        """Back-pressure: a queued disk persist reads the CURRENT shm, so
+        staging the next step before the saver's copy would silently drop
+        the persisted step (the saver refuses mismatched steps). Block
+        until the saver reports the copy done (reference analogue: the
+        trainer's next save contends on the saver-held shm lock)."""
+        if self._awaiting_persist < 0:
+            return
+        state = self._persist_dict()
+        if state is None:
+            self._awaiting_persist = -1
+            return
+        deadline = time.time() + timeout
+        key = f"copied-{self.process_id}"
+        while time.time() < deadline:
+            try:
+                copied = state.get(key)
+            except Exception:
+                break
+            if copied is not None and int(copied) >= self._awaiting_persist:
+                self._awaiting_persist = -1
+                return
+            time.sleep(0.02)
+        logger.warning(
+            "persist of step %s still pending after %.0fs; staging anyway "
+            "(that step may not reach storage)",
+            self._awaiting_persist,
+            timeout,
+        )
+        self._awaiting_persist = -1
 
     # -- save ---------------------------------------------------------------
 
@@ -147,6 +193,7 @@ class CheckpointEngine:
         import jax
 
         t0 = time.time()
+        self._wait_pending_persist()
         named_leaves, shard_info, treedef_bytes = self._gather_local_shards(state)
         lock = self._lock()
         if lock is not None and not lock.acquire(timeout=120):
@@ -169,6 +216,12 @@ class CheckpointEngine:
             if lock is not None:
                 lock.release()
         self.latest_saved_step = step
+        # replica mode (agent-set env): tell the saver to stream this staged
+        # state to the backup peer, off the training critical path
+        if os.environ.get("DLROVER_TPU_CKPT_REPLICA") == "1":
+            q = self._queue()
+            if q is not None:
+                q.put(CheckpointEvent("backup", step=step).to_wire())
         blocking = time.time() - t0
         if self._master_client is not None:
             try:
@@ -195,6 +248,7 @@ class CheckpointEngine:
                     "save", step=step, persist=True, ckpt_dir=self.ckpt_dir
                 ).to_wire()
             )
+            self._awaiting_persist = step
         else:
             # no agent (bare run): persist synchronously in-process
             self._persist_inline(step)
@@ -229,9 +283,10 @@ class CheckpointEngine:
         import jax
 
         meta = self._shm.read_meta()
-        if meta is None:
-            return None
-        if meta.world_size != jax.process_count():
+        step = -1
+        if meta is not None and meta.world_size == jax.process_count():
+            step = meta.step
+        elif meta is not None:
             # The world resized: this process's staged shards no longer
             # cover what the new mesh assigns it. Storage has all shards.
             logger.info(
@@ -240,6 +295,26 @@ class CheckpointEngine:
                 meta.world_size,
                 jax.process_count(),
             )
+        # Restore-time consistency gate: every process must hold the SAME
+        # staged step, else one host restores step N and another N-1 and
+        # the job trains from a torn state. The reference guards this at
+        # save time with a gloo allgather (engine.py:76-95); gating at
+        # restore keeps the save hot path collective-free.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            steps = np.asarray(
+                multihost_utils.process_allgather(np.array([step]))
+            ).reshape(-1)
+            if not (steps == steps[0]).all():
+                logger.warning(
+                    "staged steps disagree across processes (%s); "
+                    "falling back to storage restore",
+                    steps.tolist(),
+                )
+                return None
+            step = int(steps[0])
+        if step < 0 or meta is None:
             return None
         pieces = self._read_pieces_from_shm(meta)
         return self._assemble(meta.step, pieces, target, full_data=False)

@@ -1,0 +1,93 @@
+"""Orbax interop: persist/restore flash checkpoints in Orbax's format.
+
+Parity intent (SURVEY.md §7): the reference exposes framework-native
+checkpoint formats (Megatron/DeepSpeed/HF trackers) next to its own shm
+staging; the JAX ecosystem's native format is Orbax. This module lets a
+user (a) keep the flash path (shm staging + async persist) while ALSO
+emitting Orbax-readable checkpoints, and (b) restore from checkpoints
+written by vanilla Orbax jobs.
+
+Multi-host: ``OrbaxCheckpointer`` delegates to Orbax's own collective
+logic, which requires ``jax.distributed`` to be initialized — exactly what
+``dlrover_tpu.train.bootstrap`` does.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+from dlrover_tpu.common.log import logger
+
+_STEP_DIR_RE = re.compile(r"^orbax-(\d+)$")
+
+
+def orbax_available() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class OrbaxCheckpointer:
+    """Thin step-dir manager over ``orbax.checkpoint.PyTreeCheckpointer``.
+
+    Layout: ``<dir>/orbax-<step>/`` per step, readable by any Orbax
+    tooling; ``latest_step`` scans the directory (no tracker file, matching
+    Orbax conventions rather than ours).
+    """
+
+    def __init__(self, ckpt_dir: str):
+        import orbax.checkpoint as ocp
+
+        self.ckpt_dir = ckpt_dir
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.ckpt_dir, f"orbax-{step}")
+
+    def save(self, step: int, state: Any, force: bool = True) -> str:
+        path = self._step_dir(step)
+        self._ckptr.save(path, state, force=force)
+        logger.info("orbax checkpoint saved: %s", path)
+        return path
+
+    def latest_step(self) -> int:
+        try:
+            entries = os.listdir(self.ckpt_dir)
+        except FileNotFoundError:
+            return -1
+        steps = [
+            int(m.group(1))
+            for m in (_STEP_DIR_RE.match(e) for e in entries)
+            if m is not None
+        ]
+        return max(steps, default=-1)
+
+    def restore(
+        self, target: Any = None, step: Optional[int] = None
+    ) -> Optional[Any]:
+        """Restore ``step`` (default: latest). With ``target`` (a pytree of
+        jax.Arrays / ShapeDtypeStructs with shardings) arrays come back
+        sharded per the target — Orbax handles resharding across mesh
+        changes natively."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+        if step < 0:
+            return None
+        path = self._step_dir(step)
+        if not os.path.isdir(path):
+            return None
+        if target is not None:
+            restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+            restored = self._ckptr.restore(
+                path, restore_args=restore_args
+            )
+        else:
+            restored = self._ckptr.restore(path)
+        return step, restored

@@ -51,6 +51,7 @@ from dlrover_tpu.checkpoint.shm_handler import (
 
 CKPT_EVENT_QUEUE = "ckpt-events"
 SHM_LOCK = "shm-ckpt-lock"
+PERSIST_STATE_DICT = "ckpt-persist-state"
 TRACKER_FILE = CheckpointConstant.TRACKER_FILE
 
 
@@ -306,7 +307,10 @@ class AsyncCheckpointSaver:
         storage: Optional[CheckpointStorage] = None,
         deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
         socket_path: str = "",
+        replica: bool = False,
     ):
+        self.replica_enabled = replica
+        self.replica_manager = None
         self.persister = CheckpointPersister(
             job_name=job_name,
             node_id=node_id,
@@ -324,6 +328,10 @@ class AsyncCheckpointSaver:
 
     def start(self):
         self._ipc.start()
+        if self.replica_enabled:
+            from dlrover_tpu.checkpoint.replica import ReplicaManager
+
+            self.replica_manager = ReplicaManager()
         self._event_queue = SharedQueue(CKPT_EVENT_QUEUE, self.socket_path)
         self._thread = threading.Thread(
             target=self._event_loop, name="ckpt-saver", daemon=True
@@ -338,7 +346,83 @@ class AsyncCheckpointSaver:
     def stop(self):
         self._stop_evt.set()
         self.persister.stop()
+        if self.replica_manager is not None:
+            self.replica_manager.server.stop()
         self._ipc.stop()
+
+    # -- replica (cross-host backup) ---------------------------------------
+
+    @property
+    def replica_port(self) -> int:
+        return self.replica_manager.port if self.replica_manager else 0
+
+    def update_replica_peers(self, peers, self_rank: int, world: int):
+        if self.replica_manager is not None:
+            self.replica_manager.update_peers(peers, self_rank, world)
+
+    def set_replica_token(self, token: str):
+        if self.replica_manager is not None:
+            self.replica_manager.set_token(token)
+
+    def maybe_fetch_replica(self) -> int:
+        """After a relaunch: if nothing is staged locally, pull this seat's
+        backup from the peer so workers restore from memory, not storage."""
+        if self.replica_manager is None:
+            return -1
+        for h in self.persister.local_handlers():
+            if h.attach() and h.read_meta() is not None:
+                return -1  # local staged state exists
+        targets = [
+            shm_name(self.persister.job_name, self.persister.node_id, pid)
+            for pid in self.persister.local_process_ids
+        ]
+        return self.replica_manager.fetch_backup_into_shm(targets)
+
+    def _release_persist_waiters(self, step: int):
+        """Release the trainer's persist back-pressure — but only for
+        processes whose staged step has reached ``step`` (copied, or the
+        trainer already moved past so waiting longer cannot help). A
+        process still holding an OLDER step keeps waiting for its own
+        event; releasing it here would let it overwrite un-copied shards."""
+        try:
+            staged: Dict[int, int] = {}
+            for h in self.persister.local_handlers():
+                meta = h.read_meta()
+                if meta is not None:
+                    staged[meta.process_id] = meta.step
+                h.close()
+            state = self._ipc.state.get_dict(PERSIST_STATE_DICT)
+            for pid in self.persister.local_process_ids:
+                if staged.get(pid, -1) >= step:
+                    key = f"copied-{pid}"
+                    state[key] = max(int(state.get(key, -1)), step)
+        except Exception:
+            logger.exception("persist-state release failed")
+
+    def _push_replica(self, step_hint: int = -1):
+        """Copy segments out of shm under the lock, stream lock-free.
+        Coalesced: a step already pushed (e.g. the persist path after a
+        backup event) is not streamed twice."""
+        if self.replica_manager is None:
+            return
+        if 0 <= step_hint <= self.replica_manager.last_pushed_step:
+            return
+        lock = self._ipc.state.get_lock(SHM_LOCK)
+        if not lock.acquire(timeout=30):
+            logger.warning("replica push skipped: shm lock busy")
+            return
+        try:
+            snapshot = self.replica_manager.collect_segments(
+                self.persister.local_handlers()
+            )
+        finally:
+            lock.release()
+        if snapshot is None:
+            return
+        step, segments, payload = snapshot
+        if step <= self.replica_manager.last_pushed_step:
+            return
+        self.replica_manager.send_backup(step, segments, payload)
 
     def update_topology(self, node_rank: int, num_nodes: int, process_ids: List[int]):
         """Called by the agent after each rendezvous round."""
@@ -393,6 +477,12 @@ class AsyncCheckpointSaver:
             event = CheckpointEvent.from_wire(raw)
             if event.event_type == "exit":
                 return
+            if event.event_type == "backup":
+                try:
+                    self._push_replica(step_hint=event.step)
+                except Exception:
+                    logger.exception("replica push failed")
+                continue
             if event.event_type == "save" and event.persist:
                 # Hold the shm lock only for the shm->storage copy (the
                 # trainer takes the same lock for staging); the commit wait
@@ -406,5 +496,9 @@ class AsyncCheckpointSaver:
                         )
                     for s in steps:
                         self.persister._maybe_commit(event.ckpt_dir, s)
+                    if self.replica_manager is not None:
+                        self._push_replica(step_hint=event.step)
                 except Exception:
                     logger.exception("persist of step %s failed", event.step)
+                finally:
+                    self._release_persist_waiters(event.step)

@@ -345,6 +345,19 @@ class SharedMemoryHandler:
         ).reshape(meta.shape)
         return arr.copy() if copy else arr
 
+    def restore_segment(self, data: bytes):
+        """Materialize a transferred segment (replica restore): ``data`` is
+        a prefix of a valid segment (header + leaf bytes). The length word
+        is written last so a concurrent reader never sees a torn header."""
+        needed = max(0, len(data) - HEADER_SPACE)
+        self._ensure(needed)
+        buf = self._shm.buf
+        struct.pack_into(_LEN_FMT, buf, 0, 0)
+        buf[_LEN_SIZE : len(data)] = data[_LEN_SIZE:]
+        struct.pack_into(
+            _LEN_FMT, buf, 0, struct.unpack_from(_LEN_FMT, data, 0)[0]
+        )
+
     def load_state(self, copy: bool = True):
         """Rebuild (step, pytree) from shm; None if nothing staged."""
         meta = self.read_meta()

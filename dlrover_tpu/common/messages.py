@@ -128,6 +128,7 @@ class NodeMeta:
     node_id: int = -1
     node_rank: int = -1
     addr: str = ""
+    port: int = 0  # auxiliary service port (ckpt replica server)
     slice_name: str = ""
     coords: Tuple = ()
 

@@ -288,6 +288,7 @@ class MasterServicer:
                     node_id=n.id,
                     node_rank=n.rank_index,
                     addr=n.host_addr,
+                    port=n.host_port,
                     slice_name=n.topology.slice_name,
                     coords=tuple(n.topology.coords),
                 )

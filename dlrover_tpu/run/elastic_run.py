@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="interpose the native PJRT profiler into workers")
     p.add_argument("--tpu-timer-port", type=int,
                    default=TpuTimerConsts.DEFAULT_PORT, dest="tpu_timer_port")
+    p.add_argument("--ckpt-replica", action="store_true", dest="ckpt_replica",
+                   help="replicate staged checkpoints into a peer host's "
+                        "memory for node-loss recovery without storage")
     p.add_argument("--monitor_interval", type=float, default=2.0)
     p.add_argument("--rdzv_join_timeout", type=float, default=600.0)
     p.add_argument("training_script", help="path to the JAX training script")
@@ -122,6 +125,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         accelerator=args.accelerator,
         tpu_timer=args.tpu_timer,
         tpu_timer_port=args.tpu_timer_port,
+        ckpt_replica=args.ckpt_replica,
         monitor_interval=args.monitor_interval,
         rdzv_join_timeout=args.rdzv_join_timeout,
         entrypoint=args.training_script,

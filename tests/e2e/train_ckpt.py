@@ -70,7 +70,10 @@ for step in range(start_step + 1, TOTAL_STEPS + 1):
         os._exit(23)
     ctx.report_step(step, force=True)
 
-w = np.asarray(jax.device_get(state["w"]))
+# multi-host safe: "w" spans all processes when nnodes > 1
+from jax.experimental import multihost_utils
+
+w = np.asarray(multihost_utils.process_allgather(state["w"], tiled=True))
 final_step = int(state["step"])
 print(f"[ckpt-e2e] done: step={final_step} w0={w[0]}", flush=True)
 assert final_step == TOTAL_STEPS, f"bad final step {final_step}"
