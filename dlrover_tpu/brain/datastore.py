@@ -1,0 +1,166 @@
+"""Brain datastore: sqlite-backed job metrics history.
+
+Parity: reference ``dlrover/go/brain/pkg/datastore`` (MySQL recorders for
+job metrics/nodes, with in-memory fakes for tests). sqlite keeps the
+service dependency-free; ``:memory:`` is the test fake.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.brain.messages import RuntimeSample
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS jobs (
+    uuid TEXT PRIMARY KEY,
+    name TEXT,
+    tpu_type TEXT,
+    min_workers INTEGER,
+    max_workers INTEGER,
+    node_unit INTEGER,
+    created_at REAL,
+    finished_at REAL,
+    status TEXT,
+    final_workers INTEGER,
+    exit_reason TEXT
+);
+CREATE INDEX IF NOT EXISTS jobs_name ON jobs(name);
+CREATE TABLE IF NOT EXISTS runtime_metrics (
+    job_uuid TEXT,
+    ts REAL,
+    worker_num INTEGER,
+    speed REAL,
+    global_step INTEGER,
+    cpu REAL,
+    mem_avg REAL,
+    mem_max REAL,
+    duty REAL
+);
+CREATE INDEX IF NOT EXISTS rm_job ON runtime_metrics(job_uuid, ts);
+"""
+
+
+class BrainDataStore:
+    def __init__(self, path: str = ":memory:"):
+        # one connection guarded by a lock: the service is low-QPS
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def upsert_job(
+        self,
+        uuid: str,
+        name: str,
+        tpu_type: str = "",
+        min_workers: int = 0,
+        max_workers: int = 0,
+        node_unit: int = 1,
+    ):
+        with self._lock:
+            self._conn.execute(
+                """INSERT INTO jobs(uuid, name, tpu_type, min_workers,
+                   max_workers, node_unit, created_at, status)
+                   VALUES(?,?,?,?,?,?,?,'running')
+                   ON CONFLICT(uuid) DO UPDATE SET
+                     name=excluded.name, tpu_type=excluded.tpu_type,
+                     min_workers=excluded.min_workers,
+                     max_workers=excluded.max_workers,
+                     node_unit=excluded.node_unit""",
+                (uuid, name, tpu_type, min_workers, max_workers, node_unit,
+                 time.time()),
+            )
+            self._conn.commit()
+
+    def finish_job(
+        self, uuid: str, status: str, worker_num: int, exit_reason: str = ""
+    ):
+        with self._lock:
+            self._conn.execute(
+                """UPDATE jobs SET finished_at=?, status=?, final_workers=?,
+                   exit_reason=? WHERE uuid=?""",
+                (time.time(), status, worker_num, exit_reason, uuid),
+            )
+            self._conn.commit()
+
+    def append_samples(self, job_uuid: str, samples: List[RuntimeSample]):
+        rows = [
+            (
+                job_uuid,
+                s.timestamp or time.time(),
+                s.worker_num,
+                s.speed_steps_per_sec,
+                s.global_step,
+                s.cpu_percent_avg,
+                s.memory_mb_avg,
+                s.memory_mb_max,
+                s.tpu_duty_cycle_avg,
+            )
+            for s in samples
+        ]
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO runtime_metrics VALUES(?,?,?,?,?,?,?,?,?)", rows
+            )
+            self._conn.commit()
+
+    def job_samples(self, job_uuid: str, limit: int = 100) -> List[RuntimeSample]:
+        with self._lock:
+            rows = self._conn.execute(
+                """SELECT ts, worker_num, speed, global_step, cpu, mem_avg,
+                   mem_max, duty FROM runtime_metrics WHERE job_uuid=?
+                   ORDER BY ts DESC LIMIT ?""",
+                (job_uuid, limit),
+            ).fetchall()
+        return [
+            RuntimeSample(
+                timestamp=r[0],
+                worker_num=r[1],
+                speed_steps_per_sec=r[2],
+                global_step=r[3],
+                cpu_percent_avg=r[4],
+                memory_mb_avg=r[5],
+                memory_mb_max=r[6],
+                tpu_duty_cycle_avg=r[7],
+            )
+            for r in rows
+        ]
+
+    def similar_job_outcome(self, job_name: str) -> Optional[Dict]:
+        """Latest successful run of a same-named job (cold-start reuse —
+        reference optalgorithm 'job create resource' consults history)."""
+        with self._lock:
+            row = self._conn.execute(
+                """SELECT final_workers, max_workers, node_unit FROM jobs
+                   WHERE name=? AND status='succeeded' AND final_workers>0
+                   ORDER BY finished_at DESC LIMIT 1""",
+                (job_name,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {
+            "final_workers": row[0],
+            "max_workers": row[1],
+            "node_unit": row[2],
+        }
+
+    def peak_memory(self, job_name: str) -> float:
+        """Max observed host memory across past runs of this job name."""
+        with self._lock:
+            row = self._conn.execute(
+                """SELECT MAX(m.mem_max) FROM runtime_metrics m
+                   JOIN jobs j ON m.job_uuid=j.uuid WHERE j.name=?""",
+                (job_name,),
+            ).fetchone()
+        return float(row[0] or 0.0)
+
+    def dump(self) -> str:  # debug aid
+        with self._lock:
+            jobs = self._conn.execute("SELECT * FROM jobs").fetchall()
+        return json.dumps(jobs, default=str)

@@ -1,0 +1,102 @@
+"""Brain service wire messages (reference ``dlrover/proto/brain.proto``:
+``optimize``/``persist_metrics``/``get_job_metrics``, carried here over the
+same two-generic-RPC transport the master uses)."""
+
+from __future__ import annotations
+
+from dataclasses import field
+from typing import Dict, List
+
+# noqa import registers SimpleResponse (the ack type the brain servicer
+# returns) in every process that can talk brain wire messages
+from dlrover_tpu.common.messages import SimpleResponse  # noqa: F401
+from dlrover_tpu.common.serde import message
+
+
+@message
+class RuntimeSample:
+    """One observation of a running job (master's stats collector)."""
+
+    timestamp: float = 0.0
+    worker_num: int = 0
+    speed_steps_per_sec: float = 0.0
+    global_step: int = 0
+    cpu_percent_avg: float = 0.0
+    memory_mb_avg: float = 0.0
+    memory_mb_max: float = 0.0
+    tpu_duty_cycle_avg: float = 0.0
+
+
+@message
+class BrainPersistMetrics:
+    """report: append runtime samples for a job."""
+
+    job_uuid: str = ""
+    job_name: str = ""
+    samples: List[RuntimeSample] = field(default_factory=list)
+    # static config of the job, persisted once (idempotent upsert)
+    tpu_type: str = ""
+    min_workers: int = 0
+    max_workers: int = 0
+    node_unit: int = 1
+
+
+@message
+class BrainJobEndReport:
+    """report: the job finished (captures outcome for cold-start reuse)."""
+
+    job_uuid: str = ""
+    status: str = ""  # succeeded | failed | oom
+    worker_num: int = 0
+    exit_reason: str = ""
+
+
+@message
+class BrainOptimizeRequest:
+    """get: produce a resource plan for a job stage."""
+
+    job_uuid: str = ""
+    job_name: str = ""
+    stage: str = ""  # JobOptStage values
+    strategy: str = "allreduce"
+    min_workers: int = 0
+    max_workers: int = 0
+    node_unit: int = 1
+    current_workers: int = 0
+    oom_nodes: List[str] = field(default_factory=list)
+    host_oom: bool = False
+
+
+@message
+class BrainResourcePlan:
+    worker_count: int = 0
+    memory_mb_per_host: float = 0.0
+    paral_config: Dict = field(default_factory=dict)
+    comment: str = ""
+
+    def empty(self) -> bool:
+        return (
+            self.worker_count <= 0
+            and self.memory_mb_per_host <= 0
+            and not self.paral_config
+        )
+
+
+@message
+class BrainOptimizeResponse:
+    success: bool = True
+    reason: str = ""
+    plan: BrainResourcePlan = field(default_factory=BrainResourcePlan)
+
+
+@message
+class BrainJobMetricsRequest:
+    job_uuid: str = ""
+    job_name: str = ""
+    limit: int = 100
+
+
+@message
+class BrainJobMetricsResponse:
+    job_uuid: str = ""
+    samples: List[RuntimeSample] = field(default_factory=list)

@@ -1,0 +1,199 @@
+"""Brain optimizer framework: pluggable per-stage algorithms.
+
+Parity: reference ``dlrover/go/brain/pkg/optimizer`` (base_optimizer.go:
+40-48 dispatch + ``optalgorithm/`` implementations). The reference's 18
+algorithms are PS-era (PS cold-create/hot-resource/OOM, worker create);
+the TPU set replaces PS math with what matters on slices: throughput
+scaling fits for worker count, history-based cold starts, and
+memory-bump OOM recovery.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dlrover_tpu.brain.datastore import BrainDataStore
+from dlrover_tpu.brain.messages import (
+    BrainOptimizeRequest,
+    BrainResourcePlan,
+    RuntimeSample,
+)
+from dlrover_tpu.common.log import logger
+
+STAGE_CREATE = "job_stage_create"
+STAGE_SAMPLE = "job_stage_sample"
+STAGE_RUNNING = "job_stage_running"
+
+Algorithm = Callable[[BrainDataStore, BrainOptimizeRequest], BrainResourcePlan]
+_ALGORITHMS: Dict[str, Algorithm] = {}
+
+
+def algorithm(stage: str):
+    def wrap(fn: Algorithm) -> Algorithm:
+        _ALGORITHMS[stage] = fn
+        return fn
+
+    return wrap
+
+
+def _round_to_unit(n: int, req: BrainOptimizeRequest) -> int:
+    unit = max(1, req.node_unit)
+    lo = max(unit, req.min_workers or unit)
+    hi = req.max_workers or max(lo, n)
+    n = max(lo, min(n, hi))
+    return max(unit, (n // unit) * unit)
+
+
+@algorithm(STAGE_CREATE)
+def create_plan(
+    store: BrainDataStore, req: BrainOptimizeRequest
+) -> BrainResourcePlan:
+    """Cold start: reuse the last successful same-named job's final
+    worker count; else be conservative (min) so the SAMPLE stage can
+    measure before scaling out."""
+    history = store.similar_job_outcome(req.job_name)
+    if history is not None:
+        n = _round_to_unit(history["final_workers"], req)
+        return BrainResourcePlan(
+            worker_count=n, comment=f"history: {history['final_workers']}"
+        )
+    n = _round_to_unit(req.min_workers or req.node_unit, req)
+    return BrainResourcePlan(worker_count=n, comment="cold start: min")
+
+
+def fit_scaling(samples: List[RuntimeSample]) -> Optional[Tuple[float, float]]:
+    """Fit speed(n) ≈ a·n / (1 + b·n) (serial-fraction model) from
+    (worker_num, speed) observations. Returns (a, b) or None."""
+    points: Dict[int, List[float]] = {}
+    for s in samples:
+        if s.worker_num > 0 and s.speed_steps_per_sec > 0:
+            points.setdefault(s.worker_num, []).append(s.speed_steps_per_sec)
+    if len(points) < 2:
+        return None
+    # linearize: n/speed = (1/a) + (b/a)·n  -> least squares on (n, n/speed)
+    xs, ys = [], []
+    for n, speeds in points.items():
+        avg = sum(speeds) / len(speeds)
+        xs.append(float(n))
+        ys.append(n / avg)
+    n_pts = len(xs)
+    sx = sum(xs)
+    sy = sum(ys)
+    sxx = sum(x * x for x in xs)
+    sxy = sum(x * y for x, y in zip(xs, ys))
+    denom = n_pts * sxx - sx * sx
+    if abs(denom) < 1e-9:
+        return None
+    slope = (n_pts * sxy - sx * sy) / denom  # b/a
+    intercept = (sy - slope * sx) / n_pts  # 1/a
+    if intercept <= 0:
+        return None
+    a = 1.0 / intercept
+    b = slope * a
+    return a, max(0.0, b)
+
+
+def predicted_speed(a: float, b: float, n: int) -> float:
+    return a * n / (1.0 + b * n)
+
+
+@algorithm(STAGE_SAMPLE)
+def sample_plan(
+    store: BrainDataStore, req: BrainOptimizeRequest
+) -> BrainResourcePlan:
+    """Early training: scale toward max in node_unit increments while
+    each increment still pays (predicted marginal speedup ≥ 5%/host)."""
+    samples = store.job_samples(req.job_uuid, limit=200)
+    fit = fit_scaling(samples)
+    if fit is None:
+        # not enough variety yet: step one unit toward max to generate it
+        n = _round_to_unit(
+            (req.current_workers or req.min_workers) + req.node_unit, req
+        )
+        return BrainResourcePlan(worker_count=n, comment="sampling: +unit")
+    return _scale_by_fit(fit, req)
+
+
+@algorithm(STAGE_RUNNING)
+def running_plan(
+    store: BrainDataStore, req: BrainOptimizeRequest
+) -> BrainResourcePlan:
+    samples = store.job_samples(req.job_uuid, limit=500)
+    fit = fit_scaling(samples)
+    if fit is None:
+        return BrainResourcePlan(comment="no fit; hold")
+    return _scale_by_fit(fit, req)
+
+
+def _scale_by_fit(
+    fit: Tuple[float, float], req: BrainOptimizeRequest
+) -> BrainResourcePlan:
+    """Pick the largest worker count whose marginal goodput per added
+    host clears 5% of a host's base throughput (reference analogue:
+    worker speed-ratio thresholding, local_optimizer.go/py)."""
+    a, b = fit
+    current = req.current_workers or req.min_workers or 1
+    best = current
+    unit = max(1, req.node_unit)
+    lo = max(unit, req.min_workers or unit)
+    hi = req.max_workers or current
+    candidates = range(lo, hi + 1, unit)
+    base = predicted_speed(a, b, 1)
+    prev_speed = predicted_speed(a, b, current)
+    for n in candidates:
+        if n <= best:
+            continue
+        gain = predicted_speed(a, b, n) - predicted_speed(a, b, best)
+        if gain >= 0.05 * base * ((n - best) / unit):
+            best = n
+    if best == current:
+        return BrainResourcePlan(comment=f"hold at {current}")
+    return BrainResourcePlan(
+        worker_count=_round_to_unit(best, req),
+        comment=f"fit a={a:.3g} b={b:.3g}: {current}->{best} "
+        f"(pred {prev_speed:.2f}->{predicted_speed(a, b, best):.2f} steps/s)",
+    )
+
+
+def oom_recovery_plan(
+    store: BrainDataStore, req: BrainOptimizeRequest
+) -> BrainResourcePlan:
+    """Host OOM: bump host memory to max(2x observed peak, 1.5x historic
+    peak) (reference adjust_oom_resource, job.py:313-395). HBM OOM: more
+    host RAM cannot help — halve micro-batch, double grad-accum so the
+    global batch is preserved (matches the local optimizer's HBM path)."""
+    if not req.host_oom:
+        return BrainResourcePlan(
+            paral_config={
+                "micro_batch_scale": 0.5,
+                "grad_accum_scale": 2.0,
+                "restart": True,
+            },
+            comment="hbm oom: micro-batch/2, grad-accum x2",
+        )
+    peak = store.peak_memory(req.job_name)
+    samples = store.job_samples(req.job_uuid, limit=50)
+    current_peak = max((s.memory_mb_max for s in samples), default=0.0)
+    target = max(2 * current_peak, 1.5 * peak)
+    if target <= 0:
+        target = 2 * 16 * 1024  # no data: double a 16GB default
+    return BrainResourcePlan(
+        memory_mb_per_host=target,
+        comment=f"host oom recovery: mem -> {target:.0f}MB",
+    )
+
+
+class BrainOptimizer:
+    """Dispatch: stage -> algorithm (reference BaseOptimizer.Optimize)."""
+
+    def __init__(self, store: BrainDataStore):
+        self._store = store
+
+    def optimize(self, req: BrainOptimizeRequest) -> BrainResourcePlan:
+        if req.oom_nodes:
+            return oom_recovery_plan(self._store, req)
+        algo = _ALGORITHMS.get(req.stage)
+        if algo is None:
+            logger.warning("no algorithm for stage %r", req.stage)
+            return BrainResourcePlan(comment=f"unknown stage {req.stage}")
+        return algo(self._store, req)

@@ -370,8 +370,11 @@ class AsyncCheckpointSaver:
         if self.replica_manager is None:
             return -1
         for h in self.persister.local_handlers():
-            if h.attach() and h.read_meta() is not None:
-                return -1  # local staged state exists
+            try:
+                if h.attach() and h.read_meta() is not None:
+                    return -1  # local staged state exists
+            finally:
+                h.close()
         targets = [
             shm_name(self.persister.job_name, self.persister.node_id, pid)
             for pid in self.persister.local_process_ids
@@ -411,12 +414,13 @@ class AsyncCheckpointSaver:
         if not lock.acquire(timeout=30):
             logger.warning("replica push skipped: shm lock busy")
             return
+        handlers = self.persister.local_handlers()
         try:
-            snapshot = self.replica_manager.collect_segments(
-                self.persister.local_handlers()
-            )
+            snapshot = self.replica_manager.collect_segments(handlers)
         finally:
             lock.release()
+            for h in handlers:
+                h.close()
         if snapshot is None:
             return
         step, segments, payload = snapshot
@@ -429,6 +433,13 @@ class AsyncCheckpointSaver:
         self.persister.node_rank = node_rank
         self.persister.num_nodes = num_nodes
         self.persister.local_process_ids = list(process_ids)
+        # a round boundary is a restart boundary: stale copied-{pid} marks
+        # from a pre-restart (possibly higher) step would disarm the new
+        # incarnation's persist back-pressure after a rollback restore
+        try:
+            self._ipc.state.get_dict(PERSIST_STATE_DICT).clear()
+        except Exception:
+            pass
 
     # Bounded commit wait for failure-path persists: a dying node writes its
     # shards + vote and gives peers only this long to show up before it gets
@@ -494,6 +505,10 @@ class AsyncCheckpointSaver:
                         steps = self.persister.copy_step_to_storage(
                             event.ckpt_dir, event.step
                         )
+                    # release back-pressure NOW: the copy the trainer is
+                    # waiting on is done; commit waits and replica pushes
+                    # below can take minutes and must not stall training
+                    self._release_persist_waiters(event.step)
                     for s in steps:
                         self.persister._maybe_commit(event.ckpt_dir, s)
                     if self.replica_manager is not None:
@@ -501,4 +516,5 @@ class AsyncCheckpointSaver:
                 except Exception:
                     logger.exception("persist of step %s failed", event.step)
                 finally:
+                    # idempotent: also covers a copy that raised
                     self._release_persist_waiters(event.step)

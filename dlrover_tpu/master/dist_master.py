@@ -76,11 +76,27 @@ class DistributedJobMaster:
         else:
             self.scaler = PodScaler(job_args, self._client)
 
-        optimizer = LocalOptimizer(
-            min_workers=worker_spec.min_nodes or 1,
-            max_workers=worker_spec.max_nodes or worker_spec.group.count,
-            node_unit=job_args.node_unit,
-        )
+        brain_addr = os.getenv("DLROVER_TPU_BRAIN_ADDR", "")
+        if brain_addr:
+            from dlrover_tpu.master.resource.brain_optimizer import (
+                BrainResourceOptimizer,
+            )
+
+            optimizer = BrainResourceOptimizer(
+                brain_addr,
+                job_uuid=job_args.job_uid or job_args.job_name,
+                job_name=job_args.job_name,
+                min_workers=worker_spec.min_nodes or 1,
+                max_workers=worker_spec.max_nodes or worker_spec.group.count,
+                node_unit=job_args.node_unit,
+                tpu_type=job_args.tpu_type,
+            )
+        else:
+            optimizer = LocalOptimizer(
+                min_workers=worker_spec.min_nodes or 1,
+                max_workers=worker_spec.max_nodes or worker_spec.group.count,
+                node_unit=job_args.node_unit,
+            )
         self.job_auto_scaler = JobAutoScaler(
             optimizer=optimizer,
             scaler=self.scaler,

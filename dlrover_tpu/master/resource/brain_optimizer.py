@@ -1,0 +1,180 @@
+"""Master-side client of the Brain service, with local fallback.
+
+Parity: reference ``master/resource/brain_optimizer.py:124``
+(``BrainResoureOptimizer``, ``OptimizeMode.CLUSTER``) falling back to the
+local optimizer when the service is unreachable
+(``local_optimizer.py:66``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from dlrover_tpu.brain import messages as bmsg
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.master.resource.optimizer import (
+    LocalOptimizer,
+    ResourceOptimizer,
+    WorkerStats,
+)
+from dlrover_tpu.master.resource.plan import ResourcePlan
+from dlrover_tpu.rpc.transport import RpcClient
+
+
+class BrainResourceOptimizer(ResourceOptimizer):
+    """Ships runtime stats to the brain; asks it for plans; degrades to
+    LocalOptimizer whenever the service misbehaves."""
+
+    def __init__(
+        self,
+        brain_addr: str,
+        job_uuid: str,
+        job_name: str,
+        min_workers: int = 1,
+        max_workers: int = 0,
+        node_unit: int = 1,
+        tpu_type: str = "",
+        client: Optional[RpcClient] = None,
+    ):
+        self._client = client or RpcClient(brain_addr, timeout=10.0)
+        self._job_uuid = job_uuid
+        self._job_name = job_name
+        self._min_workers = min_workers
+        self._max_workers = max_workers
+        self._node_unit = node_unit
+        self._tpu_type = tpu_type
+        self._current_workers = 0
+        self._fallback = LocalOptimizer(
+            min_workers=min_workers,
+            max_workers=max_workers,
+            node_unit=node_unit,
+        )
+
+    # -- observations (mirrored into both brain and local fallback) --------
+
+    def observe_speed(self, worker_num: int, steps_per_sec: float):
+        self._current_workers = worker_num or self._current_workers
+        self._fallback.observe_speed(worker_num, steps_per_sec)
+
+    def report_stats(self, stats: WorkerStats, global_step: int = 0):
+        sample = bmsg.RuntimeSample(
+            timestamp=time.time(),
+            worker_num=stats.worker_num,
+            speed_steps_per_sec=stats.speed_steps_per_sec,
+            global_step=global_step,
+            cpu_percent_avg=_avg(stats.cpu_percents),
+            memory_mb_avg=_avg(stats.memory_mbs),
+            memory_mb_max=max(stats.memory_mbs, default=0.0),
+            tpu_duty_cycle_avg=_avg(stats.duty_cycles),
+        )
+        try:
+            self._client.report(
+                bmsg.BrainPersistMetrics(
+                    job_uuid=self._job_uuid,
+                    job_name=self._job_name,
+                    samples=[sample],
+                    tpu_type=self._tpu_type,
+                    min_workers=self._min_workers,
+                    max_workers=self._max_workers,
+                    node_unit=self._node_unit,
+                )
+            )
+        except Exception as e:
+            logger.warning("brain persist_metrics failed: %s", e)
+
+    def report_job_end(self, status: str, worker_num: int, exit_reason: str = ""):
+        try:
+            self._client.report(
+                bmsg.BrainJobEndReport(
+                    job_uuid=self._job_uuid,
+                    status=status,
+                    worker_num=worker_num,
+                    exit_reason=exit_reason,
+                )
+            )
+        except Exception as e:
+            logger.warning("brain job-end report failed: %s", e)
+
+    # -- plans --------------------------------------------------------------
+
+    def _request(
+        self, stage: str, oom_nodes: Optional[List[str]] = None,
+        host_oom: bool = False,
+    ) -> Optional[bmsg.BrainResourcePlan]:
+        try:
+            resp = self._client.get(
+                bmsg.BrainOptimizeRequest(
+                    job_uuid=self._job_uuid,
+                    job_name=self._job_name,
+                    stage=stage,
+                    min_workers=self._min_workers,
+                    max_workers=self._max_workers,
+                    node_unit=self._node_unit,
+                    current_workers=self._current_workers,
+                    oom_nodes=oom_nodes or [],
+                    host_oom=host_oom,
+                )
+            )
+        except Exception as e:
+            logger.warning("brain optimize failed (%s); local fallback", e)
+            return None
+        if not isinstance(resp, bmsg.BrainOptimizeResponse) or not resp.success:
+            logger.warning(
+                "brain optimize rejected (%s); local fallback",
+                getattr(resp, "reason", "?"),
+            )
+            return None
+        return resp.plan
+
+    def _to_resource_plan(
+        self, plan: bmsg.BrainResourcePlan
+    ) -> ResourcePlan:
+        out = ResourcePlan(comment=plan.comment)
+        if plan.worker_count > 0:
+            out.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+                count=plan.worker_count,
+                node_resource=NodeResource(
+                    memory_mb=plan.memory_mb_per_host,
+                    tpu_type=self._tpu_type,
+                ),
+            )
+        elif plan.memory_mb_per_host > 0:
+            out.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+                count=self._current_workers,
+                node_resource=NodeResource(
+                    memory_mb=plan.memory_mb_per_host,
+                    tpu_type=self._tpu_type,
+                ),
+            )
+        if plan.paral_config:
+            out.paral_config = dict(plan.paral_config)
+        return out
+
+    def generate_opt_plan(self, stage: str, stats: WorkerStats) -> ResourcePlan:
+        self.report_stats(stats)
+        plan = self._request(stage)
+        if plan is None:
+            return self._fallback.generate_opt_plan(stage, stats)
+        if plan.empty():
+            return ResourcePlan(comment=plan.comment)
+        resource_plan = self._to_resource_plan(plan)
+        if resource_plan.comment:
+            logger.info("brain plan: %s", resource_plan.comment)
+        return resource_plan
+
+    def generate_oom_recovery_plan(
+        self, node_names: List[str], stage: str, host_oom: bool = False
+    ) -> ResourcePlan:
+        plan = self._request(stage, oom_nodes=node_names, host_oom=host_oom)
+        if plan is None:
+            return self._fallback.generate_oom_recovery_plan(
+                node_names, stage, host_oom=host_oom
+            )
+        return self._to_resource_plan(plan)
+
+
+def _avg(values: List[float]) -> float:
+    return sum(values) / len(values) if values else 0.0

@@ -1,0 +1,160 @@
+"""Brain service tests: datastore, optimizer algorithms, and the full
+master-client path over a real RPC server (mirrors the reference's
+hermetic optalgorithm tests over fake recorders, §2.2)."""
+
+import pytest
+
+from dlrover_tpu.brain import messages as bmsg
+from dlrover_tpu.brain.datastore import BrainDataStore
+from dlrover_tpu.brain.optimizer import (
+    STAGE_CREATE,
+    STAGE_RUNNING,
+    STAGE_SAMPLE,
+    BrainOptimizer,
+    fit_scaling,
+    predicted_speed,
+)
+from dlrover_tpu.brain.server import BrainServer
+from dlrover_tpu.master.resource.brain_optimizer import BrainResourceOptimizer
+from dlrover_tpu.master.resource.optimizer import WorkerStats
+
+
+def sample(n, speed, mem=1000.0):
+    return bmsg.RuntimeSample(
+        worker_num=n, speed_steps_per_sec=speed, memory_mb_max=mem
+    )
+
+
+def req(stage, uuid="j1", name="train", cur=2, lo=1, hi=8, unit=1, **kw):
+    return bmsg.BrainOptimizeRequest(
+        job_uuid=uuid,
+        job_name=name,
+        stage=stage,
+        current_workers=cur,
+        min_workers=lo,
+        max_workers=hi,
+        node_unit=unit,
+        **kw,
+    )
+
+
+def test_fit_scaling_recovers_amdahl_curve():
+    # speed(n) = 10n / (1 + 0.1n)
+    samples = [sample(n, 10 * n / (1 + 0.1 * n)) for n in (1, 2, 4, 8)]
+    a, b = fit_scaling(samples)
+    assert a == pytest.approx(10, rel=0.01)
+    assert b == pytest.approx(0.1, rel=0.05)
+    assert predicted_speed(a, b, 4) == pytest.approx(10 * 4 / 1.4, rel=0.01)
+
+
+def test_create_stage_uses_history_else_min():
+    store = BrainDataStore()
+    opt = BrainOptimizer(store)
+    plan = opt.optimize(req(STAGE_CREATE, cur=0))
+    assert plan.worker_count == 1  # cold: min
+
+    store.upsert_job("old", "train", max_workers=8)
+    store.finish_job("old", "succeeded", worker_num=6)
+    plan = opt.optimize(req(STAGE_CREATE, cur=0))
+    assert plan.worker_count == 6
+    assert "history" in plan.comment
+
+
+def test_running_stage_scales_up_on_linear_speedup():
+    store = BrainDataStore()
+    store.upsert_job("j1", "train")
+    # near-linear scaling observed between 1, 2 and 4 workers
+    store.append_samples(
+        "j1", [sample(n, 9.9 * n / (1 + 0.01 * n)) for n in (1, 2, 4)]
+    )
+    plan = BrainOptimizer(store).optimize(req(STAGE_RUNNING, cur=4))
+    assert plan.worker_count == 8  # worth scaling to max
+
+
+def test_running_stage_holds_when_scaling_saturates():
+    store = BrainDataStore()
+    store.upsert_job("j1", "train")
+    # hard saturation: b = 2 -> speed nearly flat beyond a few workers
+    store.append_samples(
+        "j1", [sample(n, 10 * n / (1 + 2.0 * n)) for n in (1, 2, 4)]
+    )
+    plan = BrainOptimizer(store).optimize(req(STAGE_RUNNING, cur=4))
+    assert plan.worker_count == 0  # hold
+    assert "hold" in plan.comment
+
+
+def test_sample_stage_without_fit_steps_one_unit():
+    store = BrainDataStore()
+    store.upsert_job("j1", "train")
+    store.append_samples("j1", [sample(2, 5.0)])  # one worker count only
+    plan = BrainOptimizer(store).optimize(req(STAGE_SAMPLE, cur=2, unit=2))
+    assert plan.worker_count == 4
+
+
+def test_host_oom_recovery_bumps_memory():
+    store = BrainDataStore()
+    store.upsert_job("j1", "train")
+    store.append_samples("j1", [sample(2, 5.0, mem=12000.0)])
+    plan = BrainOptimizer(store).optimize(
+        req(STAGE_RUNNING, oom_nodes=["worker-1"], host_oom=True)
+    )
+    assert plan.memory_mb_per_host == pytest.approx(24000.0)
+
+
+def test_hbm_oom_recovery_shrinks_micro_batch():
+    """HBM OOM: host RAM cannot help — adjust the batch schedule instead."""
+    store = BrainDataStore()
+    store.upsert_job("j1", "train")
+    plan = BrainOptimizer(store).optimize(
+        req(STAGE_RUNNING, oom_nodes=["worker-1"], host_oom=False)
+    )
+    assert plan.memory_mb_per_host == 0
+    assert plan.paral_config["micro_batch_scale"] == 0.5
+    assert plan.paral_config["grad_accum_scale"] == 2.0
+
+
+def test_brain_server_end_to_end_with_master_optimizer():
+    server = BrainServer(port=0)
+    server.start()
+    try:
+        opt = BrainResourceOptimizer(
+            f"127.0.0.1:{server.port}",
+            job_uuid="job-1",
+            job_name="llama",
+            min_workers=1,
+            max_workers=8,
+        )
+        # ship near-linear observations at several worker counts
+        for n, speed in ((1, 9.9), (2, 19.4), (4, 38.0)):
+            opt.observe_speed(n, speed)
+            opt.report_stats(
+                WorkerStats(worker_num=n, speed_steps_per_sec=speed)
+            )
+        plan = opt.generate_opt_plan(STAGE_RUNNING, WorkerStats(worker_num=4))
+        group = plan.node_group_resources["worker"]
+        assert group.count == 8
+
+        # metrics readable back
+        resp = opt._client.get(bmsg.BrainJobMetricsRequest(job_uuid="job-1"))
+        assert len(resp.samples) >= 3
+
+        opt.report_job_end("succeeded", worker_num=8)
+        assert server.store.similar_job_outcome("llama")["final_workers"] == 8
+    finally:
+        server.stop()
+
+
+def test_master_optimizer_falls_back_when_brain_down():
+    opt = BrainResourceOptimizer(
+        "127.0.0.1:1",  # nothing listening
+        job_uuid="job-2",
+        job_name="x",
+        min_workers=2,
+        max_workers=4,
+    )
+    opt._client._timeout = 0.5
+    plan = opt.generate_opt_plan(
+        STAGE_CREATE, WorkerStats(worker_num=0)
+    )
+    # local fallback produced a CREATE plan
+    assert plan.node_group_resources["worker"].count >= 2
