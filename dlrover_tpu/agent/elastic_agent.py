@@ -83,6 +83,7 @@ class ElasticAgent:
         )
         self._diagnosis.set_log_source(self._last_worker_log_tail)
         self._tpu_timer_env: Dict[str, str] = {}
+        self._paral_tuner = None
         if config.tpu_timer:
             self._setup_tpu_timer()
 
@@ -129,14 +130,31 @@ class ElasticAgent:
         self._start_heartbeats()
         self._install_signal_handlers()
         self._diagnosis.start()
+        self._start_paral_config_tuner()
         try:
             return self._invoke_run()
         finally:
             self._stop_evt.set()
             self._diagnosis.stop()
+            if self._paral_tuner is not None:
+                self._paral_tuner.stop()
             self._stop_workers()
             if self._ckpt_saver is not None:
                 self._ckpt_saver.stop()
+
+    def _start_paral_config_tuner(self):
+        from dlrover_tpu.agent.paral_config_tuner import ParalConfigTuner
+
+        try:
+            self._paral_tuner = ParalConfigTuner(
+                self._client,
+                job_name=self._config.job_name,
+                node_id=self._config.node_id,
+            )
+            self._paral_tuner.start()
+        except Exception:
+            logger.exception("paral config tuner failed to start")
+            self._paral_tuner = None
 
     def _start_ckpt_saver(self):
         """Host the flash-checkpoint saver so staged state survives worker
@@ -316,6 +334,12 @@ class ElasticAgent:
         env.update(self._config.env)
         if self._config.ckpt_replica:
             env["DLROVER_TPU_CKPT_REPLICA"] = "1"
+        if self._paral_tuner is not None:
+            from dlrover_tpu.agent.paral_config_tuner import (
+                PARAL_CONFIG_PATH_ENV,
+            )
+
+            env[PARAL_CONFIG_PATH_ENV] = self._paral_tuner.path
         if self._tpu_timer_env:
             env.update(self._tpu_timer_env)
             # one metrics server per local rank

@@ -41,7 +41,11 @@ def _round_to_unit(n: int, req: BrainOptimizeRequest) -> int:
     lo = max(unit, req.min_workers or unit)
     hi = req.max_workers or max(lo, n)
     n = max(lo, min(n, hi))
-    return max(unit, (n // unit) * unit)
+    floored = (n // unit) * unit
+    if floored < lo:
+        # flooring must not violate the job minimum: round UP instead
+        floored = -(-lo // unit) * unit
+    return max(unit, min(floored, max((hi // unit) * unit, unit)))
 
 
 @algorithm(STAGE_CREATE)

@@ -374,7 +374,19 @@ class ParallelConfig:
     optimizer_learning_rate: float = 0.0
     grad_accum_steps: int = 0
     optimizer_version: int = 0
+    # relative adjustments (OOM recovery plans): applied to the worker's
+    # own base config when the absolute fields above are 0
+    micro_batch_scale: float = 1.0
+    grad_accum_scale: float = 1.0
     restart: bool = False
+
+    @classmethod
+    def filter_known(cls, d: Dict) -> Dict:
+        """Keep only keys that are wire fields (plans may carry extras)."""
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(cls)}
+        return {k: v for k, v in d.items() if k in known}
 
 
 @message

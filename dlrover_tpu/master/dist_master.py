@@ -97,10 +97,28 @@ class DistributedJobMaster:
                 max_workers=worker_spec.max_nodes or worker_spec.group.count,
                 node_unit=job_args.node_unit,
             )
+        self.optimizer = optimizer
         self.job_auto_scaler = JobAutoScaler(
             optimizer=optimizer,
             scaler=self.scaler,
             speed_monitor=self.speed_monitor,
+        )
+        from dlrover_tpu.master.monitor.error_monitor import K8sErrorMonitor
+        from dlrover_tpu.master.stats.job_collector import (
+            BrainStatsReporter,
+            JobMetricCollector,
+            LocalStatsReporter,
+            StatsReporter,
+        )
+
+        self.error_monitor = K8sErrorMonitor(
+            self._client, job_args.job_name, job_args.namespace
+        )
+        reporters = [StatsReporter(), LocalStatsReporter()]
+        if brain_addr:
+            reporters.append(BrainStatsReporter(optimizer))
+        self.metric_collector = JobMetricCollector(
+            speed_monitor=self.speed_monitor, reporters=reporters
         )
         self.job_manager = DistributedJobManager(
             job_args=job_args,
@@ -109,6 +127,7 @@ class DistributedJobMaster:
             speed_monitor=self.speed_monitor,
             rdzv_managers=self.rdzv_managers,
             job_auto_scaler=self.job_auto_scaler,
+            error_monitor=self.error_monitor,
         )
         self.pod_watcher = PodWatcher(
             job_args.job_name, self._client, self.job_manager.handle_node_event
@@ -145,6 +164,7 @@ class DistributedJobMaster:
         self.task_manager.start()
         self.job_manager.start()
         self.scale_plan_watcher.start()
+        self.metric_collector.start()
         self.diagnosis_manager.start_observing()
         logger.info(
             "distributed master for job %s serving on port %s",
@@ -182,9 +202,32 @@ class DistributedJobMaster:
                     self._exit_reason = JobExitReason.SUCCEEDED
                     break
         finally:
+            self._report_job_outcome()
             self.stop()
         logger.info("distributed master exiting: %s", self._exit_reason)
         return self._exit_code
+
+    def _report_job_outcome(self):
+        """Close the brain's history record so future same-named jobs can
+        cold-start from this run's final worker count."""
+        if not hasattr(self.optimizer, "report_job_end"):
+            return
+        status = (
+            "succeeded"
+            if self._exit_reason == JobExitReason.SUCCEEDED
+            else "failed"
+        )
+        samples = self.metric_collector.metrics.samples
+        worker_num = max(
+            (s.worker_num for s in samples),
+            default=self.job_args.worker_spec.group.count,
+        )
+        try:
+            self.optimizer.report_job_end(
+                status, worker_num, exit_reason=self._exit_reason
+            )
+        except Exception:
+            logger.exception("brain job-end report failed")
 
     def request_stop(self, success: bool, reason: str, msg: str = ""):
         logger.info("stop requested (success=%s): %s %s", success, reason, msg)
@@ -196,5 +239,6 @@ class DistributedJobMaster:
         self.task_manager.stop()
         self.job_manager.stop()
         self.scale_plan_watcher.stop()
+        self.metric_collector.stop()
         self.diagnosis_manager.stop()
         self._server.stop(grace=1)

@@ -35,11 +35,20 @@ class LocalJobMaster:
         elastic_run_configs: Optional[Dict] = None,
         heartbeat_timeout: float = 600,
     ):
+        from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
+        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
         self.speed_monitor = SpeedMonitor()
         self.speed_monitor.set_target_worker_num(node_num)
         self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
+        self.error_monitor = ErrorMonitor()
+        self.metric_collector = JobMetricCollector(
+            speed_monitor=self.speed_monitor
+        )
         self.job_manager = LocalJobManager(
-            speed_monitor=self.speed_monitor, heartbeat_timeout=heartbeat_timeout
+            speed_monitor=self.speed_monitor,
+            heartbeat_timeout=heartbeat_timeout,
+            error_monitor=self.error_monitor,
         )
         self.rdzv_managers = {
             RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
@@ -78,6 +87,7 @@ class LocalJobMaster:
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
+        self.metric_collector.start()
         self.diagnosis_manager.start_observing()
         logger.info("local master serving on port %s", self.port)
 
@@ -106,6 +116,7 @@ class LocalJobMaster:
     def stop(self):
         self.task_manager.stop()
         self.job_manager.stop()
+        self.metric_collector.stop()
         if self.diagnosis_manager is not None:
             self.diagnosis_manager.stop()
         self._server.stop(grace=1)

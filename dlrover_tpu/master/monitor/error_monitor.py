@@ -1,0 +1,94 @@
+"""Error/event reporting from the master.
+
+Parity: reference ``master/monitor/error_monitor.py:22,53,100``
+(SimpleErrorMonitor logging locally, K8sJobErrorMonitor emitting k8s
+Events on the job object so operators see failures in ``kubectl describe``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+
+
+class ErrorEvent:
+    def __init__(self, event_type: str, instance: str, message: str):
+        self.timestamp = time.time()
+        self.event_type = event_type  # info | warning | error
+        self.instance = instance  # e.g. "worker-3"
+        self.message = message
+
+
+class ErrorMonitor:
+    """Default sink: the master log + an in-memory window."""
+
+    def __init__(self, max_events: int = 256):
+        self.events: List[ErrorEvent] = []
+        self._max = max_events
+
+    def report(self, event_type: str, instance: str, message: str):
+        event = ErrorEvent(event_type, instance, message)
+        self.events.append(event)
+        if len(self.events) > self._max:
+            self.events.pop(0)
+        log = logger.error if event_type == "error" else logger.warning
+        log("[event %s] %s: %s", event_type, instance, message)
+        self._emit(event)
+
+    def _emit(self, event: ErrorEvent):
+        pass
+
+    def process_error(
+        self, node_type: str, node_id: int, error_data: str, level: str
+    ):
+        """Node failure hook (reference handle_process_error)."""
+        self.report(
+            "error" if level == "error" else "warning",
+            f"{node_type}-{node_id}",
+            error_data[:500],
+        )
+
+
+class K8sErrorMonitor(ErrorMonitor):
+    """Additionally writes k8s Events attached to the ElasticJob."""
+
+    def __init__(self, client, job_name: str, namespace: str = "default"):
+        super().__init__()
+        self._client = client
+        self._job_name = job_name
+        self._namespace = namespace
+        self._seq = 0
+
+    def _emit(self, event: ErrorEvent):
+        self._seq += 1
+        k8s_event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{self._job_name}-ev-{int(event.timestamp)}-{self._seq}",
+                "namespace": self._namespace,
+            },
+            "involvedObject": {
+                "apiVersion": "elastic.dlrover-tpu.org/v1alpha1",
+                "kind": "ElasticJob",
+                "name": self._job_name,
+                "namespace": self._namespace,
+            },
+            "reason": event.instance,
+            "message": event.message[:1024],
+            "type": "Warning" if event.event_type != "info" else "Normal",
+            "source": {"component": "dlrover-tpu-master"},
+            "firstTimestamp": _rfc3339(event.timestamp),
+            "lastTimestamp": _rfc3339(event.timestamp),
+            "count": 1,
+        }
+        try:
+            self._client.create_event(k8s_event)
+        except Exception as e:
+            logger.warning("k8s event emit failed: %s", e)
+
+
+def _rfc3339(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))

@@ -42,8 +42,9 @@ class DistributedJobManager(JobManager):
         job_auto_scaler=None,
         heartbeat_timeout: float = DefaultValues.SEC_HEARTBEAT_TIMEOUT,
         pending_timeout: float = DefaultValues.SEC_NODE_START_TIMEOUT,
+        error_monitor=None,
     ):
-        super().__init__(job_args, speed_monitor)
+        super().__init__(job_args, speed_monitor, error_monitor)
         self._scaler = scaler
         self._watcher = watcher
         self._rdzv_managers = rdzv_managers or {}

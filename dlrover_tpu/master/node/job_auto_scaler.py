@@ -112,8 +112,15 @@ class JobAutoScaler:
         return scale_plan
 
     def _push_paral_config(self, cfg: dict):
+        from dlrover_tpu.common.messages import ParallelConfig
+
+        filtered = ParallelConfig.filter_known(cfg)
+        dropped = set(cfg) - set(filtered)
+        if dropped:
+            logger.warning("paral config keys without a wire field: %s", dropped)
         for node in self._job_context.workers().values():
-            node.paral_config = dict(cfg)
+            version = int(node.paral_config.get("dataloader_version", 0)) + 1
+            node.paral_config = {**filtered, "dataloader_version": version}
 
     # -- failure hooks -----------------------------------------------------
 

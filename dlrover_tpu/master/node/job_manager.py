@@ -30,9 +30,10 @@ from dlrover_tpu.master.node.status_flow import get_node_state_flow
 class JobManager(ABC):
     """Shared API the servicer and master loop program against."""
 
-    def __init__(self, job_args=None, speed_monitor=None):
+    def __init__(self, job_args=None, speed_monitor=None, error_monitor=None):
         self._job_args = job_args
         self._speed_monitor = speed_monitor
+        self._error_monitor = error_monitor
         self._job_context = get_job_context()
         self._stopped = False
 
@@ -104,6 +105,10 @@ class JobManager(ABC):
         )
         if level == TrainingExceptionLevel.ERROR:
             node.exit_reason = _classify_error(error_data, exit_code)
+        if self._error_monitor is not None:
+            self._error_monitor.process_error(
+                node_type, node_id, error_data, level
+            )
 
     def handle_node_succeeded(self, node_type: str, node_id: int):
         node = self._job_context.get_node(node_type, node_id)
@@ -160,8 +165,9 @@ class LocalJobManager(JobManager):
         job_args=None,
         speed_monitor=None,
         heartbeat_timeout: float = DefaultValues.SEC_HEARTBEAT_TIMEOUT,
+        error_monitor=None,
     ):
-        super().__init__(job_args, speed_monitor)
+        super().__init__(job_args, speed_monitor, error_monitor)
         self._heartbeat_timeout = heartbeat_timeout
         self._monitor_thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()

@@ -142,19 +142,31 @@ class BrainResourceOptimizer(ResourceOptimizer):
                 ),
             )
         elif plan.memory_mb_per_host > 0:
-            out.node_group_resources[NodeType.WORKER] = NodeGroupResource(
-                count=self._current_workers,
-                node_resource=NodeResource(
-                    memory_mb=plan.memory_mb_per_host,
-                    tpu_type=self._tpu_type,
-                ),
-            )
+            if self._current_workers > 0:
+                out.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+                    count=self._current_workers,
+                    node_resource=NodeResource(
+                        memory_mb=plan.memory_mb_per_host,
+                        tpu_type=self._tpu_type,
+                    ),
+                )
+            else:
+                # count unknown: a group entry with count=0 would read as
+                # "scale to zero" downstream — drop the bump instead
+                logger.warning(
+                    "memory-only plan before any worker count observation; "
+                    "skipping (%s)",
+                    plan.comment,
+                )
         if plan.paral_config:
             out.paral_config = dict(plan.paral_config)
         return out
 
     def generate_opt_plan(self, stage: str, stats: WorkerStats) -> ResourcePlan:
-        self.report_stats(stats)
+        # metrics persistence is owned by the JobMetricCollector's
+        # BrainStatsReporter; reporting here too would double every sample
+        if stats.worker_num > 0:
+            self._current_workers = stats.worker_num
         plan = self._request(stage)
         if plan is None:
             return self._fallback.generate_opt_plan(stage, stats)

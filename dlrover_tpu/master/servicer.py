@@ -339,7 +339,9 @@ class MasterServicer:
     def _get_paral_config(self, request: msg.ParallelConfigRequest):
         node = self._job_context.get_node(NodeType.WORKER, request.node_id)
         if node is not None and node.paral_config:
-            return msg.ParallelConfig(**node.paral_config)
+            return msg.ParallelConfig(
+                **msg.ParallelConfig.filter_known(node.paral_config)
+            )
         return msg.ParallelConfig()
 
     def _get_elastic_run_config(self, request: msg.ElasticRunConfigRequest):

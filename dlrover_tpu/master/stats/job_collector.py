@@ -1,0 +1,155 @@
+"""Job runtime metric collection inside the master.
+
+Parity: reference ``master/stats/job_collector.py:84`` (JobMetricCollector)
++ ``reporter.py:99,146`` (LocalStatsReporter / BrainReporter). A periodic
+thread samples the job (throughput from the SpeedMonitor, per-node used
+resources from the JobContext) and hands the sample to a reporter; the
+brain reporter doubles as the data feed for cluster-level optimization.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.node.job_context import get_job_context
+
+
+@dataclass
+class JobRuntimeSample:
+    timestamp: float = 0.0
+    worker_num: int = 0
+    speed_steps_per_sec: float = 0.0
+    global_step: int = 0
+    cpu_percent_avg: float = 0.0
+    memory_mb_avg: float = 0.0
+    memory_mb_max: float = 0.0
+    tpu_duty_cycle_avg: float = 0.0
+
+
+@dataclass
+class JobMetrics:
+    """Accumulated job metrics (model info + runtime history window)."""
+
+    model_params: int = 0
+    model_flops_per_step: float = 0.0
+    samples: List[JobRuntimeSample] = field(default_factory=list)
+    max_samples: int = 512
+
+    def add(self, sample: JobRuntimeSample):
+        self.samples.append(sample)
+        if len(self.samples) > self.max_samples:
+            self.samples.pop(0)
+
+
+class StatsReporter:
+    """Reporter ABC; default sink is the log."""
+
+    def report_runtime(self, sample: JobRuntimeSample):
+        logger.info(
+            "job stats: workers=%s speed=%.2f steps/s step=%s "
+            "cpu=%.0f%% mem=%.0f/%.0fMB duty=%.2f",
+            sample.worker_num,
+            sample.speed_steps_per_sec,
+            sample.global_step,
+            sample.cpu_percent_avg,
+            sample.memory_mb_avg,
+            sample.memory_mb_max,
+            sample.tpu_duty_cycle_avg,
+        )
+
+
+class LocalStatsReporter(StatsReporter):
+    """Keeps the window in memory (tests + standalone)."""
+
+    def __init__(self, metrics: Optional[JobMetrics] = None):
+        self.metrics = metrics or JobMetrics()
+
+    def report_runtime(self, sample: JobRuntimeSample):
+        self.metrics.add(sample)
+
+
+class BrainStatsReporter(StatsReporter):
+    """Routes samples into the brain service via the master's optimizer."""
+
+    def __init__(self, brain_optimizer):
+        self._opt = brain_optimizer
+
+    def report_runtime(self, sample: JobRuntimeSample):
+        from dlrover_tpu.master.resource.optimizer import WorkerStats
+
+        stats = WorkerStats(
+            worker_num=sample.worker_num,
+            speed_steps_per_sec=sample.speed_steps_per_sec,
+            cpu_percents=[sample.cpu_percent_avg] if sample.cpu_percent_avg else [],
+            memory_mbs=[sample.memory_mb_max] if sample.memory_mb_max else [],
+            duty_cycles=[sample.tpu_duty_cycle_avg] if sample.tpu_duty_cycle_avg else [],
+        )
+        self._opt.report_stats(stats, global_step=sample.global_step)
+
+
+class JobMetricCollector:
+    def __init__(
+        self,
+        speed_monitor=None,
+        reporters: Optional[List[StatsReporter]] = None,
+        interval: float = 30.0,
+    ):
+        self._speed_monitor = speed_monitor
+        self._reporters = reporters or [LocalStatsReporter()]
+        self._interval = interval
+        self._job_context = get_job_context()
+        self.metrics = JobMetrics()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="job-metric-collector", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def set_model_info(self, params: int, flops_per_step: float = 0.0):
+        self.metrics.model_params = params
+        self.metrics.model_flops_per_step = flops_per_step
+
+    def collect_once(self) -> JobRuntimeSample:
+        workers = self._job_context.running_nodes(NodeType.WORKER)
+        cpus = [n.used_resource.cpu for n in workers if n.used_resource.cpu]
+        mems = [
+            n.used_resource.memory_mb
+            for n in workers
+            if n.used_resource.memory_mb
+        ]
+        sample = JobRuntimeSample(
+            timestamp=time.time(),
+            worker_num=len(workers),
+            cpu_percent_avg=sum(cpus) / len(cpus) if cpus else 0.0,
+            memory_mb_avg=sum(mems) / len(mems) if mems else 0.0,
+            memory_mb_max=max(mems, default=0.0),
+        )
+        if self._speed_monitor is not None:
+            sample.speed_steps_per_sec = self._speed_monitor.running_speed()
+            sample.global_step = self._speed_monitor.completed_global_step
+        self.metrics.add(sample)
+        for reporter in self._reporters:
+            try:
+                reporter.report_runtime(sample)
+            except Exception:
+                logger.exception("stats reporter failed")
+        return sample
+
+    def _loop(self):
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self.collect_once()
+            except Exception:
+                logger.exception("metric collection failed")

@@ -201,3 +201,107 @@ class ElasticDistributedSampler:
         # Align to the *new* global batch so a resized world resumes cleanly.
         global_batch = self.batch_size * self.num_replicas
         self.state.completed_samples = (completed // global_batch) * global_batch
+
+
+class ElasticDataLoader:
+    """Batches from an indexable dataset with runtime-tunable batch size.
+
+    Parity: reference ``ElasticDataLoader`` (``dataloader.py:26-147``): the
+    batch size reloads from the ParalConfigTuner JSON the agent maintains,
+    so a master-pushed ``dataloader_batch_size`` (e.g. the brain's HBM-OOM
+    micro-batch adjustment) takes effect at the next batch without code
+    changes in the training loop. ``collate`` turns a list of samples into
+    the yielded batch (default: numpy stack).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate=None,
+        config_path: str = "",
+        sampler: Optional[ElasticDistributedSampler] = None,
+    ):
+        self.dataset = dataset
+        self._base_batch_size = batch_size
+        self._config_path = config_path
+        self._config_version = -1
+        self._collate = collate or _default_collate
+        self.sampler = sampler or ElasticDistributedSampler(
+            dataset_size=len(dataset),
+            batch_size=batch_size,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+        )
+
+    @property
+    def batch_size(self) -> int:
+        return self.sampler.batch_size
+
+    def update_batch_size_from_config(self) -> bool:
+        """Apply the tuner config; returns True when the size changed.
+
+        SPMD-safe: process 0 reads its node's file and BROADCASTS
+        (version, size) so every process applies the identical change —
+        per-node tuner files update on independent poll schedules, and a
+        mismatched micro-batch under pjit lockstep hangs the collective.
+        Called only between epochs: the sampler's iterator captures the
+        global batch at epoch start, so a mid-epoch change would skip or
+        duplicate samples.
+        """
+        from dlrover_tpu.agent.paral_config_tuner import read_paral_config
+
+        version, new_size = self._config_version, self.sampler.batch_size
+        config = read_paral_config(self._config_path)
+        if config:
+            version = int(config.get("dataloader_version", 0))
+            new_size = int(config.get("dataloader_batch_size", 0))
+            if new_size <= 0:
+                # relative adjustment (HBM-OOM recovery halves micro-batch)
+                scale = float(config.get("micro_batch_scale", 1.0) or 1.0)
+                new_size = max(1, int(self._base_batch_size * scale))
+        import jax
+
+        version, new_size = _broadcast_tuple(
+            (version, new_size), is_source=jax.process_index() == 0
+        )
+        if version == self._config_version:
+            return False
+        self._config_version = version
+        if new_size == self.sampler.batch_size or new_size <= 0:
+            return False
+        logger.info(
+            "elastic dataloader: batch size %s -> %s (config v%s)",
+            self.sampler.batch_size,
+            new_size,
+            version,
+        )
+        self.sampler.batch_size = new_size
+        return True
+
+    def __iter__(self):
+        self.update_batch_size_from_config()
+        for indices in self.sampler:
+            yield self._collate([self.dataset[i] for i in indices])
+        # next epoch may pick up a new config (never mid-epoch)
+
+    def state_dict(self) -> dict:
+        return self.sampler.state_dict()
+
+    def load_state_dict(self, state: dict):
+        self.sampler.load_state_dict(state)
+
+
+def _default_collate(samples):
+    if isinstance(samples[0], (tuple, list)):
+        return tuple(
+            np.stack([s[i] for s in samples])
+            for i in range(len(samples[0]))
+        )
+    if isinstance(samples[0], dict):
+        return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+    return np.stack(samples)

@@ -144,6 +144,35 @@ def test_brain_server_end_to_end_with_master_optimizer():
         server.stop()
 
 
+def test_round_to_unit_never_violates_min():
+    from dlrover_tpu.brain.optimizer import _round_to_unit
+
+    r = req(STAGE_CREATE, lo=3, hi=8, unit=2)
+    assert _round_to_unit(3, r) == 4  # round UP, not down past min
+    assert _round_to_unit(7, r) == 6
+    assert _round_to_unit(99, r) == 8
+
+
+def test_memory_only_plan_without_worker_count_is_dropped():
+    server = BrainServer(port=0)
+    server.start()
+    try:
+        opt = BrainResourceOptimizer(
+            f"127.0.0.1:{server.port}",
+            job_uuid="j-oom",
+            job_name="oomjob",
+            min_workers=1,
+            max_workers=8,
+        )
+        # no speed observations yet -> current workers unknown
+        plan = opt.generate_oom_recovery_plan(
+            ["worker-0"], STAGE_RUNNING, host_oom=True
+        )
+        assert "worker" not in plan.node_group_resources  # no scale-to-0
+    finally:
+        server.stop()
+
+
 def test_master_optimizer_falls_back_when_brain_down():
     opt = BrainResourceOptimizer(
         "127.0.0.1:1",  # nothing listening

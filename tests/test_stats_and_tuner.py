@@ -1,0 +1,132 @@
+"""Stats collection, error monitor, and the paral-config chain:
+autoscaler plan -> node config -> servicer -> client -> tuner file ->
+ElasticDataLoader batch size (reference ParalConfigTuner + ElasticDataLoader,
+§2.5/§2.6)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.paral_config_tuner import (
+    PARAL_CONFIG_PATH_ENV,
+    ParalConfigTuner,
+    read_paral_config,
+)
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.master.local_master import start_local_master
+from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor, K8sErrorMonitor
+from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+from dlrover_tpu.master.stats.job_collector import (
+    JobMetricCollector,
+    LocalStatsReporter,
+)
+from dlrover_tpu.train.data import ElasticDataLoader
+from tests.k8s_fakes import make_fake_client
+
+
+@pytest.fixture
+def local_master():
+    master = start_local_master(node_num=1)
+    yield master
+    master.stop()
+    JobContext.reset_singleton()
+
+
+def test_metric_collector_samples_context(local_master):
+    client = MasterClient(f"127.0.0.1:{local_master.port}", node_id=0)
+    client.report_node_address("127.0.0.1")
+    client.report_used_resource(cpu_percent=55.0, memory_mb=2048.0)
+    reporter = LocalStatsReporter()
+    collector = JobMetricCollector(
+        speed_monitor=local_master.speed_monitor, reporters=[reporter]
+    )
+    sample = collector.collect_once()
+    assert sample.worker_num == 1
+    assert sample.cpu_percent_avg == 55.0
+    assert sample.memory_mb_max == 2048.0
+    assert reporter.metrics.samples[-1] is sample
+
+
+def test_error_monitor_collects_failures(local_master):
+    client = MasterClient(f"127.0.0.1:{local_master.port}", node_id=0)
+    client.report_node_address("127.0.0.1")
+    client.report_failure("Traceback ... ValueError", 0)
+    events = local_master.error_monitor.events
+    assert events and events[-1].instance == "worker-0"
+    assert "ValueError" in events[-1].message
+
+
+def test_k8s_error_monitor_emits_events():
+    k8s, transport = make_fake_client()
+    monitor = K8sErrorMonitor(k8s, "job-x", "dlrover")
+    monitor.report("error", "worker-2", "chip failure")
+    assert len(transport.events) == 1
+    ev = transport.events[0]
+    assert ev["involvedObject"]["name"] == "job-x"
+    assert ev["reason"] == "worker-2"
+
+
+def test_paral_config_chain_end_to_end(local_master, tmp_path, monkeypatch):
+    """Autoscaler pushes an HBM-OOM adjustment; the worker's dataloader
+    halves its micro batch after the tuner writes the file."""
+    client = MasterClient(f"127.0.0.1:{local_master.port}", node_id=0)
+    client.report_node_address("127.0.0.1")
+
+    # master side: a plan with scales lands on the node
+    from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.resource.optimizer import LocalOptimizer
+
+    scaler = JobAutoScaler(
+        optimizer=LocalOptimizer(),
+        scaler=_NoopScaler(),
+        speed_monitor=local_master.speed_monitor,
+    )
+    scaler._push_paral_config(
+        {"micro_batch_scale": 0.5, "grad_accum_scale": 2.0, "restart": True,
+         "bogus_key": 1}
+    )
+    node = get_job_context().get_node(NodeType.WORKER, 0)
+    assert "bogus_key" not in node.paral_config
+    assert node.paral_config["dataloader_version"] == 1
+
+    # agent side: tuner polls and writes the file
+    path = str(tmp_path / "paral.json")
+    tuner = ParalConfigTuner(client, "j", 0, path=path, interval=3600)
+    assert tuner.poll_once() is True
+    config = read_paral_config(path)
+    assert config["micro_batch_scale"] == 0.5
+    assert config["dataloader_version"] == 1
+    assert tuner.poll_once() is False  # unchanged -> no rewrite
+
+    # worker side: dataloader applies the scale to its base batch size
+    monkeypatch.setenv(PARAL_CONFIG_PATH_ENV, path)
+    dataset = [np.full((4,), i, np.float32) for i in range(64)]
+    loader = ElasticDataLoader(dataset, batch_size=8, shuffle=False)
+    # force single-replica sampler regardless of test env
+    loader.sampler.num_replicas = 1
+    loader.sampler.rank = 0
+    batches = list(iter(loader))
+    assert batches[0].shape[0] == 4  # 8 * 0.5
+    assert loader.batch_size == 4
+
+
+def test_elastic_dataloader_without_config(tmp_path, monkeypatch):
+    monkeypatch.delenv(PARAL_CONFIG_PATH_ENV, raising=False)
+    dataset = [np.full((2,), i, np.float32) for i in range(16)]
+    loader = ElasticDataLoader(dataset, batch_size=4, shuffle=False)
+    loader.sampler.num_replicas = 1
+    loader.sampler.rank = 0
+    batches = list(iter(loader))
+    assert len(batches) == 4
+    assert batches[0].shape == (4, 2)
+    # mid-epoch resume carries through state_dict
+    state = loader.state_dict()
+    assert state["epoch"] == 1
+
+
+class _NoopScaler:
+    def scale(self, plan):
+        pass
