@@ -23,6 +23,7 @@ class NodeResource:
     memory_mb: float = 0.0
     tpu_chips: int = 0
     tpu_type: str = ""  # e.g. "v5p"
+    tpu_duty_cycle: float = 0.0  # observed busy fraction (usage only)
     gpu_num: int = 0  # parity field; unused on TPU
 
     def to_dict(self) -> Dict:

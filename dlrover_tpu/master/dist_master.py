@@ -114,7 +114,8 @@ class DistributedJobMaster:
         self.error_monitor = K8sErrorMonitor(
             self._client, job_args.job_name, job_args.namespace
         )
-        reporters = [StatsReporter(), LocalStatsReporter()]
+        # (the collector keeps its own sample window; no LocalStatsReporter)
+        reporters = [StatsReporter()]
         if brain_addr:
             reporters.append(BrainStatsReporter(optimizer))
         self.metric_collector = JobMetricCollector(
@@ -218,9 +219,11 @@ class DistributedJobMaster:
             else "failed"
         )
         samples = self.metric_collector.metrics.samples
-        worker_num = max(
-            (s.worker_num for s in samples),
-            default=self.job_args.worker_spec.group.count,
+        # the FINAL observed size is what a same-named job should cold-start
+        # at (teardown-phase zero samples skipped)
+        worker_num = next(
+            (s.worker_num for s in reversed(samples) if s.worker_num > 0),
+            self.job_args.worker_spec.group.count,
         )
         try:
             self.optimizer.report_job_end(

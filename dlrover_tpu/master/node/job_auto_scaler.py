@@ -119,6 +119,13 @@ class JobAutoScaler:
         if dropped:
             logger.warning("paral config keys without a wire field: %s", dropped)
         for node in self._job_context.workers().values():
+            current = {
+                k: v
+                for k, v in node.paral_config.items()
+                if k != "dataloader_version"
+            }
+            if current == filtered:
+                continue  # no-op push: don't churn versions/files
             version = int(node.paral_config.get("dataloader_version", 0)) + 1
             node.paral_config = {**filtered, "dataloader_version": version}
 

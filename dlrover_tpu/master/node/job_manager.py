@@ -55,6 +55,9 @@ class JobManager(ABC):
             return
         node.used_resource.cpu = cpu
         node.used_resource.memory_mb = memory_mb
+        duty = kw.get("tpu_duty_cycle")
+        if duty is not None:
+            node.used_resource.tpu_duty_cycle = float(duty)
 
     def collect_node_heartbeat(
         self, node_type: str, node_id: int, ts: float

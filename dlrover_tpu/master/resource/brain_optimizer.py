@@ -60,16 +60,20 @@ class BrainResourceOptimizer(ResourceOptimizer):
         self._fallback.observe_speed(worker_num, steps_per_sec)
 
     def report_stats(self, stats: WorkerStats, global_step: int = 0):
-        sample = bmsg.RuntimeSample(
-            timestamp=time.time(),
-            worker_num=stats.worker_num,
-            speed_steps_per_sec=stats.speed_steps_per_sec,
-            global_step=global_step,
-            cpu_percent_avg=_avg(stats.cpu_percents),
-            memory_mb_avg=_avg(stats.memory_mbs),
-            memory_mb_max=max(stats.memory_mbs, default=0.0),
-            tpu_duty_cycle_avg=_avg(stats.duty_cycles),
+        self.report_sample(
+            bmsg.RuntimeSample(
+                timestamp=time.time(),
+                worker_num=stats.worker_num,
+                speed_steps_per_sec=stats.speed_steps_per_sec,
+                global_step=global_step,
+                cpu_percent_avg=_avg(stats.cpu_percents),
+                memory_mb_avg=_avg(stats.memory_mbs),
+                memory_mb_max=max(stats.memory_mbs, default=0.0),
+                tpu_duty_cycle_avg=_avg(stats.duty_cycles),
+            )
         )
+
+    def report_sample(self, sample: "bmsg.RuntimeSample"):
         try:
             self._client.report(
                 bmsg.BrainPersistMetrics(

@@ -80,16 +80,20 @@ class BrainStatsReporter(StatsReporter):
         self._opt = brain_optimizer
 
     def report_runtime(self, sample: JobRuntimeSample):
-        from dlrover_tpu.master.resource.optimizer import WorkerStats
+        from dlrover_tpu.brain.messages import RuntimeSample
 
-        stats = WorkerStats(
-            worker_num=sample.worker_num,
-            speed_steps_per_sec=sample.speed_steps_per_sec,
-            cpu_percents=[sample.cpu_percent_avg] if sample.cpu_percent_avg else [],
-            memory_mbs=[sample.memory_mb_max] if sample.memory_mb_max else [],
-            duty_cycles=[sample.tpu_duty_cycle_avg] if sample.tpu_duty_cycle_avg else [],
+        self._opt.report_sample(
+            RuntimeSample(
+                timestamp=sample.timestamp,
+                worker_num=sample.worker_num,
+                speed_steps_per_sec=sample.speed_steps_per_sec,
+                global_step=sample.global_step,
+                cpu_percent_avg=sample.cpu_percent_avg,
+                memory_mb_avg=sample.memory_mb_avg,
+                memory_mb_max=sample.memory_mb_max,
+                tpu_duty_cycle_avg=sample.tpu_duty_cycle_avg,
+            )
         )
-        self._opt.report_stats(stats, global_step=sample.global_step)
 
 
 class JobMetricCollector:
@@ -100,7 +104,9 @@ class JobMetricCollector:
         interval: float = 30.0,
     ):
         self._speed_monitor = speed_monitor
-        self._reporters = reporters or [LocalStatsReporter()]
+        # the collector's own ``metrics`` window always records; reporters
+        # are additional sinks (log, brain)
+        self._reporters = reporters if reporters is not None else []
         self._interval = interval
         self._job_context = get_job_context()
         self.metrics = JobMetrics()
@@ -129,12 +135,18 @@ class JobMetricCollector:
             for n in workers
             if n.used_resource.memory_mb
         ]
+        duties = [
+            n.used_resource.tpu_duty_cycle
+            for n in workers
+            if n.used_resource.tpu_duty_cycle
+        ]
         sample = JobRuntimeSample(
             timestamp=time.time(),
             worker_num=len(workers),
             cpu_percent_avg=sum(cpus) / len(cpus) if cpus else 0.0,
             memory_mb_avg=sum(mems) / len(mems) if mems else 0.0,
             memory_mb_max=max(mems, default=0.0),
+            tpu_duty_cycle_avg=sum(duties) / len(duties) if duties else 0.0,
         )
         if self._speed_monitor is not None:
             sample.speed_steps_per_sec = self._speed_monitor.running_speed()

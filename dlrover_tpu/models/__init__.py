@@ -1,4 +1,5 @@
-"""Model families. Flagship: Llama-3 decoder (BASELINE.json north star)."""
+"""Model families. Flagship: Llama-3 decoder (BASELINE.json north star);
+Mixtral-class sparse MoE with expert parallelism in ``models.moe``."""
 
 from dlrover_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
@@ -9,3 +10,4 @@ from dlrover_tpu.models.llama import (  # noqa: F401
     param_count,
     param_specs,
 )
+from dlrover_tpu.models.moe import MoeConfig  # noqa: F401
