@@ -35,6 +35,7 @@ class Checkpointer:
         storage=None,
         master_client: Optional[object] = None,
         save_storage_interval: int = 0,
+        async_staging: Optional[bool] = None,
     ):
         """``save_storage_interval > 0`` auto-upgrades every Nth memory save
         to a disk persist (so callers can save(…, MEMORY) every step and
@@ -48,7 +49,10 @@ class Checkpointer:
             except Exception:
                 master_client = None
         self._engine = CheckpointEngine(
-            ckpt_dir, storage=storage, master_client=master_client
+            ckpt_dir,
+            storage=storage,
+            master_client=master_client,
+            async_staging=async_staging,
         )
         self._save_storage_interval = max(0, save_storage_interval)
         self.last_blocking_s = 0.0

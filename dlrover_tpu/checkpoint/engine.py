@@ -17,6 +17,7 @@ Restore:
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -68,6 +69,7 @@ class CheckpointEngine:
         storage: Optional[CheckpointStorage] = None,
         socket_path: str = "",
         master_client=None,
+        async_staging: Optional[bool] = None,
     ):
         from dlrover_tpu.common.constants import NodeEnv
 
@@ -96,6 +98,21 @@ class CheckpointEngine:
         self._awaiting_persist = -1
         self._master_client = master_client
         self.latest_saved_step = -1
+        # Async staging exploits jax.Array immutability: "snapshotting" the
+        # state is just holding references (training's next step builds NEW
+        # arrays), so device->host + shm copy can run in a background
+        # thread and the training pause collapses to reference capture.
+        # torch engines cannot do this — in-place optimizer updates force
+        # them to finish the copy before step N+1 (the reference blocks for
+        # the whole shm stage, flash_checkpoint.md). Costs one extra
+        # generation of params/opt-state kept alive until staging ends.
+        if async_staging is None:
+            async_staging = (
+                os.environ.get("DLROVER_TPU_ASYNC_STAGING", "0") == "1"
+            )
+        self._async_staging = bool(async_staging)
+        self._staging_thread: Optional[threading.Thread] = None
+        self._staging_error: Optional[BaseException] = None
 
     # -- IPC (lazy: standalone use without an agent works too) --------------
 
@@ -189,20 +206,84 @@ class CheckpointEngine:
         return named_leaves, shard_info, treedef_bytes
 
     def save_to_memory(self, step: int, state: Any) -> float:
-        """Stage into shm; returns the blocking seconds (the training pause)."""
+        """Stage into shm; returns the blocking seconds (the training pause).
+
+        With ``async_staging`` the stage runs in a background thread and
+        this returns in microseconds; a subsequent save (or load/close)
+        joins the in-flight stage first.
+        """
+        t0 = time.time()
+        if self._async_staging:
+            return self._start_async_stage(t0, step, state, persist=False)
+        try:
+            self._stage_sync(step, state)
+        except TimeoutError as e:
+            logger.warning("%s; skipping memory save", e)
+            return time.time() - t0
+        blocking = time.time() - t0
+        self._report_save(step, blocking)
+        return blocking
+
+    def _start_async_stage(
+        self, t0: float, step: int, state: Any, persist: bool
+    ) -> float:
+        self.wait_staging()
+        self._staging_error = None
+        pause = time.time() - t0
+        self._staging_thread = threading.Thread(
+            target=self._stage_in_background,
+            args=(step, state, persist, pause),
+            name="ckpt-staging",
+            daemon=True,
+        )
+        self._staging_thread.start()
+        return time.time() - t0
+
+    def wait_staging(self, timeout: Optional[float] = None):
+        """Join any in-flight background stage; re-raise its failure.
+        Raises TimeoutError (keeping the thread tracked) if it is still
+        running after ``timeout`` — callers must not touch the shm then."""
+        thread = self._staging_thread
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                raise TimeoutError(
+                    f"checkpoint staging still running after {timeout}s"
+                )
+            self._staging_thread = None
+        if self._staging_error is not None:
+            err, self._staging_error = self._staging_error, None
+            raise err
+
+    def _stage_in_background(
+        self, step: int, state: Any, persist: bool, pause: float
+    ):
+        try:
+            self._stage_sync(step, state)
+            if persist:
+                self._queue_persist(step)
+            self._report_save(step, pause)
+        except BaseException as e:  # surfaced on the next wait_staging
+            logger.exception("background staging of step %s failed", step)
+            self._staging_error = e
+
+    def _report_save(self, step: int, blocking: float):
+        if self._master_client is not None:
+            try:
+                self._master_client.report_ckpt_step(step, blocking)
+            except Exception:
+                pass
+
+    def _stage_sync(self, step: int, state: Any):
         import jax
 
-        t0 = time.time()
         self._wait_pending_persist()
         named_leaves, shard_info, treedef_bytes = self._gather_local_shards(state)
         lock = self._lock()
         if lock is not None and not lock.acquire(timeout=120):
-            logger.warning(
-                "shm lock not acquired in 120s; skipping memory save of "
-                "step %s",
-                step,
+            raise TimeoutError(
+                f"shm lock not acquired in 120s; step {step} not staged"
             )
-            return time.time() - t0
         try:
             self._shm.save_state(
                 step,
@@ -222,25 +303,8 @@ class CheckpointEngine:
             q = self._queue()
             if q is not None:
                 q.put(CheckpointEvent("backup", step=step).to_wire())
-        blocking = time.time() - t0
-        if self._master_client is not None:
-            try:
-                self._master_client.report_ckpt_step(step, blocking)
-            except Exception:
-                pass
-        return blocking
 
-    def save_to_storage(self, step: int, state: Any) -> float:
-        """Stage + hand persistence to the agent saver (async)."""
-        blocking = self.save_to_memory(step, state)
-        if self.latest_saved_step != step:
-            # staging was skipped (shm lock timeout): queuing a persist
-            # event would make the saver persist a stale step as if it were
-            # this one — surface the failure instead
-            logger.error(
-                "step %s was not staged to shm; skipping persist", step
-            )
-            return blocking
+    def _queue_persist(self, step: int):
         q = self._queue()
         if q is not None:
             q.put(
@@ -252,6 +316,23 @@ class CheckpointEngine:
         else:
             # no agent (bare run): persist synchronously in-process
             self._persist_inline(step)
+
+    def save_to_storage(self, step: int, state: Any) -> float:
+        """Stage + hand persistence to the agent saver (async)."""
+        t0 = time.time()
+        if self._async_staging:
+            return self._start_async_stage(t0, step, state, persist=True)
+        try:
+            self._stage_sync(step, state)
+        except TimeoutError as e:
+            # staging was skipped (shm lock timeout): queuing a persist
+            # event would make the saver persist a stale step as if it were
+            # this one — surface the failure instead
+            logger.error("%s; skipping persist", e)
+            return time.time() - t0
+        self._queue_persist(step)
+        blocking = time.time() - t0
+        self._report_save(step, blocking)
         return blocking
 
     def _persist_inline(self, step: int):
@@ -273,6 +354,10 @@ class CheckpointEngine:
 
     def load(self, target: Any = None) -> Optional[Tuple[int, Any]]:
         """Restore (step, state). shm first, storage fallback."""
+        try:
+            self.wait_staging()
+        except Exception as e:
+            logger.warning("in-flight staging failed before load: %s", e)
         result = self._load_from_memory(target)
         if result is not None:
             logger.info("restored step %s from shared memory", result[0])
@@ -476,6 +561,10 @@ class CheckpointEngine:
             return -1
 
     def close(self):
+        try:
+            self.wait_staging(timeout=300)
+        except Exception as e:
+            logger.warning("in-flight staging failed at close: %s", e)
         if self._event_queue is not None:
             self._event_queue.close()
         if self._shm_lock is not None:

@@ -107,7 +107,6 @@ class DistributedJobMaster:
         from dlrover_tpu.master.stats.job_collector import (
             BrainStatsReporter,
             JobMetricCollector,
-            LocalStatsReporter,
             StatsReporter,
         )
 

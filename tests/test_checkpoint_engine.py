@@ -59,6 +59,51 @@ def test_memory_save_restore(job_env):
     engine.close()
 
 
+def test_async_staging_save_restore(job_env):
+    """Async staging: save returns ~immediately; load joins the stage."""
+    job, ckpt_dir = job_env
+    mesh = _mesh((8,), ("dp",))
+    state = _make_state(mesh)
+    engine = CheckpointEngine(ckpt_dir, async_staging=True)
+    engine.save_to_memory(0, state)  # warmup (shm alloc)
+    engine.wait_staging()
+    blocking = engine.save_to_memory(7, state)
+    assert blocking < 0.05  # reference capture only
+    step, restored = engine.load(target=state)  # joins the stage
+    assert step == 7
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.asarray(state["w"])
+    )
+    # async persist to storage commits too
+    engine.save_to_storage(8, state)
+    engine.wait_staging()
+    assert engine.committed_step() == 8
+    engine.close()
+
+
+def test_async_staging_snapshots_are_immutable(job_env):
+    """The snapshot taken at save time is not affected by later updates —
+    jax arrays are immutable, so 'later training steps' build new arrays
+    and the background stage reads the originals."""
+    job, ckpt_dir = job_env
+    mesh = _mesh((8,), ("dp",))
+    state = _make_state(mesh)
+    engine = CheckpointEngine(ckpt_dir, async_staging=True)
+    engine.save_to_memory(0, state)
+    engine.wait_staging()
+    engine.save_to_memory(1, state)
+    # "training" continues: new arrays, old references untouched
+    state2 = {k: v + 1 for k, v in state.items()}
+    engine.wait_staging()
+    step, restored = engine.load(target=state)
+    assert step == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.asarray(state["w"])
+    )
+    del state2
+    engine.close()
+
+
 def test_storage_save_without_agent_is_synchronous(job_env):
     job, ckpt_dir = job_env
     mesh = _mesh((8,), ("dp",))
