@@ -148,7 +148,7 @@ def _scale_by_fit(
         if n <= best:
             continue
         gain = predicted_speed(a, b, n) - predicted_speed(a, b, best)
-        if gain >= 0.05 * base * ((n - best) / unit):
+        if gain >= 0.05 * base * (n - best):
             best = n
     if best == current:
         return BrainResourcePlan(comment=f"hold at {current}")

@@ -98,14 +98,16 @@ class CheckpointEngine:
         self._awaiting_persist = -1
         self._master_client = master_client
         self.latest_saved_step = -1
-        # Async staging exploits jax.Array immutability: "snapshotting" the
-        # state is just holding references (training's next step builds NEW
-        # arrays), so device->host + shm copy can run in a background
-        # thread and the training pause collapses to reference capture.
-        # torch engines cannot do this — in-place optimizer updates force
-        # them to finish the copy before step N+1 (the reference blocks for
-        # the whole shm stage, flash_checkpoint.md). Costs one extra
-        # generation of params/opt-state kept alive until staging ends.
+        # Async staging: the device->host snapshot happens synchronously
+        # (donation-safe — the trainer's jitted step donates state buffers
+        # via donate_argnums, which invalidates the source arrays the
+        # moment the next step runs, so holding references is NOT enough),
+        # but the transfers for all leaves are issued together via
+        # copy_to_host_async so they overlap, and the expensive part — the
+        # host->shm memcpy — runs in a background thread. torch engines
+        # must block for the whole shm stage (in-place optimizer updates;
+        # the reference blocks ~0.5 s here, flash_checkpoint.md); we block
+        # only for the d2h transfer.
         if async_staging is None:
             async_staging = (
                 os.environ.get("DLROVER_TPU_ASYNC_STAGING", "0") == "1"
@@ -178,6 +180,14 @@ class CheckpointEngine:
         import jax
 
         flat, treedef_bytes = flatten_state_lazy(state)
+        # Issue every device->host transfer before consuming any, so the
+        # copies overlap on the transfer engine instead of serializing.
+        for _, leaf in flat:
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:
+                    pass
         named_leaves: List[Tuple[str, np.ndarray]] = []
         shard_info: Dict[str, Tuple[Tuple[int, ...], Tuple]] = {}
         for path, leaf in flat:
@@ -227,12 +237,30 @@ class CheckpointEngine:
     def _start_async_stage(
         self, t0: float, step: int, state: Any, persist: bool
     ) -> float:
-        self.wait_staging()
+        # Degrade, don't crash training: a failure of the PREVIOUS cycle's
+        # staging (incl. its shm-lock timeout) means that step was lost —
+        # log it and carry on with this one. The unbounded join means the
+        # previous thread is always finished here, so the shm is free.
+        try:
+            self.wait_staging()
+        except Exception as e:
+            logger.warning(
+                "previous background staging failed (%s); continuing", e
+            )
         self._staging_error = None
+        # Donation-safe snapshot: d2h transfers happen HERE, synchronously,
+        # before the caller's next (buffer-donating) train step can run.
+        # Only host memory is touched after this point.
+        try:
+            snapshot = self._gather_local_shards(state)
+        except Exception as e:
+            logger.warning("device->host snapshot of step %s failed: %s",
+                           step, e)
+            return time.time() - t0
         pause = time.time() - t0
         self._staging_thread = threading.Thread(
             target=self._stage_in_background,
-            args=(step, state, persist, pause),
+            args=(step, snapshot, persist, pause),
             name="ckpt-staging",
             daemon=True,
         )
@@ -256,10 +284,11 @@ class CheckpointEngine:
             raise err
 
     def _stage_in_background(
-        self, step: int, state: Any, persist: bool, pause: float
+        self, step: int, snapshot, persist: bool, pause: float
     ):
         try:
-            self._stage_sync(step, state)
+            self._wait_pending_persist()
+            self._write_shm(step, snapshot)
             if persist:
                 self._queue_persist(step)
             self._report_save(step, pause)
@@ -275,10 +304,13 @@ class CheckpointEngine:
                 pass
 
     def _stage_sync(self, step: int, state: Any):
+        self._wait_pending_persist()
+        self._write_shm(step, self._gather_local_shards(state))
+
+    def _write_shm(self, step: int, snapshot):
         import jax
 
-        self._wait_pending_persist()
-        named_leaves, shard_info, treedef_bytes = self._gather_local_shards(state)
+        named_leaves, shard_info, treedef_bytes = snapshot
         lock = self._lock()
         if lock is not None and not lock.acquire(timeout=120):
             raise TimeoutError(

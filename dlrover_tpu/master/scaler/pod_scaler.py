@@ -24,7 +24,7 @@ from dlrover_tpu.common.node import Node
 from dlrover_tpu.master.resource.plan import ScalePlan
 from dlrover_tpu.master.scaler.base import Scaler
 from dlrover_tpu.scheduler.job import JobArgs
-from dlrover_tpu.scheduler.k8s_client import K8sClient
+from dlrover_tpu.scheduler.k8s_client import K8sApiError, K8sClient
 
 #: labels stamped on every pod we create; the watcher selects on these
 LABEL_JOB_KEY = "elastic.dlrover-tpu.org/job-name"
@@ -106,7 +106,25 @@ class PodScaler(Scaler):
         for i, node in enumerate(pending):
             try:
                 self._create_pod(node)
-            except Exception:
+            except Exception as e:
+                if (
+                    isinstance(e, K8sApiError)
+                    and 400 <= e.status < 500
+                    and e.status not in (409, 429)
+                ):
+                    # permanently rejected spec (e.g. 422 validation):
+                    # requeueing would hot-loop forever and the job would
+                    # never surface the failure — report and drop this node
+                    logger.error(
+                        "create pod for %s-%s permanently rejected (%s %s); "
+                        "not retrying",
+                        node.type,
+                        node.id,
+                        e.status,
+                        e.reason,
+                    )
+                    self._report_create_failure(node, e)
+                    continue
                 logger.exception(
                     "create pod for %s-%s failed; requeueing %s nodes",
                     node.type,
@@ -118,6 +136,30 @@ class PodScaler(Scaler):
                 for retry in pending[i:]:
                     self._create_queue.put(retry)
                 break
+
+    def _report_create_failure(self, node: Node, err: Exception):
+        try:
+            self._client.create_event({
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {
+                    "name": f"{self.pod_name(node)}-createrejected-{int(time.time())}",
+                    "namespace": self._client.namespace,
+                },
+                "involvedObject": {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "name": self.pod_name(node),
+                    "namespace": self._client.namespace,
+                },
+                "reason": "CreateRejected",
+                "message": str(err)[:1024],
+                "type": "Warning",
+                "source": {"component": "dlrover-tpu-master"},
+                "count": 1,
+            })
+        except Exception:
+            logger.debug("could not emit k8s event for create failure")
 
     def pod_name(self, node: Node) -> str:
         return f"{self._job_name}-{node.type}-{node.id}"

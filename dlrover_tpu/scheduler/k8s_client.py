@@ -61,8 +61,17 @@ class ApiServerTransport:
             self._ctx = ssl.create_default_context(cafile=ca_file)
         else:  # out-of-cluster dev setups
             self._ctx = ssl.create_default_context()
-            self._ctx.check_hostname = False
-            self._ctx.verify_mode = ssl.CERT_NONE
+            # Never silently disable verification: the bearer token rides
+            # this connection. Unverified TLS is an explicit opt-in.
+            if os.getenv("DLROVER_TPU_K8S_INSECURE_TLS", "") == "1":
+                logger.warning(
+                    "TLS certificate verification DISABLED for %s "
+                    "(DLROVER_TPU_K8S_INSECURE_TLS=1) — cluster credentials "
+                    "are exposed to MITM; dev use only",
+                    self.base_url,
+                )
+                self._ctx.check_hostname = False
+                self._ctx.verify_mode = ssl.CERT_NONE
 
     def request(
         self,

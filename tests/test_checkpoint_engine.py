@@ -104,6 +104,31 @@ def test_async_staging_snapshots_are_immutable(job_env):
     engine.close()
 
 
+def test_async_staging_survives_donated_buffers(job_env):
+    """The trainer's jitted step donates the state buffers
+    (donate_argnums) — which deletes the saved arrays as soon as the next
+    step runs. The engine must have finished its device->host snapshot
+    before save_to_memory returns, so the checkpoint is unaffected."""
+    job, ckpt_dir = job_env
+    mesh = _mesh((8,), ("dp",))
+    state = _make_state(mesh)
+    step_fn = jax.jit(
+        lambda s: {k: v + 1 for k, v in s.items()}, donate_argnums=(0,)
+    )
+    engine = CheckpointEngine(ckpt_dir, async_staging=True)
+    engine.save_to_memory(0, state)
+    engine.wait_staging()
+    expect_w = np.asarray(state["w"]).copy()
+    engine.save_to_memory(1, state)
+    state = step_fn(state)  # donation invalidates the staged arrays
+    jax.block_until_ready(state)
+    engine.wait_staging()  # must not raise "Array has been deleted"
+    step, restored = engine.load(target=state)
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]), expect_w)
+    engine.close()
+
+
 def test_storage_save_without_agent_is_synchronous(job_env):
     job, ckpt_dir = job_env
     mesh = _mesh((8,), ("dp",))
