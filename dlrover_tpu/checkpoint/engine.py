@@ -180,16 +180,11 @@ class CheckpointEngine:
         import jax
 
         flat, treedef_bytes = flatten_state_lazy(state)
-        # Issue every device->host transfer before consuming any, so the
-        # copies overlap on the transfer engine instead of serializing.
-        for _, leaf in flat:
-            if isinstance(leaf, jax.Array):
-                try:
-                    leaf.copy_to_host_async()
-                except Exception:
-                    pass
-        named_leaves: List[Tuple[str, np.ndarray]] = []
-        shard_info: Dict[str, Tuple[Tuple[int, ...], Tuple]] = {}
+        # Pass 1: select each leaf's unique addressable shards (replicated
+        # duplicates are skipped, never transferred) and issue all their
+        # device->host transfers together, so the copies overlap on the
+        # transfer engine instead of serializing behind np.asarray.
+        plan: List[Tuple[str, Any, Tuple[int, ...], Tuple, Tuple[int, ...]]] = []
         for path, leaf in flat:
             if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
                 seen = set()
@@ -199,20 +194,29 @@ class CheckpointEngine:
                     if ranges in seen:
                         continue
                     seen.add(ranges)
-                    name = f"{path}#s{k}"
+                    try:
+                        shard.data.copy_to_host_async()
+                    except Exception:
+                        pass
                     extent = tuple(e - s for s, e in ranges)
-                    arr = np.asarray(shard.data).reshape(extent)
-                    named_leaves.append((name, arr))
-                    shard_info[name] = (tuple(leaf.shape), ranges)
+                    plan.append(
+                        (f"{path}#s{k}", shard.data, extent, ranges,
+                         tuple(leaf.shape))
+                    )
                     k += 1
             else:
                 arr = np.asarray(leaf)
-                name = f"{path}#s0"
-                named_leaves.append((name, arr))
-                shard_info[name] = (
-                    tuple(arr.shape),
-                    tuple((0, d) for d in arr.shape),
+                plan.append(
+                    (f"{path}#s0", arr, tuple(arr.shape),
+                     tuple((0, d) for d in arr.shape), tuple(arr.shape))
                 )
+        # Pass 2: consume (np.asarray reuses the host literal the async
+        # copy produced, so this is a wait + memcpy, not a transfer).
+        named_leaves: List[Tuple[str, np.ndarray]] = []
+        shard_info: Dict[str, Tuple[Tuple[int, ...], Tuple]] = {}
+        for name, data, extent, ranges, gshape in plan:
+            named_leaves.append((name, np.asarray(data).reshape(extent)))
+            shard_info[name] = (gshape, ranges)
         return named_leaves, shard_info, treedef_bytes
 
     def save_to_memory(self, step: int, state: Any) -> float:
@@ -256,6 +260,10 @@ class CheckpointEngine:
         except Exception as e:
             logger.warning("device->host snapshot of step %s failed: %s",
                            step, e)
+            # surface on the next wait_staging/load/close — a silently
+            # dead snapshot path would let a job train for hours while
+            # believing it is checkpointing
+            self._staging_error = e
             return time.time() - t0
         pause = time.time() - t0
         self._staging_thread = threading.Thread(

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.ops import (
     apply_rope,
+    embed_lookup,
     flash_attention,
     mha_reference,
     ring_attention,
@@ -252,13 +253,7 @@ def forward(
     b, s = tokens.shape
     if mesh is not None:
         validate_for_mesh(cfg, mesh, seq_len=s)
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    if mesh is not None:
-        from jax.sharding import NamedSharding
-
-        x = lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(BATCH_AXES, SP, None))
-        )
+    x = embed_lookup(params["embed"], tokens, mesh, cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
 

@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.models import llama
-from dlrover_tpu.ops import apply_rope, rms_norm, rope_frequencies
+from dlrover_tpu.ops import apply_rope, embed_lookup, rms_norm, rope_frequencies
 from dlrover_tpu.parallel.mesh import BATCH_AXES, EP, FSDP, SP, TP
 
 Params = Dict[str, Any]
@@ -281,11 +281,7 @@ def forward(
     b, s = tokens.shape
     if mesh is not None:
         validate_for_mesh(cfg, mesh, seq_len=s)
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    if mesh is not None:
-        x = lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(BATCH_AXES, SP, None))
-        )
+    x = embed_lookup(params["embed"], tokens, mesh, cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
 

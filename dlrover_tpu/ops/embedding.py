@@ -1,0 +1,52 @@
+"""Embedding lookup that partitions cleanly under SPMD.
+
+A plain ``embed[tokens]`` gather over a tp-sharded vocab axis forces
+XLA's SPMD partitioner into "involuntary full rematerialization": it
+all-gathers the table, gathers, replicates the result, then re-partitions
+to the activation sharding — the worst possible data movement for the
+hottest lookup in the model.
+
+The TPU-idiomatic form is a one-hot contraction: ``one_hot(tokens) @
+embed``. A matmul with the vocab axis as the contraction dim partitions
+like every other matmul (partial products + psum over tp), rides the MXU,
+and its transpose (the embedding gradient) becomes a matmul too instead
+of a scatter-add. XLA fuses the iota/compare one-hot generation into the
+matmul operand read, so the (b, s, vocab) operand is never materialized
+in HBM.
+
+Green-field relative to the reference (it owns no model code,
+SURVEY.md §2.8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.parallel.mesh import BATCH_AXES, SP, TP
+
+
+def embed_lookup(
+    embed: jnp.ndarray,   # (vocab, dim), typically P(TP, FSDP)
+    tokens: jnp.ndarray,  # (b, s) int32
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.bfloat16,
+) -> jnp.ndarray:
+    """Token embedding lookup → (b, s, dim) activations sharded
+    P(batch, sp, None). Uses the one-hot matmul form under a mesh; a
+    plain gather otherwise (single-device: gather is cheaper)."""
+    table = embed.astype(dtype)
+    if mesh is None:
+        return table[tokens]
+    one_hot = jax.nn.one_hot(tokens, embed.shape[0], dtype=dtype)
+    one_hot = lax.with_sharding_constraint(
+        one_hot, NamedSharding(mesh, P(BATCH_AXES, SP, TP))
+    )
+    x = jnp.einsum("bsv,vd->bsd", one_hot, table)
+    return lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(BATCH_AXES, SP, None))
+    )

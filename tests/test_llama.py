@@ -73,6 +73,22 @@ def test_sharded_loss_matches_single_device(params, toks, mc, ring):
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
+def test_sharded_grad_compiles_without_involuntary_remat(params, toks, capfd):
+    """The embedding gather over a tp-sharded vocab used to trigger XLA
+    SPMD 'involuntary full rematerialization' (all-gather + replicate) in
+    the backward; the one-hot matmul form must compile clean on the
+    sp/tp mesh (the flagship dryrun layout)."""
+    mesh = build_mesh(MeshConfig(dp=1, fsdp=2, sp=2, tp=2))
+    cfg = llama.LlamaConfig.tiny(attn_impl="ring")
+    sharded = jax.device_put(params, named_shardings(mesh, llama.param_specs(cfg)))
+    grads = jax.jit(
+        jax.grad(lambda p, t: llama.loss_fn(p, t, cfg, mesh))
+    )(sharded, toks)
+    jax.block_until_ready(grads)
+    captured = capfd.readouterr()
+    assert "Involuntary full rematerialization" not in captured.err
+
+
 def test_trainer_converges_and_global_batch_fixed(params, toks):
     mc = MeshConfig(dp=2, fsdp=2, sp=1, tp=2)
     mesh = build_mesh(mc)
