@@ -1,15 +1,24 @@
-"""Attention: jnp reference + Pallas TPU flash-attention forward.
+"""Attention: jnp reference + Pallas TPU flash-attention forward AND backward.
 
 Layout convention everywhere: ``(batch, seq, n_heads, head_dim)``; GQA via
 ``n_kv_heads <= n_heads`` (kv head ``h // group`` serves query head ``h``
-— resolved in the kernel's BlockSpec index_map, never materialized).
+— resolved in the kernels' BlockSpec index_maps, never materialized).
 
-`flash_attention` is a `jax.custom_vjp`: the forward pass runs a Pallas
-online-softmax kernel on TPU (O(seq) memory, MXU-tiled 128-blocks, never
-materializing the s×s matrix); the backward recomputes attention with the
-jnp reference under XLA — flash-backward is a later-round kernel. On
-non-TPU backends the forward falls back to the reference, so the same model
-code runs in CPU tests.
+`flash_attention_with_lse` is a `jax.custom_vjp` returning ``(out, lse)``
+where ``lse`` is the per-row logsumexp of the attention logits:
+
+- **forward**: Pallas online-softmax kernel — O(seq) memory, MXU-tiled
+  blocks, the s×s matrix never exists.
+- **backward**: two Pallas kernels (dq, then dk/dv) that *recompute*
+  probabilities blockwise from (q, k, v, lse) — also O(seq) memory. The
+  ``lse`` output is differentiable: its cotangent folds into the standard
+  flash-backward ``delta`` term (``ds = p * (dp - delta + g_lse)``), which
+  is what lets ring attention merge per-chunk results by logsumexp and
+  still get exact gradients through the merge.
+
+On non-TPU backends both directions fall back to the jnp reference, so the
+same model code runs in CPU tests; ``interpret=True`` runs the Pallas
+kernels in interpreter mode for numerics tests without a TPU.
 
 The reference framework has no attention op at all (it launches
 Megatron/DeepSpeed which own the math, SURVEY.md §2.8) — this is part of
@@ -36,17 +45,18 @@ except Exception:  # pragma: no cover
 _NEG_INF = -1e30
 
 
-def mha_reference(
+def mha_reference_with_lse(
     q: jnp.ndarray,  # (b, sq, h, d)
     k: jnp.ndarray,  # (b, sk, hkv, d)
     v: jnp.ndarray,  # (b, sk, hkv, d)
     causal: bool = True,
     q_offset=0,
     k_offset=0,
-) -> jnp.ndarray:
-    """Stable-softmax attention in float32, GQA-aware. ``q_offset`` /
-    ``k_offset`` are *global* positions of element 0 — this is what lets
-    ring-attention chunks mask causally against each other."""
+):
+    """Stable-softmax attention in float32, GQA-aware; returns
+    ``(out (b,sq,h,d), lse (b,h,sq))``. ``q_offset`` / ``k_offset`` are
+    *global* positions of element 0 — this is what lets ring-attention
+    chunks mask causally against each other."""
     b, sq, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
@@ -61,9 +71,16 @@ def mha_reference(
         kpos = k_offset + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
+    lse = jax.nn.logsumexp(logits, axis=-1)  # (b, h, sq)
+    probs = jnp.exp(logits - lse[..., None])
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    return out.astype(q.dtype), lse
+
+
+def mha_reference(q, k, v, causal: bool = True, q_offset=0, k_offset=0):
+    return mha_reference_with_lse(
+        q, k, v, causal=causal, q_offset=q_offset, k_offset=k_offset
+    )[0]
 
 
 # ---------------------------------------------------------------------------
@@ -71,7 +88,7 @@ def mha_reference(
 # ---------------------------------------------------------------------------
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, block_q: int, block_k: int, n_kblocks: int, causal: bool, scale: float
 ):
     qi = pl.program_id(2)
@@ -120,8 +137,9 @@ def _flash_fwd_kernel(
     @pl.when(ki == n_kblocks - 1)
     def _finalize():
         l = l_ref[:, 0]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        lsafe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / lsafe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(lsafe)
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -146,7 +164,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
         block_q=block_q, block_k=block_k, n_kblocks=n_k,
         causal=causal, scale=scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -160,10 +178,18 @@ def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
                 lambda bi, hi, qi, ki, _g=group: (bi, hi // _g, ki, 0),
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -171,7 +197,231 @@ def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    return out.transpose(0, 2, 1, 3), lse
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU backward kernels
+# ---------------------------------------------------------------------------
+#
+# Standard flash backward, blockwise recompute from (q, k, v, lse):
+#   p  = exp(s - lse)            s = scale * q @ k^T  (+ causal mask)
+#   dp = do @ v^T
+#   ds = p * (dp - delta) * scale     delta = rowsum(do * o) - g_lse
+#   dq = ds @ k ; dk = ds^T @ q ; dv = p^T @ do
+# dq iterates k blocks per q block; dk/dv iterates q blocks per k block
+# (per *query* head — the group sum down to kv heads happens outside,
+# keeping the kernels free of cross-block output contention).
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, block_q: int, block_k: int, n_kblocks: int, causal: bool, scale: float
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    if causal:
+        block_needed = ki * block_k <= qi * block_q + block_q - 1
+    else:
+        block_needed = qi >= 0
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                                   # (bq,)
+        delta = delta_ref[0, 0]                               # (bq,)
+        s = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                         # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_kblocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q: int, block_k: int, n_qblocks: int, causal: bool, scale: float
+):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    if causal:
+        block_needed = qi * block_q + block_q - 1 >= ki * block_k
+    else:
+        block_needed = ki >= 0
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                     # (bq, bk)
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        # dv += p^T @ do
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        # dk += ds^T @ q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_qblocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, g_lse, causal,
+                      block_q, block_k, interpret=False):
+    b, sq, h, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    n_q, n_k = sq // block_q, sk // block_k
+    scale = 1.0 / math.sqrt(d)
+
+    # delta rows; the lse cotangent folds in here (see module docstring)
+    delta = jnp.einsum(
+        "bshd,bshd->bhs", do.astype(jnp.float32), o.astype(jnp.float32)
+    )
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+
+    # -- dq: grid (b, h, n_q, n_k), q block fixed per-(i), k rotates (j) --
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+            n_kblocks=n_k, causal=causal, scale=scale,
+        ),
+        grid=(b, h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, i, j, _g=group: (bi, hi // _g, j, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, i, j, _g=group: (bi, hi // _g, j, 0),
+            ),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    # -- dk/dv: grid (b, h, n_k, n_q) per *query* head; group-sum after --
+    dkh, dvh = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+            n_qblocks=n_q, causal=causal, scale=scale,
+        ),
+        grid=(b, h, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, j, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, i, j, _g=group: (bi, hi // _g, i, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, d),
+                lambda bi, hi, i, j, _g=group: (bi, hi // _g, i, 0),
+            ),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, j)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dq = dq.transpose(0, 2, 1, 3)
+    if group > 1:
+        dkh = dkh.reshape(b, hkv, group, sk, d).sum(axis=2)
+        dvh = dvh.reshape(b, hkv, group, sk, d).sum(axis=2)
+    dk = dkh.transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dvh.transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
 
 
 def _on_tpu() -> bool:
@@ -181,25 +431,48 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = 128, block_k: int = 128):
-    return _flash_attention_fwd(q, k, v, causal, block_q, block_k)[0]
+# ---------------------------------------------------------------------------
+# custom_vjp surfaces
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: bool = False):
+    """(out (b,s,h,d), lse (b,h,s)) — both differentiable."""
+    return _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
-def _flash_attention_fwd(q, k, v, causal, block_q, block_k):
-    if _HAS_PALLAS and _on_tpu():
-        out = _flash_fwd_pallas(q, k, v, causal, block_q, block_k)
+def _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    if _HAS_PALLAS and (interpret or _on_tpu()):
+        out, lse = _flash_fwd_pallas(q, k, v, causal, block_q, block_k,
+                                     interpret=interpret)
     else:
-        out = mha_reference(q, k, v, causal=causal)
-    return out, (q, k, v)
+        out, lse = mha_reference_with_lse(q, k, v, causal=causal)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal=causal),
-                     q, k, v)
-    return vjp(g)
+def _flash_with_lse_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    g_out, g_lse = g
+    if _HAS_PALLAS and (interpret or _on_tpu()):
+        return _flash_bwd_pallas(
+            q, k, v, o, lse, g_out, g_lse, causal, block_q, block_k,
+            interpret=interpret,
+        )
+    _, vjp = jax.vjp(
+        lambda q, k, v: mha_reference_with_lse(q, k, v, causal=causal),
+        q, k, v,
+    )
+    return vjp((g_out, g_lse))
 
 
-flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+flash_attention_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    return flash_attention_with_lse(
+        q, k, v, causal, block_q, block_k, interpret
+    )[0]

@@ -6,26 +6,34 @@ delegates context parallelism to Megatron/DeepSpeed). TPU-native design:
 - the sequence dim is sharded over the mesh ``sp`` axis;
 - each device holds one q/k/v chunk; kv chunks rotate around the ring with
   `lax.ppermute` (single-hop ICI neighbor exchange — the torus makes this
-  free-ish) while every device accumulates online-softmax partials;
-- compute and the next kv transfer overlap naturally: XLA schedules the
-  ppermute DMA concurrently with the chunk matmuls.
+  free-ish);
+- every ring step runs the **Pallas flash kernel on the local chunk pair**
+  (`flash_attention_with_lse`) — O(chunk) memory, GQA resolved in the
+  kernel's index_map (never materialized), the chunk×chunk logit matrix
+  never exists;
+- per-chunk results merge by the standard logsumexp combine
+  ``out = Σ_i exp(lse_i - lse_total) · out_i`` — exact, and exactly
+  differentiable because the kernel's ``lse`` output is differentiable
+  (its cotangent folds into the flash backward's delta term).
+
+Chunk-level causality: a kv chunk strictly *after* the query chunk
+contributes nothing (skipped via a zero merge-weight); the *diagonal*
+chunk uses the causal kernel; chunks strictly before use the full
+(non-causal) kernel — `lax.cond` picks the branch per device at runtime.
 
 Must be called inside `shard_map` with ``axis_name`` bound (see
 `models/llama.py` for the wiring). Differentiable through `lax.scan` +
-`ppermute`; the per-step chunk attention is rematerialized under
-`jax.checkpoint` so the backward does not keep every rotated kv copy.
+`ppermute`; each step is rematerialized under `jax.checkpoint` so the
+backward does not keep every rotated kv copy.
 """
 
 from __future__ import annotations
-
-import functools
-import math
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dlrover_tpu.ops.attention import _NEG_INF
+from dlrover_tpu.ops.attention import _NEG_INF, flash_attention_with_lse
 
 
 def ring_attention(
@@ -34,54 +42,68 @@ def ring_attention(
     v: jnp.ndarray,  # (b, s_local, hkv, d)
     axis_name: str,
     causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
 ) -> jnp.ndarray:
     b, s_local, h, d = q.shape
-    hkv = k.shape[2]
-    group = h // hkv
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
-    scale = 1.0 / math.sqrt(d)
 
-    qf = q.astype(jnp.float32) * scale
-    # einsum layout: (b, h, sq, sk) blocks
-    qb = qf.transpose(0, 2, 1, 3)  # (b, h, s, d)
+    def chunk_attn(kc, vc, src):
+        """(out (b,s,h,d) f32, lse (b,h,s) f32) for this kv chunk."""
+        def diag(_):
+            o, lse = flash_attention_with_lse(
+                q, kc, vc, True, block_q, block_k
+            )
+            return o.astype(jnp.float32), lse
 
-    def chunk_scores(kc):  # kc: (b, s, hkv, d) → (b, h, sq, sk) f32
-        kb = kc.astype(jnp.float32).transpose(0, 2, 1, 3)
-        if group > 1:
-            kb = jnp.repeat(kb, group, axis=1)
-        return jnp.einsum("bhqd,bhkd->bhqk", qb, kb)
+        def full(_):
+            o, lse = flash_attention_with_lse(
+                q, kc, vc, False, block_q, block_k
+            )
+            return o.astype(jnp.float32), lse
+
+        def skip(_):
+            return (
+                jnp.zeros((b, s_local, h, d), jnp.float32),
+                jnp.full((b, h, s_local), _NEG_INF, jnp.float32),
+            )
+
+        if not causal:
+            return full(None)
+        # src > my: every key is in the future of every query → skip
+        return lax.cond(
+            src > my_idx,
+            skip,
+            lambda _: lax.cond(src == my_idx, diag, full, None),
+            None,
+        )
 
     def step_fn(carry, _):
-        m, l, acc, kc, vc, src = carry
-        s = chunk_scores(kc)
-        if causal:
-            qpos = my_idx * s_local + jnp.arange(s_local)
-            kpos = src * s_local + jnp.arange(s_local)
-            mask = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        m_cur = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_cur[..., None])
-        corr = jnp.exp(m - m_cur)
-        l = l * corr + jnp.sum(p, axis=-1)
-        vb = vc.astype(jnp.float32).transpose(0, 2, 1, 3)
-        if group > 1:
-            vb = jnp.repeat(vb, group, axis=1)
-        acc = acc * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        o_acc, lse_acc, kc, vc, src = carry
+        o_i, lse_i = chunk_attn(kc, vc, src)
+        # logsumexp merge of two normalized partial softmaxes
+        lse_new = jnp.logaddexp(lse_acc, lse_i)              # (b, h, s)
+        w_acc = jnp.exp(lse_acc - lse_new)
+        w_i = jnp.exp(lse_i - lse_new)
+        # (b,h,s) weights → (b,s,h,1) to scale (b,s,h,d) outputs
+        o_acc = (
+            o_acc * w_acc.transpose(0, 2, 1)[..., None]
+            + o_i * w_i.transpose(0, 2, 1)[..., None]
+        )
         # rotate kv to the next ring position (device i → i+1)
         perm = [(i, (i + 1) % n) for i in range(n)]
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         src = (src - 1) % n
-        return (m_cur, l, acc, kc, vc, src), None
+        return (o_acc, lse_new, kc, vc, src), None
 
-    m0 = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s_local), jnp.float32)
-    acc0 = jnp.zeros((b, h, s_local, d), jnp.float32)
-    carry0 = (m0, l0, acc0, k, v, my_idx)
-    (m, l, acc, *_), _ = lax.scan(
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    # finite "minus infinity": logaddexp(-1e30, x) == x for any real lse,
+    # and the first merge weight exp(-1e30 - lse_new) underflows to 0
+    lse0 = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    carry0 = (o0, lse0, k, v, my_idx)
+    (o, lse, *_), _ = lax.scan(
         jax.checkpoint(step_fn), carry0, None, length=n
     )
-    l = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / l[..., None]).transpose(0, 2, 1, 3)  # (b, s, h, d)
-    return out.astype(q.dtype)
+    return o.astype(q.dtype)
