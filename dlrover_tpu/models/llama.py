@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.ops import (
@@ -60,7 +61,19 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16          # activation/compute dtype
     param_dtype: Any = jnp.float32     # master params
     remat: bool = True
+    # "all": recompute the whole layer in bwd (min memory);
+    # "mlp": save the ffn gate/up activations — ~75% of a layer's
+    # recompute FLOPs are the two d×ffn matmuls, so saving their outputs
+    # (2*b*s*ffn elements/layer) buys most of no-remat's speed at a
+    # fraction of its memory
+    remat_policy: str = "all"
     attn_impl: str = "auto"            # auto | flash | reference | ring
+
+    def __post_init__(self):
+        if self.remat_policy not in ("all", "mlp"):
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r}: expected 'all' or 'mlp'"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -210,8 +223,8 @@ def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x):
     x = x + attn @ lp["wo"].astype(dt)
 
     y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(y @ lp["w_gate"].astype(dt))
-    up = y @ lp["w_up"].astype(dt)
+    gate = checkpoint_name(jax.nn.silu(y @ lp["w_gate"].astype(dt)), "ffn_gate")
+    up = checkpoint_name(y @ lp["w_up"].astype(dt), "ffn_up")
     x = x + (gate * up) @ lp["w_down"].astype(dt)
 
     if mesh is not None:
@@ -259,16 +272,26 @@ def forward(
 
     layer_fn = functools.partial(_decoder_layer, cfg, mesh, inv_freq, positions)
     if cfg.remat:
-        layer_fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
-        )
+        if cfg.remat_policy == "mlp":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "ffn_gate", "ffn_up"
+            )
+        else:
+            policy = jax.checkpoint_policies.nothing_saveable
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     def scan_body(x, lp):
         return layer_fn(lp, x), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    # bf16 operands + f32 MXU accumulation: f32 logits for the loss at bf16
+    # matmul throughput (a pure-f32 matmul runs off the MXU fast path)
+    logits = lax.dot_general(
+        x, params["lm_head"].astype(x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     return logits
 
 

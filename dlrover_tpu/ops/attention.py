@@ -44,6 +44,12 @@ except Exception:  # pragma: no cover
 
 _NEG_INF = -1e30
 
+# lse/delta carry a broadcast minor lane dim so TPU block shapes tile
+# ((second-to-last, last) must be (divisible by 8, divisible by 128) or
+# equal to the array dims — 8 lanes satisfies "equal", at 1/16th the HBM
+# of upstream flash-attention's 128-lane convention)
+_LSE_LANES = 8
+
 
 def mha_reference_with_lse(
     q: jnp.ndarray,  # (b, sq, h, d)
@@ -139,7 +145,10 @@ def _flash_fwd_kernel(
         l = l_ref[:, 0]
         lsafe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / lsafe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(lsafe)
+        # lse carries a broadcast minor lane dim for TPU block tiling
+        # (see _LSE_LANES)
+        lse = m_ref[:, 0] + jnp.log(lsafe)
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, None], lse_ref[0, 0].shape)
 
 
 def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -183,12 +192,13 @@ def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
                 (1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
             ),
             pl.BlockSpec(
-                (1, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, qi)
+                (1, 1, block_q, _LSE_LANES),
+                lambda bi, hi, qi, ki: (bi, hi, qi, 0),
             ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, _LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -197,7 +207,7 @@ def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3), lse
+    return out.transpose(0, 2, 1, 3), lse[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -236,8 +246,8 @@ def _flash_bwd_dq_kernel(
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]                                   # (bq,)
-        delta = delta_ref[0, 0]                               # (bq,)
+        lse = lse_ref[0, 0, :, 0]                             # (bq,)
+        delta = delta_ref[0, 0, :, 0]                         # (bq,)
         s = jax.lax.dot_general(
             q * scale, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -290,8 +300,8 @@ def _flash_bwd_dkv_kernel(
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
             q * scale, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -349,6 +359,9 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, g_lse, causal,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     dot = do.transpose(0, 2, 1, 3)
+    # broadcast minor lane dim for TPU block tiling (see fwd kernel)
+    lse4 = jnp.broadcast_to(lse[..., None], (b, h, sq, _LSE_LANES))
+    delta4 = jnp.broadcast_to(delta[..., None], (b, h, sq, _LSE_LANES))
 
     # -- dq: grid (b, h, n_q, n_k), q block fixed per-(i), k rotates (j) --
     dq = pl.pallas_call(
@@ -368,8 +381,14 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, g_lse, causal,
                 lambda bi, hi, i, j, _g=group: (bi, hi // _g, j, 0),
             ),
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, i)),
+            pl.BlockSpec(
+                (1, 1, block_q, _LSE_LANES),
+                lambda bi, hi, i, j: (bi, hi, i, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, _LSE_LANES),
+                lambda bi, hi, i, j: (bi, hi, i, 0),
+            ),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, i, 0)
@@ -377,7 +396,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, g_lse, causal,
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse4, delta4)
 
     # -- dk/dv: grid (b, h, n_k, n_q) per *query* head; group-sum after --
     dkh, dvh = pl.pallas_call(
@@ -397,8 +416,14 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, g_lse, causal,
                 lambda bi, hi, i, j, _g=group: (bi, hi // _g, i, 0),
             ),
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i, j: (bi, hi, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, j)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, i, j: (bi, hi, j)),
+            pl.BlockSpec(
+                (1, 1, block_q, _LSE_LANES),
+                lambda bi, hi, i, j: (bi, hi, j, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_q, _LSE_LANES),
+                lambda bi, hi, i, j: (bi, hi, j, 0),
+            ),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, i, j: (bi, hi, i, 0)),
@@ -413,7 +438,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, g_lse, causal,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse4, delta4)
 
     dq = dq.transpose(0, 2, 1, 3)
     if group > 1:

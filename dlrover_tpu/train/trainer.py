@@ -123,20 +123,29 @@ class ElasticTrainer:
 
         def step(state, batch):
             # batch: (accum, micro*dp, seq) int32
-            def micro_grads(carry, micro):
-                loss_sum, grads = carry
-                loss, g = jax.value_and_grad(self.loss_fn)(
-                    state["params"], micro
+            if accum == 1:
+                # single microbatch: no accumulator scan — grads stay in
+                # param dtype and the f32 accumulation buffer (a full extra
+                # param-sized pytree) is never allocated
+                loss_sum, grads = jax.value_and_grad(self.loss_fn)(
+                    state["params"], batch[0]
                 )
-                grads = jax.tree.map(jnp.add, grads, g)
-                return (loss_sum + loss, grads), None
+            else:
+                def micro_grads(carry, micro):
+                    loss_sum, grads = carry
+                    loss, g = jax.value_and_grad(self.loss_fn)(
+                        state["params"], micro
+                    )
+                    grads = jax.tree.map(jnp.add, grads, g)
+                    return (loss_sum + loss, grads), None
 
-            zero = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
-            )
-            (loss_sum, grads), _ = jax.lax.scan(
-                micro_grads, (jnp.zeros((), jnp.float32), zero), batch
-            )
+                zero = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32),
+                    state["params"],
+                )
+                (loss_sum, grads), _ = jax.lax.scan(
+                    micro_grads, (jnp.zeros((), jnp.float32), zero), batch
+                )
             scale = 1.0 / accum
             grads = jax.tree.map(lambda g: g * scale, grads)
             updates, opt_state = self.optimizer.update(
