@@ -30,6 +30,12 @@ CREATE TABLE IF NOT EXISTS jobs (
     exit_reason TEXT
 );
 CREATE INDEX IF NOT EXISTS jobs_name ON jobs(name);
+CREATE TABLE IF NOT EXISTS master_config (
+    job_name TEXT,          -- '' = cluster-wide default
+    key TEXT,
+    value TEXT,
+    PRIMARY KEY (job_name, key)
+);
 CREATE TABLE IF NOT EXISTS runtime_metrics (
     job_uuid TEXT,
     ts REAL,
@@ -159,6 +165,31 @@ class BrainDataStore:
                 (job_name,),
             ).fetchone()
         return float(row[0] or 0.0)
+
+    # -- master config overrides (global_context seeding) ------------------
+
+    def set_master_config(self, key: str, value, job_name: str = ""):
+        """Admin-set tunable override; ``job_name=''`` is cluster-wide."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO master_config (job_name, key, value) "
+                "VALUES (?, ?, ?)",
+                (job_name, key, str(value)),
+            )
+            self._conn.commit()
+
+    def master_config(self, job_name: str = "") -> Dict[str, str]:
+        """Cluster-wide defaults overlaid by per-job overrides."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT job_name, key, value FROM master_config "
+                "WHERE job_name IN ('', ?) ORDER BY job_name",
+                (job_name,),
+            ).fetchall()
+        values: Dict[str, str] = {}
+        for _jn, key, value in rows:  # '' sorts first → job rows win
+            values[key] = value
+        return values
 
     def dump(self) -> str:  # debug aid
         with self._lock:

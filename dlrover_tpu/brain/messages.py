@@ -90,6 +90,21 @@ class BrainOptimizeResponse:
 
 
 @message
+class BrainConfigRequest:
+    """get: master tunable overrides for a job (consumed by
+    ``common/global_context.py``; the reference's
+    ``set_params_from_brain`` was a TODO — this is the real path)."""
+
+    job_name: str = ""
+
+
+@message
+class BrainConfigResponse:
+    success: bool = True
+    values: Dict = field(default_factory=dict)
+
+
+@message
 class BrainJobMetricsRequest:
     job_uuid: str = ""
     job_name: str = ""

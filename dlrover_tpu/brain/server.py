@@ -34,6 +34,10 @@ class BrainServicer:
             except Exception as e:
                 logger.exception("optimize failed")
                 return bmsg.BrainOptimizeResponse(success=False, reason=str(e))
+        if isinstance(request, bmsg.BrainConfigRequest):
+            return bmsg.BrainConfigResponse(
+                values=self.store.master_config(request.job_name)
+            )
         if isinstance(request, bmsg.BrainJobMetricsRequest):
             return bmsg.BrainJobMetricsResponse(
                 job_uuid=request.job_uuid,

@@ -128,6 +128,8 @@ class DefaultValues:
     SEC_AGENT_HEARTBEAT_INTERVAL = 15
     SEC_WORKER_MONITOR_INTERVAL = 3
     MAX_NODE_RESTARTS = 3
+    # OOM recovery fallback when a node had no configured memory
+    MB_DEFAULT_HOST_MEMORY = 8192
     # Data sharding
     TASK_TIMEOUT_SECS = 1800
     # Speed monitor

@@ -1,0 +1,128 @@
+"""Global runtime config context for the master.
+
+Parity: reference ``dlrover/python/common/global_context.py:62-194``
+(``Context(Singleton)`` — master tunables with a ``set_params_from_brain``
+hook the reference left as a TODO). Ours goes further: the brain service
+actually serves per-job config overrides (``BrainConfigRequest``), and every
+field is runtime-mutable with type coercion, so an admin or the brain can
+retune a live master (timeouts, autoscale cadence, hang strategy) without a
+restart. Consumers read attributes directly each time they need a value —
+no caching — which is what makes runtime mutation take effect.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from dlrover_tpu.common.constants import DefaultValues
+from dlrover_tpu.common.log import logger
+
+
+class HangStrategy:
+    LOG_ONLY = 0
+    NOTIFY = 1
+    FAULT_TOLERANCE = 2
+
+
+class MasterConfigContext:
+    """Thread-safe, runtime-mutable master tunables (process singleton)."""
+
+    _instance: Optional["MasterConfigContext"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # -- node lifecycle ------------------------------------------------
+        self.heartbeat_timeout = float(DefaultValues.SEC_HEARTBEAT_TIMEOUT)
+        self.pending_timeout = float(DefaultValues.SEC_NODE_START_TIMEOUT)
+        self.monitor_interval = float(DefaultValues.SEC_MONITOR_INTERVAL)
+        self.relaunch_always = False
+        # -- autoscaling ---------------------------------------------------
+        self.auto_worker_enabled = True
+        self.seconds_to_autoscale_worker = 90.0
+        self.seconds_interval_to_optimize = 300.0
+        self.sample_count_to_adjust_worker = 5
+        # -- hang detection ------------------------------------------------
+        self.hang_detection = HangStrategy.NOTIFY
+        self.seconds_hang_threshold = 1800.0
+        # -- rendezvous ----------------------------------------------------
+        self.rdzv_waiting_timeout = float(DefaultValues.SEC_RDZV_WAITING_TIMEOUT)
+        # -- checkpoint ----------------------------------------------------
+        self.ckpt_persist_max_lag = 2  # steps the disk writer may trail shm
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def singleton(cls) -> "MasterConfigContext":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset_singleton(cls):
+        with cls._instance_lock:
+            cls._instance = None
+
+    # ------------------------------------------------------------------
+    def update(self, values: Dict[str, Any]) -> Dict[str, Any]:
+        """Apply ``{field: value}`` with type coercion to each field's
+        current type; unknown keys are ignored with a warning. Returns the
+        applied subset."""
+        applied: Dict[str, Any] = {}
+        with self._lock:
+            for key, value in values.items():
+                if key.startswith("_") or not hasattr(self, key):
+                    logger.warning("unknown master config key %r ignored", key)
+                    continue
+                current = getattr(self, key)
+                try:
+                    if isinstance(current, bool):
+                        # bool("False") is True — parse strings explicitly
+                        coerced = _parse_bool(value)
+                    else:
+                        coerced = type(current)(value)
+                except (TypeError, ValueError):
+                    logger.warning(
+                        "master config %s=%r not coercible to %s; ignored",
+                        key, value, type(current).__name__,
+                    )
+                    continue
+                setattr(self, key, coerced)
+                applied[key] = coerced
+        if applied:
+            logger.info("master config updated: %s", applied)
+        return applied
+
+    def seed_from_brain(self, fetch: Callable[[], Dict[str, Any]]):
+        """Pull config overrides from the brain (or any provider) once;
+        failures are non-fatal — defaults stand (reference
+        ``set_params_from_brain``, global_context.py:110-169)."""
+        try:
+            values = fetch() or {}
+        except Exception as e:
+            logger.warning("brain config fetch failed (%s); using defaults", e)
+            return
+        self.update(values)
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")
+            }
+
+
+def _parse_bool(value: Any) -> bool:
+    if isinstance(value, str):
+        lowered = value.strip().lower()
+        if lowered in ("false", "0", "no", "off", ""):
+            return False
+        if lowered in ("true", "1", "yes", "on"):
+            return True
+        raise ValueError(f"not a boolean: {value!r}")
+    return bool(value)
+
+
+def get_master_config() -> MasterConfigContext:
+    return MasterConfigContext.singleton()

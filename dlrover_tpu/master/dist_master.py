@@ -91,6 +91,13 @@ class DistributedJobMaster:
                 node_unit=job_args.node_unit,
                 tpu_type=job_args.tpu_type,
             )
+            # brain-seeded runtime tunables (global_context.py:110-169 in
+            # the reference — a TODO there, a live path here)
+            from dlrover_tpu.common.global_context import get_master_config
+
+            get_master_config().seed_from_brain(
+                optimizer.fetch_master_config
+            )
         else:
             optimizer = LocalOptimizer(
                 min_workers=worker_spec.min_nodes or 1,
@@ -128,6 +135,7 @@ class DistributedJobMaster:
             rdzv_managers=self.rdzv_managers,
             job_auto_scaler=self.job_auto_scaler,
             error_monitor=self.error_monitor,
+            resource_optimizer=optimizer,
         )
         self.pod_watcher = PodWatcher(
             job_args.job_name, self._client, self.job_manager.handle_node_event

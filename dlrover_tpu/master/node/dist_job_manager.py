@@ -11,6 +11,7 @@ a dead node.
 
 from __future__ import annotations
 
+import copy
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -18,11 +19,13 @@ from typing import Dict, List, Optional, Tuple
 from dlrover_tpu.common.constants import (
     DefaultValues,
     JobExitReason,
+    JobStage,
     NodeEventType,
     NodeExitReason,
     NodeStatus,
     NodeType,
 )
+from dlrover_tpu.common.global_context import get_master_config
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node, NodeEvent
 from dlrover_tpu.master.node.job_manager import JobManager
@@ -40,21 +43,40 @@ class DistributedJobManager(JobManager):
         speed_monitor=None,
         rdzv_managers: Optional[Dict] = None,
         job_auto_scaler=None,
-        heartbeat_timeout: float = DefaultValues.SEC_HEARTBEAT_TIMEOUT,
-        pending_timeout: float = DefaultValues.SEC_NODE_START_TIMEOUT,
+        heartbeat_timeout: Optional[float] = None,
+        pending_timeout: Optional[float] = None,
         error_monitor=None,
+        resource_optimizer=None,
     ):
         super().__init__(job_args, speed_monitor, error_monitor)
         self._scaler = scaler
         self._watcher = watcher
         self._rdzv_managers = rdzv_managers or {}
         self._job_auto_scaler = job_auto_scaler
-        self._heartbeat_timeout = heartbeat_timeout
-        self._pending_timeout = pending_timeout
+        # None → read the runtime-mutable global context at use time, so a
+        # brain/admin update takes effect without restarting the master
+        self._heartbeat_timeout_override = heartbeat_timeout
+        self._pending_timeout_override = pending_timeout
+        #: feeds the OOM-split recovery path on OOMKilled relaunches
+        self._resource_optimizer = resource_optimizer
         self._stop_evt = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
         self._start_ts = 0.0
         self._lock = threading.RLock()
+        #: set when a node dies unrecoverably → drives early stop
+        self._unrecoverable: Tuple[str, str] = ("", "")
+
+    @property
+    def _heartbeat_timeout(self) -> float:
+        if self._heartbeat_timeout_override is not None:
+            return self._heartbeat_timeout_override
+        return get_master_config().heartbeat_timeout
+
+    @property
+    def _pending_timeout(self) -> float:
+        if self._pending_timeout_override is not None:
+            return self._pending_timeout_override
+        return get_master_config().pending_timeout
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -92,7 +114,9 @@ class DistributedJobManager(JobManager):
                 node = Node(
                     node_type=rtype,
                     node_id=node_id,
-                    config_resource=spec.group.node_resource,
+                    # own copy: per-node overrides (OOM bump) must not leak
+                    # into the job spec or sibling nodes
+                    config_resource=copy.copy(spec.group.node_resource),
                     max_relaunch_count=spec.restart_count,
                 )
                 self._job_context.update_node(node)
@@ -158,16 +182,29 @@ class DistributedJobManager(JobManager):
             return
         if self._should_relaunch(node):
             self._relaunch_node(node)
-        elif node.status == NodeStatus.FAILED and node.critical:
-            logger.error(
-                "critical node %s-%s failed unrecoverably", node.type, node.id
+        elif node.status == NodeStatus.FAILED:
+            # exit classified unrecoverable (fatal user error / budget
+            # exhausted): surface via should_early_stop instead of leaving
+            # the job to starve (reference dist_job_manager.py:849-910 +
+            # early-stop rules :252-360)
+            reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
+            msg = (
+                f"node {node.type}-{node.id} failed unrecoverably "
+                f"(reason={reason}, relaunch={node.relaunch_count}/"
+                f"{node.max_relaunch_count})"
             )
+            if node.critical:
+                # non-critical fatal failures attrite toward the
+                # insufficient-worker early stop instead
+                logger.error(msg)
+                self._unrecoverable = (JobExitReason.ERROR, msg)
 
     def _should_relaunch(self, node: Node) -> bool:
         """Reference ``_should_relaunch`` :849-910, condensed to the policy:
-        never for clean exits or fatal user errors; otherwise while relaunch
-        budget remains (preemption does not consume budget — the host did
-        nothing wrong)."""
+        never for clean exits or fatal user errors; preemption and hardware
+        faults always relaunch (the platform's fault, budget-free);
+        everything else (OOM, external kill, unknown) relaunches while
+        budget remains."""
         if node.status == NodeStatus.SUCCEEDED or node.is_released:
             return False
         if not node.relaunchable:
@@ -175,33 +212,67 @@ class DistributedJobManager(JobManager):
         reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
         if reason == NodeExitReason.FATAL_ERROR:
             return False
-        if reason == NodeExitReason.PREEMPTED:
+        if reason in (NodeExitReason.PREEMPTED, NodeExitReason.HARDWARE_ERROR):
             return True
         if reason in NodeExitReason.RELAUNCHABLE:
             return node.relaunch_count < node.max_relaunch_count
         return False
 
     def _relaunch_node(self, node: Node):
+        """Exit reason → differentiated relaunch plan:
+
+        - PREEMPTED / HARDWARE_ERROR: plain relaunch, budget untouched;
+        - OOM: relaunch with a memory bump from the resource optimizer's
+          OOM-split path (reference ``resource/job.py:313-395``
+          ``adjust_oom_resource``); consumes budget;
+        - anything else relaunchable: plain relaunch, consumes budget.
+        """
         with self._lock:
             new_id = self._job_context.next_node_id(node.type)
         new_node = node.get_relaunch_node_info(new_id)
-        if node.exit_reason == NodeExitReason.PREEMPTED:
-            # preemption is the platform's fault, not the host's
+        reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
+        if reason in (NodeExitReason.PREEMPTED, NodeExitReason.HARDWARE_ERROR):
+            # the platform's fault, not the host's
             new_node.relaunch_count = node.relaunch_count
+        elif reason == NodeExitReason.OOM:
+            self._bump_oom_memory(node, new_node)
         node.relaunchable = False
         node.is_released = True
         self._job_context.update_node(new_node)
         logger.info(
-            "relaunching %s-%s as %s-%s (relaunch=%s, reason=%s)",
+            "relaunching %s-%s as %s-%s (relaunch=%s, reason=%s, mem=%sMB)",
             node.type,
             node.id,
             new_node.type,
             new_node.id,
             new_node.relaunch_count,
-            node.exit_reason,
+            reason,
+            new_node.config_resource.memory_mb or "-",
         )
         plan = ScalePlan(launch_nodes=[new_node], remove_nodes=[node])
         self._scaler.scale(plan)
+
+    def _bump_oom_memory(self, node: Node, new_node: Node):
+        """Ask the optimizer (local heuristic or brain-backed) for an OOM
+        recovery resource; fall back to a 2x bump."""
+        name = node.name or f"{node.type}-{node.id}"
+        current = node.config_resource.memory_mb or 0.0
+        target = 0.0
+        if self._resource_optimizer is not None:
+            try:
+                plan = self._resource_optimizer.generate_oom_recovery_plan(
+                    [name], JobStage.RUNNING, host_oom=True
+                )
+                for res in plan.node_resources.values():
+                    target = max(target, res.memory_mb)
+            except Exception:
+                logger.exception("oom recovery plan failed; using 2x bump")
+        if target <= current:
+            target = (current or DefaultValues.MB_DEFAULT_HOST_MEMORY) * 2
+        # never mutate in place: config_resource may be shared with the
+        # job spec and sibling nodes (init passes the group resource)
+        new_node.config_resource = copy.copy(new_node.config_resource)
+        new_node.config_resource.memory_mb = target
 
     # -- manual scale plans -------------------------------------------------
 
@@ -231,7 +302,7 @@ class DistributedJobManager(JobManager):
                     node = Node(
                         node_type=NodeType.WORKER,
                         node_id=new_id,
-                        config_resource=spec.group.node_resource,
+                        config_resource=copy.copy(spec.group.node_resource),
                         max_relaunch_count=spec.restart_count,
                     )
                     self._job_context.update_node(node)
@@ -287,6 +358,8 @@ class DistributedJobManager(JobManager):
     def should_early_stop(self) -> Tuple[bool, str, str]:
         """(stop?, exit reason, message). Reference :252-360 rules: pending
         pods never scheduled, or too few workers alive to make progress."""
+        if self._unrecoverable[0]:
+            return True, self._unrecoverable[0], self._unrecoverable[1]
         now = time.time()
         workers = list(self._job_context.workers().values())
         if not workers:

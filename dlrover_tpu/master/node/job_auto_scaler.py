@@ -13,6 +13,7 @@ import threading
 from typing import List, Optional
 
 from dlrover_tpu.common.constants import NodeExitReason, NodeType
+from dlrover_tpu.common.global_context import get_master_config
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.node.job_context import get_job_context
 from dlrover_tpu.master.resource.optimizer import (
@@ -29,18 +30,28 @@ class JobAutoScaler:
         optimizer: LocalOptimizer,
         scaler,
         speed_monitor=None,
-        interval_secs: float = 300.0,
+        interval_secs: Optional[float] = None,
         sample_after_steps: int = 10,
     ):
         self._optimizer = optimizer
         self._scaler = scaler
         self._speed_monitor = speed_monitor
-        self._interval = interval_secs
+        # None → read the runtime-mutable global context each cycle
+        self._interval_override = interval_secs
         self._sample_after_steps = sample_after_steps
         self._job_context = get_job_context()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._autoscale_enabled = True
+
+    @property
+    def _interval(self) -> float:
+        if self._interval_override is not None:
+            return self._interval_override
+        return get_master_config().seconds_interval_to_optimize
+
+    @property
+    def _autoscale_enabled(self) -> bool:
+        return get_master_config().auto_worker_enabled
 
     # -- lifecycle ---------------------------------------------------------
 

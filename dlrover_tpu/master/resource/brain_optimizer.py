@@ -102,6 +102,18 @@ class BrainResourceOptimizer(ResourceOptimizer):
         except Exception as e:
             logger.warning("brain job-end report failed: %s", e)
 
+    # -- master config seeding ----------------------------------------------
+
+    def fetch_master_config(self) -> dict:
+        """Tunable overrides for ``MasterConfigContext.seed_from_brain``
+        (brain ``master_config`` table; cluster defaults + per-job)."""
+        resp = self._client.get(
+            bmsg.BrainConfigRequest(job_name=self._job_name)
+        )
+        if isinstance(resp, bmsg.BrainConfigResponse) and resp.success:
+            return resp.values
+        return {}
+
     # -- plans --------------------------------------------------------------
 
     def _request(

@@ -174,6 +174,7 @@ class PodScaler(Scaler):
             "spec": template.get("spec", {"containers": [{}]}),
         }
         self._inject_env(pod["spec"], node)
+        self._inject_resources(pod["spec"], node)
         pod["spec"].setdefault("restartPolicy", "Never")
         created = self._client.create_pod(pod)
         node.create_time = time.time()
@@ -228,6 +229,27 @@ class PodScaler(Scaler):
             container.setdefault("env", []).extend(
                 e for e in env if e["name"] not in existing
             )
+
+    def _inject_resources(self, pod_spec: Dict, node: Node):
+        """Node-specific resource overrides (e.g. the OOM-relaunch memory
+        bump, dist_job_manager._bump_oom_memory) take precedence over the
+        template's requests — reference pod_scaler.py per-node resources."""
+        res = node.config_resource
+        overrides: Dict[str, str] = {}
+        if res.memory_mb:
+            overrides["memory"] = f"{int(res.memory_mb)}Mi"
+        if res.cpu:
+            overrides["cpu"] = str(res.cpu)
+        if not overrides:
+            return
+        for container in pod_spec.setdefault("containers", [{}]):
+            requests = container.setdefault("resources", {}).setdefault(
+                "requests", {}
+            )
+            requests.update(overrides)
+            limits = container["resources"].get("limits")
+            if limits is not None:
+                limits.update(overrides)
 
     # -- master service -----------------------------------------------------
 

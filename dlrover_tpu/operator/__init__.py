@@ -1,0 +1,4 @@
+from dlrover_tpu.operator.controller import (  # noqa: F401
+    ElasticJobController,
+    JobPhase,
+)

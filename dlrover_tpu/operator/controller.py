@@ -1,0 +1,594 @@
+"""ElasticJob controller: the operator-side reconcile loop.
+
+Parity: reference Go operator
+``go/elasticjob/pkg/controllers/elasticjob_controller.go:85-156``
+(phase-driven reconcile) and
+``go/elasticjob/pkg/controllers/master/master.go:60-244`` (master pod
+construction, job-state sync from the master pod, ``HandleFaultPods``
+relaunching an evicted/deleted master). The reference builds this on
+controller-runtime; ours is a dependency-free Python reconcile loop over
+the same REST surface the master already uses (``scheduler/k8s_client``),
+so one binary can host it and tests drive it with the fake transport.
+
+Responsibilities (the master remains the in-job control plane):
+
+- **ElasticJob CR → master pod + service**: on a new job, create the
+  job-master pod (index 0) and its stable-DNS service, then keep the CR's
+  ``status.phase`` in sync with the master pod phase.
+- **Master fault tolerance**: the master pod is the job's single point of
+  failure without the operator — if it is deleted, evicted, or fails with
+  a retryable reason, recreate it under a fresh replica index (bounded by
+  ``master-restart-limit``); the relaunched master re-adopts running
+  workers through its pod re-list (`master/watcher/k8s_watcher.py`).
+- **Operator-side ScalePlan application**: when a master runs with
+  ``scale_plan_mode == "crd"`` it records intent as ScalePlan CRs
+  (``master/scaler/pod_scaler.py:257`` ``ElasticJobScaler``) instead of
+  mutating pods; the operator executes those plans (create/remove worker
+  pods from the job's worker template) and stamps the plan status.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeEnv, NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.master.scaler.pod_scaler import (
+    LABEL_ID_KEY,
+    LABEL_JOB_KEY,
+    LABEL_RANK_KEY,
+    LABEL_RELAUNCH_KEY,
+    LABEL_TYPE_KEY,
+)
+from dlrover_tpu.scheduler.k8s_client import (
+    ELASTICJOB_PLURAL,
+    GROUP,
+    SCALEPLAN_PLURAL,
+    VERSION,
+    K8sApiError,
+    K8sClient,
+)
+
+
+class JobPhase:
+    CREATED = "Created"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+#: pod failure reasons the operator treats as retryable for the master
+_RETRYABLE_MASTER_REASONS = ("Preempt", "Evict", "Shutdown", "OOMKilled",
+                             "NodeLost", "Killed")
+
+_MASTER_PORT = 50001
+
+
+def master_pod_name(job_name: str, index: int) -> str:
+    return f"elasticjob-{job_name}-master-{index}"
+
+
+def master_service_name(job_name: str) -> str:
+    return f"elasticjob-{job_name}-master"
+
+
+class ElasticJobController:
+    """Level-triggered reconcile: watch events only *enqueue* a job name;
+    every reconcile re-reads actual state and converges it (the
+    controller-runtime model, minus the framework)."""
+
+    def __init__(
+        self,
+        client: K8sClient,
+        master_image: str = "",
+        resync_interval: float = 30.0,
+        master_restart_limit: int = 3,
+    ):
+        self._client = client
+        self._master_image = master_image
+        self._resync = resync_interval
+        self._master_restart_limit = master_restart_limit
+        self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
+        self._stop_evt = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self):
+        self._stop_evt.clear()
+        for name, target in (
+            ("ejc-worker", self._worker_loop),
+            ("ejc-job-watch", self._watch_jobs),
+            ("ejc-pod-watch", self._watch_pods),
+            ("ejc-plan-watch", self._watch_scaleplans),
+            ("ejc-resync", self._resync_loop),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop_evt.set()
+        self._queue.put(None)
+
+    # ------------------------------------------------------------------
+    # watch → enqueue
+    # ------------------------------------------------------------------
+
+    def _watch_jobs(self):
+        while not self._stop_evt.is_set():
+            try:
+                for _etype, cr in self._client.watch_custom_resources(
+                    ELASTICJOB_PLURAL
+                ):
+                    if self._stop_evt.is_set():
+                        return
+                    name = cr.get("metadata", {}).get("name", "")
+                    if name:
+                        self._queue.put(name)
+            except Exception as e:
+                if self._stop_evt.is_set():
+                    return
+                logger.warning("elasticjob watch broke (%s); retrying", e)
+                self._stop_evt.wait(3)
+
+    def _watch_pods(self):
+        selector = f"{LABEL_TYPE_KEY}={NodeType.MASTER}"
+        while not self._stop_evt.is_set():
+            try:
+                for _etype, pod in self._client.watch_pods(selector):
+                    if self._stop_evt.is_set():
+                        return
+                    job = pod.get("metadata", {}).get("labels", {}).get(
+                        LABEL_JOB_KEY, ""
+                    )
+                    if job:
+                        self._queue.put(job)
+            except Exception as e:
+                if self._stop_evt.is_set():
+                    return
+                logger.warning("master pod watch broke (%s); retrying", e)
+                self._stop_evt.wait(3)
+
+    def _watch_scaleplans(self):
+        while not self._stop_evt.is_set():
+            try:
+                for _etype, cr in self._client.watch_custom_resources(
+                    SCALEPLAN_PLURAL
+                ):
+                    if self._stop_evt.is_set():
+                        return
+                    job = cr.get("spec", {}).get("ownerJob", "")
+                    if job:
+                        self._queue.put(job)
+            except Exception as e:
+                if self._stop_evt.is_set():
+                    return
+                logger.warning("scaleplan watch broke (%s); retrying", e)
+                self._stop_evt.wait(3)
+
+    def _resync_loop(self):
+        while not self._stop_evt.wait(self._resync):
+            try:
+                for cr in self._client.list_custom_resources(ELASTICJOB_PLURAL):
+                    name = cr.get("metadata", {}).get("name", "")
+                    if name:
+                        self._queue.put(name)
+            except Exception:
+                logger.exception("elasticjob resync list failed")
+
+    def _worker_loop(self):
+        while not self._stop_evt.is_set():
+            name = self._queue.get()
+            if name is None:
+                return
+            try:
+                self.reconcile_once(name)
+            except Exception:
+                logger.exception("reconcile of elasticjob %s failed", name)
+
+    # ------------------------------------------------------------------
+    # reconcile
+    # ------------------------------------------------------------------
+
+    def reconcile_once(self, job_name: str):
+        """One full convergence pass for one ElasticJob. Deterministic and
+        re-entrant: the unit tests call this directly."""
+        job = self._client.get_custom_resource(ELASTICJOB_PLURAL, job_name)
+        if job is None:
+            return  # deleted: pods are garbage-collected via ownerReferences
+        if job.get("metadata", {}).get("deletionTimestamp"):
+            return
+
+        status = job.setdefault("status", {})
+        phase = status.get("phase", "")
+        if not phase:
+            self._initialize_job(job)
+            phase = JobPhase.CREATED
+
+        if phase in (JobPhase.SUCCEEDED, JobPhase.FAILED):
+            self._stop_running_pods(job)
+            return
+
+        master = self._get_master_pod(job_name)
+        if master is None:
+            # first creation OR the master vanished (deleted/evicted):
+            # HandleFaultPods semantics — master.go:139
+            self._ensure_master(job, index=self._next_master_index(job))
+        else:
+            mphase = master.get("status", {}).get("phase", "")
+            if mphase == "Failed":
+                self._handle_failed_master(job, master)
+            elif master.get("metadata", {}).get("deletionTimestamp"):
+                idx = self._pod_index(master)
+                self._ensure_master(job, index=idx + 1)
+
+        self._apply_pending_scaleplans(job)
+        self._sync_job_state(job)
+
+    # -- init / status ---------------------------------------------------
+
+    def _initialize_job(self, job: Dict):
+        now = _now_iso()
+        status = job.setdefault("status", {})
+        status.update({
+            "phase": JobPhase.CREATED,
+            "startTime": now,
+            "conditions": [_condition(JobPhase.CREATED, "JobCreated",
+                                      "ElasticJob created")],
+        })
+        self._patch_status(job)
+
+    def _patch_status(self, job: Dict):
+        name = job["metadata"]["name"]
+        try:
+            self._client.patch_custom_resource_status(
+                ELASTICJOB_PLURAL, name, job.get("status", {})
+            )
+        except K8sApiError as e:
+            logger.warning("status patch for %s failed: %s", name, e)
+
+    def _set_phase(self, job: Dict, phase: str, reason: str, msg: str):
+        status = job.setdefault("status", {})
+        if status.get("phase") == phase:
+            return
+        status["phase"] = phase
+        status.setdefault("conditions", []).append(
+            _condition(phase, reason, msg)
+        )
+        if phase in (JobPhase.SUCCEEDED, JobPhase.FAILED):
+            status.setdefault("completionTime", _now_iso())
+        self._patch_status(job)
+
+    def _sync_job_state(self, job: Dict):
+        """Job phase follows the master pod phase (master.go:104-139)."""
+        name = job["metadata"]["name"]
+        master = self._get_master_pod(name)
+        if master is None:
+            return
+        mphase = master.get("status", {}).get("phase", "")
+        job.setdefault("status", {})["replicaStatuses"] = {
+            NodeType.MASTER: {"phase": mphase,
+                              "name": master["metadata"]["name"]}
+        }
+        if mphase == "Succeeded":
+            self._set_phase(job, JobPhase.SUCCEEDED, "MasterSucceeded",
+                            f"job {name} completed")
+            self._stop_running_pods(job)
+        elif mphase == "Running":
+            self._set_phase(job, JobPhase.RUNNING, "MasterRunning",
+                            f"job {name} is running")
+        elif mphase == "Pending":
+            if job["status"].get("phase") in ("", JobPhase.CREATED):
+                self._set_phase(job, JobPhase.PENDING, "MasterPending",
+                                f"job {name} is pending")
+        else:
+            self._patch_status(job)
+
+    # -- master pod management ------------------------------------------
+
+    def _get_master_pod(self, job_name: str) -> Optional[Dict]:
+        selector = (
+            f"{LABEL_JOB_KEY}={job_name},{LABEL_TYPE_KEY}={NodeType.MASTER}"
+        )
+        pods = self._client.list_pods(selector)
+        if not pods:
+            return None
+        return max(pods, key=self._pod_index)
+
+    @staticmethod
+    def _pod_index(pod: Dict) -> int:
+        try:
+            return int(
+                pod.get("metadata", {}).get("labels", {}).get(LABEL_ID_KEY, 0)
+            )
+        except ValueError:
+            return 0
+
+    def _next_master_index(self, job: Dict) -> int:
+        """Index for a fresh master when none exists: count prior attempts
+        recorded in status so a vanished pod still advances the index."""
+        return int(job.get("status", {}).get("masterRelaunchCount", 0))
+
+    def _handle_failed_master(self, job: Dict, master: Dict):
+        reason = _pod_failure_reason(master)
+        idx = self._pod_index(master)
+        retryable = any(tok in reason for tok in _RETRYABLE_MASTER_REASONS)
+        if retryable and idx + 1 <= self._master_restart_limit:
+            logger.info(
+                "master %s failed (%s); relaunching as index %d",
+                master["metadata"]["name"], reason, idx + 1,
+            )
+            self._client.delete_pod(master["metadata"]["name"],
+                                    grace_seconds=0)
+            self._ensure_master(job, index=idx + 1)
+        else:
+            self._set_phase(
+                job, JobPhase.FAILED, reason or "MasterFailed",
+                f"master failed ({reason or 'fatal'}), "
+                f"index={idx}, limit={self._master_restart_limit}",
+            )
+
+    def _ensure_master(self, job: Dict, index: int):
+        job_name = job["metadata"]["name"]
+        if index > self._master_restart_limit:
+            self._set_phase(
+                job, JobPhase.FAILED, "MasterRestartBudget",
+                f"master relaunch budget exhausted ({index} > "
+                f"{self._master_restart_limit})",
+            )
+            return
+        name = master_pod_name(job_name, index)
+        if self._client.get_pod(name) is not None:
+            return
+        pod = self._build_master_pod(job, index)
+        try:
+            self._client.create_pod(pod)
+        except K8sApiError as e:
+            if e.status != 409:  # already exists: lost a race with ourselves
+                raise
+        job.setdefault("status", {})["masterRelaunchCount"] = index + 1
+        self._patch_status(job)
+        self._ensure_master_service(job)
+        logger.info("created master pod %s for job %s", name, job_name)
+
+    def _build_master_pod(self, job: Dict, index: int) -> Dict:
+        """Master pod from the job's ``master`` replica template when given,
+        else a default spec running ``dlrover_tpu.master.main``
+        (master.go:60-77 + createDefaultMasterTemplate there)."""
+        job_name = job["metadata"]["name"]
+        spec = job.get("spec", {})
+        replica_specs = spec.get("replicaSpecs", {})
+        template = copy.deepcopy(
+            replica_specs.get(NodeType.MASTER, {}).get("template", {})
+        )
+        pod_spec = template.get("spec") or {
+            "containers": [{
+                "name": "master",
+                "image": self._master_image or _first_worker_image(spec),
+                "command": [
+                    "python", "-m", "dlrover_tpu.master.main",
+                    "--job_name", job_name,
+                    "--port", str(_MASTER_PORT),
+                ],
+            }],
+        }
+        pod_spec.setdefault("restartPolicy", "Never")
+        env = [
+            {"name": NodeEnv.JOB_NAME, "value": job_name},
+            {"name": "POD_NAMESPACE", "value": self._client.namespace},
+            {"name": "JOB_UID",
+             "value": job.get("metadata", {}).get("uid", "")},
+        ]
+        for container in pod_spec.setdefault("containers", [{}]):
+            existing = {e.get("name") for e in container.get("env", [])}
+            container.setdefault("env", []).extend(
+                e for e in env if e["name"] not in existing
+            )
+        meta = copy.deepcopy(template.get("metadata", {}))
+        labels = meta.setdefault("labels", {})
+        labels.update({
+            LABEL_JOB_KEY: job_name,
+            LABEL_TYPE_KEY: NodeType.MASTER,
+            LABEL_ID_KEY: str(index),
+            LABEL_RANK_KEY: "0",
+            LABEL_RELAUNCH_KEY: str(index),
+        })
+        meta["name"] = master_pod_name(job_name, index)
+        meta["ownerReferences"] = [_owner_ref(job)]
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": meta,
+            "spec": pod_spec,
+        }
+
+    def _ensure_master_service(self, job: Dict):
+        job_name = job["metadata"]["name"]
+        name = master_service_name(job_name)
+        if self._client.get_service(name) is not None:
+            return
+        self._client.create_service({
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "labels": {LABEL_JOB_KEY: job_name},
+                "ownerReferences": [_owner_ref(job)],
+            },
+            "spec": {
+                "selector": {
+                    LABEL_JOB_KEY: job_name,
+                    LABEL_TYPE_KEY: NodeType.MASTER,
+                },
+                "ports": [{"port": _MASTER_PORT,
+                           "targetPort": _MASTER_PORT}],
+            },
+        })
+
+    # -- operator-side ScalePlan application ----------------------------
+
+    def _apply_pending_scaleplans(self, job: Dict):
+        """Execute ScalePlan CRs the master recorded in ``crd`` mode
+        (elasticjob_controller.go:126-143 executeScaling): create the
+        listed worker pods from the job's worker template, delete the
+        listed pods, then mark the plan Succeeded so it is not re-run."""
+        job_name = job["metadata"]["name"]
+        selector = f"{LABEL_JOB_KEY}={job_name},scale-type=auto"
+        for plan in self._client.list_custom_resources(
+            SCALEPLAN_PLURAL, selector
+        ):
+            phase = plan.get("status", {}).get("phase", "")
+            if phase in (JobPhase.SUCCEEDED, JobPhase.FAILED):
+                continue
+            name = plan.get("metadata", {}).get("name", "")
+            try:
+                self._execute_scaleplan(job, plan)
+                self._client.patch_custom_resource_status(
+                    SCALEPLAN_PLURAL, name,
+                    {"phase": JobPhase.SUCCEEDED,
+                     "finishTime": _now_iso()},
+                )
+            except Exception as e:
+                logger.exception("scaleplan %s failed", name)
+                self._client.patch_custom_resource_status(
+                    SCALEPLAN_PLURAL, name,
+                    {"phase": JobPhase.FAILED, "message": str(e)[:500]},
+                )
+
+    def _execute_scaleplan(self, job: Dict, plan: Dict):
+        job_name = job["metadata"]["name"]
+        spec = plan.get("spec", {})
+        for entry in spec.get("createPods", []):
+            pod = self._build_worker_pod(
+                job,
+                node_type=entry.get("type", NodeType.WORKER),
+                node_id=int(entry.get("id", 0)),
+                rank=int(entry.get("rankIndex", entry.get("id", 0))),
+            )
+            try:
+                self._client.create_pod(pod)
+            except K8sApiError as e:
+                if e.status != 409:
+                    raise
+        for pod_name in spec.get("removePods", []):
+            self._client.delete_pod(pod_name)
+        logger.info(
+            "applied scaleplan %s for %s: +%d/-%d pods",
+            plan.get("metadata", {}).get("name", "?"), job_name,
+            len(spec.get("createPods", [])), len(spec.get("removePods", [])),
+        )
+
+    def _build_worker_pod(
+        self, job: Dict, node_type: str, node_id: int, rank: int
+    ) -> Dict:
+        job_name = job["metadata"]["name"]
+        replica_specs = job.get("spec", {}).get("replicaSpecs", {})
+        template = copy.deepcopy(
+            replica_specs.get(node_type, {}).get("template", {})
+        )
+        pod_spec = template.get("spec", {"containers": [{}]})
+        pod_spec.setdefault("restartPolicy", "Never")
+        master_addr = (
+            f"{master_service_name(job_name)}."
+            f"{self._client.namespace}:{_MASTER_PORT}"
+        )
+        env = [
+            {"name": NodeEnv.JOB_NAME, "value": job_name},
+            {"name": NodeEnv.MASTER_ADDR, "value": master_addr},
+            {"name": NodeEnv.NODE_ID, "value": str(node_id)},
+            {"name": NodeEnv.NODE_RANK, "value": str(rank)},
+        ]
+        for container in pod_spec.setdefault("containers", [{}]):
+            existing = {e.get("name") for e in container.get("env", [])}
+            container.setdefault("env", []).extend(
+                e for e in env if e["name"] not in existing
+            )
+        meta = copy.deepcopy(template.get("metadata", {}))
+        labels = meta.setdefault("labels", {})
+        labels.update({
+            LABEL_JOB_KEY: job_name,
+            LABEL_TYPE_KEY: node_type,
+            LABEL_ID_KEY: str(node_id),
+            LABEL_RANK_KEY: str(rank),
+        })
+        meta["name"] = f"{job_name}-{node_type}-{node_id}"
+        meta["ownerReferences"] = [_owner_ref(job)]
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": meta,
+            "spec": pod_spec,
+        }
+
+    # -- teardown --------------------------------------------------------
+
+    def _stop_running_pods(self, job: Dict):
+        """On terminal phases delete this job's still-live pods
+        (elasticjob_controller.go stopRunningPods)."""
+        job_name = job["metadata"]["name"]
+        for pod in self._client.list_pods(f"{LABEL_JOB_KEY}={job_name}"):
+            phase = pod.get("status", {}).get("phase", "")
+            if phase in ("Running", "Pending"):
+                self._client.delete_pod(pod["metadata"]["name"])
+
+
+# ----------------------------------------------------------------------
+# helpers
+# ----------------------------------------------------------------------
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _condition(phase: str, reason: str, msg: str) -> Dict:
+    return {
+        "type": phase,
+        "status": "True",
+        "reason": reason,
+        "message": msg,
+        "lastTransitionTime": _now_iso(),
+    }
+
+
+def _owner_ref(job: Dict) -> Dict:
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "ElasticJob",
+        "name": job["metadata"]["name"],
+        "uid": job.get("metadata", {}).get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def _first_worker_image(spec: Dict) -> str:
+    for rspec in spec.get("replicaSpecs", {}).values():
+        containers = (
+            rspec.get("template", {}).get("spec", {}).get("containers", [])
+        )
+        for c in containers:
+            if c.get("image"):
+                return c["image"]
+    return "dlrover-tpu:latest"
+
+
+def _pod_failure_reason(pod: Dict) -> str:
+    status = pod.get("status", {})
+    reason = status.get("reason", "")
+    for cs in status.get("containerStatuses", []):
+        term = cs.get("state", {}).get("terminated") or cs.get(
+            "lastState", {}
+        ).get("terminated")
+        if term and term.get("reason"):
+            reason = reason or term["reason"]
+            if term["reason"] == "OOMKilled":
+                return "OOMKilled"
+    return reason

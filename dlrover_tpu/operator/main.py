@@ -1,0 +1,55 @@
+"""Operator entrypoint: ``python -m dlrover_tpu.operator.main``.
+
+Parity: reference ``go/elasticjob/main.go`` (manager setup + controller
+registration). Deployed as a single cluster-scoped (well, namespace-scoped)
+deployment; see ``deploy/k8s/operator.yaml``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.operator.controller import ElasticJobController
+from dlrover_tpu.scheduler.k8s_client import get_k8s_client
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dlrover-tpu-operator")
+    p.add_argument("--namespace", default="", help="namespace to watch "
+                   "(default: POD_NAMESPACE or 'default')")
+    p.add_argument("--master_image", default="",
+                   help="image for default master pods")
+    p.add_argument("--resync_seconds", type=float, default=30.0)
+    p.add_argument("--master_restart_limit", type=int, default=3)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    client = get_k8s_client(namespace=args.namespace)
+    controller = ElasticJobController(
+        client,
+        master_image=args.master_image,
+        resync_interval=args.resync_seconds,
+        master_restart_limit=args.master_restart_limit,
+    )
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        logger.info("operator stopping")
+        controller.stop()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    controller.start()
+    logger.info("elasticjob operator watching namespace %s", client.namespace)
+    stop.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,10 +28,14 @@ from dlrover_tpu.master.node.job_context import JobContext  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _reset_job_context():
-    """Each test gets a fresh JobContext singleton."""
+    """Each test gets fresh JobContext / MasterConfigContext singletons."""
+    from dlrover_tpu.common.global_context import MasterConfigContext
+
     JobContext.reset_singleton()
+    MasterConfigContext.reset_singleton()
     yield
     JobContext.reset_singleton()
+    MasterConfigContext.reset_singleton()
 
 
 @pytest.fixture

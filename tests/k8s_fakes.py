@@ -32,22 +32,40 @@ class FakeTransport:
             resource = "pods" if "/pods" in path else parts[5]
             return self._stream(resource)
         if "/pods" in path:
-            return self._handle(self.pods, method, parts, body, "pods")
+            return self._handle(self.pods, method, parts, body, "pods", params)
         if "/services" in path:
-            return self._handle(self.services, method, parts, body, "services")
+            return self._handle(
+                self.services, method, parts, body, "services", params
+            )
         if "/events" in path:
             self.events.append(body)
             return body
         # custom resources: /apis/<group>/<ver>/namespaces/<ns>/<plural>[/name]
         plural = parts[5] if len(parts) > 5 else ""
         store = self.crs.setdefault(plural, {})
-        return self._handle(store, method, parts, body, plural)
+        return self._handle(store, method, parts, body, plural, params)
 
-    def _handle(self, store, method, parts, body, kind_key):
+    @staticmethod
+    def _matches_selector(obj: dict, selector: str) -> bool:
+        labels = obj.get("metadata", {}).get("labels", {})
+        for clause in selector.split(","):
+            if not clause:
+                continue
+            key, _, value = clause.partition("=")
+            if labels.get(key.strip()) != value.strip():
+                return False
+        return True
+
+    def _handle(self, store, method, parts, body, kind_key, params=None):
         idx = parts.index(kind_key)
         name = parts[idx + 1] if len(parts) > idx + 1 else ""
         if method == "GET" and not name:
-            return {"items": list(store.values())}
+            selector = (params or {}).get("labelSelector", "")
+            items = [
+                o for o in store.values()
+                if not selector or self._matches_selector(o, selector)
+            ]
+            return {"items": items}
         if method == "GET":
             if name not in store:
                 raise K8sApiError(404, "NotFound")

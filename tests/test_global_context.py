@@ -1,0 +1,102 @@
+"""MasterConfigContext: runtime-mutable master tunables, brain seeding
+(reference global_context.py:62-194 — whose brain path was a TODO; ours is
+served by the brain's master_config table end to end)."""
+
+from __future__ import annotations
+
+from dlrover_tpu.brain.server import BrainServer
+from dlrover_tpu.common.global_context import (
+    MasterConfigContext,
+    get_master_config,
+)
+from dlrover_tpu.master.resource.brain_optimizer import BrainResourceOptimizer
+
+
+def test_singleton_and_update_coercion():
+    ctx = get_master_config()
+    assert ctx is get_master_config()
+    applied = ctx.update({
+        "heartbeat_timeout": "120",          # str → float
+        "auto_worker_enabled": 0,            # int → bool
+        "sample_count_to_adjust_worker": "7",
+        "no_such_key": 1,                    # ignored
+        "_lock": "nope",                     # private: ignored
+    })
+    assert applied == {
+        "heartbeat_timeout": 120.0,
+        "auto_worker_enabled": False,
+        "sample_count_to_adjust_worker": 7,
+    }
+    assert ctx.heartbeat_timeout == 120.0
+    assert "no_such_key" not in ctx.to_dict()
+
+
+def test_bool_fields_parse_string_false():
+    """bool('False') is True — the string forms the brain's str()-typed
+    table produces must parse correctly."""
+    ctx = get_master_config()
+    assert ctx.update({"auto_worker_enabled": "False"}) == {
+        "auto_worker_enabled": False
+    }
+    assert ctx.update({"auto_worker_enabled": "true"}) == {
+        "auto_worker_enabled": True
+    }
+    assert ctx.update({"auto_worker_enabled": "0"}) == {
+        "auto_worker_enabled": False
+    }
+    assert ctx.update({"relaunch_always": "banana"}) == {}  # rejected
+
+
+def test_update_rejects_uncoercible():
+    ctx = get_master_config()
+    before = ctx.heartbeat_timeout
+    applied = ctx.update({"heartbeat_timeout": "not-a-number"})
+    assert applied == {}
+    assert ctx.heartbeat_timeout == before
+
+
+def test_seed_from_brain_failure_keeps_defaults():
+    ctx = get_master_config()
+    before = ctx.to_dict()
+    ctx.seed_from_brain(lambda: (_ for _ in ()).throw(RuntimeError("down")))
+    assert ctx.to_dict() == before
+
+
+def test_job_manager_reads_runtime_mutations():
+    """A live master must honor a context mutation without restart."""
+    from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+    from tests.test_k8s_platform import RecordingScaler, make_job_args
+
+    mgr = DistributedJobManager(
+        job_args=make_job_args(), scaler=RecordingScaler()
+    )
+    assert mgr._heartbeat_timeout == get_master_config().heartbeat_timeout
+    get_master_config().update({"heartbeat_timeout": 42})
+    assert mgr._heartbeat_timeout == 42.0
+    # explicit constructor override still wins
+    mgr2 = DistributedJobManager(
+        job_args=make_job_args(), scaler=RecordingScaler(),
+        heartbeat_timeout=5.0,
+    )
+    assert mgr2._heartbeat_timeout == 5.0
+
+
+def test_brain_serves_master_config_end_to_end():
+    MasterConfigContext.reset_singleton()
+    server = BrainServer(port=0)
+    server.start()
+    try:
+        # cluster default + per-job override
+        server.store.set_master_config("heartbeat_timeout", 300)
+        server.store.set_master_config("pending_timeout", 900, job_name="llama")
+        server.store.set_master_config("pending_timeout", 600)  # cluster
+
+        opt = BrainResourceOptimizer(
+            f"127.0.0.1:{server.port}", job_uuid="j", job_name="llama",
+        )
+        ctx = get_master_config()
+        ctx.seed_from_brain(opt.fetch_master_config)
+        assert ctx.heartbeat_timeout == 300.0
+        assert ctx.pending_timeout == 900.0  # job override beats cluster
+    finally:
+        server.stop()

@@ -161,7 +161,12 @@ def test_elasticjob_scaler_recovers_plan_index():
     client, transport = make_fake_client()
     transport.crs[SCALEPLAN_PLURAL] = {
         "llama-elastic-scaleplan-7": {
-            "metadata": {"name": "llama-elastic-scaleplan-7"}
+            "metadata": {
+                "name": "llama-elastic-scaleplan-7",
+                # production plans always carry the job label
+                # (ElasticJobScaler.scale); the fake filters on it
+                "labels": {LABEL_JOB_KEY: "llama-elastic"},
+            }
         }
     }
     scaler = ElasticJobScaler(args, client)
@@ -324,6 +329,86 @@ def test_relaunch_budget_exhausted_stops():
     run_event(mgr, 3, NodeStatus.RUNNING)
     run_event(mgr, 3, NodeStatus.FAILED, NodeExitReason.OOM)
     assert len(scaler.plans) == n_plans
+
+
+def test_oom_relaunch_bumps_memory_and_consumes_budget():
+    """Exit-reason differentiation (reference dist_job_manager.py:849-910 +
+    resource/job.py:313-395): OOMKilled → relaunch with a memory bump from
+    the optimizer's OOM-split path, budget consumed."""
+    from dlrover_tpu.master.resource.optimizer import LocalOptimizer
+
+    mgr, scaler = make_manager(resource_optimizer=LocalOptimizer())
+    mgr._init_nodes()
+    node = get_job_context().get_node(NodeType.WORKER, 0)
+    node.config_resource.memory_mb = 4096
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
+    new_node = scaler.plans[-1].launch_nodes[0]
+    assert new_node.config_resource.memory_mb > 4096
+    assert new_node.relaunch_count == 1
+
+
+def test_oom_relaunch_without_optimizer_doubles_memory():
+    mgr, scaler = make_manager()  # no optimizer wired
+    mgr._init_nodes()
+    node = get_job_context().get_node(NodeType.WORKER, 0)
+    node.config_resource.memory_mb = 4096
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
+    assert scaler.plans[-1].launch_nodes[0].config_resource.memory_mb == 8192
+
+
+def test_oom_bump_does_not_leak_to_siblings_or_job_spec():
+    """The bump must be per-node: config_resource used to be the shared
+    group NodeResource, so one OOM silently raised every pod's request."""
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    spec_res = mgr._job_args.worker_spec.group.node_resource
+    before_spec = spec_res.memory_mb
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
+    sibling = get_job_context().get_node(NodeType.WORKER, 1)
+    assert sibling.config_resource.memory_mb == before_spec
+    assert spec_res.memory_mb == before_spec
+
+
+def test_hardware_error_relaunch_is_budget_free():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    node = get_job_context().get_node(NodeType.WORKER, 2)
+    node.relaunch_count = 3  # budget exhausted
+    run_event(mgr, 2, NodeStatus.RUNNING)
+    run_event(mgr, 2, NodeStatus.FAILED, NodeExitReason.HARDWARE_ERROR)
+    plan = scaler.plans[-1]
+    assert plan.launch_nodes and plan.launch_nodes[0].relaunch_count == 3
+
+
+def test_fatal_error_on_critical_node_triggers_early_stop():
+    mgr, scaler = make_manager()
+    mgr._init_nodes()
+    node = get_job_context().get_node(NodeType.WORKER, 1)
+    node.critical = True
+    run_event(mgr, 1, NodeStatus.RUNNING)
+    run_event(mgr, 1, NodeStatus.FAILED, NodeExitReason.FATAL_ERROR)
+    stop, reason, msg = mgr.should_early_stop()
+    assert stop and reason == "error" and "fatal_error" in msg
+
+
+def test_pod_scaler_applies_node_memory_override():
+    """The OOM bump must survive into the pod spec (requests/limits)."""
+    from dlrover_tpu.master.scaler.pod_scaler import PodScaler
+
+    args = make_job_args()
+    client, transport = make_fake_client()
+    scaler = PodScaler(args, client, master_addr="m:1")
+    node = Node(NodeType.WORKER, 7)
+    node.config_resource.memory_mb = 12288
+    scaler._create_pod(node)
+    pod = transport.pods[f"{args.job_name}-worker-7"]
+    req = pod["spec"]["containers"][0]["resources"]["requests"]
+    assert req["memory"] == "12288Mi"
+    # template's tpu request is preserved
+    assert req.get("google.com/tpu") == "4"
 
 
 def test_dead_node_removed_from_rendezvous():

@@ -1,0 +1,225 @@
+"""ElasticJob operator: reconcile loop, master fault relaunch, operator-side
+ScalePlan application (reference elasticjob_controller.go:85-156 +
+master.go:60-244 behavior, driven against the fake API server)."""
+
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.master.scaler.pod_scaler import (
+    LABEL_ID_KEY,
+    LABEL_JOB_KEY,
+    LABEL_TYPE_KEY,
+)
+from dlrover_tpu.operator.controller import (
+    ElasticJobController,
+    JobPhase,
+    master_pod_name,
+    master_service_name,
+)
+from dlrover_tpu.scheduler.k8s_client import (
+    ELASTICJOB_PLURAL,
+    SCALEPLAN_PLURAL,
+)
+from tests.k8s_fakes import ELASTICJOB_CR, make_fake_client
+
+JOB = "llama-elastic"
+
+
+@pytest.fixture()
+def ctrl():
+    client, transport = make_fake_client()
+    transport.crs.setdefault(ELASTICJOB_PLURAL, {})[JOB] = copy.deepcopy(
+        ELASTICJOB_CR
+    )
+    controller = ElasticJobController(client, master_image="img:latest")
+    return controller, client, transport
+
+
+def _set_master_phase(transport, index: int, phase: str, reason: str = ""):
+    pod = transport.pods[master_pod_name(JOB, index)]
+    pod.setdefault("status", {})["phase"] = phase
+    if reason:
+        pod["status"]["reason"] = reason
+
+
+def test_new_job_creates_master_and_service(ctrl):
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    pod = transport.pods[master_pod_name(JOB, 0)]
+    labels = pod["metadata"]["labels"]
+    assert labels[LABEL_JOB_KEY] == JOB
+    assert labels[LABEL_TYPE_KEY] == NodeType.MASTER
+    assert labels[LABEL_ID_KEY] == "0"
+    assert pod["metadata"]["ownerReferences"][0]["uid"] == "uid-123"
+    assert master_service_name(JOB) in transport.services
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    assert job["status"]["phase"] == JobPhase.CREATED
+    assert job["status"]["startTime"]
+
+
+def test_job_phase_follows_master_pod(ctrl):
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Pending")
+    controller.reconcile_once(JOB)
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    assert job["status"]["phase"] == JobPhase.PENDING
+
+    _set_master_phase(transport, 0, "Running")
+    controller.reconcile_once(JOB)
+    assert job["status"]["phase"] == JobPhase.RUNNING
+
+    _set_master_phase(transport, 0, "Succeeded")
+    controller.reconcile_once(JOB)
+    assert job["status"]["phase"] == JobPhase.SUCCEEDED
+    assert job["status"]["completionTime"]
+
+
+def test_deleted_master_is_relaunched_with_next_index(ctrl):
+    """The HandleFaultPods behavior: an evicted/deleted master pod must be
+    recreated so the job survives master loss (master.go:139)."""
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Running")
+    controller.reconcile_once(JOB)
+
+    # node reclaim: the pod object vanishes entirely
+    del transport.pods[master_pod_name(JOB, 0)]
+    controller.reconcile_once(JOB)
+
+    assert master_pod_name(JOB, 1) in transport.pods
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    assert job["status"]["phase"] == JobPhase.RUNNING  # still alive
+    # and the replacement runs → job keeps running
+    _set_master_phase(transport, 1, "Running")
+    controller.reconcile_once(JOB)
+    assert job["status"]["phase"] == JobPhase.RUNNING
+
+
+def test_evicted_master_failure_is_retryable(ctrl):
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Running")
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Failed", reason="Evicted")
+    controller.reconcile_once(JOB)
+    assert master_pod_name(JOB, 0) not in transport.pods  # cleaned up
+    assert master_pod_name(JOB, 1) in transport.pods
+
+
+def test_fatal_master_failure_fails_the_job(ctrl):
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Failed", reason="Error")
+    controller.reconcile_once(JOB)
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    assert job["status"]["phase"] == JobPhase.FAILED
+    assert master_pod_name(JOB, 1) not in transport.pods
+
+
+def test_master_relaunch_budget_exhaustion_fails_job(ctrl):
+    controller, client, transport = ctrl
+    controller._master_restart_limit = 2
+    controller.reconcile_once(JOB)
+    for idx in range(3):
+        name = master_pod_name(JOB, idx)
+        if name in transport.pods:
+            del transport.pods[name]
+        controller.reconcile_once(JOB)
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    assert job["status"]["phase"] == JobPhase.FAILED
+    assert "budget" in job["status"]["conditions"][-1]["message"]
+
+
+def test_terminal_job_stops_running_pods(ctrl):
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Running")
+    # a worker pod of this job is still running
+    transport.pods[f"{JOB}-worker-0"] = {
+        "metadata": {"name": f"{JOB}-worker-0",
+                     "labels": {LABEL_JOB_KEY: JOB,
+                                LABEL_TYPE_KEY: NodeType.WORKER,
+                                LABEL_ID_KEY: "0"}},
+        "status": {"phase": "Running"},
+    }
+    _set_master_phase(transport, 0, "Succeeded")
+    controller.reconcile_once(JOB)
+    assert f"{JOB}-worker-0" not in transport.pods
+
+
+def test_operator_applies_crd_mode_scaleplan(ctrl):
+    """The master in crd mode records intent as a ScalePlan; the operator
+    executes it (elasticjob_controller.go executeScaling)."""
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Running")
+
+    transport.crs.setdefault(SCALEPLAN_PLURAL, {})[f"{JOB}-scaleplan-1"] = {
+        "metadata": {
+            "name": f"{JOB}-scaleplan-1",
+            "labels": {LABEL_JOB_KEY: JOB, "scale-type": "auto"},
+        },
+        "spec": {
+            "ownerJob": JOB,
+            "createPods": [
+                {"name": f"{JOB}-worker-4", "type": "worker", "id": 4,
+                 "rankIndex": 4},
+            ],
+            "removePods": [f"{JOB}-worker-0"],
+        },
+    }
+    transport.pods[f"{JOB}-worker-0"] = {
+        "metadata": {"name": f"{JOB}-worker-0",
+                     "labels": {LABEL_JOB_KEY: JOB,
+                                LABEL_TYPE_KEY: NodeType.WORKER,
+                                LABEL_ID_KEY: "0"}},
+        "status": {"phase": "Running"},
+    }
+    controller.reconcile_once(JOB)
+
+    created = transport.pods[f"{JOB}-worker-4"]
+    assert created["metadata"]["labels"][LABEL_ID_KEY] == "4"
+    # worker template node selectors survive into the pod spec
+    assert "nodeSelector" in created["spec"]
+    envs = {e["name"]: e["value"]
+            for e in created["spec"]["containers"][0]["env"]}
+    assert envs["DLROVER_TPU_MASTER_ADDR"].startswith(
+        master_service_name(JOB)
+    )
+    assert f"{JOB}-worker-0" not in transport.pods
+    plan = transport.crs[SCALEPLAN_PLURAL][f"{JOB}-scaleplan-1"]
+    assert plan["status"]["phase"] == JobPhase.SUCCEEDED
+
+    # idempotence: re-reconcile does not recreate the removed pod
+    controller.reconcile_once(JOB)
+    assert f"{JOB}-worker-0" not in transport.pods
+
+
+def test_event_driven_loop_reconciles_from_watch(ctrl):
+    """Full controller thread loop against the fake watch streams: a CR
+    ADDED event leads to a created master pod."""
+    import time
+
+    controller, client, transport = ctrl
+    controller.start()
+    try:
+        transport.push_watch_event(
+            "ADDED", transport.crs[ELASTICJOB_PLURAL][JOB],
+            resource=ELASTICJOB_PLURAL,
+        )
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if master_pod_name(JOB, 0) in transport.pods:
+                break
+            time.sleep(0.02)
+        assert master_pod_name(JOB, 0) in transport.pods
+    finally:
+        controller.stop()
+        transport.end_watch(ELASTICJOB_PLURAL)
+        transport.end_watch("pods")
+        transport.end_watch(SCALEPLAN_PLURAL)
