@@ -41,12 +41,19 @@ class DiagnosisAgent:
         self._thread: Optional[threading.Thread] = None
         self._log_source = None  # callable -> str (worker log tail)
         self._metrics_source = None  # callable -> dict (tpu_timer scrape)
+        self._hang_dumper = None  # profiler.hang_dump.HangDumper
 
     def set_log_source(self, fn):
         self._log_source = fn
 
     def set_metrics_source(self, fn):
         self._metrics_source = fn
+
+    def set_hang_dumper(self, dumper):
+        """On a detected hang the agent collects all-rank Python stacks +
+        pending device programs and ships them as a HangDumpRecord
+        (reference manager.cc:454-464 gdb/py-spy dump)."""
+        self._hang_dumper = dumper
 
     # -- failure-time decision ---------------------------------------------
 
@@ -92,6 +99,8 @@ class DiagnosisAgent:
                 logger.warning("diagnosis report failed: %s", e)
 
     def report_once(self):
+        import json
+
         if self._log_source is not None:
             tail = self._log_source()
             if tail:
@@ -99,8 +108,15 @@ class DiagnosisAgent:
         if self._metrics_source is not None:
             metrics = self._metrics_source()
             if metrics:
-                import json
-
                 self._client.report_diagnosis_data(
                     "TpuMetricsRecord", json.dumps(metrics)
                 )
+                if (
+                    metrics.get("hang")
+                    and self._hang_dumper is not None
+                    and self._hang_dumper.should_dump()
+                ):
+                    bundle = self._hang_dumper.dump(reason="tpu_timer_hang")
+                    self._client.report_diagnosis_data(
+                        "HangDumpRecord", json.dumps(bundle)
+                    )

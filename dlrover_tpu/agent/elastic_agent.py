@@ -83,6 +83,7 @@ class ElasticAgent:
         )
         self._diagnosis.set_log_source(self._last_worker_log_tail)
         self._tpu_timer_env: Dict[str, str] = {}
+        self._hang_dumper = None
         self._paral_tuner = None
         if config.tpu_timer:
             self._setup_tpu_timer()
@@ -112,11 +113,18 @@ class ElasticAgent:
             self._tpu_timer_env = {}
             return
         if self._tpu_timer_env:
+            from dlrover_tpu.profiler.hang_dump import HangDumper
+
             ports = [
                 self._config.tpu_timer_port + i
                 for i in range(self._config.nproc_per_node)
             ]
             self._diagnosis.set_metrics_source(TpuTimerMetricsSource(ports))
+            self._hang_dumper = HangDumper(
+                stack_dir=os.path.join(self._log_dir, "hang"),
+                metrics_ports=ports,
+            )
+            self._diagnosis.set_hang_dumper(self._hang_dumper)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -360,6 +368,9 @@ class ElasticAgent:
                 NodeEnv.RESTART_COUNT: str(self._restart_count),
                 "DLROVER_TPU_ACCELERATOR": self._config.accelerator,
                 "DLROVER_TPU_LOCAL_RANK": str(local_rank),
+                # workers install a SIGUSR2 faulthandler writing here; the
+                # agent's HangDumper signals + collects on a detected hang
+                "DLROVER_TPU_STACK_DIR": os.path.join(self._log_dir, "hang"),
             }
         )
         return env
@@ -391,6 +402,10 @@ class ElasticAgent:
                 process_id,
                 proc.pid,
                 log_path,
+            )
+        if self._hang_dumper is not None:
+            self._hang_dumper.set_workers(
+                [w.proc.pid for w in self._workers]
             )
 
     def _stop_workers(self, grace: float = 10.0):

@@ -19,12 +19,6 @@ from dlrover_tpu.common.constants import DefaultValues
 from dlrover_tpu.common.log import logger
 
 
-class HangStrategy:
-    LOG_ONLY = 0
-    NOTIFY = 1
-    FAULT_TOLERANCE = 2
-
-
 class MasterConfigContext:
     """Thread-safe, runtime-mutable master tunables (process singleton)."""
 
@@ -33,23 +27,22 @@ class MasterConfigContext:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # -- node lifecycle ------------------------------------------------
+        # Every field here has a live consumer that re-reads it per use —
+        # an update() genuinely retunes a running master. Do not add
+        # fields without wiring a reader (update() would accept them and
+        # log "applied" while nothing changes).
+        # -- node lifecycle (dist_job_manager) -------------------------------
         self.heartbeat_timeout = float(DefaultValues.SEC_HEARTBEAT_TIMEOUT)
         self.pending_timeout = float(DefaultValues.SEC_NODE_START_TIMEOUT)
         self.monitor_interval = float(DefaultValues.SEC_MONITOR_INTERVAL)
-        self.relaunch_always = False
-        # -- autoscaling ---------------------------------------------------
+        self.relaunch_always = False  # relaunch any failure, ignore budget
+        # -- autoscaling (job_auto_scaler) -----------------------------------
         self.auto_worker_enabled = True
-        self.seconds_to_autoscale_worker = 90.0
+        self.seconds_to_autoscale_worker = 90.0  # warmup before 1st cycle
         self.seconds_interval_to_optimize = 300.0
         self.sample_count_to_adjust_worker = 5
-        # -- hang detection ------------------------------------------------
-        self.hang_detection = HangStrategy.NOTIFY
-        self.seconds_hang_threshold = 1800.0
-        # -- rendezvous ----------------------------------------------------
-        self.rdzv_waiting_timeout = float(DefaultValues.SEC_RDZV_WAITING_TIMEOUT)
-        # -- checkpoint ----------------------------------------------------
-        self.ckpt_persist_max_lag = 2  # steps the disk writer may trail shm
+        # -- hang detection (diagnosis CheckTrainingHangOperator) ------------
+        self.seconds_hang_threshold = 300.0  # step-report silence to confirm
 
     # ------------------------------------------------------------------
     @classmethod

@@ -240,6 +240,8 @@ class DatasetShardParams:
     shuffle: bool = False
     storage_type: str = "text"
     num_minibatches_per_shard: int = 0
+    # streaming datasets: {partition -> starting offset}
+    partition_offsets: Dict = field(default_factory=dict)
 
 
 @message
@@ -257,6 +259,7 @@ class Task:
     shard_end: int = 0
     shard_indices: List[int] = field(default_factory=list)
     epoch: int = 0
+    partition: str = ""  # streaming datasets: source partition of the range
 
     @property
     def empty(self) -> bool:

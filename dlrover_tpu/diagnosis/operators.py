@@ -67,10 +67,19 @@ class CheckTrainingHangOperator(InferenceOperator):
     and the fleet has been silent for `silence_secs` of step reports."""
 
     def __init__(self, data_manager: DiagnosisDataManager, speed_monitor=None,
-                 silence_secs: float = 300.0):
+                 silence_secs=None):
         super().__init__(data_manager)
         self._speed_monitor = speed_monitor
-        self._silence_secs = silence_secs
+        # None → runtime-tunable global context value at check time
+        self._silence_secs_override = silence_secs
+
+    @property
+    def _silence_secs(self) -> float:
+        if self._silence_secs_override is not None:
+            return self._silence_secs_override
+        from dlrover_tpu.common.global_context import get_master_config
+
+        return get_master_config().seconds_hang_threshold
 
     def is_compatible(self, inference: Inference) -> bool:
         return inference == HANG_PROBLEM

@@ -209,6 +209,8 @@ class DistributedJobManager(JobManager):
             return False
         if not node.relaunchable:
             return False
+        if get_master_config().relaunch_always:
+            return True  # operator override: budget and reason ignored
         reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
         if reason == NodeExitReason.FATAL_ERROR:
             return False
@@ -326,7 +328,8 @@ class DistributedJobManager(JobManager):
     # -- periodic monitoring ------------------------------------------------
 
     def _monitor_loop(self):
-        while not self._stop_evt.wait(DefaultValues.SEC_MONITOR_INTERVAL):
+        # interval read per tick: runtime-tunable via the global context
+        while not self._stop_evt.wait(get_master_config().monitor_interval):
             try:
                 self._check_heartbeats()
             except Exception:

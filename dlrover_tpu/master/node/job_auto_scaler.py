@@ -31,17 +31,18 @@ class JobAutoScaler:
         scaler,
         speed_monitor=None,
         interval_secs: Optional[float] = None,
-        sample_after_steps: int = 10,
+        sample_after_steps: Optional[int] = None,
     ):
         self._optimizer = optimizer
         self._scaler = scaler
         self._speed_monitor = speed_monitor
         # None → read the runtime-mutable global context each cycle
         self._interval_override = interval_secs
-        self._sample_after_steps = sample_after_steps
+        self._sample_after_steps_override = sample_after_steps
         self._job_context = get_job_context()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._started_ts = 0.0
 
     @property
     def _interval(self) -> float:
@@ -50,12 +51,21 @@ class JobAutoScaler:
         return get_master_config().seconds_interval_to_optimize
 
     @property
+    def _sample_after_steps(self) -> int:
+        if self._sample_after_steps_override is not None:
+            return self._sample_after_steps_override
+        return get_master_config().sample_count_to_adjust_worker
+
+    @property
     def _autoscale_enabled(self) -> bool:
         return get_master_config().auto_worker_enabled
 
     # -- lifecycle ---------------------------------------------------------
 
     def start_auto_scaling(self):
+        import time
+
+        self._started_ts = time.time()
         self._stop_evt.clear()
         self._thread = threading.Thread(
             target=self._loop, name="job-auto-scaler", daemon=True
@@ -66,9 +76,14 @@ class JobAutoScaler:
         self._stop_evt.set()
 
     def _loop(self):
+        import time
+
         while not self._stop_evt.wait(self._interval):
             if not self._autoscale_enabled:
                 continue
+            warmup = get_master_config().seconds_to_autoscale_worker
+            if time.time() - self._started_ts < warmup:
+                continue  # let rendezvous + first steps settle first
             try:
                 self.optimize_once()
             except Exception:

@@ -8,8 +8,20 @@ ICI-adjacent and DCN hops only occur at slice boundaries.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
+
+_NUM_RE = re.compile(r"(\d+)")
+
+
+def _natural_key(name: str) -> Tuple:
+    """'slice-10' sorts after 'slice-2' (plain lexicographic would not),
+    so rank blocks follow the operator's slice numbering."""
+    return tuple(
+        int(tok) if tok.isdigit() else tok
+        for tok in _NUM_RE.split(name)
+    )
 
 
 @dataclass
@@ -36,7 +48,7 @@ class TpuTopologySorter:
         metas: List[NodeTopologyMeta] = list(nodes.values())
         metas.sort(
             key=lambda m: (
-                m.slice_name,
+                _natural_key(m.slice_name),
                 tuple(m.coords) if m.coords else (),
                 m.node_rank if m.node_rank >= 0 else m.node_id,
                 m.node_id,

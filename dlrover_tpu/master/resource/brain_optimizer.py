@@ -106,9 +106,12 @@ class BrainResourceOptimizer(ResourceOptimizer):
 
     def fetch_master_config(self) -> dict:
         """Tunable overrides for ``MasterConfigContext.seed_from_brain``
-        (brain ``master_config`` table; cluster defaults + per-job)."""
+        (brain ``master_config`` table; cluster defaults + per-job).
+        Best-effort and on the master's startup path: one attempt, short
+        timeout — a down brain must not stall rendezvous."""
         resp = self._client.get(
-            bmsg.BrainConfigRequest(job_name=self._job_name)
+            bmsg.BrainConfigRequest(job_name=self._job_name),
+            retries=1, timeout=3.0,
         )
         if isinstance(resp, bmsg.BrainConfigResponse) and resp.success:
             return resp.values

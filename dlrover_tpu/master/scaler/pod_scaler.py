@@ -34,6 +34,29 @@ LABEL_RANK_KEY = "elastic.dlrover-tpu.org/rank-index"
 LABEL_RELAUNCH_KEY = "elastic.dlrover-tpu.org/relaunch-count"
 
 
+def merge_container_env(pod_spec: Dict, env: List[Dict]) -> None:
+    """Append ``env`` entries to every container, never overriding an
+    existing name (user template wins). Shared by the master's PodScaler
+    and the operator's pod builders so the merge semantics cannot
+    diverge."""
+    for container in pod_spec.setdefault("containers", [{}]):
+        existing = {e.get("name") for e in container.get("env", [])}
+        container.setdefault("env", []).extend(
+            e for e in env if e["name"] not in existing
+        )
+
+
+def main_container_of(pod_spec: Dict) -> Dict:
+    """The training container: the one named "main"/"worker"/"master" if
+    present, else the first. Per-node resource overrides target only this
+    container — sidecars keep their template requests."""
+    containers = pod_spec.setdefault("containers", [{}])
+    for c in containers:
+        if c.get("name") in ("main", "worker", "master"):
+            return c
+    return containers[0]
+
+
 class PodScaler(Scaler):
     def __init__(
         self,
@@ -213,7 +236,7 @@ class PodScaler(Scaler):
         return meta
 
     def _inject_env(self, pod_spec: Dict, node: Node):
-        env = [
+        merge_container_env(pod_spec, [
             {"name": NodeEnv.JOB_NAME, "value": self._job_name},
             {"name": NodeEnv.MASTER_ADDR, "value": self._master_addr},
             {"name": NodeEnv.NODE_ID, "value": str(node.id)},
@@ -223,17 +246,14 @@ class PodScaler(Scaler):
                 "value": str(self._job_args.worker_spec.group.count),
             },
             {"name": NodeEnv.RESTART_COUNT, "value": str(node.relaunch_count)},
-        ]
-        for container in pod_spec.setdefault("containers", [{}]):
-            existing = {e.get("name") for e in container.get("env", [])}
-            container.setdefault("env", []).extend(
-                e for e in env if e["name"] not in existing
-            )
+        ])
 
     def _inject_resources(self, pod_spec: Dict, node: Node):
         """Node-specific resource overrides (e.g. the OOM-relaunch memory
         bump, dist_job_manager._bump_oom_memory) take precedence over the
-        template's requests — reference pod_scaler.py per-node resources."""
+        template's requests — reference pod_scaler.py per-node resources.
+        Applied to the main container only: bumping a sidecar's request
+        too would inflate the pod's aggregate and risk unschedulability."""
         res = node.config_resource
         overrides: Dict[str, str] = {}
         if res.memory_mb:
@@ -242,14 +262,14 @@ class PodScaler(Scaler):
             overrides["cpu"] = str(res.cpu)
         if not overrides:
             return
-        for container in pod_spec.setdefault("containers", [{}]):
-            requests = container.setdefault("resources", {}).setdefault(
-                "requests", {}
-            )
-            requests.update(overrides)
-            limits = container["resources"].get("limits")
-            if limits is not None:
-                limits.update(overrides)
+        container = main_container_of(pod_spec)
+        requests = container.setdefault("resources", {}).setdefault(
+            "requests", {}
+        )
+        requests.update(overrides)
+        limits = container["resources"].get("limits")
+        if limits is not None:
+            limits.update(overrides)
 
     # -- master service -----------------------------------------------------
 

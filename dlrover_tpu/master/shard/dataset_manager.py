@@ -27,13 +27,20 @@ class DoingTask:
 
 @dataclass
 class DatasetShardCheckpoint:
-    """Resumable sharding state: epoch + undone shard ranges."""
+    """Resumable sharding state: epoch + undone shard ranges.
+
+    Batch datasets store ``[start, end]`` ranges; streaming datasets store
+    ``[partition, start, end]`` plus the per-partition consumed offsets so
+    a restored master resumes the stream exactly where it stopped
+    (reference ``streaming_dataset_manager.py:32`` + its
+    ``checkpoint``/``restore_checkpoint``)."""
 
     dataset_name: str = ""
     todo: List = field(default_factory=list)  # [[start, end], ...]
     doing: List = field(default_factory=list)
     epoch: int = 0
     completed_records: int = 0
+    partition_offsets: Dict = field(default_factory=dict)  # streaming only
 
     def to_json(self) -> str:
         return json.dumps(
@@ -43,6 +50,7 @@ class DatasetShardCheckpoint:
                 "doing": self.doing,
                 "epoch": self.epoch,
                 "completed_records": self.completed_records,
+                "partition_offsets": self.partition_offsets,
             }
         )
 
@@ -55,6 +63,7 @@ class DatasetShardCheckpoint:
             doing=d.get("doing", []),
             epoch=d.get("epoch", 0),
             completed_records=d.get("completed_records", 0),
+            partition_offsets=d.get("partition_offsets", {}),
         )
 
 
@@ -186,6 +195,76 @@ class BatchDatasetManager:
                     dataset_name=self.dataset_name,
                     shard_start=start,
                     shard_end=end,
+                    epoch=ckpt.epoch,
+                )
+                self._task_id_seq += 1
+                self._todo.append(task)
+
+
+class StreamingDatasetManager(BatchDatasetManager):
+    """Task dispatch over an unbounded stream of (partition, offset-range)
+    shards.
+
+    Parity: reference ``master/shard/streaming_dataset_manager.py:32``.
+    Differences from batch: tasks carry their source partition; the
+    splitter mints new offset ranges on demand forever (``completed()`` is
+    never True); the checkpoint persists the per-partition consumed
+    offsets *minus* undone work, so a master restart re-dispatches exactly
+    the unfinished ranges and then continues the stream."""
+
+    def __init__(self, task_type: str, splitter):
+        super().__init__(task_type, splitter)
+
+    def _create_tasks_from_shards(self, shards: List[Shard], epoch: int):
+        for shard in shards:
+            task = Task(
+                task_id=self._task_id_seq,
+                task_type=self.task_type,
+                dataset_name=self._splitter.dataset_name,
+                shard_start=shard.start,
+                shard_end=shard.end,
+                partition=shard.name,
+                epoch=epoch,
+            )
+            self._task_id_seq += 1
+            self._todo.append(task)
+
+    def completed(self) -> bool:
+        return False  # streams are unbounded
+
+    # -- checkpoint -------------------------------------------------------
+
+    def checkpoint(self) -> DatasetShardCheckpoint:
+        with self._lock:
+            return DatasetShardCheckpoint(
+                dataset_name=self.dataset_name,
+                todo=[
+                    [t.partition, t.shard_start, t.shard_end]
+                    for t in self._todo
+                ],
+                doing=[
+                    [d.task.partition, d.task.shard_start, d.task.shard_end]
+                    for d in self._doing.values()
+                ],
+                epoch=self._splitter.epoch,
+                completed_records=self._completed_records,
+                partition_offsets=self._splitter.offsets,
+            )
+
+    def restore_checkpoint(self, ckpt: DatasetShardCheckpoint):
+        with self._lock:
+            self._todo.clear()
+            self._doing.clear()
+            self._completed_records = ckpt.completed_records
+            self._splitter.reset_offsets(ckpt.partition_offsets)
+            for partition, start, end in list(ckpt.doing) + list(ckpt.todo):
+                task = Task(
+                    task_id=self._task_id_seq,
+                    task_type=self.task_type,
+                    dataset_name=self.dataset_name,
+                    shard_start=start,
+                    shard_end=end,
+                    partition=str(partition),
                     epoch=ckpt.epoch,
                 )
                 self._task_id_seq += 1

@@ -151,6 +151,16 @@ class StreamingDatasetSplitter(DatasetSplitter):
     def epoch_finished(self) -> bool:
         return False
 
+    @property
+    def offsets(self) -> dict:
+        """Current consumed offset per partition (checkpoint surface)."""
+        return dict(self._offsets.partition_offsets)
+
+    def reset_offsets(self, offsets: dict):
+        """Restore consumed offsets (checkpoint restore)."""
+        self._offsets = PartitionOffsets(offsets)
+        self._shards = []
+
 
 def new_dataset_splitter(
     splitter_type: str,

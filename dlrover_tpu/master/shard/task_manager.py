@@ -17,6 +17,7 @@ from dlrover_tpu.common.messages import DatasetShardParams, Task
 from dlrover_tpu.master.shard.dataset_manager import (
     BatchDatasetManager,
     DatasetShardCheckpoint,
+    StreamingDatasetManager,
 )
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
 
@@ -42,9 +43,15 @@ class TaskManager:
                 params.shard_size,
                 params.num_epochs,
                 params.shuffle,
+                partition_offsets=params.partition_offsets or None,
             )
             task_type = "eval" if "eval" in params.dataset_name else "train"
-            self._datasets[params.dataset_name] = BatchDatasetManager(
+            manager_cls = (
+                StreamingDatasetManager
+                if params.storage_type == "streaming"
+                else BatchDatasetManager
+            )
+            self._datasets[params.dataset_name] = manager_cls(
                 task_type, splitter
             )
             logger.info(

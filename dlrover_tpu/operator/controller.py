@@ -43,6 +43,7 @@ from dlrover_tpu.master.scaler.pod_scaler import (
     LABEL_RANK_KEY,
     LABEL_RELAUNCH_KEY,
     LABEL_TYPE_KEY,
+    merge_container_env,
 )
 from dlrover_tpu.scheduler.k8s_client import (
     ELASTICJOB_PLURAL,
@@ -218,20 +219,27 @@ class ElasticJobController:
             return
 
         master = self._get_master_pod(job_name)
+        mutated = False
         if master is None:
             # first creation OR the master vanished (deleted/evicted):
             # HandleFaultPods semantics — master.go:139
             self._ensure_master(job, index=self._next_master_index(job))
+            mutated = True
         else:
             mphase = master.get("status", {}).get("phase", "")
             if mphase == "Failed":
                 self._handle_failed_master(job, master)
+                mutated = True
             elif master.get("metadata", {}).get("deletionTimestamp"):
                 idx = self._pod_index(master)
                 self._ensure_master(job, index=idx + 1)
+                mutated = True
 
         self._apply_pending_scaleplans(job)
-        self._sync_job_state(job)
+        # re-list only when this pass changed the master pod set
+        self._sync_job_state(
+            job, master=None if mutated else master
+        )
 
     # -- init / status ---------------------------------------------------
 
@@ -267,10 +275,13 @@ class ElasticJobController:
             status.setdefault("completionTime", _now_iso())
         self._patch_status(job)
 
-    def _sync_job_state(self, job: Dict):
-        """Job phase follows the master pod phase (master.go:104-139)."""
+    def _sync_job_state(self, job: Dict, master: Optional[Dict] = None):
+        """Job phase follows the master pod phase (master.go:104-139).
+        ``master`` is the pod reconcile_once already fetched; None forces a
+        re-list (after this pass mutated the pod set)."""
         name = job["metadata"]["name"]
-        master = self._get_master_pod(name)
+        if master is None:
+            master = self._get_master_pod(name)
         if master is None:
             return
         mphase = master.get("status", {}).get("phase", "")
@@ -381,17 +392,12 @@ class ElasticJobController:
             }],
         }
         pod_spec.setdefault("restartPolicy", "Never")
-        env = [
+        merge_container_env(pod_spec, [
             {"name": NodeEnv.JOB_NAME, "value": job_name},
             {"name": "POD_NAMESPACE", "value": self._client.namespace},
             {"name": "JOB_UID",
              "value": job.get("metadata", {}).get("uid", "")},
-        ]
-        for container in pod_spec.setdefault("containers", [{}]):
-            existing = {e.get("name") for e in container.get("env", [])}
-            container.setdefault("env", []).extend(
-                e for e in env if e["name"] not in existing
-            )
+        ])
         meta = copy.deepcopy(template.get("metadata", {}))
         labels = meta.setdefault("labels", {})
         labels.update({
@@ -500,17 +506,12 @@ class ElasticJobController:
             f"{master_service_name(job_name)}."
             f"{self._client.namespace}:{_MASTER_PORT}"
         )
-        env = [
+        merge_container_env(pod_spec, [
             {"name": NodeEnv.JOB_NAME, "value": job_name},
             {"name": NodeEnv.MASTER_ADDR, "value": master_addr},
             {"name": NodeEnv.NODE_ID, "value": str(node_id)},
             {"name": NodeEnv.NODE_RANK, "value": str(rank)},
-        ]
-        for container in pod_spec.setdefault("containers", [{}]):
-            existing = {e.get("name") for e in container.get("env", [])}
-            container.setdefault("env", []).extend(
-                e for e in env if e["name"] not in existing
-            )
+        ])
         meta = copy.deepcopy(template.get("metadata", {}))
         labels = meta.setdefault("labels", {})
         labels.update({

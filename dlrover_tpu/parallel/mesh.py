@@ -109,14 +109,27 @@ class MeshConfig:
 def build_mesh(
     config: MeshConfig,
     devices: Optional[Sequence[jax.Device]] = None,
+    n_slices: int = 1,
 ) -> Mesh:
     """Build the Mesh. Uses `mesh_utils.create_device_mesh` when the whole
     process's device set is used (it knows TPU torus topology); falls back
-    to a plain reshape for explicit device subsets."""
+    to a plain reshape for explicit device subsets.
+
+    ``n_slices > 1`` builds a **multislice** mesh: the outermost slab of
+    the ``dp`` axis spans slices, so only pure-data-parallel gradient
+    reductions cross DCN while every other collective (fsdp gathers, tp/sp/
+    ep) stays on a single slice's ICI — the layout
+    ``mesh_utils.create_hybrid_device_mesh`` produces on real multislice
+    TPU, reproduced manually for virtual/partial device sets. Devices are
+    grouped by their ``slice_index`` attribute when present (real TPU
+    multislice), else split into ``n_slices`` equal contiguous chunks
+    (CPU dryruns)."""
     if devices is None:
         devices = jax.devices()
     config = config.resolve(len(devices))
     shape = tuple(config.shape()[a] for a in AXIS_ORDER)
+    if n_slices > 1:
+        return _build_multislice_mesh(config, list(devices), n_slices)
     try:
         from jax.experimental import mesh_utils
 
@@ -127,6 +140,61 @@ def build_mesh(
     except Exception:
         arr = np.array(list(devices)).reshape(shape)
     return Mesh(arr, AXIS_ORDER)
+
+
+def _build_multislice_mesh(
+    config: MeshConfig, devices: list, n_slices: int
+) -> Mesh:
+    n = len(devices)
+    if n % n_slices:
+        raise ValueError(f"{n} devices not divisible by {n_slices} slices")
+    per_slice = n // n_slices
+    if config.dp % n_slices:
+        raise ValueError(
+            f"dp={config.dp} must be divisible by n_slices={n_slices}: dp is "
+            "the only axis allowed to span DCN (fsdp/ep/sp/tp collectives "
+            "must stay on one slice's ICI)"
+        )
+    within = (config.dp // n_slices) * config.fsdp * config.ep \
+        * config.sp * config.tp
+    if within != per_slice:
+        raise ValueError(
+            f"per-slice mesh ({within}) != devices per slice ({per_slice})"
+        )
+    # group by hardware slice when the runtime exposes it
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) == n_slices:
+        ordered = sorted(
+            devices, key=lambda d: (d.slice_index, getattr(d, "id", 0))
+        )
+    else:
+        ordered = list(devices)  # contiguous chunks = virtual slices
+    try:
+        from jax.experimental import mesh_utils
+
+        if None not in slice_ids and len(slice_ids) == n_slices:
+            ici = (config.dp // n_slices, config.fsdp, config.ep,
+                   config.sp, config.tp)
+            dcn = (n_slices, 1, 1, 1, 1)
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici, dcn, devices=ordered
+            )
+            return Mesh(arr, AXIS_ORDER)
+    except Exception:
+        pass
+    # manual hybrid layout: slice-major over the outer dp slab, so
+    # mesh[d, ...] with d // (dp/n_slices) selecting the slice
+    arr = np.array(ordered).reshape(
+        (n_slices, config.dp // n_slices, config.fsdp, config.ep,
+         config.sp, config.tp)
+    ).reshape(tuple(config.shape()[a] for a in AXIS_ORDER))
+    return Mesh(arr, AXIS_ORDER)
+
+
+def mesh_slice_of(mesh: Mesh, n_slices: int, dp_index: int) -> int:
+    """Which slice a given dp-axis index lives on (slice-major layout)."""
+    per = mesh.shape[DP] // n_slices
+    return dp_index // per
 
 
 def remesh(config: MeshConfig, n_devices: int) -> MeshConfig:

@@ -6,3 +6,8 @@ from dlrover_tpu.profiler.tpu_timer import (  # noqa: F401
     native_build_dir,
     scrape_metrics,
 )
+from dlrover_tpu.profiler.hang_dump import (  # noqa: F401
+    HangDumper,
+    install_stack_dump_handler,
+)
+from dlrover_tpu.profiler.py_tracing import PyTracer, py_tracer  # noqa: F401

@@ -1,0 +1,113 @@
+"""Python-side tracing: GC pauses + user spans into a chrome-trace ring.
+
+Parity: reference ``xpu_timer/python/py_tracing_manager.cc`` +
+``py_tracing_loader`` — it intercepts CPython functions (GC, dataloader
+fetch) and merges their spans into the kernel timeline. TPU-natively the
+device timeline comes from the PJRT interposer; this module supplies the
+host-side spans that explain gaps in it:
+
+- **GC pauses** via ``gc.callbacks`` (a stop-the-world pause during a
+  training step is a classic straggler cause);
+- **user spans** (``with py_tracer.span("dataloader.next")``) for input
+  pipeline / host preprocessing;
+
+both recorded into a bounded ring and exportable as chrome-trace JSON that
+can be merged with the interposer's ``/timeline`` dump (same clock basis:
+``time.monotonic``)."""
+
+from __future__ import annotations
+
+import contextlib
+import gc
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class PyTracer:
+    """Process-wide host-span recorder (bounded ring, thread-safe)."""
+
+    def __init__(self, capacity: int = 100_000):
+        self._events: List[Dict] = []
+        self._cap = capacity
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._gc_start: Optional[float] = None
+        self._gc_installed = False
+        self._enabled = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        self._enabled = True
+        if not self._gc_installed:
+            gc.callbacks.append(self._on_gc)
+            self._gc_installed = True
+
+    def stop(self):
+        self._enabled = False
+        if self._gc_installed:
+            try:
+                gc.callbacks.remove(self._on_gc)
+            except ValueError:
+                pass
+            self._gc_installed = False
+
+    # -- recording -----------------------------------------------------
+
+    def _now_us(self) -> int:
+        return int((time.monotonic() - self._t0) * 1e6)
+
+    def _record(self, name: str, cat: str, start_us: int, dur_us: int):
+        ev = {
+            "name": name, "cat": cat, "ph": "X",
+            "ts": start_us, "dur": dur_us,
+            "pid": 1, "tid": threading.get_ident() % 100000,
+        }
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > self._cap:
+                del self._events[: len(self._events) // 2]
+
+    def _on_gc(self, phase: str, info: Dict):
+        if not self._enabled:
+            return
+        if phase == "start":
+            self._gc_start = self._now_us()
+        elif phase == "stop" and self._gc_start is not None:
+            start = self._gc_start
+            self._gc_start = None
+            self._record(
+                f"gc.collect(gen{info.get('generation', '?')})",
+                "gc", start, self._now_us() - start,
+            )
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "host"):
+        """``with py_tracer.span("dataloader.next"): ...``"""
+        if not self._enabled:
+            yield
+            return
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            self._record(name, cat, start, self._now_us() - start)
+
+    # -- export --------------------------------------------------------
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self) -> str:
+        return json.dumps({"traceEvents": self.events()})
+
+    def dump(self, path: str):
+        with open(path, "w") as f:
+            f.write(self.chrome_trace())
+
+
+#: process singleton, mirroring the interposer's per-process TimerManager
+py_tracer = PyTracer()

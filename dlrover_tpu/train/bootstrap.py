@@ -113,6 +113,23 @@ def init(
         return _context
     env = WorkerEnv.from_env()
 
+    # hang diagnosis: register the SIGUSR2 all-thread stack dumper the
+    # agent's HangDumper triggers (profiler/hang_dump.py)
+    stack_dir = os.environ.get("DLROVER_TPU_STACK_DIR", "")
+    if stack_dir:
+        try:
+            from dlrover_tpu.profiler.hang_dump import (
+                install_stack_dump_handler,
+            )
+
+            install_stack_dump_handler(stack_dir)
+        except Exception:
+            logger.exception("stack-dump handler install failed; continuing")
+    if os.environ.get("DLROVER_TPU_PY_TRACING", "") == "1":
+        from dlrover_tpu.profiler.py_tracing import py_tracer
+
+        py_tracer.start()  # GC pauses + user spans into the host timeline
+
     import jax
 
     if env.accelerator == "cpu":

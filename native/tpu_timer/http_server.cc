@@ -81,10 +81,20 @@ void MetricsHttpServer::Serve() {
         Respond(fd, "text/plain", mgr.PrometheusText());
       else if (strstr(buf, "GET /timeline"))
         Respond(fd, "application/json", mgr.TimelineJson());
-      else if (strstr(buf, "GET /healthz"))
+      else if (strstr(buf, "GET /pending"))
+        Respond(fd, "application/json", mgr.PendingJson());
+      else if (strstr(buf, " /trace/start")) {  // GET or POST
+        mgr.StartTrace();
+        Respond(fd, "application/json", "{\"tracing\":true}");
+      } else if (strstr(buf, " /trace/stop")) {
+        mgr.StopTrace();
+        Respond(fd, "application/json", "{\"tracing\":false}");
+      } else if (strstr(buf, "GET /healthz"))
         Respond(fd, "text/plain", "ok\n");
       else
-        Respond(fd, "text/plain", "dlrover_tpu_timer: /metrics /timeline\n");
+        Respond(fd, "text/plain",
+                "dlrover_tpu_timer: /metrics /timeline /pending "
+                "/trace/start /trace/stop\n");
     }
     close(fd);
   }

@@ -39,8 +39,10 @@ void TimerManager::RecordCompile(const std::string& name, int64_t dur_us) {
   s.count++;
   s.total_us += dur_us;
   if ((uint64_t)dur_us > s.max_us) s.max_us = dur_us;
-  trace_.push_back({name, "compile", NowUs() - dur_us, dur_us});
-  if (trace_.size() > trace_cap_) trace_.pop_front();
+  if (tracing_.load()) {
+    trace_.push_back({name, "compile", NowUs() - dur_us, dur_us});
+    if (trace_.size() > trace_cap_) trace_.pop_front();
+  }
 }
 
 uint64_t TimerManager::BeginExecute(const std::string& name) {
@@ -60,8 +62,10 @@ void TimerManager::EndExecute(uint64_t token, bool error) {
   s.total_us += dur;
   if ((uint64_t)dur > s.max_us) s.max_us = dur;
   if (error) s.errors++;
-  trace_.push_back({it->second.name, "execute", it->second.start_us, dur});
-  if (trace_.size() > trace_cap_) trace_.pop_front();
+  if (tracing_.load()) {
+    trace_.push_back({it->second.name, "execute", it->second.start_us, dur});
+    if (trace_.size() > trace_cap_) trace_.pop_front();
+  }
   pending_.erase(it);
   if (pending_.empty()) hang_ = false;
 }
@@ -159,6 +163,31 @@ static void JsonEscape(std::ostringstream& out, const std::string& s) {
       out << c;
   }
 }
+
+std::string TimerManager::PendingJson() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::ostringstream out;
+  int64_t now = NowUs();
+  out << "{\"hang\":" << (hang_ ? "true" : "false") << ",\"pending\":[";
+  bool first = true;
+  for (const auto& kv : pending_) {
+    if (!first) out << ",";
+    first = false;
+    out << "{\"name\":\"";
+    JsonEscape(out, kv.second.name);
+    out << "\",\"age_us\":" << (now - kv.second.start_us) << "}";
+  }
+  out << "]}";
+  return out.str();
+}
+
+void TimerManager::StartTrace() {
+  std::lock_guard<std::mutex> lock(mu_);
+  trace_.clear();
+  tracing_ = true;
+}
+
+void TimerManager::StopTrace() { tracing_ = false; }
 
 std::string TimerManager::TimelineJson() {
   // Chrome trace-event format; loadable in Perfetto (reference

@@ -52,6 +52,16 @@ class TimerManager {
   int64_t OldestPendingUs();
   std::string PrometheusText();
   std::string TimelineJson();
+  // Pending executions as JSON [{"name":..., "age_us":...}] — the hang
+  // dump's "which kernels are stuck" list (reference printHangName,
+  // manager.cc:454-464).
+  std::string PendingJson();
+  // Management surface (reference hosting_service StartDump/StopDump,
+  // server/hosting_service_server_client.h:40-242): toggle trace-event
+  // collection at runtime; Start clears the ring.
+  void StartTrace();
+  void StopTrace();
+  bool Tracing() const { return tracing_.load(); }
 
   int64_t NowUs() const;
 
@@ -78,6 +88,7 @@ class TimerManager {
   size_t trace_cap_ = 100000;
 
   std::atomic<bool> hang_{false};
+  std::atomic<bool> tracing_{true};
   std::atomic<int64_t> hang_timeout_us_;
   std::atomic<bool> stop_{false};
   int64_t t0_ns_;

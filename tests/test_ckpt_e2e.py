@@ -41,6 +41,8 @@ def test_crash_resume_from_flash_checkpoint(tmp_path):
     log_dir = "/tmp/dlrover_tpu_logs/e2e-ckpt/node-0"
     logs = ""
     for f in sorted(os.listdir(log_dir)):
+        if os.path.isdir(os.path.join(log_dir, f)):
+            continue  # e.g. hang/ stack-dump dir
         logs += open(os.path.join(log_dir, f), errors="replace").read()
     assert r.returncode == 0, f"stderr:\n{r.stderr[-2000:]}\nworker:\n{logs[-2000:]}"
     assert "injected crash at step 7" in logs

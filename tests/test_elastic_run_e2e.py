@@ -36,6 +36,8 @@ def _agent_logs(job_name, node_id=0):
     out = ""
     if os.path.isdir(log_dir):
         for f in sorted(os.listdir(log_dir)):
+            if os.path.isdir(os.path.join(log_dir, f)):
+                continue  # e.g. hang/ stack-dump dir
             out += open(os.path.join(log_dir, f), errors="replace").read()
     return out
 

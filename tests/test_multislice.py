@@ -1,0 +1,148 @@
+"""Multislice/DCN awareness: hybrid mesh layout (dp across slices, every
+other axis within a slice's ICI) and slice-aware rendezvous rank order
+(reference net_topology.py:22-79 sorts DP rings under one access switch;
+the TPU analogue keeps rank blocks slice-contiguous so DCN hops only occur
+at slice boundaries)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from dlrover_tpu.master.rendezvous.manager import (
+    ElasticTrainingRendezvousManager,
+)
+from dlrover_tpu.master.rendezvous.net_topology import (
+    NodeTopologyMeta,
+    TpuTopologySorter,
+)
+from dlrover_tpu.parallel.mesh import MeshConfig, build_mesh, mesh_slice_of
+
+
+# -- mesh -------------------------------------------------------------------
+
+def test_multislice_mesh_dp_is_slice_major():
+    devices = jax.devices()[:8]
+    mc = MeshConfig(dp=4, fsdp=1, ep=1, sp=1, tp=2)
+    mesh = build_mesh(mc, devices=devices, n_slices=2)
+    grid = mesh.devices  # (dp, fsdp, ep, sp, tp)
+    # dp indices 0-1 = slice 0 (device ids 0-3), 2-3 = slice 1 (ids 4-7)
+    assert {d.id for d in grid[:2].flat} == {0, 1, 2, 3}
+    assert {d.id for d in grid[2:].flat} == {4, 5, 6, 7}
+    assert mesh_slice_of(mesh, 2, 0) == 0
+    assert mesh_slice_of(mesh, 2, 1) == 0
+    assert mesh_slice_of(mesh, 2, 3) == 1
+    # tp pairs never straddle a slice
+    for d in range(4):
+        tp_ids = {dev.id for dev in grid[d, 0, 0, 0]}
+        assert all(i < 4 for i in tp_ids) or all(i >= 4 for i in tp_ids)
+
+
+def test_multislice_rejects_non_dp_dcn_axes():
+    devices = jax.devices()[:8]
+    # fsdp=4 with 2 slices of 4 devices: fsdp would have to straddle DCN
+    with pytest.raises(ValueError, match="dp"):
+        build_mesh(
+            MeshConfig(dp=1, fsdp=4, ep=1, sp=1, tp=2),
+            devices=devices, n_slices=2,
+        )
+
+
+def test_multislice_rejects_uneven_devices():
+    with pytest.raises(ValueError, match="slices"):
+        build_mesh(
+            MeshConfig(dp=6, fsdp=1, ep=1, sp=1, tp=1),
+            devices=jax.devices()[:6], n_slices=4,
+        )
+
+
+def test_multislice_psum_crosses_dcn_axis():
+    """A dp-psum over the 2-slice mesh must produce the global sum — the
+    collective path that rides DCN in production."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    mesh = build_mesh(
+        MeshConfig(dp=4, fsdp=1, ep=1, sp=1, tp=2),
+        devices=jax.devices()[:8], n_slices=2,
+    )
+    x = jax.device_put(
+        np.arange(8, dtype=np.float32).reshape(4, 2),
+        NamedSharding(mesh, P("dp", "tp")),
+    )
+    summed = shard_map(
+        lambda v: jax.lax.psum(v, "dp"),
+        mesh=mesh, in_specs=P("dp", "tp"), out_specs=P(None, "tp"),
+    )(x)
+    np.testing.assert_allclose(
+        np.asarray(summed)[0], np.arange(8, dtype=np.float32)
+        .reshape(4, 2).sum(0)
+    )
+
+
+# -- rendezvous rank order --------------------------------------------------
+
+def _meta(node_id, slice_name, coords=(), rank=-1):
+    return NodeTopologyMeta(
+        node_id=node_id, node_rank=rank, slice_name=slice_name,
+        coords=coords,
+    )
+
+
+def test_sorter_blocks_are_slice_contiguous():
+    """Interleaved joins from 3 slices: each slice's hosts must get one
+    contiguous rank block, torus-ordered inside it."""
+    nodes = {
+        0: _meta(0, "slice-1", (1, 0)),
+        1: _meta(1, "slice-0", (0, 1)),
+        2: _meta(2, "slice-2", (0, 0)),
+        3: _meta(3, "slice-0", (0, 0)),
+        4: _meta(4, "slice-1", (0, 0)),
+        5: _meta(5, "slice-2", (1, 0)),
+    }
+    ranked = TpuTopologySorter().sort(nodes)
+    slices_in_rank_order = [ranked[r].slice_name for r in sorted(ranked)]
+    assert slices_in_rank_order == [
+        "slice-0", "slice-0", "slice-1", "slice-1", "slice-2", "slice-2",
+    ]
+    # torus order within the slice block
+    assert ranked[0].coords == (0, 0) and ranked[1].coords == (0, 1)
+
+
+def test_sorter_natural_slice_numbering():
+    """'slice-10' must rank after 'slice-2' (lexicographic would not)."""
+    nodes = {
+        0: _meta(0, "slice-10"),
+        1: _meta(1, "slice-2"),
+        2: _meta(2, "slice-1"),
+    }
+    ranked = TpuTopologySorter().sort(nodes)
+    assert [ranked[r].slice_name for r in sorted(ranked)] == [
+        "slice-1", "slice-2", "slice-10",
+    ]
+
+
+def test_rendezvous_world_is_slice_contiguous():
+    """End to end through the rendezvous manager: interleaved joins from
+    two slices → the completed world's rank order is slice-blocked."""
+    mgr = ElasticTrainingRendezvousManager()
+    mgr.update_rdzv_params(4, 4, 0.1, 1)
+    join_order = [
+        (0, "slice-b", (0, 1)),
+        (1, "slice-a", (0, 1)),
+        (2, "slice-b", (0, 0)),
+        (3, "slice-a", (0, 0)),
+    ]
+    for node_id, slice_name, coords in join_order:
+        mgr.join_rendezvous(
+            node_id, node_id,
+            _meta(node_id, slice_name, coords, rank=node_id),
+        )
+    _rnd, _grp, world, _coord = mgr.get_comm_world(0)
+    assert world, "rendezvous should complete at max_nodes"
+    ordered = [world[r] for r in sorted(world)]
+    assert [m.slice_name for m in ordered] == [
+        "slice-a", "slice-a", "slice-b", "slice-b",
+    ]
+    assert [m.node_id for m in ordered] == [3, 1, 2, 0]

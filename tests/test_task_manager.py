@@ -52,3 +52,71 @@ def test_dead_node_tasks_reassigned():
 
 def test_empty_registry_not_finished():
     assert not TaskManager().finished()
+
+
+# -- streaming datasets (reference streaming_dataset_manager.py:32) ---------
+
+def _stream_params(name="stream", shard=10, offsets=None):
+    return DatasetShardParams(
+        dataset_name=name, shard_size=shard, storage_type="streaming",
+        partition_offsets=offsets or {"p0": 0, "p1": 100},
+    )
+
+
+def test_streaming_dispatch_advances_offsets_forever():
+    tm = TaskManager()
+    tm.new_dataset(_stream_params())
+    seen = []
+    for _ in range(6):  # 3 create_shards rounds x 2 partitions
+        t = tm.get_dataset_task(0, "stream")
+        assert not t.empty
+        seen.append((t.partition, t.shard_start, t.shard_end))
+        tm.report_dataset_task("stream", t.task_id, success=True)
+    assert ("p0", 0, 10) in seen and ("p0", 10, 20) in seen
+    assert ("p1", 100, 110) in seen and ("p1", 110, 120) in seen
+    assert not tm.finished()  # streams never finish
+
+
+def test_streaming_failed_task_redispatched_same_range():
+    tm = TaskManager()
+    tm.new_dataset(_stream_params(offsets={"p0": 0}))
+    t = tm.get_dataset_task(0, "stream")
+    tm.report_dataset_task("stream", t.task_id, success=False)
+    t2 = tm.get_dataset_task(0, "stream")
+    assert (t2.partition, t2.shard_start, t2.shard_end) == (
+        t.partition, t.shard_start, t.shard_end,
+    )
+
+
+def test_streaming_dead_node_tasks_reassigned():
+    tm = TaskManager()
+    tm.new_dataset(_stream_params(offsets={"p0": 0, "p1": 0}))
+    t_dead = tm.get_dataset_task(node_id=7, dataset_name="stream")
+    tm.remove_node_tasks(7)
+    t_new = tm.get_dataset_task(node_id=1, dataset_name="stream")
+    assert (t_new.partition, t_new.shard_start) == (
+        t_dead.partition, t_dead.shard_start,
+    )
+
+
+def test_streaming_checkpoint_restore_resumes_offsets():
+    """Master restart: consumed offsets + undone ranges survive; the
+    in-flight ('doing') range is re-dispatched, then fresh ranges continue
+    from the checkpointed high-water mark."""
+    tm = TaskManager()
+    tm.new_dataset(_stream_params(offsets={"p0": 0}, shard=10))
+    t1 = tm.get_dataset_task(0, "stream")           # p0 [0,10) -> done
+    tm.report_dataset_task("stream", t1.task_id, success=True)
+    t2 = tm.get_dataset_task(0, "stream")           # p0 [10,20) in flight
+    ckpt = tm.checkpoint_dataset("stream")
+    payload = ckpt.to_json()
+
+    tm2 = TaskManager()
+    tm2.new_dataset(_stream_params(offsets={"p0": 0}, shard=10))
+    assert tm2.restore_dataset_checkpoint(payload)
+    r1 = tm2.get_dataset_task(0, "stream")
+    assert (r1.partition, r1.shard_start, r1.shard_end) == ("p0", 10, 20)
+    r2 = tm2.get_dataset_task(0, "stream")          # new range, after ckpt
+    assert (r2.partition, r2.shard_start, r2.shard_end) == ("p0", 20, 30)
+    assert tm2.completed_records("stream") == 10
+    _ = t2

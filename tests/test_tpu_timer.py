@@ -133,3 +133,173 @@ def test_scrape_metrics_and_diagnosis_source(native):
 
 def test_scrape_metrics_absent_endpoint_returns_empty():
     assert scrape_metrics(find_free_port()) == {}
+
+
+def test_trace_mgmt_and_pending_endpoints(native):
+    """Tier-2 mgmt surface (reference hosting_service
+    server_client.h:40-242): /trace/stop halts timeline collection,
+    /trace/start clears + resumes; /pending lists stuck executions."""
+    import time
+    import urllib.request
+
+    port = find_free_port()
+    env = dict(os.environ)
+    env.update({
+        "DLROVER_TPU_TIMER_REAL_PLUGIN": native["mock"],
+        "DLROVER_TPU_TIMER_PORT": str(port),
+        "MOCK_PJRT_EXEC_US": "1000",
+        "MOCK_PJRT_HANG": "1",
+        "DLROVER_TPU_TIMER_HANG_SECS": "1",
+    })
+    proc = subprocess.Popen(
+        [native["harness"], native["interposer"], "2", "4000"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+    def get(path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=2
+        ) as r:
+            return r.read().decode()
+
+    try:
+        deadline = time.time() + 10
+        pending = {}
+        while time.time() < deadline:
+            try:
+                pending = json.loads(get("/pending"))
+                if pending.get("hang"):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        assert pending.get("hang") is True
+        names = {p["name"] for p in pending["pending"]}
+        assert names == {"mock_program"}
+        assert all(p["age_us"] > 1_000_000 for p in pending["pending"])
+
+        # mgmt: stop -> timeline frozen; start -> ring cleared
+        assert json.loads(get("/trace/stop")) == {"tracing": False}
+        assert json.loads(get("/trace/start")) == {"tracing": True}
+        trace = json.loads(get("/timeline"))
+        assert trace["traceEvents"] == []  # cleared by start
+    finally:
+        proc.wait(timeout=30)
+
+
+def test_hang_dump_reports_stacks_and_pending(native, tmp_path):
+    """Forced hang end to end: the DiagnosisAgent sees hang=1 from the
+    interposer metrics, triggers the HangDumper, and ships a
+    HangDumpRecord containing every worker's Python stack and each rank's
+    pending-program list (reference manager.cc:393-414,454-464)."""
+    import sys
+    import time
+
+    from dlrover_tpu.agent.diagnosis_agent import DiagnosisAgent
+    from dlrover_tpu.profiler.hang_dump import HangDumper
+
+    port = find_free_port()
+    stack_dir = str(tmp_path / "hang")
+
+    # hung "device": mock plugin never completes its executions
+    env = dict(os.environ)
+    env.update({
+        "DLROVER_TPU_TIMER_REAL_PLUGIN": native["mock"],
+        "DLROVER_TPU_TIMER_PORT": str(port),
+        "MOCK_PJRT_HANG": "1",
+        "DLROVER_TPU_TIMER_HANG_SECS": "1",
+    })
+    device = subprocess.Popen(
+        [native["harness"], native["interposer"], "2", "8000"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    # hung "worker": installs the SIGUSR2 handler, then blocks in sleep
+    worker = subprocess.Popen([
+        sys.executable, "-c",
+        "import os, time\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from dlrover_tpu.profiler.hang_dump import install_stack_dump_handler\n"
+        f"install_stack_dump_handler({stack_dir!r})\n"
+        "print('READY', flush=True)\n"
+        "def stuck_in_allreduce():\n"
+        "    time.sleep(60)\n"
+        "stuck_in_allreduce()\n",
+    ], stdout=subprocess.PIPE, text=True)
+
+    class FakeClient:
+        def __init__(self):
+            self.records = []
+
+        def report_diagnosis_data(self, kind, payload):
+            self.records.append((kind, payload))
+
+    client = FakeClient()
+    try:
+        assert worker.stdout.readline().strip() == "READY"
+        from dlrover_tpu.profiler.tpu_timer import TpuTimerMetricsSource
+
+        source = TpuTimerMetricsSource(port)
+        deadline = time.time() + 12
+        while time.time() < deadline and not source().get("hang"):
+            time.sleep(0.2)
+        assert source()["hang"] is True
+
+        agent = DiagnosisAgent(client=client, node_id=0)
+        agent.set_metrics_source(source)
+        agent.set_hang_dumper(HangDumper(
+            stack_dir, worker_pids=[worker.pid], metrics_ports=[port],
+            settle_secs=1.0,
+        ))
+        agent.report_once()
+
+        kinds = [k for k, _ in client.records]
+        assert "TpuMetricsRecord" in kinds
+        assert "HangDumpRecord" in kinds
+        bundle = json.loads(
+            next(p for k, p in client.records if k == "HangDumpRecord")
+        )
+        stack = bundle["stacks"][str(worker.pid)]
+        assert "stuck_in_allreduce" in stack  # the hung frame is visible
+        pend = bundle["pending"][str(port)]
+        assert pend["hang"] is True
+        assert {p["name"] for p in pend["pending"]} == {"mock_program"}
+
+        # cooldown: a second report does not re-dump
+        n = len(client.records)
+        agent.report_once()
+        assert ("HangDumpRecord" not in
+                [k for k, _ in client.records[n:]])
+    finally:
+        worker.kill()
+        worker.wait(timeout=10)
+        device.wait(timeout=30)
+
+
+def test_py_tracer_records_gc_and_spans():
+    """Host-side tracing tier (reference py_tracing_manager.cc): GC pauses
+    and user spans land in the chrome-trace ring."""
+    import gc
+
+    from dlrover_tpu.profiler.py_tracing import PyTracer
+
+    tracer = PyTracer()
+    tracer.start()
+    try:
+        with tracer.span("dataloader.next"):
+            pass
+        gc.collect()
+    finally:
+        tracer.stop()
+    events = tracer.events()
+    names = {e["name"] for e in events}
+    assert "dataloader.next" in names
+    assert any(n.startswith("gc.collect") for n in names)
+    trace = json.loads(tracer.chrome_trace())
+    assert all(
+        {"name", "cat", "ph", "ts", "dur"} <= set(e) for e in
+        trace["traceEvents"]
+    )
+    # stopped tracer records nothing
+    with tracer.span("after.stop"):
+        pass
+    assert "after.stop" not in {e["name"] for e in tracer.events()}
