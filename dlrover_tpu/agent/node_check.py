@@ -106,6 +106,13 @@ def run_network_check(
     config: ElasticLaunchConfig, client: MasterClient, rounds: int = 2
 ) -> bool:
     """Returns True if THIS node is healthy (regardless of others)."""
+    # Straggler localization NEEDS both rounds even when every group
+    # passes: a slow node drags its collective partners to the same
+    # elapsed time, so any single round flags the whole group — only the
+    # cross-round intersection under different pairings isolates the true
+    # straggler (same reason the reference always runs its second
+    # comm-perf round, training.py:1585-1644).
+    need_all_rounds = config.exclude_straggler or config.comm_perf_test
     for rnd in range(rounds):
         ok, elapsed = _run_check_round(config, client)
         logger.info(
@@ -116,7 +123,7 @@ def run_network_check(
             elapsed,
         )
         group_ok = _wait_group_results(client)
-        if group_ok:
+        if group_ok and not need_all_rounds:
             # All groups healthy: no need for the fault-localization round.
             break
     fault_nodes = client.get_fault_nodes()

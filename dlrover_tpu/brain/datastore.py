@@ -30,6 +30,13 @@ CREATE TABLE IF NOT EXISTS jobs (
     exit_reason TEXT
 );
 CREATE INDEX IF NOT EXISTS jobs_name ON jobs(name);
+CREATE TABLE IF NOT EXISTS cluster_state (
+    ts REAL,
+    running_pods INTEGER,
+    pending_pods INTEGER,
+    tpu_chips_running INTEGER,
+    tpu_chips_pending INTEGER
+);
 CREATE TABLE IF NOT EXISTS master_config (
     job_name TEXT,          -- '' = cluster-wide default
     key TEXT,
@@ -165,6 +172,49 @@ class BrainDataStore:
                 (job_name,),
             ).fetchone()
         return float(row[0] or 0.0)
+
+    # -- cluster state (k8s cluster watcher snapshots) ----------------------
+
+    def record_cluster_state(
+        self,
+        running_pods: int,
+        pending_pods: int,
+        tpu_chips_running: int,
+        tpu_chips_pending: int,
+        ts: Optional[float] = None,
+    ):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO cluster_state VALUES (?, ?, ?, ?, ?)",
+                (ts if ts is not None else time.time(), running_pods,
+                 pending_pods, tpu_chips_running, tpu_chips_pending),
+            )
+            # bound growth: keep the most recent ~10k snapshots
+            self._conn.execute(
+                "DELETE FROM cluster_state WHERE ts < ("
+                "SELECT MIN(ts) FROM (SELECT ts FROM cluster_state "
+                "ORDER BY ts DESC LIMIT 10000))"
+            )
+            self._conn.commit()
+
+    def latest_cluster_state(self, max_age_s: float = 120.0) -> Optional[Dict]:
+        """Most recent snapshot no older than ``max_age_s``; None when the
+        watcher is absent or stale (optimizers then skip the gate)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT ts, running_pods, pending_pods, tpu_chips_running, "
+                "tpu_chips_pending FROM cluster_state "
+                "ORDER BY ts DESC LIMIT 1"
+            ).fetchone()
+        if row is None or time.time() - row[0] > max_age_s:
+            return None
+        return {
+            "ts": row[0],
+            "running_pods": row[1],
+            "pending_pods": row[2],
+            "tpu_chips_running": row[3],
+            "tpu_chips_pending": row[4],
+        }
 
     # -- master config overrides (global_context seeding) ------------------
 

@@ -101,6 +101,14 @@ def predicted_speed(a: float, b: float, n: int) -> float:
     return a * n / (1.0 + b * n)
 
 
+def cluster_saturated(store: BrainDataStore) -> bool:
+    """Cluster-pressure gate (fed by ``cluster_watcher.py``, reference
+    ``go/brain/pkg/platform/k8s`` watchers): TPU chips already sitting in
+    Pending pods mean a grow plan would only mint more Pending pods."""
+    state = store.latest_cluster_state()
+    return bool(state) and state["tpu_chips_pending"] > 0
+
+
 @algorithm(STAGE_SAMPLE)
 def sample_plan(
     store: BrainDataStore, req: BrainOptimizeRequest
@@ -111,11 +119,14 @@ def sample_plan(
     fit = fit_scaling(samples)
     if fit is None:
         # not enough variety yet: step one unit toward max to generate it
+        # (growth, so the saturation gate applies; shrink paths never gate)
+        if cluster_saturated(store):
+            return BrainResourcePlan(comment="cluster saturated; hold")
         n = _round_to_unit(
             (req.current_workers or req.min_workers) + req.node_unit, req
         )
         return BrainResourcePlan(worker_count=n, comment="sampling: +unit")
-    return _scale_by_fit(fit, req)
+    return _scale_by_fit(fit, req, store)
 
 
 @algorithm(STAGE_RUNNING)
@@ -126,11 +137,13 @@ def running_plan(
     fit = fit_scaling(samples)
     if fit is None:
         return BrainResourcePlan(comment="no fit; hold")
-    return _scale_by_fit(fit, req)
+    return _scale_by_fit(fit, req, store)
 
 
 def _scale_by_fit(
-    fit: Tuple[float, float], req: BrainOptimizeRequest
+    fit: Tuple[float, float],
+    req: BrainOptimizeRequest,
+    store: Optional[BrainDataStore] = None,
 ) -> BrainResourcePlan:
     """Pick the largest worker count whose marginal goodput per added
     host clears 5% of a host's base throughput (reference analogue:
@@ -152,6 +165,11 @@ def _scale_by_fit(
             best = n
     if best == current:
         return BrainResourcePlan(comment=f"hold at {current}")
+    if best > current and store is not None and cluster_saturated(store):
+        # shrink plans still pass: they relieve the pressure
+        return BrainResourcePlan(
+            comment=f"cluster saturated; hold at {current} (wanted {best})"
+        )
     return BrainResourcePlan(
         worker_count=_round_to_unit(best, req),
         comment=f"fit a={a:.3g} b={b:.3g}: {current}->{best} "

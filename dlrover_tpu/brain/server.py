@@ -90,9 +90,25 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser("dlrover_tpu brain")
     p.add_argument("--port", type=int, default=50051)
     p.add_argument("--db", default="/tmp/dlrover_tpu_brain.db")
+    p.add_argument(
+        "--watch_cluster", action="store_true",
+        help="poll k8s pods into cluster_state so optimize() sees cluster "
+             "pressure (reference go/brain k8s watchers)",
+    )
+    p.add_argument("--watch_interval", type=float, default=30.0)
     args = p.parse_args(argv)
     server = BrainServer(port=args.port, db_path=args.db)
     server.start()
+    if args.watch_cluster:
+        from dlrover_tpu.brain.cluster_watcher import ClusterWatcher
+        from dlrover_tpu.scheduler.k8s_client import get_k8s_client
+
+        watcher = ClusterWatcher(
+            get_k8s_client(), server.store,
+            interval_secs=args.watch_interval,
+        )
+        watcher.start()
+        logger.info("cluster watcher polling every %ss", args.watch_interval)
     threading.Event().wait()  # serve forever
     return 0
 

@@ -34,6 +34,8 @@ class LocalJobMaster:
         node_num: int = 1,
         elastic_run_configs: Optional[Dict] = None,
         heartbeat_timeout: float = 600,
+        min_node_num: Optional[int] = None,
+        rdzv_waiting_timeout: float = 60,
     ):
         from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
         from dlrover_tpu.master.stats.job_collector import JobMetricCollector
@@ -56,9 +58,11 @@ class LocalJobMaster:
         }
         for mgr in self.rdzv_managers.values():
             mgr.update_rdzv_params(
-                min_nodes=node_num,
+                min_nodes=(
+                    min_node_num if min_node_num is not None else node_num
+                ),
                 max_nodes=node_num,
-                waiting_timeout=60,
+                waiting_timeout=rdzv_waiting_timeout,
                 node_unit=1,
             )
         self.kv_store = KVStoreService()

@@ -1,0 +1,20 @@
+"""Minimal elastic worker for e2e tests that only need rendezvous +
+jax.distributed bring-up (works with any surviving world size, unlike
+train_toy.py whose global batch constrains the device count)."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import dlrover_tpu.train as dtrain
+
+ctx = dtrain.init(local_device_count=2)
+
+import jax
+
+n = jax.device_count()
+print(f"[noop] done: world={ctx.num_processes} devices={n}", flush=True)

@@ -2,6 +2,8 @@
 master-client path over a real RPC server (mirrors the reference's
 hermetic optalgorithm tests over fake recorders, §2.2)."""
 
+import time
+
 import pytest
 
 from dlrover_tpu.brain import messages as bmsg
@@ -187,3 +189,99 @@ def test_master_optimizer_falls_back_when_brain_down():
     )
     # local fallback produced a CREATE plan
     assert plan.node_group_resources["worker"].count >= 2
+
+
+# -- cluster watchers (reference go/brain/pkg/platform/k8s) -----------------
+
+def test_cluster_watcher_snapshots_tpu_pressure():
+    from dlrover_tpu.brain.cluster_watcher import ClusterWatcher
+    from dlrover_tpu.brain.datastore import BrainDataStore
+    from tests.k8s_fakes import make_fake_client
+
+    client, transport = make_fake_client()
+
+    def pod(name, phase, chips):
+        return {
+            "metadata": {"name": name, "labels": {}},
+            "status": {"phase": phase},
+            "spec": {"containers": [{
+                "resources": {"requests": {"google.com/tpu": str(chips)}},
+            }]},
+        }
+
+    transport.pods["a"] = pod("a", "Running", 4)
+    transport.pods["b"] = pod("b", "Running", 4)
+    transport.pods["c"] = pod("c", "Pending", 8)
+    transport.pods["d"] = pod("d", "Succeeded", 4)  # terminal: ignored
+
+    store = BrainDataStore()
+    snap = ClusterWatcher(client, store).collect_once()
+    assert snap == {
+        "running_pods": 2, "pending_pods": 1,
+        "tpu_chips_running": 8, "tpu_chips_pending": 8,
+    }
+    state = store.latest_cluster_state()
+    assert state["tpu_chips_pending"] == 8
+    # stale snapshots are ignored
+    store2 = BrainDataStore()
+    store2.record_cluster_state(1, 0, 4, 0, ts=time.time() - 999)
+    assert store2.latest_cluster_state(max_age_s=120) is None
+
+
+def test_optimizer_holds_growth_when_cluster_saturated():
+    """A near-linear fit wants to grow, but pending TPU chips in the
+    cluster gate the plan to hold; once pressure clears it grows."""
+    from dlrover_tpu.brain.datastore import BrainDataStore
+    from dlrover_tpu.brain.messages import BrainOptimizeRequest, RuntimeSample
+    from dlrover_tpu.brain.optimizer import BrainOptimizer, STAGE_RUNNING
+
+    store = BrainDataStore()
+    store.upsert_job("j1", "llama", min_workers=1, max_workers=8, node_unit=1)
+    store.append_samples("j1", [
+        RuntimeSample(worker_num=n, speed_steps_per_sec=s)
+        for n, s in ((1, 9.9), (2, 19.4), (4, 38.0))
+    ])
+    req = BrainOptimizeRequest(
+        job_uuid="j1", job_name="llama", stage=STAGE_RUNNING,
+        min_workers=1, max_workers=8, current_workers=4,
+    )
+    opt = BrainOptimizer(store)
+
+    store.record_cluster_state(10, 3, 40, 12)  # 12 chips pending
+    plan = opt.optimize(req)
+    assert plan.worker_count == 0 and "saturated" in plan.comment
+
+    store.record_cluster_state(10, 0, 40, 0)  # pressure cleared
+    plan = opt.optimize(req)
+    assert plan.worker_count == 8
+
+
+def test_pending_age_window_filters_transit_and_stuck_pods():
+    """Pressure = pods pending past the scheduling-transit grace but not
+    yet 'stuck forever' — one misconfigured pod must not gate all growth
+    permanently, and a seconds-old pod is just in transit."""
+    from dlrover_tpu.brain.cluster_watcher import aggregate_pods
+
+    def pod(phase, age_s, chips=4, now=1_000_000.0):
+        return {
+            "metadata": {
+                "name": "p",
+                "creationTimestamp": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now - age_s)
+                ),
+            },
+            "status": {"phase": phase},
+            "spec": {"containers": [{
+                "resources": {"requests": {"google.com/tpu": str(chips)}},
+            }]},
+        }
+
+    now = 1_000_000.0
+    pods = [
+        pod("Pending", age_s=10, now=now),       # transit: ignored
+        pod("Pending", age_s=600, now=now),      # real pressure
+        pod("Pending", age_s=7200, now=now),     # stuck: ignored
+        pod("Running", age_s=600, now=now),
+    ]
+    running, pending, c_run, c_pend = aggregate_pods(pods, now=now)
+    assert (running, pending, c_run, c_pend) == (1, 1, 4, 4)

@@ -210,7 +210,7 @@ def test_hang_dump_reports_stacks_and_pending(native, tmp_path):
         "DLROVER_TPU_TIMER_HANG_SECS": "1",
     })
     device = subprocess.Popen(
-        [native["harness"], native["interposer"], "2", "8000"],
+        [native["harness"], native["interposer"], "2", "60000"],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
     # hung "worker": installs the SIGUSR2 handler, then blocks in sleep
@@ -220,9 +220,9 @@ def test_hang_dump_reports_stacks_and_pending(native, tmp_path):
         "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
         "from dlrover_tpu.profiler.hang_dump import install_stack_dump_handler\n"
         f"install_stack_dump_handler({stack_dir!r})\n"
-        "print('READY', flush=True)\n"
         "def stuck_in_allreduce():\n"
-        "    time.sleep(60)\n"
+        "    print('READY', flush=True)\n"  # frame exists once READY is read
+        "    time.sleep(120)\n"
         "stuck_in_allreduce()\n",
     ], stdout=subprocess.PIPE, text=True)
 
@@ -239,7 +239,9 @@ def test_hang_dump_reports_stacks_and_pending(native, tmp_path):
         from dlrover_tpu.profiler.tpu_timer import TpuTimerMetricsSource
 
         source = TpuTimerMetricsSource(port)
-        deadline = time.time() + 12
+        # generous: under full-suite CPU contention the 1s hang timeout
+        # can take tens of seconds of wall time to trip
+        deadline = time.time() + 60
         while time.time() < deadline and not source().get("hang"):
             time.sleep(0.2)
         assert source()["hang"] is True
@@ -272,6 +274,7 @@ def test_hang_dump_reports_stacks_and_pending(native, tmp_path):
     finally:
         worker.kill()
         worker.wait(timeout=10)
+        device.kill()  # don't sit out the harness's long settle window
         device.wait(timeout=30)
 
 
