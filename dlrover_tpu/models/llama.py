@@ -42,7 +42,7 @@ from dlrover_tpu.ops import (
     rms_norm,
     rope_frequencies,
 )
-from dlrover_tpu.parallel.mesh import BATCH_AXES, FSDP, SP, TP
+from dlrover_tpu.parallel.mesh import BATCH_AXES, FSDP, PP, SP, TP
 
 Params = Dict[str, Any]
 
@@ -68,6 +68,9 @@ class LlamaConfig:
     # fraction of its memory
     remat_policy: str = "all"
     attn_impl: str = "auto"            # auto | flash | reference | ring
+    # pipeline parallelism: microbatches in flight per step (0 → pp size).
+    # More microbatches shrink the GPipe bubble (pp-1)/(n_micro+pp-1).
+    pp_microbatches: int = 0
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "mlp"):
@@ -147,21 +150,24 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> Params:
     }
 
 
-def param_specs(cfg: LlamaConfig) -> Params:
-    """PartitionSpec pytree mirroring `init_params` (leading axis of every
-    layer leaf is the scan/layer axis, never sharded)."""
+def param_specs(cfg: LlamaConfig, pp: int = 1) -> Params:
+    """PartitionSpec pytree mirroring `init_params`. The leading axis of
+    every layer leaf is the scan/layer axis: unsharded normally, split
+    over the ``pp`` mesh axis under pipeline parallelism (each stage holds
+    its contiguous slab of layers)."""
+    layer_axis = PP if pp > 1 else None
     return {
         "embed": P(TP, FSDP),
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, FSDP, TP),
-            "wk": P(None, FSDP, TP),
-            "wv": P(None, FSDP, TP),
-            "wo": P(None, TP, FSDP),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, FSDP, TP),
-            "w_up": P(None, FSDP, TP),
-            "w_down": P(None, TP, FSDP),
+            "attn_norm": P(layer_axis, None),
+            "wq": P(layer_axis, FSDP, TP),
+            "wk": P(layer_axis, FSDP, TP),
+            "wv": P(layer_axis, FSDP, TP),
+            "wo": P(layer_axis, TP, FSDP),
+            "mlp_norm": P(layer_axis, None),
+            "w_gate": P(layer_axis, FSDP, TP),
+            "w_up": P(layer_axis, FSDP, TP),
+            "w_down": P(layer_axis, TP, FSDP),
         },
         "final_norm": P(None),
         "lm_head": P(FSDP, TP),
@@ -236,6 +242,20 @@ def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x):
     return x
 
 
+def _maybe_remat(cfg: LlamaConfig, layer_fn):
+    """Apply the configured rematerialization policy (one place for the
+    policy ladder: forward() and the pp schedule must never diverge)."""
+    if not cfg.remat:
+        return layer_fn
+    if cfg.remat_policy == "mlp":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "ffn_gate", "ffn_up"
+        )
+    else:
+        policy = jax.checkpoint_policies.nothing_saveable
+    return jax.checkpoint(layer_fn, policy=policy)
+
+
 def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
     """Fail fast (trace time) on model-shape / mesh-axis mismatches instead
     of a cryptic shard_map partition error deep in the stack."""
@@ -244,8 +264,9 @@ def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
 
     shape = dict(mesh.shape)
     mc = MeshConfig(
-        dp=shape.get("dp", 1), fsdp=shape.get("fsdp", 1),
-        ep=shape.get("ep", 1), sp=shape.get("sp", 1), tp=shape.get("tp", 1),
+        dp=shape.get("dp", 1), pp=shape.get("pp", 1),
+        fsdp=shape.get("fsdp", 1), ep=shape.get("ep", 1),
+        sp=shape.get("sp", 1), tp=shape.get("tp", 1),
     )
     validate_divisibility(
         mc,
@@ -253,7 +274,13 @@ def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
         n_kv_heads=cfg.n_kv_heads,
         seq_len=seq_len or cfg.max_seq_len,
         vocab=cfg.vocab_size,
+        n_layers=cfg.n_layers,
     )
+    if mc.pp > 1 and (mc.sp > 1 or cfg.attn_impl == "ring"):
+        raise ValueError(
+            "pipeline parallelism does not compose with sp/ring attention "
+            "(ring runs its own shard_map); use pp with tp/fsdp/dp"
+        )
 
 
 def forward(
@@ -270,15 +297,9 @@ def forward(
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
 
-    layer_fn = functools.partial(_decoder_layer, cfg, mesh, inv_freq, positions)
-    if cfg.remat:
-        if cfg.remat_policy == "mlp":
-            policy = jax.checkpoint_policies.save_only_these_names(
-                "ffn_gate", "ffn_up"
-            )
-        else:
-            policy = jax.checkpoint_policies.nothing_saveable
-        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+    layer_fn = _maybe_remat(
+        cfg, functools.partial(_decoder_layer, cfg, mesh, inv_freq, positions)
+    )
 
     def scan_body(x, lp):
         return layer_fn(lp, x), None
@@ -295,6 +316,19 @@ def forward(
     return logits
 
 
+def _ce_sums(logits: jnp.ndarray, tokens: jnp.ndarray):
+    """(sum of next-token NLL, count of valid targets); pad tokens < 0
+    are ignored. ``logits``/``tokens`` are (mb, s, vocab)/(mb, s)."""
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    valid = (targets >= 0).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, jnp.maximum(targets, 0)[..., None], axis=-1
+    )[..., 0]
+    return jnp.sum((logz - gold) * valid), jnp.sum(valid)
+
+
 def loss_fn(
     params: Params,
     tokens: jnp.ndarray,  # (b, s) int32; next-token targets derived inside
@@ -302,12 +336,155 @@ def loss_fn(
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy (pad tokens < 0 are ignored)."""
-    logits = forward(params, tokens, cfg, mesh)[:, :-1]
-    targets = tokens[:, 1:]
-    valid = (targets >= 0).astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(
-        logits, jnp.maximum(targets, 0)[..., None], axis=-1
-    )[..., 0]
-    nll = (logz - gold) * valid
-    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1.0)
+    if mesh is not None and mesh.shape.get(PP, 1) > 1:
+        return _pp_loss(params, tokens, cfg, mesh)
+    logits = forward(params, tokens, cfg, mesh)
+    nll_sum, n_valid = _ce_sums(logits, tokens)
+    return nll_sum / jnp.maximum(n_valid, 1.0)
+
+
+def _pp_loss(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """GPipe over the ``pp`` mesh axis, TPU-native.
+
+    The reference is only checkpoint-aware of PP (megatron_dist_ckpt.py:
+    262,489 there — Megatron owns the schedule); here the schedule itself
+    is built from JAX primitives: layer-stacked params are sharded
+    ``P(pp)`` on the layer axis so each stage holds a contiguous slab,
+    and a ``shard_map`` manual over ONLY the pp axis (tp/fsdp stay
+    automatic inside) runs the classic pipeline: ``n_micro + pp - 1``
+    ticks of (run my slab) → (``ppermute`` the activation to the next
+    stage). Autodiff through scan + ppermute yields the reverse pipeline
+    for backward. The bubble is the standard (pp-1)/(T) — raise
+    ``cfg.pp_microbatches`` to shrink it.
+
+    Constraints: sp/ring attention is not composed with pp (ring runs its
+    own shard_map); validated in ``validate_for_mesh``.
+    """
+    from jax import shard_map
+
+    pp_size = mesh.shape[PP]
+    n_micro = cfg.pp_microbatches or pp_size
+    b, s = tokens.shape
+    if b % n_micro:
+        raise ValueError(f"batch={b} not divisible by pp_microbatches={n_micro}")
+    mb = b // n_micro
+    validate_for_mesh(cfg, mesh, seq_len=s)
+
+    from jax.sharding import NamedSharding
+
+    x = embed_lookup(params["embed"], tokens, mesh, cfg.dtype)  # (b, s, d)
+    # keep the data axes on the *per-microbatch* batch dim: if the reshape
+    # left dp on the microbatch-index dim, every tick's dynamic_index
+    # would gather across dp shards (and trip XLA's grouped-collective
+    # partitioner under the manual pp axis)
+    x_micro = lax.with_sharding_constraint(
+        x.reshape(n_micro, mb, s, cfg.dim),
+        NamedSharding(mesh, P(None, BATCH_AXES, None, None)),
+    )
+    tok_micro = lax.with_sharding_constraint(
+        tokens.reshape(n_micro, mb, s),
+        NamedSharding(mesh, P(None, BATCH_AXES, None)),
+    )
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
+
+    # mesh=None inside the manual-pp region: NamedSharding constraints on
+    # the concrete mesh clash with the Manual-pp context mesh; tp/fsdp
+    # placement inside stages is propagated by XLA from the param
+    # shardings instead (sp/ring is validated off under pp)
+    layer_fn = _maybe_remat(
+        cfg, functools.partial(_decoder_layer, cfg, None, inv_freq, positions)
+    )
+
+    n_ticks = n_micro + pp_size - 1
+    fwd_perm = [(i, i + 1) for i in range(pp_size - 1)]
+
+    def stage(layers_local, x_mb, tok_mb, final_norm, lm_head):
+        rank = lax.axis_index(PP)
+
+        def run_slab(h):
+            def body(carry, lp):
+                return layer_fn(lp, carry), None
+
+            out, _ = lax.scan(body, h, layers_local)
+            return out
+
+        def tick(carry, t):
+            recv, outs = carry
+            mb_in = jnp.clip(t, 0, n_micro - 1)
+            inp = jnp.where(
+                rank == 0,
+                lax.dynamic_index_in_dim(x_mb, mb_in, keepdims=False),
+                recv,
+            )
+            out = run_slab(inp)
+            recv_next = lax.ppermute(out, PP, fwd_perm)
+            # collect finished microbatches (real only on the last stage;
+            # early bubble writes land on index 0 and are overwritten by
+            # the first valid tick)
+            mb_out = jnp.clip(t - (pp_size - 1), 0, n_micro - 1)
+            outs = lax.dynamic_update_index_in_dim(outs, out, mb_out, 0)
+            return (recv_next, outs), None
+
+        init = (
+            jnp.zeros((mb, s, cfg.dim), cfg.dtype),
+            jnp.zeros((n_micro, mb, s, cfg.dim), cfg.dtype),
+        )
+        (_, outs), _ = lax.scan(
+            tick, init, jnp.arange(n_ticks, dtype=jnp.int32)
+        )
+        # head + loss: the collected activations are real only on the
+        # last stage, but the lm_head matmul is ~10% of model FLOPs at
+        # 8B scale — burning it on every rank and masking would waste
+        # (pp-1)/pp of it. Instead psum_scatter hands each rank 1/pp of
+        # the row axis (non-last ranks contribute zeros, so each chunk
+        # IS the last stage's data), every rank computes the head for
+        # its chunk, and the CE sums psum back together.
+        rows = n_micro * mb
+        pad = (-rows) % pp_size
+        is_last = (rank == pp_size - 1).astype(outs.dtype)
+        outs_flat = outs.reshape(rows, s, cfg.dim) * is_last
+        toks_flat = tok_mb.reshape(rows, s)
+        if pad:
+            outs_flat = jnp.concatenate(
+                [outs_flat, jnp.zeros((pad, s, cfg.dim), outs_flat.dtype)]
+            )
+            toks_flat = jnp.concatenate(
+                [toks_flat, jnp.full((pad, s), -1, toks_flat.dtype)]
+            )
+        chunk = (rows + pad) // pp_size
+        my_rows = lax.psum_scatter(
+            outs_flat, PP, scatter_dimension=0, tiled=True
+        )
+        my_toks = lax.dynamic_slice_in_dim(toks_flat, rank * chunk, chunk, 0)
+        h = rms_norm(my_rows, final_norm, cfg.norm_eps)
+        logits = lax.dot_general(
+            h, lm_head.astype(h.dtype),
+            (((h.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        nll_sum, n_valid = _ce_sums(logits, my_toks)
+        nll_sum = lax.psum(nll_sum, PP)
+        n_valid = lax.psum(n_valid, PP)
+        return nll_sum / jnp.maximum(n_valid, 1.0)
+
+    pipe = shard_map(
+        stage,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(PP), params["layers"]),
+            P(), P(), P(), P(),
+        ),
+        out_specs=P(),
+        axis_names={PP},
+        check_vma=False,
+    )
+    return pipe(
+        params["layers"], x_micro, tok_micro,
+        params["final_norm"], params["lm_head"],
+    )

@@ -9,6 +9,7 @@ membership change means *re-building the mesh* and resharding state.
 Axis convention (outermost → innermost):
 
     dp    pure data parallelism (gradient psum; rides DCN across slices)
+    pp    pipeline parallelism (layer stages; point-to-point ppermute)
     fsdp  data parallelism with parameter/optimizer sharding (ZeRO-3 style)
     ep    expert parallelism for MoE layers (experts split across this axis)
     sp    sequence/context parallelism (ring attention over this axis)
@@ -32,11 +33,12 @@ from jax.sharding import Mesh
 
 # Canonical axis names, outermost first.
 DP = "dp"
+PP = "pp"
 FSDP = "fsdp"
 EP = "ep"
 SP = "sp"
 TP = "tp"
-AXIS_ORDER = (DP, FSDP, EP, SP, TP)
+AXIS_ORDER = (DP, PP, FSDP, EP, SP, TP)
 
 # Axes over which a data batch is split (sharding of the batch dimension).
 BATCH_AXES = (DP, FSDP, EP)
@@ -49,18 +51,19 @@ class MeshConfig:
     properties, dp is whatever the current world provides."""
 
     dp: int = -1
+    pp: int = 1
     fsdp: int = 1
     ep: int = 1
     sp: int = 1
     tp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
-        fixed = self.fsdp * self.ep * self.sp * self.tp
+        fixed = self.pp * self.fsdp * self.ep * self.sp * self.tp
         if self.dp == -1:
             if n_devices % fixed:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"fsdp*ep*sp*tp={fixed}"
+                    f"pp*fsdp*ep*sp*tp={fixed}"
                 )
             return dataclasses.replace(self, dp=n_devices // fixed)
         if self.dp * fixed != n_devices:
@@ -73,6 +76,7 @@ class MeshConfig:
     def shape(self) -> dict:
         return {
             DP: self.dp,
+            PP: self.pp,
             FSDP: self.fsdp,
             EP: self.ep,
             SP: self.sp,
@@ -90,20 +94,21 @@ class MeshConfig:
         tp: int = 1,
         sp: int = 1,
         ep: int = 1,
+        pp: int = 1,
         prefer_fsdp: bool = True,
     ) -> "MeshConfig":
         """Pick a mesh for ``n_devices``: model axes given, the data axes
         inferred. With ``prefer_fsdp`` the whole data dimension is fsdp
         (ZeRO-style, the usual choice for large models); otherwise pure dp."""
-        model = tp * sp * ep
+        model = tp * sp * ep * pp
         if n_devices % model:
             raise ValueError(
-                f"{n_devices} devices not divisible by tp*sp*ep={model}"
+                f"{n_devices} devices not divisible by tp*sp*ep*pp={model}"
             )
         data = n_devices // model
         if prefer_fsdp:
-            return MeshConfig(dp=1, fsdp=data, ep=ep, sp=sp, tp=tp)
-        return MeshConfig(dp=data, fsdp=1, ep=ep, sp=sp, tp=tp)
+            return MeshConfig(dp=1, pp=pp, fsdp=data, ep=ep, sp=sp, tp=tp)
+        return MeshConfig(dp=data, pp=pp, fsdp=1, ep=ep, sp=sp, tp=tp)
 
 
 def build_mesh(
@@ -155,8 +160,8 @@ def _build_multislice_mesh(
             "the only axis allowed to span DCN (fsdp/ep/sp/tp collectives "
             "must stay on one slice's ICI)"
         )
-    within = (config.dp // n_slices) * config.fsdp * config.ep \
-        * config.sp * config.tp
+    within = (config.dp // n_slices) * config.pp * config.fsdp \
+        * config.ep * config.sp * config.tp
     if within != per_slice:
         raise ValueError(
             f"per-slice mesh ({within}) != devices per slice ({per_slice})"
@@ -173,9 +178,9 @@ def _build_multislice_mesh(
         from jax.experimental import mesh_utils
 
         if None not in slice_ids and len(slice_ids) == n_slices:
-            ici = (config.dp // n_slices, config.fsdp, config.ep,
-                   config.sp, config.tp)
-            dcn = (n_slices, 1, 1, 1, 1)
+            ici = (config.dp // n_slices, config.pp, config.fsdp,
+                   config.ep, config.sp, config.tp)
+            dcn = (n_slices, 1, 1, 1, 1, 1)
             arr = mesh_utils.create_hybrid_device_mesh(
                 ici, dcn, devices=ordered
             )
@@ -185,8 +190,8 @@ def _build_multislice_mesh(
     # manual hybrid layout: slice-major over the outer dp slab, so
     # mesh[d, ...] with d // (dp/n_slices) selecting the slice
     arr = np.array(ordered).reshape(
-        (n_slices, config.dp // n_slices, config.fsdp, config.ep,
-         config.sp, config.tp)
+        (n_slices, config.dp // n_slices, config.pp, config.fsdp,
+         config.ep, config.sp, config.tp)
     ).reshape(tuple(config.shape()[a] for a in AXIS_ORDER))
     return Mesh(arr, AXIS_ORDER)
 
@@ -206,7 +211,7 @@ def remesh(config: MeshConfig, n_devices: int) -> MeshConfig:
     new world cannot host the model axes at all (caller then falls back to
     a smaller tp/sp — a *resharding* restore, reference-equivalent of
     storage restore on world change, SURVEY.md §7 'hard parts')."""
-    model = config.tp * config.sp * config.ep
+    model = config.tp * config.sp * config.ep * config.pp
     if n_devices % model:
         raise ValueError(
             f"cannot remesh: {n_devices} devices vs model axes {model}"
@@ -226,8 +231,13 @@ def remesh(config: MeshConfig, n_devices: int) -> MeshConfig:
 
 
 def validate_divisibility(config: MeshConfig, *, n_heads: int,
-                          n_kv_heads: int, seq_len: int, vocab: int) -> None:
+                          n_kv_heads: int, seq_len: int, vocab: int,
+                          n_layers: int = 0) -> None:
     """Fail fast (before tracing) on shape/mesh mismatches."""
+    if n_layers and n_layers % max(config.pp, 1):
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pp={config.pp}"
+        )
     if n_heads % config.tp:
         raise ValueError(f"n_heads={n_heads} not divisible by tp={config.tp}")
     if n_kv_heads % config.tp:

@@ -26,7 +26,7 @@ def test_multislice_mesh_dp_is_slice_major():
     devices = jax.devices()[:8]
     mc = MeshConfig(dp=4, fsdp=1, ep=1, sp=1, tp=2)
     mesh = build_mesh(mc, devices=devices, n_slices=2)
-    grid = mesh.devices  # (dp, fsdp, ep, sp, tp)
+    grid = mesh.devices  # (dp, pp, fsdp, ep, sp, tp)
     # dp indices 0-1 = slice 0 (device ids 0-3), 2-3 = slice 1 (ids 4-7)
     assert {d.id for d in grid[:2].flat} == {0, 1, 2, 3}
     assert {d.id for d in grid[2:].flat} == {4, 5, 6, 7}
@@ -35,7 +35,7 @@ def test_multislice_mesh_dp_is_slice_major():
     assert mesh_slice_of(mesh, 2, 3) == 1
     # tp pairs never straddle a slice
     for d in range(4):
-        tp_ids = {dev.id for dev in grid[d, 0, 0, 0]}
+        tp_ids = {dev.id for dev in grid[d].flat}
         assert all(i < 4 for i in tp_ids) or all(i >= 4 for i in tp_ids)
 
 
