@@ -84,6 +84,17 @@ class ElasticAgent:
         self._diagnosis.set_log_source(self._last_worker_log_tail)
         self._tpu_timer_env: Dict[str, str] = {}
         self._hang_dumper = None
+        # external accelerator exporters (GKE TPU metrics agent etc.):
+        # comma-separated host:port/path endpoints
+        self._metric_monitor = None
+        endpoints = os.environ.get("DLROVER_TPU_METRIC_ENDPOINTS", "")
+        if endpoints:
+            from dlrover_tpu.common.metric import TpuMetricMonitor
+
+            self._metric_monitor = TpuMetricMonitor(
+                [e.strip() for e in endpoints.split(",") if e.strip()],
+                client=self._client,
+            )
         self._paral_tuner = None
         if config.tpu_timer:
             self._setup_tpu_timer()
@@ -136,6 +147,8 @@ class ElasticAgent:
         )
         self._start_ckpt_saver()
         self._start_heartbeats()
+        if self._metric_monitor is not None:
+            self._metric_monitor.start()
         self._install_signal_handlers()
         self._diagnosis.start()
         self._start_paral_config_tuner()
@@ -144,6 +157,8 @@ class ElasticAgent:
         finally:
             self._stop_evt.set()
             self._diagnosis.stop()
+            if self._metric_monitor is not None:
+                self._metric_monitor.stop()
             if self._paral_tuner is not None:
                 self._paral_tuner.stop()
             self._stop_workers()

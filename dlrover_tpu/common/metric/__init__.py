@@ -1,0 +1,5 @@
+from dlrover_tpu.common.metric.monitor import (  # noqa: F401
+    PrometheusScraper,
+    TpuMetricMonitor,
+    parse_prometheus,
+)

@@ -1,0 +1,190 @@
+"""Accelerator metric scraper tier: Prometheus exporters → diagnosis.
+
+Parity: reference ``dlrover/python/common/metric/monitor.py:73-391``
+(``GpuMetricMonitor``/``NpuMetricMonitor`` querying a metrics backend for
+DCGM/NPU gauges per pod, feeding the job-metric context the master's
+diagnosis reads). The TPU-native tier scrapes Prometheus **text
+endpoints directly** — the GKE TPU device-plugin/metrics-agent exporter
+on the host, libtpu's metrics port when enabled, or any sidecar — with a
+stdlib HTTP client and a small text-format parser, so there is no
+dependency on a vendor metrics backend.
+
+Agent-side, ``TpuMetricMonitor`` condenses the configured gauges into an
+``AcceleratorMetricsRecord`` per host (duty cycle, tensorcore
+utilization, HBM usage) and ships it over the existing diagnosis RPC;
+the master's data manager then exposes it to the inference operators the
+same way tpu_timer records flow today.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dlrover_tpu.common.log import logger
+
+#: gauge names commonly exposed by TPU host exporters; values are
+#: averaged over devices when multiple series share a name
+DEFAULT_TPU_GAUGES = (
+    "duty_cycle",
+    "tensorcore_utilization",
+    "hbm_memory_usage_bytes",
+    "hbm_memory_total_bytes",
+    "memory_used",
+    "memory_total",
+)
+
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^\s]+)"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+Series = Tuple[Dict[str, str], float]
+
+
+def parse_prometheus(
+    text: str, wanted: Optional[Iterable[str]] = None
+) -> Dict[str, List[Series]]:
+    """Prometheus text format → {metric: [(labels, value), ...]}.
+
+    ``wanted`` filters by metric name (suffix match, so exporters that
+    prefix names — ``dcgm_``, ``tpu_`` — still match the logical name)."""
+    wanted = tuple(wanted) if wanted is not None else None
+    out: Dict[str, List[Series]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name = m.group("name")
+        if wanted is not None and not any(name.endswith(w) for w in wanted):
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+class PrometheusScraper:
+    """Fetch + parse one or more Prometheus text endpoints."""
+
+    def __init__(
+        self,
+        endpoints: List[str],
+        metric_names: Optional[Iterable[str]] = None,
+        timeout: float = 3.0,
+    ):
+        self._endpoints = [
+            e if "://" in e else f"http://{e}" for e in endpoints
+        ]
+        self._names = tuple(metric_names or DEFAULT_TPU_GAUGES)
+        self._timeout = timeout
+
+    def scrape(self) -> Dict[str, List[Series]]:
+        """Merged series from every reachable endpoint; dead endpoints
+        are skipped (a down exporter must not take diagnosis with it)."""
+        merged: Dict[str, List[Series]] = {}
+        for url in self._endpoints:
+            try:
+                with urllib.request.urlopen(
+                    url, timeout=self._timeout
+                ) as resp:
+                    text = resp.read().decode(errors="replace")
+            except Exception as e:
+                # down/misconfigured exporters (OSError, InvalidURL, ...)
+                # must not take the other endpoints or diagnosis with them
+                logger.debug("metric endpoint %s unreachable: %s", url, e)
+                continue
+            for name, series in parse_prometheus(text, self._names).items():
+                merged.setdefault(name, []).extend(series)
+        return merged
+
+
+def _avg(series: List[Series]) -> float:
+    vals = [v for _l, v in series]
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def _sum(series: List[Series]) -> float:
+    return sum(v for _l, v in series)
+
+
+class TpuMetricMonitor:
+    """Agent-side daemon: scrape → condense → report to the master.
+
+    The condensed record: mean duty cycle / tensorcore utilization over
+    the host's devices plus summed HBM usage — the TPU quantities that
+    play the role DCGM's gpu-util/memory gauges play in the reference's
+    diagnosis (low duty cycle during training ⇒ stall/straggler)."""
+
+    def __init__(
+        self,
+        endpoints: List[str],
+        client=None,
+        interval_secs: float = 60.0,
+        metric_names: Optional[Iterable[str]] = None,
+    ):
+        # NB: per-record node attribution comes from the client itself
+        # (MasterClient stamps its node_id on every report)
+        self._scraper = PrometheusScraper(endpoints, metric_names)
+        self._client = client
+        self._interval = interval_secs
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def collect_once(self) -> Dict:
+        series = self._scraper.scrape()
+
+        def pick(*suffixes, agg=_avg) -> float:
+            for name, ss in series.items():
+                if any(name.endswith(suf) for suf in suffixes):
+                    return agg(ss)
+            return 0.0
+
+        snapshot = {
+            "duty_cycle": pick("duty_cycle"),
+            "tensorcore_util": pick("tensorcore_utilization"),
+            "hbm_used_bytes": pick(
+                "hbm_memory_usage_bytes", "memory_used", agg=_sum
+            ),
+            "hbm_total_bytes": pick(
+                "hbm_memory_total_bytes", "memory_total", agg=_sum
+            ),
+            "series_count": sum(len(s) for s in series.values()),
+        }
+        return snapshot
+
+    def report_once(self):
+        snapshot = self.collect_once()
+        if not snapshot["series_count"]:
+            return  # nothing scraped: skip the report, not an error
+        if self._client is not None:
+            self._client.report_diagnosis_data(
+                "AcceleratorMetricsRecord", json.dumps(snapshot)
+            )
+
+    def start(self):
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpu-metric-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def _loop(self):
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self.report_once()
+            except Exception:
+                logger.exception("accelerator metric report failed")

@@ -18,6 +18,7 @@ class DiagnosisDataType:
     GENERIC = "generic"
     TRAINING_LOG = "training_log"
     TPU_METRICS = "tpu_metrics"
+    ACCEL_METRICS = "accel_metrics"  # external exporter scrape tier
     RESOURCE_USAGE = "resource_usage"
 
 
@@ -121,10 +122,46 @@ class TpuMetricsRecord(DiagnosisData):
         return rec
 
 
+class AcceleratorMetricsRecord(DiagnosisData):
+    """Condensed accelerator-exporter gauges for one host (the scraper
+    tier, ``common/metric/monitor.py`` — reference GpuMetricMonitor's
+    DCGM gauges re-cast as TPU duty cycle / tensorcore / HBM)."""
+
+    def __init__(
+        self,
+        duty_cycle: float = 0.0,
+        tensorcore_util: float = 0.0,
+        hbm_used_bytes: float = 0.0,
+        hbm_total_bytes: float = 0.0,
+        **kw,
+    ):
+        kw.setdefault("data_type", DiagnosisDataType.ACCEL_METRICS)
+        super().__init__(**kw)
+        self.duty_cycle = duty_cycle
+        self.tensorcore_util = tensorcore_util
+        self.hbm_used_bytes = hbm_used_bytes
+        self.hbm_total_bytes = hbm_total_bytes
+
+    @classmethod
+    def from_json(cls, text: str) -> "AcceleratorMetricsRecord":
+        rec = cls()
+        rec.data_content = text  # keep the raw payload for debugging
+        try:
+            payload = json.loads(text)
+        except (ValueError, TypeError):
+            return rec
+        if isinstance(payload, dict):
+            for k, v in payload.items():
+                if k != "series_count":
+                    setattr(rec, k, v)
+        return rec
+
+
 _DATA_CLASSES: Dict[str, Type[DiagnosisData]] = {
     "DiagnosisData": DiagnosisData,
     "TrainingLogRecord": TrainingLogRecord,
     "TpuMetricsRecord": TpuMetricsRecord,
+    "AcceleratorMetricsRecord": AcceleratorMetricsRecord,
 }
 
 

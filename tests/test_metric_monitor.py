@@ -1,0 +1,112 @@
+"""Accelerator Prometheus scraper tier (reference
+common/metric/monitor.py:73-391 GpuMetricMonitor/NpuMetricMonitor): text
+parsing, live-endpoint scrape, condensed AcceleratorMetricsRecord through
+the diagnosis report path."""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+from dlrover_tpu.common.metric import (
+    PrometheusScraper,
+    TpuMetricMonitor,
+    parse_prometheus,
+)
+from dlrover_tpu.diagnosis.data import (
+    AcceleratorMetricsRecord,
+    DiagnosisDataType,
+    parse_report,
+)
+
+SAMPLE = """\
+# HELP duty_cycle TPU duty cycle percent
+# TYPE duty_cycle gauge
+tpu_duty_cycle{accelerator_id="0",container="w"} 93.5
+tpu_duty_cycle{accelerator_id="1",container="w"} 88.5
+tensorcore_utilization{accelerator_id="0"} 0.71
+hbm_memory_usage_bytes{accelerator_id="0"} 12884901888
+hbm_memory_total_bytes{accelerator_id="0"} 17179869184
+some_counter_total 42
+bad_value_metric not_a_number
+"""
+
+
+def test_parse_prometheus_names_labels_filter():
+    series = parse_prometheus(SAMPLE, wanted=("duty_cycle",))
+    assert list(series) == ["tpu_duty_cycle"]  # suffix match, prefix kept
+    (l0, v0), (l1, v1) = series["tpu_duty_cycle"]
+    assert (v0, v1) == (93.5, 88.5)
+    assert l0["accelerator_id"] == "0" and l0["container"] == "w"
+    # no filter: everything parseable, unparseable values dropped
+    all_series = parse_prometheus(SAMPLE)
+    assert "some_counter_total" in all_series
+    assert "bad_value_metric" not in all_series
+
+
+class _Exporter(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = SAMPLE.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def _serve():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Exporter)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_scrape_live_endpoint_and_condense():
+    srv = _serve()
+    try:
+        endpoint = f"127.0.0.1:{srv.server_port}/metrics"
+        scraper = PrometheusScraper([endpoint, "127.0.0.1:1/metrics"])
+        series = scraper.scrape()  # dead endpoint skipped
+        assert len(series["tpu_duty_cycle"]) == 2
+
+        class FakeClient:
+            def __init__(self):
+                self.records = []
+
+            def report_diagnosis_data(self, kind, payload):
+                self.records.append((kind, payload))
+
+        client = FakeClient()
+        mon = TpuMetricMonitor([endpoint], client=client)
+        mon.report_once()
+        kind, payload = client.records[0]
+        assert kind == "AcceleratorMetricsRecord"
+        snap = json.loads(payload)
+        assert snap["duty_cycle"] == 91.0       # mean over devices
+        assert snap["tensorcore_util"] == 0.71
+        assert snap["hbm_used_bytes"] == 12884901888
+        assert snap["hbm_total_bytes"] == 17179869184
+
+        # master-side decode into the typed record
+        rec = parse_report(kind, payload, node_id=3)
+        assert isinstance(rec, AcceleratorMetricsRecord)
+        assert rec.data_type == DiagnosisDataType.ACCEL_METRICS
+        assert rec.duty_cycle == 91.0 and rec.node_id == 3
+    finally:
+        srv.shutdown()
+
+
+def test_monitor_skips_report_when_nothing_scraped():
+    class FakeClient:
+        def __init__(self):
+            self.records = []
+
+        def report_diagnosis_data(self, kind, payload):
+            self.records.append((kind, payload))
+
+    client = FakeClient()
+    mon = TpuMetricMonitor(["127.0.0.1:1/metrics"], client=client)
+    mon.report_once()
+    assert client.records == []
