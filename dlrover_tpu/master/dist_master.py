@@ -137,6 +137,15 @@ class DistributedJobMaster:
             error_monitor=self.error_monitor,
             resource_optimizer=optimizer,
         )
+        # data shards of dead workers go back to the todo queue
+        # (reference TaskRescheduleCallback, event_callback.py:111-130)
+        from dlrover_tpu.master.node.event_callback import (
+            TaskRescheduleCallback,
+        )
+
+        self.job_manager.add_node_event_callback(
+            TaskRescheduleCallback(self.task_manager)
+        )
         self.pod_watcher = PodWatcher(
             job_args.job_name, self._client, self.job_manager.handle_node_event
         )

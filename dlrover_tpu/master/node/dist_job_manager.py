@@ -28,6 +28,10 @@ from dlrover_tpu.common.constants import (
 from dlrover_tpu.common.global_context import get_master_config
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node, NodeEvent
+from dlrover_tpu.master.node.event_callback import (
+    AllReduceNodeHandlingCallback,
+    ClusterContext,
+)
 from dlrover_tpu.master.node.job_manager import JobManager
 from dlrover_tpu.master.node.status_flow import get_node_state_flow
 from dlrover_tpu.master.resource.plan import ScalePlan
@@ -65,6 +69,34 @@ class DistributedJobManager(JobManager):
         self._lock = threading.RLock()
         #: set when a node dies unrecoverably → drives early stop
         self._unrecoverable: Tuple[str, str] = ("", "")
+        #: pluggable observers (reference event_callback.py:1-348); the
+        #: constructor args self-register the built-in reactions so a
+        #: directly-constructed manager behaves as before
+        self._event_callbacks: List = []
+        self._cluster_context = ClusterContext(self)
+        self.add_node_event_callback(
+            AllReduceNodeHandlingCallback(
+                rdzv_managers=self._rdzv_managers,
+                speed_monitor=self._speed_monitor,
+                job_auto_scaler=self._job_auto_scaler,
+            )
+        )
+
+    def add_node_event_callback(self, callback) -> None:
+        self._event_callbacks.append(callback)
+
+    def _fire(self, hook: str, node: Node):
+        for cb in self._event_callbacks:
+            try:
+                getattr(cb, hook)(node, self._cluster_context)
+            except Exception:
+                # a broken observer must never break node handling (the
+                # relaunch decision runs after this) — guaranteed here,
+                # not just for callbacks that used @log_callback_exception
+                logger.exception(
+                    "node-event callback %s.%s failed",
+                    type(cb).__name__, hook,
+                )
 
     @property
     def _heartbeat_timeout(self) -> float:
@@ -153,9 +185,16 @@ class DistributedJobManager(JobManager):
                     node.exit_reason or event.event_type,
                 )
             if flow.to_status == NodeStatus.RUNNING:
-                if self._speed_monitor is not None:
-                    self._speed_monitor.add_running_worker(node.type, node.id)
+                self._fire("on_node_started", node)
+            elif flow.to_status == NodeStatus.SUCCEEDED:
+                self._fire("on_node_succeeded", node)
             if flow.to_status in (NodeStatus.FAILED, NodeStatus.DELETED):
+                self._fire(
+                    "on_node_failed"
+                    if flow.to_status == NodeStatus.FAILED
+                    else "on_node_deleted",
+                    node,
+                )
                 self._on_node_down(node)
 
     def _merge_reported_fields(self, node: Node, incoming: Node):
@@ -171,13 +210,8 @@ class DistributedJobManager(JobManager):
             node.name = incoming.name
 
     def _on_node_down(self, node: Node):
-        if self._speed_monitor is not None:
-            self._speed_monitor.remove_running_worker(node.type, node.id)
-            self._speed_monitor.mark_downtime_start()
-        for mgr in self._rdzv_managers.values():
-            mgr.remove_alive_node(node.id)
-        if self._job_auto_scaler is not None:
-            self._job_auto_scaler.handle_node_failure(node.type, node.id)
+        # membership/accounting reactions live in the event callbacks
+        # (AllReduceNodeHandlingCallback); only the relaunch POLICY is here
         if node.is_released:
             return
         if self._should_relaunch(node):

@@ -1,0 +1,136 @@
+"""Pluggable node-event callbacks.
+
+Parity: reference ``master/node/event_callback.py:1-348``
+(``NodeEventCallback`` ABC + ``TaskRescheduleCallback`` +
+``AllReduceNodeHandlingCallback``; the TF-PS callback is out of scope per
+SURVEY §7). Round 2 had these reactions folded inline into
+``DistributedJobManager._on_node_down``; the pluggable layer restores the
+reference's extension point — a platform integrator can observe node
+lifecycle without patching the manager — while the built-in callbacks
+reproduce exactly the previous inline behavior.
+"""
+
+from __future__ import annotations
+
+import abc
+import functools
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+
+
+class ClusterContext:
+    """What callbacks may reach (reference ClusterContext)."""
+
+    def __init__(self, job_manager):
+        self.job_manager = job_manager
+
+
+def log_callback_exception(func):
+    """A broken observer must never break node-event handling."""
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        try:
+            return func(self, *args, **kwargs)
+        except Exception:
+            logger.exception(
+                "node-event callback %s.%s failed",
+                type(self).__name__, func.__name__,
+            )
+
+    return wrapper
+
+
+class NodeEventCallback(abc.ABC):
+    """Observer interface for node lifecycle transitions."""
+
+    def on_node_started(self, node: Node, cluster_context: ClusterContext):
+        """Node became RUNNING."""
+
+    def on_node_succeeded(self, node: Node, cluster_context: ClusterContext):
+        """Node finished cleanly."""
+
+    def on_node_failed(self, node: Node, cluster_context: ClusterContext):
+        """Node failed (exit_reason already classified)."""
+
+    def on_node_deleted(self, node: Node, cluster_context: ClusterContext):
+        """Node object disappeared from the platform."""
+
+
+class TaskRescheduleCallback(NodeEventCallback):
+    """Requeue the data shards a dead worker was holding (reference
+    TaskRescheduleCallback, event_callback.py:111-130). Worker-only:
+    task/rdzv state is keyed by node id, and master/other pods share the
+    same id space — a relaunched master's old pod dying must not clobber
+    worker-0's shards."""
+
+    def __init__(self, task_manager):
+        self._task_manager = task_manager
+
+    @log_callback_exception
+    def on_node_failed(self, node: Node, cluster_context: ClusterContext):
+        if node.type == NodeType.WORKER:
+            self._task_manager.remove_node_tasks(node.id)
+
+    @log_callback_exception
+    def on_node_deleted(self, node: Node, cluster_context: ClusterContext):
+        if node.type == NodeType.WORKER:
+            self._task_manager.remove_node_tasks(node.id)
+
+
+class AllReduceNodeHandlingCallback(NodeEventCallback):
+    """Keep rendezvous membership, throughput accounting and autoscaling
+    in sync with node lifecycle (reference AllReduceNodeHandlingCallback,
+    event_callback.py:255-348)."""
+
+    def __init__(
+        self,
+        rdzv_managers: Optional[Dict] = None,
+        speed_monitor=None,
+        job_auto_scaler=None,
+    ):
+        self._rdzv_managers = rdzv_managers or {}
+        self._speed_monitor = speed_monitor
+        self._job_auto_scaler = job_auto_scaler
+
+    @log_callback_exception
+    def on_node_started(self, node: Node, cluster_context: ClusterContext):
+        if node.type != NodeType.WORKER:
+            return
+        if self._speed_monitor is not None:
+            self._speed_monitor.add_running_worker(node.type, node.id)
+
+    @log_callback_exception
+    def on_node_succeeded(self, node: Node, cluster_context: ClusterContext):
+        if node.type != NodeType.WORKER:
+            return
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_running_worker(node.type, node.id)
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(node.id)
+
+    @log_callback_exception
+    def on_node_failed(self, node: Node, cluster_context: ClusterContext):
+        if node.type != NodeType.WORKER:
+            return
+        self._on_down(node)
+        if self._job_auto_scaler is not None:
+            self._job_auto_scaler.handle_node_failure(node.type, node.id)
+
+    @log_callback_exception
+    def on_node_deleted(self, node: Node, cluster_context: ClusterContext):
+        if node.type != NodeType.WORKER:
+            return
+        self._on_down(node)
+        if self._job_auto_scaler is not None:
+            self._job_auto_scaler.handle_node_failure(node.type, node.id)
+
+    def _on_down(self, node: Node):
+        if self._speed_monitor is not None:
+            self._speed_monitor.remove_running_worker(node.type, node.id)
+            self._speed_monitor.mark_downtime_start()
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(node.id)

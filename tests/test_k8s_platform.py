@@ -532,3 +532,88 @@ def test_dist_master_boots_creates_pods_and_stops():
         master.stop()
         transport.end_watch("pods")
         transport.end_watch("scaleplans")
+
+
+def test_event_callback_layer_is_pluggable():
+    """The pluggable observer layer (reference event_callback.py:1-348):
+    custom callbacks see lifecycle transitions; a raising callback does
+    not break event handling; task reschedule requeues a dead worker's
+    shards."""
+    from dlrover_tpu.master.node.event_callback import (
+        NodeEventCallback,
+        TaskRescheduleCallback,
+        log_callback_exception,
+    )
+
+    events = []
+
+    class Recorder(NodeEventCallback):
+        def on_node_started(self, node, ctx):
+            events.append(("started", node.id))
+
+        def on_node_succeeded(self, node, ctx):
+            events.append(("succeeded", node.id))
+
+        @log_callback_exception
+        def on_node_failed(self, node, ctx):
+            events.append(("failed", node.id))
+            raise RuntimeError("observer bug must not break handling")
+
+    class FakeTaskManager:
+        def __init__(self):
+            self.removed = []
+
+        def remove_node_tasks(self, node_id):
+            self.removed.append(node_id)
+
+    mgr, scaler = make_manager()
+    tm = FakeTaskManager()
+    mgr.add_node_event_callback(Recorder())
+    mgr.add_node_event_callback(TaskRescheduleCallback(tm))
+    mgr._init_nodes()
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
+    run_event(mgr, 1, NodeStatus.RUNNING)
+    run_event(mgr, 1, NodeStatus.SUCCEEDED)
+    assert ("started", 0) in events and ("failed", 0) in events
+    assert ("succeeded", 1) in events
+    assert tm.removed == [0]
+    # the relaunch still happened despite the raising observer
+    assert scaler.plans[-1].launch_nodes[0].id == 4
+
+
+def test_event_callbacks_ignore_non_worker_nodes():
+    """A relaunched master's old pod dying must not clobber worker-0's
+    rdzv/task/ledger state (ids collide across node types)."""
+    class FakeRdzv:
+        def __init__(self):
+            self.removed = []
+
+        def add_alive_node(self, node_id):
+            pass
+
+        def remove_alive_node(self, node_id):
+            self.removed.append(node_id)
+
+    rdzv = FakeRdzv()
+    mgr, scaler = make_manager(rdzv_managers={"training": rdzv})
+    mgr._init_nodes()
+    master_node = Node(NodeType.MASTER, 0, status=NodeStatus.FAILED)
+    master_node.exit_reason = NodeExitReason.KILLED
+    mgr.handle_node_event(NodeEvent(NodeEventType.MODIFIED, master_node))
+    assert rdzv.removed == []  # worker-0 untouched
+
+
+def test_raising_third_party_callback_does_not_block_relaunch():
+    from dlrover_tpu.master.node.event_callback import NodeEventCallback
+
+    class Hostile(NodeEventCallback):
+        def on_node_failed(self, node, ctx):  # no @log_callback_exception
+            raise RuntimeError("integrator bug")
+
+    mgr, scaler = make_manager()
+    mgr.add_node_event_callback(Hostile())
+    mgr._init_nodes()
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
+    assert scaler.plans[-1].launch_nodes[0].id == 4  # relaunch happened
