@@ -33,19 +33,6 @@ import sys
 import tempfile
 import time
 
-# peak dense bf16 FLOPs/s per chip, by device_kind substring (ordered:
-# first match wins, so "v5 lite" outranks "v5")
-PEAK_BF16 = [
-    ("v6", 918e12),       # Trillium / v6e
-    ("v5 lite", 197e12),  # v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
-
 BASELINE_MFU = 0.40        # Megatron-LM-class GPU MFU, 1-2B dense models
 BASELINE_CKPT_S = 0.5      # reference FCP blocking save, 1.5B model
 
@@ -71,11 +58,9 @@ def _tpu_alive(timeout: float = 120.0) -> bool:
 
 
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peak in PEAK_BF16:
-        if sub in kind:
-            return peak
-    return 0.0
+    from dlrover_tpu.utils.tpu_info import peak_bf16_flops
+
+    return peak_bf16_flops(getattr(device, "device_kind", ""))
 
 
 def _model_flops_per_step(cfg, batch: int, seq: int) -> float:
@@ -282,6 +267,14 @@ def main():
                 "d2h_gbps": round(rate, 3) if on_tpu else None,
                 "trials": trials,
             }
+            if on_tpu and rate < 1.0:
+                # direct-attached TPU hosts stage at several GB/s; a
+                # sub-GB/s link means the remote-tunnel transport is the
+                # bottleneck, not the staging design
+                ckpt["link_limited"] = True
+                ckpt["projected_at_5gbps_s"] = round(
+                    param_bytes / 2**30 / 5.0, 3
+                )
         finally:
             engine.close()
             shutil.rmtree(ckpt_dir, ignore_errors=True)

@@ -20,6 +20,7 @@ class DiagnosisDataType:
     TPU_METRICS = "tpu_metrics"
     ACCEL_METRICS = "accel_metrics"  # external exporter scrape tier
     RESOURCE_USAGE = "resource_usage"
+    HANG_DUMP = "hang_dump"  # all-rank stacks + pending device programs
 
 
 class DiagnosisData:
@@ -157,11 +158,41 @@ class AcceleratorMetricsRecord(DiagnosisData):
         return rec
 
 
+class HangDumpRecord(DiagnosisData):
+    """One host's hang bundle (``profiler.hang_dump.HangDumper.dump``):
+    per-worker faulthandler stacks + per-rank pending device programs.
+    Reference parity: the gdb/py-spy all-rank dump the xpu_timer daemon
+    takes on ``doHang`` (``manager.cc:454-464``)."""
+
+    def __init__(self, stacks: Optional[Dict] = None,
+                 pending: Optional[Dict] = None, reason: str = "", **kw):
+        kw.setdefault("data_type", DiagnosisDataType.HANG_DUMP)
+        super().__init__(**kw)
+        self.stacks = stacks or {}
+        self.pending = pending or {}
+        self.reason = reason
+
+    @classmethod
+    def from_json(cls, text: str) -> "HangDumpRecord":
+        rec = cls()
+        rec.data_content = text
+        try:
+            payload = json.loads(text)
+        except (ValueError, TypeError):
+            return rec
+        if isinstance(payload, dict):
+            rec.stacks = payload.get("stacks", {}) or {}
+            rec.pending = payload.get("pending", {}) or {}
+            rec.reason = payload.get("reason", "")
+        return rec
+
+
 _DATA_CLASSES: Dict[str, Type[DiagnosisData]] = {
     "DiagnosisData": DiagnosisData,
     "TrainingLogRecord": TrainingLogRecord,
     "TpuMetricsRecord": TpuMetricsRecord,
     "AcceleratorMetricsRecord": AcceleratorMetricsRecord,
+    "HangDumpRecord": HangDumpRecord,
 }
 
 

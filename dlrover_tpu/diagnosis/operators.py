@@ -17,6 +17,7 @@ from dlrover_tpu.diagnosis.data import (
     DiagnosisDataType,
     TpuMetricsRecord,
 )
+from dlrover_tpu.common.log import logger
 from dlrover_tpu.diagnosis.inference import (
     Inference,
     InferenceAttribute,
@@ -158,7 +159,14 @@ def classify_log(text: str) -> Optional[str]:
 
 
 class ResolveTrainingHangOperator(InferenceOperator):
-    """Confirmed hang -> action fact (restart all workers to break it)."""
+    """Confirmed hang -> action fact (restart all workers to break it).
+
+    If the agents shipped hang bundles (``HangDumpRecord``: all-rank
+    faulthandler stacks + pending device programs), summarize them into
+    the action config — the dominant shared stack path and the pending
+    program names — so the restart event names WHERE the fleet is stuck
+    (reference ``manager.cc:393-414``: pending-kernel print + all-rank
+    stack dumps on hang)."""
 
     def is_compatible(self, inference: Inference) -> bool:
         return inference == Inference(
@@ -166,11 +174,50 @@ class ResolveTrainingHangOperator(InferenceOperator):
         )
 
     def infer(self, inferences: List[Inference]) -> List[Inference]:
+        cfg = {"reason": "training_hang"}
+        try:
+            # agent-shipped JSON; malformed shapes must never block the
+            # restart_all action that breaks the actual hang
+            cfg.update(self._summarize_dumps())
+        except Exception as e:
+            logger.warning("hang-dump summarization failed: %s", e)
         return [
             Inference(
                 InferenceName.ACTION, InferenceAttribute.IS, "restart_all"
-            ).with_config(reason="training_hang")
+            ).with_config(**cfg)
         ]
+
+    def _summarize_dumps(self) -> dict:
+        from dlrover_tpu.diagnosis.data import HangDumpRecord
+        from dlrover_tpu.profiler.analysis import StackTrie
+
+        dumps = [
+            r
+            for r in self._data_manager.latest_per_node(
+                DiagnosisDataType.HANG_DUMP
+            ).values()
+            if isinstance(r, HangDumpRecord)
+        ]
+        if not dumps:
+            return {}
+        trie = StackTrie()
+        pending_names = set()
+        for rec in dumps:
+            for text in rec.stacks.values():
+                trie.add_dump(text)
+            for rank in rec.pending.values():
+                for prog in rank.get("pending", []):
+                    name = prog.get("name") if isinstance(prog, dict) else prog
+                    if name:
+                        pending_names.add(str(name))
+        out: dict = {"hang_dump_hosts": len(dumps)}
+        hot = trie.hot_path()
+        if hot:
+            out["stuck_at"] = hot[-1]
+        if pending_names:
+            # config values travel as strings; keep the list greppable
+            out["pending_programs"] = ",".join(sorted(pending_names)[:8])
+        return out
 
 
 class ResolveFailureNodeOperator(InferenceOperator):

@@ -91,8 +91,7 @@ class DistributedJobManager(JobManager):
                 getattr(cb, hook)(node, self._cluster_context)
             except Exception:
                 # a broken observer must never break node handling (the
-                # relaunch decision runs after this) — guaranteed here,
-                # not just for callbacks that used @log_callback_exception
+                # relaunch decision runs after this)
                 logger.exception(
                     "node-event callback %s.%s failed",
                     type(cb).__name__, hook,

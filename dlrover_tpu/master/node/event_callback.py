@@ -13,11 +13,9 @@ reproduce exactly the previous inline behavior.
 from __future__ import annotations
 
 import abc
-import functools
 from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import NodeType
-from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import Node
 
 
@@ -27,21 +25,6 @@ class ClusterContext:
     def __init__(self, job_manager):
         self.job_manager = job_manager
 
-
-def log_callback_exception(func):
-    """A broken observer must never break node-event handling."""
-
-    @functools.wraps(func)
-    def wrapper(self, *args, **kwargs):
-        try:
-            return func(self, *args, **kwargs)
-        except Exception:
-            logger.exception(
-                "node-event callback %s.%s failed",
-                type(self).__name__, func.__name__,
-            )
-
-    return wrapper
 
 
 class NodeEventCallback(abc.ABC):
@@ -70,12 +53,10 @@ class TaskRescheduleCallback(NodeEventCallback):
     def __init__(self, task_manager):
         self._task_manager = task_manager
 
-    @log_callback_exception
     def on_node_failed(self, node: Node, cluster_context: ClusterContext):
         if node.type == NodeType.WORKER:
             self._task_manager.remove_node_tasks(node.id)
 
-    @log_callback_exception
     def on_node_deleted(self, node: Node, cluster_context: ClusterContext):
         if node.type == NodeType.WORKER:
             self._task_manager.remove_node_tasks(node.id)
@@ -96,14 +77,12 @@ class AllReduceNodeHandlingCallback(NodeEventCallback):
         self._speed_monitor = speed_monitor
         self._job_auto_scaler = job_auto_scaler
 
-    @log_callback_exception
     def on_node_started(self, node: Node, cluster_context: ClusterContext):
         if node.type != NodeType.WORKER:
             return
         if self._speed_monitor is not None:
             self._speed_monitor.add_running_worker(node.type, node.id)
 
-    @log_callback_exception
     def on_node_succeeded(self, node: Node, cluster_context: ClusterContext):
         if node.type != NodeType.WORKER:
             return
@@ -112,7 +91,6 @@ class AllReduceNodeHandlingCallback(NodeEventCallback):
         for mgr in self._rdzv_managers.values():
             mgr.remove_alive_node(node.id)
 
-    @log_callback_exception
     def on_node_failed(self, node: Node, cluster_context: ClusterContext):
         if node.type != NodeType.WORKER:
             return
@@ -120,7 +98,6 @@ class AllReduceNodeHandlingCallback(NodeEventCallback):
         if self._job_auto_scaler is not None:
             self._job_auto_scaler.handle_node_failure(node.type, node.id)
 
-    @log_callback_exception
     def on_node_deleted(self, node: Node, cluster_context: ClusterContext):
         if node.type != NodeType.WORKER:
             return

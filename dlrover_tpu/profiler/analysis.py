@@ -1,0 +1,307 @@
+"""Offline analysis tooling over profiler artifacts.
+
+Parity: the reference ships a ``py_xpu_timer`` toolbox next to its native
+profiler — a stack-trie viewer for all-rank stacktrace dumps
+(``xpu_timer/py_xpu_timer/py_xpu_timer/stack_viewer.py:21-132``), matmul
+timing analysis/replay (``parse_matmul.py``) and NCCL collective analysis.
+TPU-natively the inputs differ (faulthandler stack dumps from
+``profiler.hang_dump``, chrome-trace timelines and per-program Prometheus
+counters from ``native/tpu_timer``), but the questions are the same:
+
+- **Where is everyone stuck?** Merge every rank's Python stacks into a
+  trie; a hang shows up as one deep shared path with ``n_ranks`` weight.
+- **What is the device doing?** Per-program duration stats, device
+  occupancy, and the largest execution gaps (host-bound stalls) from the
+  chrome-trace timeline.
+- **How fast SHOULD this matmul be?** Replay an (M, K, N) matmul on the
+  live backend and report achieved vs peak FLOPs — the reference's replay
+  tool rebuilt CUDA GEMMs; here XLA compiles the same HLO the trainer hits.
+
+CLI::
+
+    python -m dlrover_tpu.profiler.analysis stacks <bundle.json | dir>
+    python -m dlrover_tpu.profiler.analysis timeline <timeline.json>
+    python -m dlrover_tpu.profiler.analysis matmul-bench M K N [--dtype bf16]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Stack trie (reference stack_viewer.py)
+# ---------------------------------------------------------------------------
+
+#: one faulthandler frame: `  File "x.py", line 10 in foo`
+_FRAME_RE = re.compile(r'^\s*File "(?P<file>[^"]+)", line (?P<line>\d+) in (?P<func>.+)$')
+_THREAD_RE = re.compile(r"^(Current thread|Thread) (?P<tid>0x[0-9a-fA-F]+)")
+
+
+def parse_faulthandler(text: str) -> List[List[str]]:
+    """Parse faulthandler output into stacks, one per thread, each a list
+    of ``func (file:line)`` frames ordered root-first (faulthandler prints
+    most-recent-call-first; we reverse so the trie roots at the entry
+    point, like a flamegraph)."""
+    stacks: List[List[str]] = []
+    cur: Optional[List[str]] = None
+    for line in text.splitlines():
+        if _THREAD_RE.match(line):
+            if cur:
+                stacks.append(list(reversed(cur)))
+            cur = []
+            continue
+        m = _FRAME_RE.match(line)
+        if m and cur is not None:
+            short = os.path.basename(m.group("file"))
+            cur.append(f"{m.group('func')} ({short}:{m.group('line')})")
+    if cur:
+        stacks.append(list(reversed(cur)))
+    return stacks
+
+
+@dataclass
+class _TrieNode:
+    weight: int = 0
+    children: Dict[str, "_TrieNode"] = field(default_factory=dict)
+
+
+class StackTrie:
+    """Merge many ranks' stacks; shared prefixes accumulate weight so the
+    dominant (stuck) path is the heaviest branch."""
+
+    def __init__(self):
+        self._root = _TrieNode()
+        self.total = 0
+
+    def insert(self, frames: List[str], weight: int = 1):
+        self.total += weight
+        node = self._root
+        node.weight += weight
+        for fr in frames:
+            node = node.children.setdefault(fr, _TrieNode())
+            node.weight += weight
+
+    def add_dump(self, text: str, weight: int = 1):
+        for stack in parse_faulthandler(text):
+            self.insert(stack, weight)
+
+    def render(self, min_share: float = 0.05, _node=None, _depth=0) -> str:
+        """Indented trie, heaviest children first, pruned below
+        ``min_share`` of the total weight."""
+        node = _node or self._root
+        lines: List[str] = []
+        if _depth == 0 and self.total == 0:
+            return "<no stacks>"
+        for name, child in sorted(
+            node.children.items(), key=lambda kv: -kv[1].weight
+        ):
+            if child.weight < min_share * self.total:
+                continue
+            pct = 100.0 * child.weight / self.total
+            lines.append(f"{'  ' * _depth}{child.weight:4d} {pct:5.1f}%  {name}")
+            sub = self.render(min_share, child, _depth + 1)
+            if sub:
+                lines.append(sub)
+        return "\n".join(l for l in lines if l)
+
+    def hot_path(self) -> List[str]:
+        """The single heaviest root-to-leaf path — for a collective hang
+        this is the frame every rank is parked in."""
+        path: List[str] = []
+        node = self._root
+        while node.children:
+            name, node = max(node.children.items(), key=lambda kv: kv[1].weight)
+            path.append(name)
+        return path
+
+
+def load_stacks(path: str) -> StackTrie:
+    """Build a trie from a hang bundle JSON (``HangDumper.dump`` output:
+    ``{"stacks": {pid: text}}``) or a directory of ``hang_stacks-*.txt``."""
+    trie = StackTrie()
+    if os.path.isdir(path):
+        for fn in sorted(os.listdir(path)):
+            if fn.startswith("hang_stacks-"):
+                with open(os.path.join(path, fn)) as f:
+                    trie.add_dump(f.read())
+    else:
+        with open(path) as f:
+            bundle = json.load(f)
+        for text in bundle.get("stacks", {}).values():
+            trie.add_dump(text)
+    return trie
+
+
+# ---------------------------------------------------------------------------
+# Timeline analysis (reference parse_matmul.py / NCCL analysis, TPU-shaped)
+# ---------------------------------------------------------------------------
+
+
+def analyze_timeline(events: Iterable[Dict]) -> Dict:
+    """Chrome-trace "X" events -> per-program stats + device occupancy +
+    largest inter-execution gaps (host-bound stalls: the device idles while
+    Python/dispatch catches up)."""
+    per: Dict[str, List[int]] = {}
+    spans: List[Tuple[int, int]] = []  # (start, end) us, execute events only
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name, dur = ev.get("name", "?"), int(ev.get("dur", 0))
+        per.setdefault(f"{ev.get('cat', '?')}:{name}", []).append(dur)
+        if ev.get("cat") == "execute":
+            ts = int(ev.get("ts", 0))
+            spans.append((ts, ts + dur))
+
+    programs = {}
+    total_us = sum(sum(v) for v in per.values()) or 1
+    for name, durs in sorted(per.items(), key=lambda kv: -sum(kv[1])):
+        durs.sort()
+        n = len(durs)
+        programs[name] = {
+            "count": n,
+            "total_us": sum(durs),
+            "share": round(sum(durs) / total_us, 4),
+            "mean_us": round(sum(durs) / n, 1),
+            "p50_us": durs[n // 2],
+            "p99_us": durs[min(n - 1, int(n * 0.99))],
+        }
+
+    occupancy, gaps = 0.0, []
+    if spans:
+        spans.sort()
+        wall = spans[-1][1] - spans[0][0]
+        busy, cur_s, cur_e = 0, spans[0][0], spans[0][0]
+        for s, e in spans:
+            if s > cur_e:  # device idle between executions
+                gaps.append({"at_us": cur_e, "gap_us": s - cur_e})
+                busy += cur_e - cur_s
+                cur_s, cur_e = s, e
+            else:
+                cur_e = max(cur_e, e)
+        busy += cur_e - cur_s
+        occupancy = busy / wall if wall else 1.0
+        gaps.sort(key=lambda g: -g["gap_us"])
+    return {
+        "programs": programs,
+        "device_occupancy": round(occupancy, 4),
+        "top_gaps": gaps[:10],
+    }
+
+
+def analyze_timeline_file(path: str) -> Dict:
+    with open(path) as f:
+        doc = json.load(f)
+    return analyze_timeline(doc.get("traceEvents", []))
+
+
+# ---------------------------------------------------------------------------
+# Matmul replay microbench (reference matmul replay, XLA-shaped)
+# ---------------------------------------------------------------------------
+
+
+def matmul_bench(m: int, k: int, n: int, dtype: str = "bfloat16",
+                 iters: int = 20) -> Dict:
+    """Time C[m,n] = A[m,k] @ B[k,n] on the live backend; report achieved
+    FLOPs and, on TPU, the fraction of the chip's peak — is this shape
+    MXU-friendly or is something (layout, small dims) leaving it on the
+    table?"""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.utils.tpu_info import peak_bf16_flops
+
+    dt = jnp.dtype(dtype)
+    a = jax.random.normal(jax.random.key(0), (m, k), jnp.float32).astype(dt)
+    b = jax.random.normal(jax.random.key(1), (k, n), jnp.float32).astype(dt)
+    # the reduction rides the same device stream as the matmuls, so
+    # fetching it waits for every queued iteration — device_get, NOT
+    # block_until_ready, which a remote-tunnel PJRT plugin (axon)
+    # resolves before the computation actually finishes
+    f = jax.jit(lambda a, b: a @ b)
+    g = jax.jit(lambda o: jnp.sum(o.astype(jnp.float32)))
+    import time
+
+    jax.device_get(g(f(a, b)))  # compile both
+    t0 = time.perf_counter()
+    jax.device_get(g(f(a, b)))
+    t_sync = time.perf_counter() - t0  # upper bound on one compute+fetch
+
+    lat_probe = g(f(a, b))  # computed long before it is fetched
+    time.sleep(max(0.05, 2.0 * t_sync))  # compute certainly done by now
+    t0 = time.perf_counter()
+    jax.device_get(lat_probe)
+    lat = time.perf_counter() - t0  # tunnel roundtrip only
+
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(a, b)
+    jax.device_get(g(out))
+    dt_s = max(time.perf_counter() - t0 - lat, 1e-9) / iters
+    achieved = 2.0 * m * k * n / dt_s
+    dev = jax.devices()[0]
+    peak = peak_bf16_flops(getattr(dev, "device_kind", ""))
+    return {
+        "m": m, "k": k, "n": n, "dtype": str(dt),
+        "backend": jax.default_backend(),
+        "time_us": round(dt_s * 1e6, 1),
+        "achieved_gflops": round(achieved / 1e9, 2),
+        "achieved_tflops": round(achieved / 1e12, 3),
+        "pct_peak": round(achieved / peak, 4) if peak else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser("dlrover-tpu-analysis")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("stacks", help="stack-trie view of a hang dump")
+    ps.add_argument("path")
+    ps.add_argument("--min-share", type=float, default=0.05)
+    pt = sub.add_parser("timeline", help="per-program stats from a timeline")
+    pt.add_argument("path")
+    pm = sub.add_parser("matmul-bench", help="replay an (M,K,N) matmul")
+    pm.add_argument("m", type=int)
+    pm.add_argument("k", type=int)
+    pm.add_argument("n", type=int)
+    pm.add_argument("--dtype", default="bfloat16")
+    pm.add_argument("--iters", type=int, default=20)
+    pm.add_argument(
+        "--platform", default="",
+        help="force a jax platform (e.g. cpu) — set via jax.config, which "
+             "wins even where sitecustomize overrides JAX_PLATFORMS",
+    )
+    args = p.parse_args(argv)
+
+    if getattr(args, "platform", ""):
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    if args.cmd == "stacks":
+        trie = load_stacks(args.path)
+        print(trie.render(min_share=args.min_share))
+        hot = trie.hot_path()
+        if hot:
+            print(f"\nhot path leaf: {hot[-1]}")
+    elif args.cmd == "timeline":
+        print(json.dumps(analyze_timeline_file(args.path), indent=2))
+    else:
+        print(json.dumps(
+            matmul_bench(args.m, args.k, args.n, args.dtype, args.iters)
+        ))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,27 @@
+"""Static TPU hardware facts used by benchmarks and analysis tooling.
+
+Peak dense bf16 FLOPs/s per chip by ``device_kind`` substring. First
+match wins, so the more specific "v5 lite" entry outranks "v5".
+"""
+
+from __future__ import annotations
+
+PEAK_BF16 = [
+    ("v6", 918e12),       # Trillium / v6e
+    ("v5 lite", 197e12),  # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_bf16_flops(device_kind: str) -> float:
+    """Peak dense bf16 FLOPs/s for a device kind string; 0.0 if unknown."""
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    return 0.0

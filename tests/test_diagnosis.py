@@ -201,3 +201,39 @@ def test_action_expiry():
     ctx.enqueue_action(actions.restart_worker(1, reason="x"))
     got = ctx.next_action(1)
     assert got is not None and got.action_cls == "RestartWorker"
+
+
+def test_hang_resolver_summarizes_hang_dumps():
+    from dlrover_tpu.diagnosis.data import HangDumpRecord
+
+    stack = (
+        'Thread 0x1 (most recent call first):\n'
+        '  File "/app/dlrover_tpu/ops/ring_attention.py", line 88 in _ring_step\n'
+        '  File "/app/train.py", line 80 in main\n'
+    )
+    bundle = {
+        "reason": "tpu_timer_hang",
+        "stacks": {"101": stack, "102": stack},
+        "pending": {"9200": {"hang": True, "pending": [
+            {"name": "jit_train_step", "age_us": 9_000_000}]}},
+    }
+    rec = parse_report("HangDumpRecord", json.dumps(bundle), node_id=0)
+    assert isinstance(rec, HangDumpRecord)
+    assert rec.data_type == DiagnosisDataType.HANG_DUMP
+
+    dm = DiagnosisDataManager()
+    dm.store_data(rec)
+    op = ResolveTrainingHangOperator(dm)
+    (fact,) = op.infer([])
+    cfg = fact.config()
+    assert fact.description == "restart_all"
+    assert cfg["stuck_at"].startswith("_ring_step")
+    assert cfg["pending_programs"] == "jit_train_step"
+    assert cfg["hang_dump_hosts"] == "1"
+
+
+def test_hang_resolver_without_dumps_keeps_plain_action():
+    dm = DiagnosisDataManager()
+    (fact,) = ResolveTrainingHangOperator(dm).infer([])
+    assert fact.description == "restart_all"
+    assert "stuck_at" not in fact.config()

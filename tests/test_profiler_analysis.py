@@ -1,0 +1,109 @@
+"""Profiler analysis tooling: stack trie, timeline stats, matmul replay.
+
+Parity: reference ``py_xpu_timer`` tests its stack viewer and timeline
+tooling offline against canned artifacts; same approach here.
+"""
+
+import json
+
+from dlrover_tpu.profiler.analysis import (
+    StackTrie,
+    analyze_timeline,
+    load_stacks,
+    matmul_bench,
+    parse_faulthandler,
+)
+
+DUMP_RANK0 = """\
+Thread 0x00007f11 (most recent call first):
+  File "/app/dlrover_tpu/ops/ring_attention.py", line 88 in _ring_step
+  File "/app/train.py", line 40 in train_step
+  File "/app/train.py", line 80 in main
+Current thread 0x00007f22 (most recent call first):
+  File "/usr/lib/python3.11/threading.py", line 320 in wait
+  File "/app/dlrover_tpu/checkpoint/engine.py", line 100 in _stage_loop
+"""
+
+DUMP_RANK1 = """\
+Thread 0x00007f33 (most recent call first):
+  File "/app/dlrover_tpu/ops/ring_attention.py", line 88 in _ring_step
+  File "/app/train.py", line 40 in train_step
+  File "/app/train.py", line 80 in main
+"""
+
+
+def test_parse_faulthandler_orders_root_first():
+    stacks = parse_faulthandler(DUMP_RANK0)
+    assert len(stacks) == 2
+    # root-first: entry point at index 0, innermost frame last
+    assert stacks[0][0].startswith("main (train.py:80")
+    assert stacks[0][-1].startswith("_ring_step (ring_attention.py:88")
+
+
+def test_stack_trie_merges_shared_hang_path():
+    trie = StackTrie()
+    trie.add_dump(DUMP_RANK0)
+    trie.add_dump(DUMP_RANK1)
+    # both ranks share main -> train_step -> _ring_step; the checkpoint
+    # thread is a 1-weight side branch
+    hot = trie.hot_path()
+    assert hot[-1].startswith("_ring_step")
+    rendered = trie.render(min_share=0.0)
+    assert "   2  66.7%  main (train.py:80)" in rendered
+    assert "_stage_loop" in rendered
+
+
+def test_load_stacks_from_bundle_json(tmp_path):
+    bundle = {"stacks": {"101": DUMP_RANK0, "102": DUMP_RANK1}}
+    p = tmp_path / "bundle.json"
+    p.write_text(json.dumps(bundle))
+    trie = load_stacks(str(p))
+    assert trie.total == 3
+    assert trie.hot_path()[-1].startswith("_ring_step")
+
+
+def test_load_stacks_from_dir(tmp_path):
+    (tmp_path / "hang_stacks-101.txt").write_text(DUMP_RANK0)
+    (tmp_path / "hang_stacks-102.txt").write_text(DUMP_RANK1)
+    trie = load_stacks(str(tmp_path))
+    assert trie.total == 3
+
+
+def test_analyze_timeline_stats_occupancy_and_gaps():
+    events = [
+        # two executes back to back, then a 500us host stall, then another
+        {"name": "jit_step", "cat": "execute", "ph": "X", "ts": 0, "dur": 100},
+        {"name": "jit_step", "cat": "execute", "ph": "X", "ts": 100, "dur": 100},
+        {"name": "jit_step", "cat": "execute", "ph": "X", "ts": 700, "dur": 200},
+        {"name": "jit_step", "cat": "compile", "ph": "X", "ts": 0, "dur": 50},
+    ]
+    rep = analyze_timeline(events)
+    ex = rep["programs"]["execute:jit_step"]
+    assert ex["count"] == 3 and ex["total_us"] == 400
+    # busy 400us over a 900us wall
+    assert abs(rep["device_occupancy"] - 400 / 900) < 1e-4
+    assert rep["top_gaps"][0]["gap_us"] == 500
+    assert "compile:jit_step" in rep["programs"]
+
+
+def test_matmul_bench_runs_on_any_backend():
+    rep = matmul_bench(64, 64, 64, dtype="float32", iters=2)
+    assert rep["achieved_gflops"] > 0
+    assert rep["time_us"] > 0
+
+
+def test_cli_stacks_and_timeline(tmp_path, capsys):
+    from dlrover_tpu.profiler.analysis import main
+
+    bundle = tmp_path / "bundle.json"
+    bundle.write_text(json.dumps({"stacks": {"1": DUMP_RANK1}}))
+    assert main(["stacks", str(bundle)]) == 0
+    out = capsys.readouterr().out
+    assert "hot path leaf" in out and "_ring_step" in out
+
+    tl = tmp_path / "timeline.json"
+    tl.write_text(json.dumps({"traceEvents": [
+        {"name": "p", "cat": "execute", "ph": "X", "ts": 0, "dur": 10},
+    ]}))
+    assert main(["timeline", str(tl)]) == 0
+    assert "device_occupancy" in capsys.readouterr().out
