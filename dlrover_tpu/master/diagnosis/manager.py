@@ -108,11 +108,26 @@ class DiagnosisManager:
             return
         cfg = fact.config()
         if fact.description == "restart_all":
+            # the hang resolver may have summarized shipped hang dumps —
+            # carry the stuck frame into the action reason and the event
+            # log so the restart names WHERE the fleet was parked
+            reason = cfg.get("reason", "hang")
+            stuck_at = cfg.get("stuck_at", "")
+            if stuck_at:
+                reason = f"{reason} @ {stuck_at}"
             for node in self._job_context.workers().values():
                 self._job_context.enqueue_action(
-                    actions.restart_worker(node.id, reason=cfg.get("reason", "hang"))
+                    actions.restart_worker(node.id, reason=reason)
                 )
-            logger.warning("diagnosis: training hang -> restart all workers")
+            logger.warning(
+                "diagnosis: training hang -> restart all workers%s%s",
+                f" (stuck at {stuck_at})" if stuck_at else "",
+                (
+                    f" (pending: {cfg['pending_programs']})"
+                    if cfg.get("pending_programs")
+                    else ""
+                ),
+            )
         elif fact.description == "restart":
             node_id = int(cfg.get("node_id", -1))
             self._job_context.enqueue_action(

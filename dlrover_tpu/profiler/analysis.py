@@ -21,7 +21,7 @@ CLI::
 
     python -m dlrover_tpu.profiler.analysis stacks <bundle.json | dir>
     python -m dlrover_tpu.profiler.analysis timeline <timeline.json>
-    python -m dlrover_tpu.profiler.analysis matmul-bench M K N [--dtype bf16]
+    python -m dlrover_tpu.profiler.analysis matmul-bench M K N [--dtype bfloat16]
 """
 
 from __future__ import annotations
@@ -214,7 +214,8 @@ def matmul_bench(m: int, k: int, n: int, dtype: str = "bfloat16",
 
     from dlrover_tpu.utils.tpu_info import peak_bf16_flops
 
-    dt = jnp.dtype(dtype)
+    dt = jnp.dtype({"bf16": "bfloat16", "f32": "float32",
+                    "f16": "float16"}.get(dtype, dtype))
     a = jax.random.normal(jax.random.key(0), (m, k), jnp.float32).astype(dt)
     b = jax.random.normal(jax.random.key(1), (k, n), jnp.float32).astype(dt)
     # the reduction rides the same device stream as the matmuls, so
@@ -244,14 +245,18 @@ def matmul_bench(m: int, k: int, n: int, dtype: str = "bfloat16",
     dt_s = max(time.perf_counter() - t0 - lat, 1e-9) / iters
     achieved = 2.0 * m * k * n / dt_s
     dev = jax.devices()[0]
+    # the peak table is dense-bf16; comparing another dtype against it
+    # would answer the MXU-efficiency question wrongly
     peak = peak_bf16_flops(getattr(dev, "device_kind", ""))
+    is_bf16 = dt == jnp.bfloat16
     return {
         "m": m, "k": k, "n": n, "dtype": str(dt),
         "backend": jax.default_backend(),
         "time_us": round(dt_s * 1e6, 1),
         "achieved_gflops": round(achieved / 1e9, 2),
         "achieved_tflops": round(achieved / 1e12, 3),
-        "pct_peak": round(achieved / peak, 4) if peak else None,
+        "pct_peak": (round(achieved / peak, 4)
+                     if peak and is_bf16 else None),
     }
 
 

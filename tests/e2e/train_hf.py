@@ -1,0 +1,63 @@
+"""E2e script: Hugging Face Flax GPT-2 + ElasticTrainer + flash
+checkpoint under the elastic agent — the HF interop path
+(``dlrover_tpu/train/hf.py``) inside the real launch stack."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import dlrover_tpu.train as dtrain
+
+ctx = dtrain.init(local_device_count=4)
+
+import jax
+import transformers
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer
+from dlrover_tpu.parallel import MeshConfig, build_mesh
+from dlrover_tpu.train.hf import HFCausalLMAdapter
+from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+CKPT_DIR = os.environ["DLROVER_TPU_TEST_CKPT_DIR"]
+N_STEPS = int(os.environ.get("DLROVER_TPU_TEST_STEPS", "4"))
+
+model = transformers.FlaxGPT2LMHeadModel(
+    transformers.GPT2Config(
+        n_embd=128, n_layer=2, n_head=2, vocab_size=1024, n_positions=64
+    ),
+    seed=0,
+)
+adapter = HFCausalLMAdapter(model)
+
+mc = MeshConfig(dp=-1, fsdp=2, sp=1, tp=1).resolve(len(jax.devices()))
+mesh = build_mesh(mc)
+specs = adapter.param_specs(mesh)
+
+tc = TrainConfig(global_batch_size=8, micro_batch_size=2, warmup_steps=0,
+                 total_steps=N_STEPS, learning_rate=1e-3)
+trainer = ElasticTrainer(adapter.loss_fn, specs, mesh, mc, tc,
+                         worker_ctx=ctx)
+state = trainer.init_state(adapter.shard_params(mesh))
+
+ckpt = Checkpointer(CKPT_DIR)
+restored = ckpt.load(target=state)
+start = 0
+if restored is not None:
+    start, state = restored
+    print(f"restored from step {start}", flush=True)
+
+a, b = trainer.step_batch_shape
+for step in range(start, N_STEPS):
+    batch = jax.random.randint(
+        jax.random.fold_in(jax.random.key(7), step), (a, b, 32), 0, 1024
+    )
+    state, loss = trainer.step(state, batch)
+    print(f"step {step + 1} loss {float(loss):.4f}", flush=True)
+    ckpt.save(step + 1, state)
+
+ckpt.close()
+print("HF_E2E_DONE", flush=True)
