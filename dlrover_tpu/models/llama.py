@@ -68,6 +68,11 @@ class LlamaConfig:
     # fraction of its memory
     remat_policy: str = "all"
     attn_impl: str = "auto"            # auto | flash | reference | ring
+    # flash-attention tile sizes — a hardware tuning knob (MXU is
+    # 128x128; longer q tiles amortize the kv-loop overhead when the
+    # per-core sequence is long enough)
+    attn_block_q: int = 128
+    attn_block_k: int = 128
     # pipeline parallelism: microbatches in flight per step (0 → pp size).
     # More microbatches shrink the GPipe bubble (pp-1)/(n_micro+pp-1).
     pp_microbatches: int = 0
@@ -201,7 +206,9 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
 
         qspec = P(BATCH_AXES, SP, TP, None)
         ring = shard_map(
-            functools.partial(ring_attention, axis_name=SP, causal=True),
+            functools.partial(ring_attention, axis_name=SP, causal=True,
+                              block_q=cfg.attn_block_q,
+                              block_k=cfg.attn_block_k),
             mesh=mesh,
             in_specs=(qspec, qspec, qspec),
             out_specs=qspec,
@@ -210,7 +217,9 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
         return ring(q, k, v)
     if impl == "reference":
         return mha_reference(q, k, v, causal=True)
-    return flash_attention(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=True,
+                           block_q=cfg.attn_block_q,
+                           block_k=cfg.attn_block_k)
 
 
 def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x):

@@ -56,6 +56,8 @@ class MoeConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     attn_impl: str = "auto"
+    attn_block_q: int = 128
+    attn_block_k: int = 128
 
     @property
     def head_dim(self) -> int:
@@ -77,6 +79,8 @@ class MoeConfig:
             param_dtype=self.param_dtype,
             remat=self.remat,
             attn_impl=self.attn_impl,
+            attn_block_q=self.attn_block_q,
+            attn_block_k=self.attn_block_k,
         )
 
     # ---- presets -------------------------------------------------------

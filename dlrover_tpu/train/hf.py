@@ -56,11 +56,32 @@ def derive_param_specs(params, n_shards: int, axis: str = "fsdp",
 
 class HFCausalLMAdapter:
     """Wraps a ``transformers`` Flax causal-LM so ElasticTrainer can
-    drive it: loss, param specs, and sharded placement."""
+    drive it: loss, param specs, and sharded placement.
+
+    The forward runs deterministic (``train=False``): ElasticTrainer's
+    loss signature carries no dropout rng, and LLM pretraining runs
+    dropout-free anyway. A model config with nonzero dropout gets a
+    loud warning at construction rather than silently-disabled
+    regularization."""
 
     def __init__(self, model, pad_token_id: Optional[int] = None):
         self.model = model
         self.pad_token_id = pad_token_id
+        cfg_dict = getattr(getattr(model, "config", None), "__dict__", {})
+        drops = {
+            k: v for k, v in cfg_dict.items()
+            if ("drop" in k and isinstance(v, (int, float))
+                and not isinstance(v, bool) and v > 0)
+        }
+        if drops:
+            from dlrover_tpu.common.log import logger
+
+            logger.warning(
+                "HFCausalLMAdapter runs the model deterministic "
+                "(train=False); configured dropout %s will NOT be applied "
+                "— set the rates to 0 in the config to silence this",
+                drops,
+            )
 
     def loss_fn(self, params, tokens: jnp.ndarray) -> jnp.ndarray:
         """Next-token cross entropy over ``tokens`` (batch, seq) int32.
@@ -68,8 +89,12 @@ class HFCausalLMAdapter:
         logits = self.model(tokens, params=params, train=False).logits
         logits = logits[:, :-1].astype(jnp.float32)
         targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        # logsumexp + gather keeps the extra activation at (batch, seq)
+        # instead of materializing full (batch, seq, vocab) log-probs
+        # (same form as models/llama.py _ce_sums)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        nll = logz - gold
         if self.pad_token_id is not None:
             mask = (targets != self.pad_token_id).astype(jnp.float32)
             return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,121 @@
+"""Elastic Llama pretraining — the flagship example.
+
+Run single-host (CPU demo, 8 virtual devices):
+
+    LOCAL_DEVICES=8 \
+    dlrover-tpu-run --standalone --nnodes=1 --nproc_per_node=1 \
+        --accelerator=cpu examples/llama_pretrain.py -- \
+        --model tiny --steps 20 --fsdp 2 --tp 2
+
+Multi-host TPU (per host, master already up):
+
+    dlrover-tpu-run --master_addr $MASTER:50051 --nnodes=2:8 \
+        --network-check --ckpt-replica examples/llama_pretrain.py -- \
+        --model 8b --fsdp 8 --tp 4 --ckpt-dir /mnt/ckpt
+
+The script is fully elastic: a membership change re-runs rendezvous,
+the trainer re-derives gradient accumulation so the global batch is
+unchanged, and state restores from shm/replica/storage.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dlrover_tpu.train as dtrain
+
+
+def parse_args():
+    p = argparse.ArgumentParser("llama_pretrain")
+    p.add_argument("--model", default="tiny",
+                   choices=["tiny", "1b", "8b"])
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--global-batch", type=int, default=0,
+                   help="0 = pick per model")
+    p.add_argument("--micro-batch", type=int, default=2)
+    p.add_argument("--seq", type=int, default=0, help="0 = model default")
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--ckpt-dir", default="/tmp/llama_pretrain_ckpt")
+    p.add_argument("--save-every", type=int, default=10)
+    return p.parse_args()
+
+
+def model_config(name, llama, jnp):
+    if name == "tiny":
+        return llama.LlamaConfig.tiny(), 16
+    if name == "1b":
+        return llama.LlamaConfig(
+            vocab_size=32768, dim=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, ffn_dim=8192, max_seq_len=2048,
+            rope_theta=10000.0, dtype=jnp.bfloat16,
+            param_dtype=jnp.bfloat16,
+        ), 64
+    return llama.LlamaConfig(), 1024  # 8B-class defaults
+
+
+def main():
+    args = parse_args()
+    # LOCAL_DEVICES forces N virtual devices on the CPU demo path
+    n = os.environ.get("LOCAL_DEVICES")
+    ctx = dtrain.init(local_device_count=int(n) if n else None)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.checkpoint.checkpointer import Checkpointer
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    cfg, default_gb = model_config(args.model, llama, jnp)
+    seq = args.seq or cfg.max_seq_len
+    mc = MeshConfig(dp=-1, fsdp=args.fsdp, sp=args.sp, tp=args.tp).resolve(
+        len(jax.devices())
+    )
+    mesh = build_mesh(mc)
+    specs = llama.param_specs(cfg)
+    params = jax.jit(
+        lambda k: llama.init_params(cfg, k),
+        out_shardings=named_shardings(mesh, specs),
+    )(jax.random.key(0))
+
+    tc = TrainConfig(
+        global_batch_size=args.global_batch or default_gb,
+        micro_batch_size=args.micro_batch,
+        total_steps=args.steps,
+    )
+    trainer = ElasticTrainer(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh),
+        specs, mesh, mc, tc, worker_ctx=ctx,
+    )
+    state = trainer.init_state(params)
+
+    ckpt = Checkpointer(args.ckpt_dir, save_storage_interval=args.save_every)
+    restored = ckpt.load(target=state)
+    start = 0
+    if restored is not None:
+        start, state = restored
+        print(f"restored from step {start}", flush=True)
+
+    a, b = trainer.step_batch_shape
+    for step in range(start, args.steps):
+        # synthetic tokens; swap in ElasticDataLoader/ShardingClient for
+        # master-driven shard assignment (see docs/tutorial)
+        batch = jax.random.randint(
+            jax.random.fold_in(jax.random.key(1), step), (a, b, seq), 0,
+            cfg.vocab_size,
+        )
+        state, loss = trainer.step(state, batch)
+        ckpt.save(step + 1, state)
+        if jax.process_index() == 0:
+            print(f"step {step + 1} loss {float(loss):.4f}", flush=True)
+    ckpt.close()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

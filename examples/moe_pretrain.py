@@ -1,0 +1,71 @@
+"""Elastic Mixtral-class sparse-MoE pretraining with expert parallelism.
+
+    LOCAL_DEVICES=8 STEPS=10 \
+    dlrover-tpu-run --standalone --nnodes=1 --nproc_per_node=1 \
+        --accelerator=cpu examples/moe_pretrain.py
+
+Experts shard over the ``ep`` mesh axis; tokens are routed with a
+capacity-bounded top-2 router and travel via all-to-all inside the
+jitted step. On TPU pods set ep to the expert count and dp=-1.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dlrover_tpu.train as dtrain
+
+_n = os.environ.get("LOCAL_DEVICES")
+ctx = dtrain.init(local_device_count=int(_n) if _n else None)
+
+import jax
+
+from dlrover_tpu.checkpoint.checkpointer import Checkpointer
+from dlrover_tpu.models import moe
+from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+STEPS = int(os.environ.get("STEPS", "10"))
+SEQ = int(os.environ.get("SEQ", "64"))
+
+n_dev = len(jax.devices())
+ep = 2 if n_dev % 2 == 0 else 1
+mc = MeshConfig(dp=-1, fsdp=1, ep=ep, sp=1, tp=1).resolve(n_dev)
+mesh = build_mesh(mc)
+
+cfg = moe.MoeConfig.tiny(n_heads=4, n_kv_heads=2, max_seq_len=SEQ)
+specs = moe.param_specs(cfg)
+params = jax.jit(
+    lambda k: moe.init_params(cfg, k),
+    out_shardings=named_shardings(mesh, specs),
+)(jax.random.key(0))
+
+tc = TrainConfig(
+    global_batch_size=2 * mc.data_parallel_size, micro_batch_size=2,
+    total_steps=STEPS,
+)
+trainer = ElasticTrainer(
+    lambda p, t: moe.loss_fn(p, t, cfg, mesh), specs, mesh, mc, tc,
+    worker_ctx=ctx,
+)
+state = trainer.init_state(params)
+
+ckpt = Checkpointer("/tmp/moe_pretrain_ckpt", save_storage_interval=5)
+restored = ckpt.load(target=state)
+start = 0
+if restored is not None:
+    start, state = restored
+
+a, b = trainer.step_batch_shape
+for step in range(start, STEPS):
+    batch = jax.random.randint(
+        jax.random.fold_in(jax.random.key(1), step), (a, b, SEQ), 0,
+        cfg.vocab_size,
+    )
+    state, loss = trainer.step(state, batch)
+    ckpt.save(step + 1, state)
+    if jax.process_index() == 0:
+        print(f"step {step + 1} loss {float(loss):.4f}", flush=True)
+ckpt.close()
+print("DONE", flush=True)

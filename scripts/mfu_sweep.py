@@ -1,0 +1,97 @@
+"""MFU tuning sweep: time the full train step across config variants on
+the live chip and print a ranked table.
+
+Variants cover the knobs that move single-chip MFU: remat policy
+(full-layer vs save-ffn), micro-batch size, sequence length, and the
+flash-attention tile shape. Run on TPU; each variant reuses bench.py's
+timing discipline (device_get sync + tunnel-latency subtraction).
+
+    python scripts/mfu_sweep.py [--steps 6] [--only NAME_SUBSTR]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # repo-root benchmark module
+
+
+def variants(llama, jnp):
+    common = dict(
+        vocab_size=32768, n_heads=16, n_kv_heads=16, max_seq_len=4096,
+        rope_theta=10000.0, dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+    )
+    b12 = dict(dim=2048, n_layers=16, ffn_dim=8192, **common)
+    out = []
+
+    def add(name, micro, seq, **kw):
+        out.append((name, llama.LlamaConfig(**{**b12, **kw}), micro, seq))
+
+    add("base_b8_s2k_rematall", 8, 2048, remat=True, remat_policy="all")
+    add("mlp_b8_s2k", 8, 2048, remat=True, remat_policy="mlp")
+    add("mlp_b4_s2k", 4, 2048, remat=True, remat_policy="mlp")
+    add("norematb4_s2k", 4, 2048, remat=False)
+    add("norematb2_s2k", 2, 2048, remat=False)
+    add("base_b16_s2k", 16, 2048, remat=True, remat_policy="all")
+    add("base_b4_s4k", 4, 4096, remat=True, remat_policy="all")
+    add("blkq256_b8_s2k", 8, 2048, remat=True, remat_policy="all",
+        attn_block_q=256)
+    add("blkq512k256_b8_s2k", 8, 2048, remat=True, remat_policy="all",
+        attn_block_q=512, attn_block_k=256)
+    add("blk256_b4_s4k", 4, 4096, remat=True, remat_policy="all",
+        attn_block_q=256, attn_block_k=256)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--only", default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama
+
+    dev = jax.devices()[0]
+    peak = bench._peak_flops(dev)
+    print(f"# device {getattr(dev, 'device_kind', '?')} "
+          f"peak {peak / 1e12:.0f} TF", flush=True)
+
+    results = []
+    for name, cfg, micro, seq in variants(llama, jnp):
+        if args.only and args.only not in name:
+            continue
+        t0 = time.time()
+        try:
+            _, _, _, step_s = bench._run_mfu(
+                jax, jnp, llama, cfg, micro, seq, args.steps
+            )
+            flops = bench._model_flops_per_step(cfg, micro, seq)
+            mfu = flops / step_s / peak if peak else 0.0
+            results.append((mfu, name, step_s))
+            print(json.dumps({
+                "variant": name, "mfu": round(mfu, 4),
+                "step_s": round(step_s, 4),
+                "tokens_per_s": round(micro * seq / step_s),
+                "wall_s": round(time.time() - t0, 1),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "variant": name,
+                "error": f"{type(e).__name__}: {str(e)[:160]}",
+            }), flush=True)
+
+    results.sort(reverse=True)
+    print("\n# ranked")
+    for mfu, name, step_s in results:
+        print(f"#  {mfu:.4f}  {name}  ({step_s:.3f} s/step)")
+
+
+if __name__ == "__main__":
+    main()
