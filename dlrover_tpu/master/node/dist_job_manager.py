@@ -367,6 +367,59 @@ class DistributedJobManager(JobManager):
                 self._check_heartbeats()
             except Exception:
                 logger.exception("heartbeat check failed")
+            try:
+                self._reconcile_stuck_pending()
+            except Exception:
+                logger.exception("stuck-pending reconcile failed")
+
+    def _reconcile_stuck_pending(self):
+        """Shrink-to-capacity instead of dying: when relaunched/scaled-up
+        pods sit Pending beyond the timeout while at least ``min_nodes``
+        workers are Running, release the stuck pods so rendezvous
+        completes with the running set (reference
+        ``worker.py:329 is_training_hang_by_pending`` +
+        ``job_auto_scaler.py:315 _periodic_adjust_worker``: pending that
+        blocks training reduces the node group). ``should_early_stop``'s
+        PENDING_TIMEOUT still fires when Running < min — a job that
+        cannot make progress at all."""
+        now = time.time()
+        spec = self._job_args.worker_spec
+        min_nodes = spec.min_nodes or spec.group.count
+        node_unit = max(1, self._job_args.node_unit)
+        plan = ScalePlan()
+        # read + mutate under the same lock handle_node_event uses, or a
+        # PENDING->RUNNING transition in the gap gets released as stuck
+        with self._lock:
+            workers = list(self._job_context.workers().values())
+            running = [
+                n
+                for n in workers
+                if n.status == NodeStatus.RUNNING and not n.is_released
+            ]
+            stuck = [
+                n
+                for n in workers
+                if n.status in (NodeStatus.INITIAL, NodeStatus.PENDING)
+                and not n.is_released
+                # no create_time = the pod isn't materialized yet (fresh
+                # relaunch, or a CR-mode scaler that never reports it) —
+                # age unknown, never "stuck"
+                and n.create_time
+                and now - n.create_time > self._pending_timeout
+            ]
+            target = (len(running) // node_unit) * node_unit
+            if not stuck or len(running) < min_nodes or target < min_nodes:
+                return
+            for node in stuck:
+                node.relaunchable = False
+                node.is_released = True
+                plan.remove_nodes.append(node)
+        logger.warning(
+            "releasing %d workers stuck pending > %.0fs; training continues "
+            "with %d running (min %d)",
+            len(stuck), self._pending_timeout, len(running), min_nodes,
+        )
+        self._scaler.scale(plan)
 
     def _check_heartbeats(self):
         now = time.time()

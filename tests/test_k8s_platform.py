@@ -615,3 +615,67 @@ def test_raising_third_party_callback_does_not_block_relaunch():
     run_event(mgr, 0, NodeStatus.RUNNING)
     run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.OOM)
     assert scaler.plans[-1].launch_nodes[0].id == 4  # relaunch happened
+
+
+def test_stuck_pending_released_when_enough_running():
+    """Shrink-to-capacity (reference is_training_hang_by_pending): pods
+    stuck Pending beyond the timeout are released — not the whole job —
+    while >= min_nodes keep running."""
+    mgr, scaler = make_manager(pending_timeout=0.1)
+    mgr._init_nodes()
+    mgr._start_ts = time.time() - 10
+    ctx = get_job_context()
+    # node_unit=2: 3 running rounds to 2 >= min 2; 1 stays stuck pending
+    for node_id in range(3):
+        ctx.get_node(NodeType.WORKER, node_id).create_time = time.time()
+        run_event(mgr, node_id, NodeStatus.RUNNING)
+    stuck = ctx.get_node(NodeType.WORKER, 3)
+    stuck.status = NodeStatus.PENDING
+    stuck.create_time = time.time() - 10
+
+    mgr._reconcile_stuck_pending()
+    assert scaler.plans[-1].remove_nodes == [stuck]
+    assert stuck.is_released and not stuck.relaunchable
+    # the job itself is NOT early-stopped
+    stop, _, _ = mgr.should_early_stop()
+    assert not stop
+
+
+def test_stuck_pending_not_released_below_min():
+    """With fewer than min_nodes running the early-stop path must win —
+    releasing the pending pods would leave a job that can't progress."""
+    mgr, scaler = make_manager(pending_timeout=0.1)
+    mgr._init_nodes()
+    mgr._start_ts = time.time() - 10
+    ctx = get_job_context()
+    ctx.get_node(NodeType.WORKER, 0).create_time = time.time()
+    run_event(mgr, 0, NodeStatus.RUNNING)  # 1 running < min 2
+    for node_id in range(1, 4):
+        n = ctx.get_node(NodeType.WORKER, node_id)
+        n.status = NodeStatus.PENDING
+        n.create_time = time.time() - 10
+    plans_before = len(scaler.plans)
+    mgr._reconcile_stuck_pending()
+    assert len(scaler.plans) == plans_before
+    stop, reason, _ = mgr.should_early_stop()
+    assert stop and reason == "pending_timeout"
+
+
+def test_stuck_pending_ignores_nodes_without_create_time():
+    """A fresh relaunch has create_time=None until its pod materializes
+    (CR-mode scalers never set it) — age is unknown, so it must never be
+    classified stuck, no matter how old the job is."""
+    mgr, scaler = make_manager(pending_timeout=0.1)
+    mgr._init_nodes()
+    mgr._start_ts = time.time() - 3600  # old job
+    ctx = get_job_context()
+    for node_id in range(3):
+        ctx.get_node(NodeType.WORKER, node_id).create_time = time.time()
+        run_event(mgr, node_id, NodeStatus.RUNNING)
+    fresh = ctx.get_node(NodeType.WORKER, 3)
+    fresh.status = NodeStatus.PENDING
+    fresh.create_time = None
+    plans_before = len(scaler.plans)
+    mgr._reconcile_stuck_pending()
+    assert len(scaler.plans) == plans_before
+    assert not fresh.is_released
