@@ -152,8 +152,25 @@ def _run_mfu(jax, jnp, llama, cfg, micro_batch: int, seq: int, steps: int):
     return trainer, state, batch, dt
 
 
+LAST_TPU_RESULT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
+)
+
+
 def main():
-    if not _tpu_alive():
+    # a wedged remote tunnel is often transient: retry the liveness probe
+    # before falling back, so one bad minute doesn't turn the round's
+    # headline into a CPU number
+    alive = False
+    for attempt in range(3):
+        if _tpu_alive():
+            alive = True
+            break
+        if attempt < 2:
+            print(f"tpu probe {attempt + 1}/3 failed; retrying",
+                  file=sys.stderr)
+            time.sleep(60 * attempt + 10)
+    if not alive:
         print("tpu backend unreachable; benchmarking on cpu", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
@@ -279,25 +296,41 @@ def main():
             engine.close()
             shutil.rmtree(ckpt_dir, ignore_errors=True)
 
-    print(json.dumps({
+    detail = {
+        "backend": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", "?"),
+        **({"warning": "unknown device_kind: peak FLOPs unknown, "
+                       "mfu reported as 0"} if peak == 0.0 else {}),
+        "peak_bf16_tflops": peak / 1e12,
+        "model": model_name,
+        "params": nparams,
+        "tokens_per_step": micro * seq,
+        "step_time_s": round(step_s, 4),
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "ckpt": ckpt,
+    }
+    result = {
         "metric": "train_step_mfu",
         "value": round(mfu, 4),
         "unit": "fraction",
         "vs_baseline": round(mfu / BASELINE_MFU, 3),
-        "detail": {
-            "backend": jax.default_backend(),
-            "device_kind": getattr(dev, "device_kind", "?"),
-            **({"warning": "unknown device_kind: peak FLOPs unknown, "
-                           "mfu reported as 0"} if peak == 0.0 else {}),
-            "peak_bf16_tflops": peak / 1e12,
-            "model": model_name,
-            "params": nparams,
-            "tokens_per_step": micro * seq,
-            "step_time_s": round(step_s, 4),
-            "achieved_tflops": round(achieved / 1e12, 2),
-            "ckpt": ckpt,
-        },
-    }))
+        "detail": detail,
+    }
+    if on_tpu:
+        # remember the last real-TPU measurement so a CPU fallback run
+        # (wedged tunnel) can still surface it — clearly marked as cached
+        try:
+            with open(LAST_TPU_RESULT, "w") as f:
+                json.dump({"time": time.time(), **result}, f)
+        except OSError:
+            pass
+    elif os.path.exists(LAST_TPU_RESULT):
+        try:
+            with open(LAST_TPU_RESULT) as f:
+                detail["last_tpu_run_cached"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+    print(json.dumps(result))
     return 0
 
 

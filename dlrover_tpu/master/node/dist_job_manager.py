@@ -466,7 +466,21 @@ class DistributedJobManager(JobManager):
             oldest = min(
                 (n.create_time or self._start_ts) for n in pending
             )
-            if now - oldest > self._pending_timeout:
+            # shrink-to-capacity guard: while >= min_nodes run, stuck
+            # pending pods are _reconcile_stuck_pending's problem (it
+            # releases them and training continues) — early-stopping here
+            # would race it and kill a job that can make progress
+            running_n = sum(
+                1
+                for n in workers
+                if n.status == NodeStatus.RUNNING and not n.is_released
+            )
+            node_unit = max(1, self._job_args.node_unit)
+            can_shrink = (
+                running_n >= min_nodes
+                and (running_n // node_unit) * node_unit >= min_nodes
+            )
+            if now - oldest > self._pending_timeout and not can_shrink:
                 return (
                     True,
                     JobExitReason.PENDING_TIMEOUT,

@@ -129,6 +129,34 @@ def init(
         from dlrover_tpu.profiler.py_tracing import py_tracer
 
         py_tracer.start()  # GC pauses + user spans into the host timeline
+    try:
+        sampler_ms = float(
+            os.environ.get("DLROVER_TPU_STACK_SAMPLER_MS", "0") or 0
+        )
+    except ValueError:
+        logger.warning("DLROVER_TPU_STACK_SAMPLER_MS not numeric; ignored")
+        sampler_ms = 0.0
+    if sampler_ms > 0:
+        # in-process hotspot sampler (reference stack_util.cc); dumps the
+        # weighted stack trie at interpreter exit
+        import atexit
+
+        from dlrover_tpu.profiler.stack_sampler import StackSampler
+
+        _sampler = StackSampler(interval=sampler_ms / 1000.0).start()
+        out = os.environ.get(
+            "DLROVER_TPU_STACK_SAMPLER_OUT",
+            f"/tmp/dlrover_tpu_hotspots-{os.getpid()}.txt",
+        )
+
+        def _dump_hotspots():
+            _sampler.stop()
+            try:
+                _sampler.dump(out)
+            except OSError:
+                logger.warning("hotspot dump to %s failed", out)
+
+        atexit.register(_dump_hotspots)
 
     import jax
 

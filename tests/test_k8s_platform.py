@@ -679,3 +679,21 @@ def test_stuck_pending_ignores_nodes_without_create_time():
     mgr._reconcile_stuck_pending()
     assert len(scaler.plans) == plans_before
     assert not fresh.is_released
+
+
+def test_early_stop_defers_to_shrink_while_enough_running():
+    """PENDING_TIMEOUT must not race the stuck-pending reconciler: with
+    >= min_nodes running the early stop defers (the reconciler will
+    release the stuck pods), even before the reconciler has run."""
+    mgr, scaler = make_manager(pending_timeout=0.1)
+    mgr._init_nodes()
+    mgr._start_ts = time.time() - 10
+    ctx = get_job_context()
+    for node_id in range(3):
+        ctx.get_node(NodeType.WORKER, node_id).create_time = time.time()
+        run_event(mgr, node_id, NodeStatus.RUNNING)
+    stuck = ctx.get_node(NodeType.WORKER, 3)
+    stuck.status = NodeStatus.PENDING
+    stuck.create_time = time.time() - 10
+    stop, _, _ = mgr.should_early_stop()  # reconciler has NOT run yet
+    assert not stop

@@ -107,3 +107,34 @@ def test_cli_stacks_and_timeline(tmp_path, capsys):
     ]}))
     assert main(["timeline", str(tl)]) == 0
     assert "device_occupancy" in capsys.readouterr().out
+
+
+def test_stack_sampler_finds_hotspot():
+    """In-process sampler (reference stack_util.cc): a busy function
+    dominates the sampled trie."""
+    import time
+
+    from dlrover_tpu.profiler.stack_sampler import StackSampler
+
+    def hot_spin(until):
+        while time.time() < until:
+            sum(range(200))
+
+    with StackSampler(interval=0.002) as s:
+        hot_spin(time.time() + 0.4)
+    assert s.samples > 20
+    hot = s.hot_path()
+    assert any("hot_spin" in fr for fr in hot), hot
+    assert "hot_spin" in s.render(min_share=0.3)
+
+
+def test_stack_sampler_dump(tmp_path):
+    import time
+
+    from dlrover_tpu.profiler.stack_sampler import StackSampler, profile_block
+
+    s = profile_block(0.1, interval=0.005)
+    p = tmp_path / "hot.txt"
+    s.dump(str(p))
+    text = p.read_text()
+    assert "samples @" in text
