@@ -41,9 +41,11 @@ class NanLossError(RuntimeError):
     """Loss went NaN — a correctness signal, never a capacity fallback."""
 
 
-def _tpu_alive(timeout: float = 120.0) -> bool:
+def _tpu_probe(timeout: float = 120.0) -> str:
     """Probe TPU backend liveness in a subprocess: a wedged remote-tunnel
-    plugin can hang jax.devices() forever, which must not hang the bench."""
+    plugin can hang jax.devices() forever, which must not hang the bench.
+    Returns "tpu" (alive), "absent" (probe clean, no TPU — definitive),
+    or "down" (hang/crash — possibly transient, worth a retry)."""
     import subprocess
 
     try:
@@ -52,9 +54,11 @@ def _tpu_alive(timeout: float = 120.0) -> bool:
              "import jax; jax.devices(); print(jax.default_backend())"],
             capture_output=True, text=True, timeout=timeout,
         )
-        return probe.returncode == 0 and "tpu" in probe.stdout
     except subprocess.TimeoutExpired:
-        return False
+        return "down"
+    if probe.returncode != 0:
+        return "down"
+    return "tpu" if "tpu" in probe.stdout else "absent"
 
 
 def _peak_flops(device) -> float:
@@ -163,11 +167,14 @@ def main():
     # headline into a CPU number
     alive = False
     for attempt in range(3):
-        if _tpu_alive():
+        state = _tpu_probe()
+        if state == "tpu":
             alive = True
             break
+        if state == "absent":
+            break  # clean probe, no TPU: retrying cannot change that
         if attempt < 2:
-            print(f"tpu probe {attempt + 1}/3 failed; retrying",
+            print(f"tpu probe {attempt + 1}/3 hung; retrying",
                   file=sys.stderr)
             time.sleep(60 * attempt + 10)
     if not alive:

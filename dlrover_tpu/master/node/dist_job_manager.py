@@ -372,6 +372,17 @@ class DistributedJobManager(JobManager):
             except Exception:
                 logger.exception("stuck-pending reconcile failed")
 
+    def _has_shrink_capacity(self, running_n: int) -> bool:
+        """True when the job can continue on the running set alone:
+        running count rounded down to node_unit still >= min_nodes. The
+        single predicate behind both the stuck-pending release and the
+        PENDING_TIMEOUT early-stop deferral — they must agree or the
+        race the deferral prevents reopens."""
+        spec = self._job_args.worker_spec
+        min_nodes = spec.min_nodes or spec.group.count
+        node_unit = max(1, self._job_args.node_unit)
+        return (running_n // node_unit) * node_unit >= min_nodes
+
     def _reconcile_stuck_pending(self):
         """Shrink-to-capacity instead of dying: when relaunched/scaled-up
         pods sit Pending beyond the timeout while at least ``min_nodes``
@@ -385,7 +396,6 @@ class DistributedJobManager(JobManager):
         now = time.time()
         spec = self._job_args.worker_spec
         min_nodes = spec.min_nodes or spec.group.count
-        node_unit = max(1, self._job_args.node_unit)
         plan = ScalePlan()
         # read + mutate under the same lock handle_node_event uses, or a
         # PENDING->RUNNING transition in the gap gets released as stuck
@@ -407,8 +417,7 @@ class DistributedJobManager(JobManager):
                 and n.create_time
                 and now - n.create_time > self._pending_timeout
             ]
-            target = (len(running) // node_unit) * node_unit
-            if not stuck or len(running) < min_nodes or target < min_nodes:
+            if not stuck or not self._has_shrink_capacity(len(running)):
                 return
             for node in stuck:
                 node.relaunchable = False
@@ -475,11 +484,7 @@ class DistributedJobManager(JobManager):
                 for n in workers
                 if n.status == NodeStatus.RUNNING and not n.is_released
             )
-            node_unit = max(1, self._job_args.node_unit)
-            can_shrink = (
-                running_n >= min_nodes
-                and (running_n // node_unit) * node_unit >= min_nodes
-            )
+            can_shrink = self._has_shrink_capacity(running_n)
             if now - oldest > self._pending_timeout and not can_shrink:
                 return (
                     True,

@@ -67,7 +67,7 @@ class LlamaConfig:
     # (2*b*s*ffn elements/layer) buys most of no-remat's speed at a
     # fraction of its memory
     remat_policy: str = "all"
-    attn_impl: str = "auto"            # auto | flash | reference | ring
+    attn_impl: str = "auto"   # auto | flash | reference | ring | ulysses
     # flash-attention tile sizes — a hardware tuning knob (MXU is
     # 128x128; longer q tiles amortize the kv-loop overhead when the
     # per-core sequence is long enough)
@@ -200,13 +200,17 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
     sp_size = mesh.shape[SP] if mesh is not None and SP in mesh.shape else 1
     if impl == "auto":
         impl = "ring" if sp_size > 1 else "flash"
-    if impl == "ring" and sp_size > 1:
+    if impl in ("ring", "ulysses") and sp_size > 1:
         assert mesh is not None
         from jax import shard_map
 
+        if impl == "ulysses":
+            from dlrover_tpu.ops.ulysses import ulysses_attention as sp_attn
+        else:
+            sp_attn = ring_attention
         qspec = P(BATCH_AXES, SP, TP, None)
-        ring = shard_map(
-            functools.partial(ring_attention, axis_name=SP, causal=True,
+        sharded = shard_map(
+            functools.partial(sp_attn, axis_name=SP, causal=True,
                               block_q=cfg.attn_block_q,
                               block_k=cfg.attn_block_k),
             mesh=mesh,
@@ -214,7 +218,7 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
             out_specs=qspec,
             check_vma=False,
         )
-        return ring(q, k, v)
+        return sharded(q, k, v)
     if impl == "reference":
         return mha_reference(q, k, v, causal=True)
     return flash_attention(q, k, v, causal=True,
@@ -285,10 +289,10 @@ def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
         vocab=cfg.vocab_size,
         n_layers=cfg.n_layers,
     )
-    if mc.pp > 1 and (mc.sp > 1 or cfg.attn_impl == "ring"):
+    if mc.pp > 1 and (mc.sp > 1 or cfg.attn_impl in ("ring", "ulysses")):
         raise ValueError(
-            "pipeline parallelism does not compose with sp/ring attention "
-            "(ring runs its own shard_map); use pp with tp/fsdp/dp"
+            "pipeline parallelism does not compose with sp attention "
+            "(ring/ulysses run their own shard_map); use pp with tp/fsdp/dp"
         )
 
 

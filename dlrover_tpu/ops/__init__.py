@@ -8,4 +8,5 @@ from dlrover_tpu.ops.attention import flash_attention, mha_reference  # noqa: F4
 from dlrover_tpu.ops.embedding import embed_lookup  # noqa: F401
 from dlrover_tpu.ops.norms import rms_norm  # noqa: F401
 from dlrover_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from dlrover_tpu.ops.ulysses import ulysses_attention  # noqa: F401
 from dlrover_tpu.ops.rotary import apply_rope, rope_frequencies  # noqa: F401

@@ -139,8 +139,6 @@ def init(
     if sampler_ms > 0:
         # in-process hotspot sampler (reference stack_util.cc); dumps the
         # weighted stack trie at interpreter exit
-        import atexit
-
         from dlrover_tpu.profiler.stack_sampler import StackSampler
 
         _sampler = StackSampler(interval=sampler_ms / 1000.0).start()

@@ -177,3 +177,21 @@ def test_remesh_rederives_accum():
 def test_param_count_8b():
     assert abs(llama.param_count(llama.LlamaConfig.llama3_8b()) - 8.0e9) < 0.4e9
     assert abs(llama.param_count(llama.LlamaConfig.gpt2_xl_class()) - 1.5e9) < 0.3e9
+
+
+def test_sharded_loss_ulysses_matches_single_device(toks):
+    """All-to-all sequence parallelism (attn_impl=ulysses) computes the
+    same loss as single-device causal attention. Fresh params: the
+    module fixture's buffers may already be donated by the trainer
+    tests above."""
+    fresh = llama.init_params(CFG, jax.random.key(0))
+    mc = MeshConfig(dp=2, fsdp=2, sp=2, tp=1)  # sp divides h=4 and hkv=2
+    mesh = build_mesh(mc)
+    cfg = llama.LlamaConfig.tiny(attn_impl="ulysses")
+    specs = llama.param_specs(cfg)
+    sharded = jax.device_put(fresh, named_shardings(mesh, specs))
+    ref = float(llama.loss_fn(fresh, toks, CFG))
+    got = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh)
+    )(sharded, toks))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
