@@ -172,13 +172,17 @@ def main():
             alive = True
             break
         if state == "absent":
-            break  # clean probe, no TPU: retrying cannot change that
+            print("no tpu on this host (probe ran clean); benchmarking "
+                  "on cpu", file=sys.stderr)
+            break  # retrying cannot change a definitive answer
         if attempt < 2:
             print(f"tpu probe {attempt + 1}/3 hung; retrying",
                   file=sys.stderr)
             time.sleep(60 * attempt + 10)
     if not alive:
-        print("tpu backend unreachable; benchmarking on cpu", file=sys.stderr)
+        if state == "down":
+            print("tpu tunnel unresponsive after retries; benchmarking "
+                  "on cpu", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
