@@ -305,3 +305,50 @@ def _default_collate(samples):
     if isinstance(samples[0], dict):
         return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
     return np.stack(samples)
+
+
+def prefetch_to_device(iterator, size: int = 2, sharding=None):
+    """Overlap host->device transfer with compute by keeping ``size``
+    batches in flight on the device.
+
+    ``jax.device_put`` dispatches asynchronously, so enqueueing the next
+    batch before yielding the current one hides the h2d copy behind the
+    running step — the standard TPU input-pipeline idiom (cf. flax
+    ``jax_utils.prefetch_to_device``), here aware of ``NamedSharding``
+    (pass the batch's sharding to place each dp shard directly). The
+    reference's analogue is the torch DataLoader's pinned-memory
+    prefetch; on TPU the win is the same: the MXU never waits on PCIe.
+
+    ``sharding`` may be a single sharding or a pytree matching the batch
+    structure. With ``size=0`` this degrades to plain iteration.
+    """
+    import collections
+    import itertools
+
+    import jax
+
+    # accept iterables (ElasticDataLoader defines only __iter__): without
+    # this, each islice would restart iteration from batch 0
+    iterator = iter(iterator)
+
+    def put(batch):
+        if sharding is None:
+            return jax.device_put(batch)
+        return jax.device_put(batch, sharding)
+
+    if size <= 0:
+        # no overlap, but placement is still honored
+        yield from map(put, iterator)
+        return
+
+    queue = collections.deque()
+
+    def enqueue(n):
+        for data in itertools.islice(iterator, n):
+            queue.append(put(data))
+
+    enqueue(size)
+    while queue:
+        out = queue.popleft()
+        enqueue(1)
+        yield out
