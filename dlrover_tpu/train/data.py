@@ -284,9 +284,16 @@ class ElasticDataLoader:
         return True
 
     def __iter__(self):
+        from dlrover_tpu.profiler.py_tracing import py_tracer
+
         self.update_batch_size_from_config()
         for indices in self.sampler:
-            yield self._collate([self.dataset[i] for i in indices])
+            # span only when tracing is on: fetch+collate stalls explain
+            # device-idle gaps in the merged timeline (reference
+            # py_tracing's dataloader interception)
+            with py_tracer.span("dataloader.next", cat="dataloader"):
+                batch = self._collate([self.dataset[i] for i in indices])
+            yield batch
         # next epoch may pick up a new config (never mid-epoch)
 
     def state_dict(self) -> dict:
@@ -320,7 +327,15 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
     prefetch; on TPU the win is the same: the MXU never waits on PCIe.
 
     ``sharding`` may be a single sharding or a pytree matching the batch
-    structure. With ``size=0`` this degrades to plain iteration.
+    structure. On a multi-host mesh (sharding not fully addressable) the
+    batch is taken as this process's LOCAL shard and the global array is
+    assembled via ``jax.make_array_from_process_local_data`` — matching
+    how ``ElasticDataLoader`` shards the sample space per process. With
+    ``size=0`` placement still applies; only the overlap is dropped.
+
+    The returned generator is one-shot (it follows the wrapped
+    iterator): re-wrap per epoch, e.g.
+    ``for epoch in range(E): for b in prefetch_to_device(loader, 2, sh):``.
     """
     import collections
     import itertools
@@ -331,10 +346,22 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
     # this, each islice would restart iteration from batch 0
     iterator = iter(iterator)
 
+    def place(leaf, sh):
+        if sh is None:
+            return jax.device_put(leaf)
+        if sh.is_fully_addressable:
+            return jax.device_put(leaf, sh)
+        # multi-host mesh: each process holds its LOCAL batch; device_put
+        # would treat it as the global value (inconsistent global array).
+        # Assemble the global array from per-process shards instead.
+        return jax.make_array_from_process_local_data(sh, leaf)
+
     def put(batch):
         if sharding is None:
             return jax.device_put(batch)
-        return jax.device_put(batch, sharding)
+        if isinstance(sharding, jax.sharding.Sharding):
+            return jax.tree.map(lambda l: place(l, sharding), batch)
+        return jax.tree.map(place, batch, sharding)
 
     if size <= 0:
         # no overlap, but placement is still honored

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dlrover_tpu.train.data import ShardingClient
+from dlrover_tpu.train.data import ShardingClient, prefetch_to_device
 
 DATASET = "toy-train"
 DATASET_SIZE = 64
@@ -52,23 +52,27 @@ def train_step(w, x, y):
     return w - 0.1 * grad, loss
 
 
-def make_global_batch(record_start: int):
-    """Each process builds its local slice of the global batch."""
+def local_batches(task):
+    """Each process yields its local slices of the task's global batches;
+    prefetch_to_device assembles the global arrays (multi-host branch)
+    and overlaps h2d with compute."""
     per_proc = GLOBAL_BATCH // ctx.num_processes
-    seed = record_start * ctx.num_processes + ctx.process_id
-    rng = np.random.RandomState(seed)
-    x_local = rng.randn(per_proc, 4).astype(np.float32)
-    y_local = x_local @ np.asarray(true_w)
-    x = jax.make_array_from_process_local_data(batch_sharding, x_local)
-    y = jax.make_array_from_process_local_data(batch_sharding, y_local)
-    return x, y
+    n = task.shard_end - task.shard_start
+    for start in range(0, n, GLOBAL_BATCH):
+        record_start = task.shard_start + start
+        seed = record_start * ctx.num_processes + ctx.process_id
+        rng = np.random.RandomState(seed)
+        x_local = rng.randn(per_proc, 4).astype(np.float32)
+        y_local = x_local @ np.asarray(true_w)
+        yield x_local, y_local
 
 
 step = 0
 for task in sharding_client.iter_tasks():
-    n = task.shard_end - task.shard_start
-    for start in range(0, n, GLOBAL_BATCH):
-        x, y = make_global_batch(task.shard_start + start)
+    for x, y in prefetch_to_device(
+        local_batches(task), size=2,
+        sharding=(batch_sharding, batch_sharding),
+    ):
         w, loss = train_step(w, x, y)
         step += 1
         if step == crash_step and ctx.restart_count == 0 and ctx.is_chief:

@@ -166,3 +166,43 @@ def test_prefetch_to_device_applies_sharding():
     batches = [np.arange(8, dtype=np.float32).reshape(8) for _ in range(3)]
     out = list(prefetch_to_device(iter(batches), size=2, sharding=sh))
     assert all(b.sharding == sh for b in out)
+
+
+def test_dataloader_fetch_traced_when_tracer_on():
+    """Dataloader fetch spans land in the host timeline (reference
+    py_tracing dataloader interception)."""
+    import numpy as np
+
+    from dlrover_tpu.profiler.py_tracing import py_tracer
+    from dlrover_tpu.train.data import ElasticDataLoader
+
+    ds = [np.zeros((2,), np.float32) for _ in range(8)]
+    loader = ElasticDataLoader(ds, batch_size=4, shuffle=False)
+    py_tracer.start()
+    try:
+        list(loader)
+    finally:
+        py_tracer.stop()
+    names = [e["name"] for e in py_tracer.events()]
+    assert names.count("dataloader.next") >= 2
+
+
+def test_prefetch_pytree_sharding():
+    """Per-leaf shardings for dict batches; single-process shardings take
+    the device_put path (multi-host assembly is covered by the
+    make_array_from_process_local_data branch)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from dlrover_tpu.train.data import prefetch_to_device
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    sh = {"x": NamedSharding(mesh, P("dp")), "y": None}
+    batches = [
+        {"x": np.ones((4,), np.float32), "y": np.zeros((2,), np.float32)}
+        for _ in range(3)
+    ]
+    out = list(prefetch_to_device(iter(batches), size=1, sharding=sh))
+    assert out[0]["x"].sharding == sh["x"]
+    assert isinstance(out[0]["y"], jax.Array)
