@@ -99,6 +99,7 @@ class Node:
 
         self.host_addr: str = ""
         self.host_port: int = 0
+        self.host_node: str = ""  # k8s node name the pod landed on
 
         self.create_time: Optional[float] = None
         self.start_time: Optional[float] = None
@@ -149,6 +150,11 @@ class Node:
         new_node.relaunchable = True
         new_node.exit_reason = ""
         new_node.heartbeat_time = 0.0
+        # placement is the scheduler's choice for the NEW pod: carrying
+        # the dead pod's addresses over could cordon/contact the wrong
+        # host if an exit is observed before the new pod reports in
+        new_node.host_addr = ""
+        new_node.host_node = ""
         new_node.inc_relaunch_count()
         return new_node
 

@@ -187,6 +187,7 @@ class DistributedJobManager(JobManager):
                 self._fire("on_node_started", node)
             elif flow.to_status == NodeStatus.SUCCEEDED:
                 self._fire("on_node_succeeded", node)
+                self._remove_exited(node)
             if flow.to_status in (NodeStatus.FAILED, NodeStatus.DELETED):
                 self._fire(
                     "on_node_failed"
@@ -199,6 +200,8 @@ class DistributedJobManager(JobManager):
     def _merge_reported_fields(self, node: Node, incoming: Node):
         if incoming.host_addr:
             node.host_addr = incoming.host_addr
+        if incoming.host_node:
+            node.host_node = incoming.host_node
         if incoming.exit_reason:
             node.exit_reason = incoming.exit_reason
         if incoming.topology.slice_name:
@@ -231,6 +234,18 @@ class DistributedJobManager(JobManager):
                 # insufficient-worker early stop instead
                 logger.error(msg)
                 self._unrecoverable = (JobExitReason.ERROR, msg)
+            self._remove_exited(node)
+
+    def _remove_exited(self, node: Node):
+        """Delete a terminal (succeeded / unrecoverably failed) pod from
+        the cluster so its resources free up (reference
+        ``remove_exited_node``); gated by the job flag, and never for
+        nodes the relaunch path already removed."""
+        if not self._job_args.remove_exited_node or node.is_released:
+            return
+        node.relaunchable = False
+        node.is_released = True
+        self._scaler.scale(ScalePlan(remove_nodes=[node]))
 
     def _should_relaunch(self, node: Node) -> bool:
         """Reference ``_should_relaunch`` :849-910, condensed to the policy:
@@ -271,6 +286,18 @@ class DistributedJobManager(JobManager):
             new_node.relaunch_count = node.relaunch_count
         elif reason == NodeExitReason.OOM:
             self._bump_oom_memory(node, new_node)
+        if (
+            reason == NodeExitReason.HARDWARE_ERROR
+            and self._job_args.cordon_fault_node
+            and node.host_node
+        ):
+            # keep the replacement off the bad host (kubectl-cordon
+            # analogue; reference cordon_fault_node); independent of the
+            # budget/memory branches above
+            try:
+                self._scaler.cordon(node.host_node)
+            except Exception:
+                logger.exception("cordon of %s failed", node.host_node)
         node.relaunchable = False
         node.is_released = True
         self._job_context.update_node(new_node)

@@ -36,6 +36,11 @@ class Scaler(ABC):
     def scale(self, plan: ScalePlan):
         ...
 
+    def cordon(self, host_node: str) -> bool:
+        """Mark the cluster host unschedulable so replacements avoid it
+        (hardware-fault reaction; platform-specific, default no-op)."""
+        return False
+
     def start(self):
         pass
 

@@ -72,12 +72,23 @@ class PodScaler(Scaler):
         self._create_interval = create_interval
         self._create_queue: "queue.Queue[Node]" = queue.Queue()
         self._stop_evt = threading.Event()
+        self._cordoned: set = set()
         self._create_thread: Optional[threading.Thread] = None
 
     def set_master_addr(self, addr: str):
         """Must be a reachable address before any pod is created; the
         composition root calls this once the RPC server has bound."""
         self._master_addr = addr
+
+    def cordon(self, host_node: str) -> bool:
+        ok = self._client.cordon_node(host_node)
+        if ok:
+            logger.warning("cordoned fault host %s", host_node)
+            self._cordoned.add(host_node)
+        else:
+            logger.warning("cordon failed: cluster node %s not found",
+                           host_node)
+        return ok
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -90,6 +101,16 @@ class PodScaler(Scaler):
 
     def stop(self):
         self._stop_evt.set()
+        # the cordon is a job-scoped fence: lift it at teardown so a
+        # misclassified transient fault does not remove the host from the
+        # shared cluster forever (operators own durable cordons)
+        for host in sorted(self._cordoned):
+            try:
+                if self._client.cordon_node(host, unschedulable=False):
+                    logger.info("uncordoned %s at job teardown", host)
+            except Exception:
+                logger.exception("uncordon of %s failed", host)
+        self._cordoned.clear()
 
     # -- scaling ------------------------------------------------------------
 

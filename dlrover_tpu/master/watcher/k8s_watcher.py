@@ -57,6 +57,7 @@ def pod_to_node(pod: Dict) -> Optional[Node]:
     )
     node.relaunch_count = int(labels.get(LABEL_RELAUNCH_KEY, 0))
     node.host_addr = status.get("podIP", "")
+    node.host_node = pod.get("spec", {}).get("nodeName", "")
     node.topology.slice_name = labels.get(TPU_SLICE_LABEL, "")
     try:
         node.topology.worker_index = int(labels.get(TPU_WORKER_INDEX_LABEL, -1))

@@ -149,6 +149,23 @@ class K8sClient:
                 return False
             raise
 
+    def cordon_node(self, node_name: str, unschedulable: bool = True) -> bool:
+        """Mark a cluster node (un)schedulable (``kubectl cordon`` /
+        ``uncordon``) so a replacement pod cannot land back on a host the
+        health machinery flagged (reference ``kubernetes.py`` cordon
+        support, used with ``cordon_fault_node``)."""
+        try:
+            self._transport.request(
+                "PATCH",
+                f"/api/v1/nodes/{node_name}",
+                body={"spec": {"unschedulable": unschedulable}},
+            )
+            return True
+        except K8sApiError as e:
+            if e.status == 404:
+                return False
+            raise
+
     def list_pods(self, label_selector: str = "") -> List[Dict]:
         params = {"labelSelector": label_selector} if label_selector else None
         out = self._transport.request("GET", self._pods_path(), params=params)

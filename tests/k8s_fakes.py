@@ -19,6 +19,7 @@ class FakeTransport:
         self.pods: Dict[str, dict] = {}
         self.services: Dict[str, dict] = {}
         self.crs: Dict[str, Dict[str, dict]] = {}  # plural -> name -> cr
+        self.nodes: Dict[str, dict] = {}  # cluster nodes (cordon target)
         self.events: List[dict] = []
         self._watch_queues: Dict[str, "queue.Queue"] = {}
 
@@ -33,6 +34,8 @@ class FakeTransport:
             return self._stream(resource)
         if "/pods" in path:
             return self._handle(self.pods, method, parts, body, "pods", params)
+        if "/nodes" in path:
+            return self._handle(self.nodes, method, parts, body, "nodes", params)
         if "/services" in path:
             return self._handle(
                 self.services, method, parts, body, "services", params

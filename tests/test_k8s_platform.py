@@ -306,7 +306,8 @@ def test_fatal_error_not_relaunched():
     n_plans = len(scaler.plans)
     run_event(mgr, 1, NodeStatus.RUNNING)
     run_event(mgr, 1, NodeStatus.FAILED, NodeExitReason.FATAL_ERROR)
-    assert len(scaler.plans) == n_plans  # no relaunch plan
+    # no relaunch: any plan since is pure cleanup (remove_exited_node)
+    assert all(not pl.launch_nodes for pl in scaler.plans[n_plans:])
 
 
 def test_preemption_does_not_consume_relaunch_budget():
@@ -328,7 +329,8 @@ def test_relaunch_budget_exhausted_stops():
     n_plans = len(scaler.plans)
     run_event(mgr, 3, NodeStatus.RUNNING)
     run_event(mgr, 3, NodeStatus.FAILED, NodeExitReason.OOM)
-    assert len(scaler.plans) == n_plans
+    # budget gone: cleanup plans allowed, relaunch plans not
+    assert all(not pl.launch_nodes for pl in scaler.plans[n_plans:])
 
 
 def test_oom_relaunch_bumps_memory_and_consumes_budget():
@@ -576,8 +578,10 @@ def test_event_callback_layer_is_pluggable():
     assert ("started", 0) in events and ("failed", 0) in events
     assert ("succeeded", 1) in events
     assert tm.removed == [0]
-    # the relaunch still happened despite the raising observer
-    assert scaler.plans[-1].launch_nodes[0].id == 4
+    # the relaunch still happened despite the raising observer (the
+    # succeeded node's cleanup plan may follow it)
+    launched = [n.id for pl in scaler.plans for n in pl.launch_nodes]
+    assert 4 in launched
 
 
 def test_event_callbacks_ignore_non_worker_nodes():
@@ -697,3 +701,70 @@ def test_early_stop_defers_to_shrink_while_enough_running():
     stuck.create_time = time.time() - 10
     stop, _, _ = mgr.should_early_stop()  # reconciler has NOT run yet
     assert not stop
+
+
+def test_cordon_fault_node_on_hardware_failure():
+    """A hardware-classified exit cordons the k8s host so the replacement
+    cannot land there (reference cordon_fault_node)."""
+    args = make_job_args()
+    args.cordon_fault_node = True
+    client, transport = make_fake_client()
+    transport.nodes["gke-tpu-host-7"] = {"metadata": {"name": "gke-tpu-host-7"}}
+    scaler = PodScaler(args, client, master_addr="m:1")
+    mgr = DistributedJobManager(job_args=args, scaler=scaler)
+    mgr._init_nodes()
+    ctx = get_job_context()
+    ctx.get_node(NodeType.WORKER, 0).host_node = "gke-tpu-host-7"
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.FAILED, NodeExitReason.HARDWARE_ERROR)
+    assert transport.nodes["gke-tpu-host-7"]["spec"]["unschedulable"] is True
+
+
+def test_remove_exited_node_cleans_terminal_pods():
+    """Succeeded and unrecoverably-failed pods are deleted to free
+    resources (reference remove_exited_node); disabled flag keeps them."""
+    mgr, scaler = make_manager()
+    mgr._job_args.remove_exited_node = True
+    mgr._init_nodes()
+    run_event(mgr, 0, NodeStatus.RUNNING)
+    run_event(mgr, 0, NodeStatus.SUCCEEDED)
+    assert scaler.plans[-1].remove_nodes[0].id == 0
+    ctx = get_job_context()
+    assert ctx.get_node(NodeType.WORKER, 0).is_released
+
+    # fatal failure: no relaunch, pod still cleaned up
+    run_event(mgr, 1, NodeStatus.RUNNING)
+    run_event(mgr, 1, NodeStatus.FAILED, NodeExitReason.FATAL_ERROR)
+    assert scaler.plans[-1].remove_nodes[0].id == 1
+
+    mgr2, scaler2 = make_manager()
+    mgr2._job_args.remove_exited_node = False
+    mgr2._init_nodes()
+    run_event(mgr2, 0, NodeStatus.RUNNING)
+    run_event(mgr2, 0, NodeStatus.SUCCEEDED)
+    assert all(not p.remove_nodes for p in scaler2.plans)
+
+
+def test_cordon_lifted_at_job_teardown():
+    """The cordon is job-scoped: scaler.stop() uncordons what it
+    cordoned, so a misclassified fault doesn't fence the host forever."""
+    args = make_job_args()
+    client, transport = make_fake_client()
+    transport.nodes["h1"] = {"metadata": {"name": "h1"}}
+    scaler = PodScaler(args, client, master_addr="m:1")
+    assert scaler.cordon("h1")
+    assert transport.nodes["h1"]["spec"]["unschedulable"] is True
+    scaler.stop()
+    assert transport.nodes["h1"]["spec"]["unschedulable"] is False
+
+
+def test_relaunch_clears_stale_host_fields():
+    """The replacement node must not inherit the dead pod's placement —
+    a later hardware exit would cordon the wrong host."""
+    from dlrover_tpu.common.node import Node as N
+
+    old = N(NodeType.WORKER, 1)
+    old.host_node = "bad-host"
+    old.host_addr = "10.0.0.5"
+    new = old.get_relaunch_node_info(2)
+    assert new.host_node == "" and new.host_addr == ""
