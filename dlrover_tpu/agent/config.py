@@ -23,7 +23,6 @@ class ElasticLaunchConfig:
     master_addr: str = ""
 
     rdzv_join_timeout: float = 600.0
-    rdzv_waiting_timeout: float = 30.0
     node_unit: int = 1
 
     max_restarts: int = 3
@@ -31,7 +30,10 @@ class ElasticLaunchConfig:
     network_check: bool = False
     comm_perf_test: bool = False
     exclude_straggler: bool = False
-    save_at_breakpoint: bool = False
+    # persist the staged shm checkpoint before stopping workers at a
+    # restart boundary. Default True (reference defaults False because
+    # its save costs minutes; ours is the flash path's shm->storage copy)
+    save_at_breakpoint: bool = True
     accelerator: str = "tpu"  # "tpu" | "cpu" (cpu = gloo test mode)
     training_port: int = 0  # coordinator port base; 0 = auto
     tpu_timer: bool = False  # interpose the native PJRT profiler

@@ -536,6 +536,8 @@ class ElasticAgent:
         self._ckpt_saver = saver
 
     def _save_checkpoint_at_breakpoint(self):
+        if not self._config.save_at_breakpoint:
+            return
         if self._ckpt_saver is not None:
             try:
                 self._ckpt_saver.save_shm_to_storage()

@@ -119,7 +119,6 @@ class CheckpointConstant:
 class DefaultValues:
     # Master-side timeouts (seconds)
     SEC_HEARTBEAT_TIMEOUT = 600
-    SEC_RDZV_WAITING_TIMEOUT = 600
     SEC_RDZV_PEND_TIMEOUT = 3600
     SEC_NODE_START_TIMEOUT = 1800
     SEC_MONITOR_INTERVAL = 5

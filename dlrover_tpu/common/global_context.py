@@ -43,6 +43,9 @@ class MasterConfigContext:
         self.sample_count_to_adjust_worker = 5
         # -- hang detection (diagnosis CheckTrainingHangOperator) ------------
         self.seconds_hang_threshold = 300.0  # step-report silence to confirm
+        # -- rendezvous (rendezvous.manager, re-read per completion check) ---
+        # last-call window past min_nodes before the round closes
+        self.rdzv_waiting_timeout = 60.0
 
     # ------------------------------------------------------------------
     @classmethod

@@ -63,10 +63,11 @@ class DistributedJobMaster:
             RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
         }
         for mgr in self.rdzv_managers.values():
+            # waiting_timeout omitted: the managers re-read the live
+            # master-config value (rdzv_waiting_timeout) per check
             mgr.update_rdzv_params(
                 min_nodes=worker_spec.min_nodes or worker_spec.group.count,
                 max_nodes=worker_spec.max_nodes or worker_spec.group.count,
-                waiting_timeout=60,
                 node_unit=job_args.node_unit,
             )
 

@@ -38,7 +38,7 @@ class RendezvousParameters:
         self,
         min_nodes: int,
         max_nodes: int,
-        waiting_timeout: float = DefaultValues.SEC_RDZV_WAITING_TIMEOUT,
+        waiting_timeout: Optional[float] = None,  # None -> live config
         node_unit: int = 1,
         join_timeout: float = DefaultValues.SEC_MASTER_JOIN_TIMEOUT,
     ):
@@ -65,7 +65,8 @@ class RendezvousManager(ABC):
         self._topology_sorter = TpuTopologySorter()
 
     def update_rdzv_params(
-        self, min_nodes: int, max_nodes: int, waiting_timeout: float, node_unit: int
+        self, min_nodes: int, max_nodes: int, node_unit: int,
+        waiting_timeout: Optional[float] = None,
     ):
         with self._lock:
             self._params = RendezvousParameters(
@@ -126,8 +127,18 @@ class RendezvousManager(ABC):
         if waiting >= p.max_nodes:
             completed = True
         elif waiting >= p.min_nodes:
+            # waiting_timeout None -> re-read the runtime-tunable master
+            # config each check, so a brain/operator update retunes the
+            # last-call window of a running job
+            timeout = p.waiting_timeout
+            if timeout is None:
+                from dlrover_tpu.common.global_context import (
+                    get_master_config,
+                )
+
+                timeout = get_master_config().rdzv_waiting_timeout
             since_last = time.time() - self._lastcall_time
-            if since_last >= p.waiting_timeout and self._effective_world_size(waiting) > 0:
+            if since_last >= timeout and self._effective_world_size(waiting) > 0:
                 completed = True
         if completed:
             self._complete_rendezvous()

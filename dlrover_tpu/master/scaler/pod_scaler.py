@@ -220,6 +220,10 @@ class PodScaler(Scaler):
         self._inject_env(pod["spec"], node)
         self._inject_resources(pod["spec"], node)
         pod["spec"].setdefault("restartPolicy", "Never")
+        if spec and spec.priority:
+            # replica priority class (reference pod_scaler priority
+            # plumbing): lets workers preempt lower classes / be preempted
+            pod["spec"].setdefault("priorityClassName", spec.priority)
         created = self._client.create_pod(pod)
         node.create_time = time.time()
         logger.info(

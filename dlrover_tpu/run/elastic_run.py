@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="interpose the native PJRT profiler into workers")
     p.add_argument("--tpu-timer-port", type=int,
                    default=TpuTimerConsts.DEFAULT_PORT, dest="tpu_timer_port")
+    p.add_argument("--no-save-at-breakpoint", action="store_false",
+                   dest="save_at_breakpoint",
+                   help="skip the shm->storage checkpoint persist before "
+                        "restart boundaries")
     p.add_argument("--ckpt-replica", action="store_true", dest="ckpt_replica",
                    help="replicate staged checkpoints into a peer host's "
                         "memory for node-loss recovery without storage")
@@ -126,6 +130,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         tpu_timer=args.tpu_timer,
         tpu_timer_port=args.tpu_timer_port,
         ckpt_replica=args.ckpt_replica,
+        save_at_breakpoint=args.save_at_breakpoint,
         monitor_interval=args.monitor_interval,
         rdzv_join_timeout=args.rdzv_join_timeout,
         entrypoint=args.training_script,

@@ -67,6 +67,11 @@ class JobArgs:
             node_unit=int(spec.get("nodeUnit", 1)),
             tpu_type=spec.get("tpuType", ""),
             scale_plan_mode=spec.get("scalePlanMode", "direct"),
+            relaunch_on_worker_failure=int(
+                spec.get("relaunchOnWorkerFailure", 3)
+            ),
+            remove_exited_node=bool(spec.get("removeExitedNode", True)),
+            cordon_fault_node=bool(spec.get("cordonFaultNode", False)),
         )
         for rtype, rspec in spec.get("replicaSpecs", {}).items():
             template = rspec.get("template", {})
@@ -76,7 +81,10 @@ class JobArgs:
                 group=NodeGroupResource(count=count, node_resource=resource),
                 min_nodes=int(rspec.get("minReplicas", count)),
                 max_nodes=int(rspec.get("maxReplicas", count)),
-                restart_count=int(rspec.get("restartCount", 3)),
+                restart_count=int(
+                    rspec.get("restartCount",
+                              args.relaunch_on_worker_failure)
+                ),
                 pod_template=template,
                 priority=rspec.get("priority", ""),
             )

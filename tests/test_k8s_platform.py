@@ -768,3 +768,24 @@ def test_relaunch_clears_stale_host_fields():
     old.host_addr = "10.0.0.5"
     new = old.get_relaunch_node_info(2)
     assert new.host_node == "" and new.host_addr == ""
+
+
+def test_priority_class_and_relaunch_defaults_flow_from_cr():
+    """priority -> pod priorityClassName; spec.relaunchOnWorkerFailure is
+    the restartCount default (both were parsed but unconsumed)."""
+    import copy
+
+    cr = copy.deepcopy(ELASTICJOB_CR)
+    cr["spec"]["relaunchOnWorkerFailure"] = 7
+    wspec = cr["spec"]["replicaSpecs"]["worker"]
+    wspec.pop("restartCount", None)
+    wspec["priority"] = "high-priority-tpu"
+    args = JobArgs.from_elasticjob_cr(cr)
+    assert args.worker_spec.restart_count == 7
+    assert args.worker_spec.priority == "high-priority-tpu"
+
+    client, transport = make_fake_client()
+    scaler = PodScaler(args, client, master_addr="m:1")
+    scaler._create_pod(Node(NodeType.WORKER, 0))
+    pod = transport.pods["llama-elastic-worker-0"]
+    assert pod["spec"]["priorityClassName"] == "high-priority-tpu"

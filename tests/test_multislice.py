@@ -127,7 +127,7 @@ def test_rendezvous_world_is_slice_contiguous():
     """End to end through the rendezvous manager: interleaved joins from
     two slices → the completed world's rank order is slice-blocked."""
     mgr = ElasticTrainingRendezvousManager()
-    mgr.update_rdzv_params(4, 4, 0.1, 1)
+    mgr.update_rdzv_params(4, 4, node_unit=1, waiting_timeout=0.1)
     join_order = [
         (0, "slice-b", (0, 1)),
         (1, "slice-a", (0, 1)),
