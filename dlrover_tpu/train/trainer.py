@@ -122,13 +122,14 @@ class ElasticTrainer:
         bspec = batch_spec()
 
         def step(state, batch):
-            # batch: (accum, micro*dp, seq) int32
+            # batch: any pytree whose leaves lead with (accum, micro*dp):
+            # token arrays for the LM families, (images, labels) for CV
             if accum == 1:
                 # single microbatch: no accumulator scan — grads stay in
                 # param dtype and the f32 accumulation buffer (a full extra
                 # param-sized pytree) is never allocated
                 loss_sum, grads = jax.value_and_grad(self.loss_fn)(
-                    state["params"], batch[0]
+                    state["params"], jax.tree.map(lambda x: x[0], batch)
                 )
             else:
                 def micro_grads(carry, micro):
