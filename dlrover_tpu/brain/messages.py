@@ -65,6 +65,12 @@ class BrainOptimizeRequest:
     current_workers: int = 0
     oom_nodes: List[str] = field(default_factory=list)
     host_oom: bool = False
+    # goodput-aware growth gate: a scale-up forces re-rendezvous +
+    # recompile + restore, costing ~restart_cost_s of downtime (the
+    # master's observed average); growth must recoup it within the
+    # horizon. 0 disables the gate.
+    restart_cost_s: float = 0.0
+    recoup_horizon_s: float = 1800.0
 
 
 @message

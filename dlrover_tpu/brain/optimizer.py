@@ -140,6 +140,31 @@ def running_plan(
     return _scale_by_fit(fit, req, store)
 
 
+def _growth_recoups_restart(
+    fit: Tuple[float, float],
+    req: BrainOptimizeRequest,
+    current: int,
+    target: int,
+) -> bool:
+    """Goodput-aware growth gate: scaling up forces a re-rendezvous +
+    recompile + restore costing ``restart_cost_s`` of downtime at the
+    CURRENT speed; the extra throughput must win that back within the
+    recoup horizon, or the scale-up lowers goodput (the ≥95% north star
+    the reference reports — README.md:46-48 there). Shrinks never gate:
+    they are forced by capacity, not chosen."""
+    cost = req.restart_cost_s
+    horizon = req.recoup_horizon_s
+    if cost <= 0 or horizon <= 0:
+        return True  # gate disabled or no restart ever observed
+    a, b = fit
+    v_cur = predicted_speed(a, b, current)
+    v_new = predicted_speed(a, b, target)
+    # steps lost while the world re-forms vs steps gained afterwards
+    lost = v_cur * cost
+    gained = (v_new - v_cur) * max(horizon - cost, 0.0)
+    return gained > lost
+
+
 def _scale_by_fit(
     fit: Tuple[float, float],
     req: BrainOptimizeRequest,
@@ -169,6 +194,14 @@ def _scale_by_fit(
         # shrink plans still pass: they relieve the pressure
         return BrainResourcePlan(
             comment=f"cluster saturated; hold at {current} (wanted {best})"
+        )
+    if best > current and not _growth_recoups_restart(fit, req, current, best):
+        return BrainResourcePlan(
+            comment=(
+                f"growth {current}->{best} would not recoup the "
+                f"{req.restart_cost_s:.0f}s restart within "
+                f"{req.recoup_horizon_s:.0f}s; hold"
+            )
         )
     return BrainResourcePlan(
         worker_count=_round_to_unit(best, req),

@@ -36,6 +36,7 @@ class SpeedMonitor:
         # goodput ledger
         self._downtime_start: float = 0.0
         self._total_downtime: float = 0.0
+        self._downtime_events: int = 0
 
     # -- step samples -------------------------------------------------------
 
@@ -108,6 +109,16 @@ class SpeedMonitor:
             if self._downtime_start > 0.0:
                 self._total_downtime += (ts or time.time()) - self._downtime_start
                 self._downtime_start = 0.0
+                self._downtime_events += 1
+
+    def avg_downtime(self) -> float:
+        """Mean seconds per completed downtime bracket — what one
+        restart/membership change actually costs this job (feeds the
+        brain's goodput-aware growth gate)."""
+        with self._lock:
+            if self._downtime_events == 0:
+                return 0.0
+            return self._total_downtime / self._downtime_events
 
     def goodput(self) -> float:
         """Fraction of wall time (since first step) spent training."""

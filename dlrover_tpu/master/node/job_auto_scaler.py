@@ -116,6 +116,9 @@ class JobAutoScaler:
             self._optimizer.observe_speed(
                 stats.worker_num, stats.speed_steps_per_sec
             )
+            self._optimizer.set_restart_cost(
+                self._speed_monitor.avg_downtime()
+            )
         return stats
 
     def optimize_once(self) -> ScalePlan:

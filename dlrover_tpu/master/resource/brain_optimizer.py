@@ -47,6 +47,7 @@ class BrainResourceOptimizer(ResourceOptimizer):
         self._node_unit = node_unit
         self._tpu_type = tpu_type
         self._current_workers = 0
+        self._restart_cost_s = 0.0  # observed avg downtime per restart
         self._fallback = LocalOptimizer(
             min_workers=min_workers,
             max_workers=max_workers,
@@ -54,6 +55,9 @@ class BrainResourceOptimizer(ResourceOptimizer):
         )
 
     # -- observations (mirrored into both brain and local fallback) --------
+
+    def set_restart_cost(self, seconds: float) -> None:
+        self._restart_cost_s = max(0.0, seconds)
 
     def observe_speed(self, worker_num: int, steps_per_sec: float):
         self._current_workers = worker_num or self._current_workers
@@ -135,6 +139,7 @@ class BrainResourceOptimizer(ResourceOptimizer):
                     current_workers=self._current_workers,
                     oom_nodes=oom_nodes or [],
                     host_oom=host_oom,
+                    restart_cost_s=self._restart_cost_s,
                 )
             )
         except Exception as e:

@@ -55,6 +55,10 @@ class WorkerStats:
 
 
 class ResourceOptimizer(ABC):
+    def set_restart_cost(self, seconds: float) -> None:
+        """Observed average downtime one restart costs this job (scale-up
+        forces one); optimizers may gate growth on it. Default: ignored."""
+
     @abstractmethod
     def generate_opt_plan(self, stage: str, stats: WorkerStats) -> ResourcePlan:
         ...

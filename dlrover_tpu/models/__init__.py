@@ -1,5 +1,6 @@
 """Model families. Flagship: Llama-3 decoder (BASELINE.json north star);
-Mixtral-class sparse MoE with expert parallelism in ``models.moe``."""
+Mixtral-class sparse MoE with expert parallelism in ``models.moe``; ViT
+for CV workloads in ``models.vit``."""
 
 from dlrover_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
@@ -11,3 +12,4 @@ from dlrover_tpu.models.llama import (  # noqa: F401
     param_specs,
 )
 from dlrover_tpu.models.moe import MoeConfig  # noqa: F401
+from dlrover_tpu.models.vit import ViTConfig  # noqa: F401

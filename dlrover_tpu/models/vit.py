@@ -127,12 +127,13 @@ def param_specs(cfg: ViTConfig) -> Params:
     }
 
 
+def abstract_params(cfg: ViTConfig) -> Params:
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
+
+
 def param_count(cfg: ViTConfig) -> int:
     return sum(
-        math.prod(l.shape)
-        for l in jax.tree.leaves(
-            jax.eval_shape(lambda: init_params(cfg, jax.random.key(0)))
-        )
+        math.prod(l.shape) for l in jax.tree.leaves(abstract_params(cfg))
     )
 
 
@@ -149,11 +150,13 @@ def patchify(cfg: ViTConfig, images: jnp.ndarray) -> jnp.ndarray:
 
 
 def _divisor_block(s: int, cap: int = 128) -> int:
-    """Largest divisor of ``s`` that is <= cap."""
-    for b in range(min(cap, s), 0, -1):
+    """Largest TPU-tile-aligned (multiple-of-8) divisor of ``s`` that is
+    <= cap, or 0 when none exists — the caller then takes the reference
+    attention path instead of handing Mosaic an unaligned tile."""
+    for b in range(min(cap, s) // 8 * 8, 0, -8):
         if s % b == 0:
             return b
-    return 1
+    return 0
 
 
 def _encoder_layer(cfg: ViTConfig, lp, x):
@@ -164,13 +167,15 @@ def _encoder_layer(cfg: ViTConfig, lp, x):
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     qkv = (y @ lp["wqkv"].astype(dt)).reshape(b, s, 3, h, hd)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    if cfg.attn_impl == "reference":
+    # patch counts are rarely powers of two (ViT-B/16: 196, whose only
+    # divisors are tile-unfriendly): the flash kernel runs only when an
+    # aligned tile divides s; otherwise full attention — at patch-count
+    # sequence lengths the s x s score matrix is small enough that the
+    # reference path costs little
+    blk = _divisor_block(s)
+    if cfg.attn_impl == "reference" or blk == 0:
         attn = mha_reference(q, k, v, causal=False)
     else:
-        # patch counts are rarely powers of two (ViT-B/16: 196): tile at
-        # the largest divisor of s within the MXU-friendly cap so the
-        # kernel's divisibility contract holds for any grid
-        blk = _divisor_block(s)
         attn = flash_attention(q, k, v, causal=False,
                                block_q=blk, block_k=blk)
     x = x + attn.reshape(b, s, d) @ lp["wo"].astype(dt)

@@ -171,7 +171,9 @@ class ElasticTrainer:
     def step(self, state: dict, batch) -> Tuple[dict, jnp.ndarray]:
         """One optimizer step = ``accum_steps`` microbatches.
 
-        ``batch``: int32 tokens shaped (accum_steps, micro*dp, seq)."""
+        ``batch``: any pytree whose leaves lead with (accum_steps,
+        micro*dp, ...) — int32 token arrays for the LM families,
+        (images, labels) tuples for CV."""
         if self._step_fn is None:
             self._step_fn = self._build_step()
         new_state, loss = self._step_fn(state, batch)

@@ -285,3 +285,45 @@ def test_pending_age_window_filters_transit_and_stuck_pods():
     ]
     running, pending, c_run, c_pend = aggregate_pods(pods, now=now)
     assert (running, pending, c_run, c_pend) == (1, 1, 4, 4)
+
+
+def test_growth_gated_by_restart_recoup():
+    """Goodput-aware gate: a scale-up that cannot win back its restart
+    downtime within the horizon is held; ample horizon lets it through;
+    cost 0 (never restarted) disables the gate."""
+    store = BrainDataStore()
+    opt = BrainOptimizer(store)
+    # linear-ish scaling: 2 -> 8 workers is clearly throughput-positive
+    store.append_samples(
+        "j1", [sample(n, 10 * n / (1 + 0.05 * n)) for n in (1, 2, 4, 8)]
+    )
+
+    # no observed restart cost: growth passes
+    plan = opt.optimize(req(STAGE_RUNNING, cur=2))
+    assert plan.worker_count > 2
+
+    # brutal restart cost with a tiny horizon: held
+    plan = opt.optimize(req(
+        STAGE_RUNNING, cur=2, restart_cost_s=300.0, recoup_horizon_s=301.0
+    ))
+    assert plan.worker_count == 0
+    assert "recoup" in plan.comment
+
+    # same cost, generous horizon: the gain pays it back -> passes
+    plan = opt.optimize(req(
+        STAGE_RUNNING, cur=2, restart_cost_s=300.0,
+        recoup_horizon_s=24 * 3600.0,
+    ))
+    assert plan.worker_count > 2
+
+
+def test_avg_downtime_feeds_restart_cost():
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    sm = SpeedMonitor()
+    assert sm.avg_downtime() == 0.0
+    sm.mark_downtime_start(ts=100.0)
+    sm.mark_downtime_end(ts=160.0)
+    sm.mark_downtime_start(ts=200.0)
+    sm.mark_downtime_end(ts=220.0)
+    assert sm.avg_downtime() == pytest.approx(40.0)
