@@ -250,3 +250,30 @@ def test_ulysses_rejects_indivisible_heads():
     )
     with pytest.raises(ValueError, match="divisible"):
         uly(q, k, v)
+
+
+def test_ring_and_ulysses_agree_at_longer_seq():
+    """The two SP strategies are interchangeable: at seq 512 over sp=4
+    both match full attention (and therefore each other) with GQA-free
+    heads — the swap a user makes via attn_impl must be numerics-neutral."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dlrover_tpu.ops.ulysses import ulysses_attention
+
+    q, k, v = _qkv(b=1, s=512, h=4, hkv=4, d=32)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    spec = P(None, "sp", None, None)
+
+    def wrap(fn):
+        return jax.jit(shard_map(
+            lambda q, k, v: fn(q, k, v, axis_name="sp"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        ))
+
+    ring_out = np.asarray(wrap(ring_attention)(q, k, v))
+    uly_out = np.asarray(wrap(ulysses_attention)(q, k, v))
+    ref = np.asarray(mha_reference(q, k, v, causal=True))
+    np.testing.assert_allclose(ring_out, ref, atol=3e-5)
+    np.testing.assert_allclose(uly_out, ref, atol=3e-5)
