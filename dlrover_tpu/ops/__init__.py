@@ -1,4 +1,5 @@
-"""TPU compute ops: norms, rotary, flash attention (Pallas), ring attention.
+"""TPU compute ops: norms, rotary, flash attention (Pallas), and two
+sequence-parallel strategies (ring, ulysses all-to-all).
 
 Green-field relative to the reference, which owns no kernels (SURVEY.md
 §2.8) — its compute path is whatever torch framework it launches.

@@ -11,3 +11,12 @@ from dlrover_tpu.profiler.hang_dump import (  # noqa: F401
     install_stack_dump_handler,
 )
 from dlrover_tpu.profiler.py_tracing import PyTracer, py_tracer  # noqa: F401
+from dlrover_tpu.profiler.stack_sampler import (  # noqa: F401
+    StackSampler,
+    profile_block,
+)
+from dlrover_tpu.profiler.analysis import (  # noqa: F401
+    StackTrie,
+    analyze_timeline,
+    matmul_bench,
+)
