@@ -109,7 +109,9 @@ class MasterConfigContext:
             }
 
 
-def _parse_bool(value: Any) -> bool:
+def parse_bool(value: Any) -> bool:
+    """Strict boolean from loosely-typed input: ``"false"``/``"0"`` parse
+    False, unrecognized strings raise rather than silently coercing True."""
     if isinstance(value, str):
         lowered = value.strip().lower()
         if lowered in ("false", "0", "no", "off", ""):
@@ -118,6 +120,9 @@ def _parse_bool(value: Any) -> bool:
             return True
         raise ValueError(f"not a boolean: {value!r}")
     return bool(value)
+
+
+_parse_bool = parse_bool  # internal callers predate the public name
 
 
 def get_master_config() -> MasterConfigContext:

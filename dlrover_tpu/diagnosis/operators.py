@@ -204,7 +204,11 @@ class ResolveTrainingHangOperator(InferenceOperator):
         pending_names = set()
         for rec in dumps:
             for text in rec.stacks.values():
-                trie.add_dump(text)
+                # main_only: each worker carries several identical idle
+                # helper threads; weighting only the "Current thread"
+                # section keeps stuck_at pointing at the hung collective
+                # rather than a parked pool worker.
+                trie.add_dump(text, main_only=True)
             for rank in rec.pending.values():
                 for prog in rank.get("pending", []):
                     name = prog.get("name") if isinstance(prog, dict) else prog

@@ -41,26 +41,68 @@ _FRAME_RE = re.compile(r'^\s*File "(?P<file>[^"]+)", line (?P<line>\d+) in (?P<f
 _THREAD_RE = re.compile(r"^(Current thread|Thread) (?P<tid>0x[0-9a-fA-F]+)")
 
 
-def parse_faulthandler(text: str) -> List[List[str]]:
+def parse_faulthandler(text: str, main_only: bool = False) -> List[List[str]]:
     """Parse faulthandler output into stacks, one per thread, each a list
     of ``func (file:line)`` frames ordered root-first (faulthandler prints
     most-recent-call-first; we reverse so the trie roots at the entry
-    point, like a flamegraph)."""
+    point, like a flamegraph).
+
+    ``main_only`` keeps just the "Current thread" section — in a hang
+    dump the main thread is the one parked in the collective, while each
+    worker process carries several identical idle helper threads that
+    would otherwise outweigh it in the trie.
+    """
     stacks: List[List[str]] = []
     cur: Optional[List[str]] = None
+    cur_is_main = False
+    any_main = False
+
+    def flush():
+        if cur and (cur_is_main or not main_only):
+            stacks.append(list(reversed(cur)))
+
     for line in text.splitlines():
-        if _THREAD_RE.match(line):
-            if cur:
-                stacks.append(list(reversed(cur)))
+        m_thread = _THREAD_RE.match(line)
+        if m_thread:
+            flush()
             cur = []
+            cur_is_main = line.startswith("Current thread")
+            any_main = any_main or cur_is_main
             continue
         m = _FRAME_RE.match(line)
         if m and cur is not None:
             short = os.path.basename(m.group("file"))
             cur.append(f"{m.group('func')} ({short}:{m.group('line')})")
-    if cur:
-        stacks.append(list(reversed(cur)))
+    flush()
+    if main_only and not any_main:
+        # Dump without a "Current thread" marker: fall back to every
+        # non-idle stack rather than returning nothing.
+        return [s for s in parse_faulthandler(text) if not is_idle_stack(s)]
     return stacks
+
+
+#: leaf frames of threads that are parked, not working: thread-pool
+#: workers waiting on their queue, threading waits, selector polls.
+#: Leaf-only on purpose — an executor thread actively running a task has
+#: deeper frames (``_worker -> run -> fn``) and must stay visible; a
+#: parked one is blocked in the C-level queue get, so its deepest
+#: *Python* frame is ``_worker`` itself.
+_IDLE_LEAF_RE = re.compile(
+    r"^(wait|_wait_for_tstate_lock|_recv_bytes|poll|select|accept|"
+    r"get|_get_block) \((threading|queue|selectors|socket|connection)\.py:"
+    r"|^_worker \(thread\.py:"
+    r"|^worker \(pool\.py:"
+)
+
+
+def is_idle_stack(frames: List[str]) -> bool:
+    """True if a root-first stack belongs to a parked helper thread
+    (thread-pool worker waiting for work, selector loop, queue get) —
+    the stacks that drown out the busy thread when every thread is
+    sampled with equal weight."""
+    if not frames:
+        return True
+    return bool(_IDLE_LEAF_RE.match(frames[-1]))
 
 
 @dataclass
@@ -85,8 +127,8 @@ class StackTrie:
             node = node.children.setdefault(fr, _TrieNode())
             node.weight += weight
 
-    def add_dump(self, text: str, weight: int = 1):
-        for stack in parse_faulthandler(text):
+    def add_dump(self, text: str, weight: int = 1, main_only: bool = False):
+        for stack in parse_faulthandler(text, main_only=main_only):
             self.insert(stack, weight)
 
     def render(self, min_share: float = 0.05, _node=None, _depth=0) -> str:
@@ -127,12 +169,12 @@ def load_stacks(path: str) -> StackTrie:
         for fn in sorted(os.listdir(path)):
             if fn.startswith("hang_stacks-"):
                 with open(os.path.join(path, fn)) as f:
-                    trie.add_dump(f.read())
+                    trie.add_dump(f.read(), main_only=True)
     else:
         with open(path) as f:
             bundle = json.load(f)
         for text in bundle.get("stacks", {}).values():
-            trie.add_dump(text)
+            trie.add_dump(text, main_only=True)
     return trie
 
 

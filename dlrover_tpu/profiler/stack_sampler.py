@@ -27,7 +27,7 @@ import threading
 import time
 from typing import List, Optional
 
-from dlrover_tpu.profiler.analysis import StackTrie
+from dlrover_tpu.profiler.analysis import StackTrie, is_idle_stack
 
 
 def _frames_of(frame) -> List[str]:
@@ -47,9 +47,11 @@ class StackSampler:
     """Periodic all-thread stack sampler aggregating into a StackTrie."""
 
     def __init__(self, interval: float = 0.01,
-                 thread_ids: Optional[List[int]] = None):
+                 thread_ids: Optional[List[int]] = None,
+                 include_idle: bool = False):
         self.interval = interval
         self._only = set(thread_ids or [])
+        self._include_idle = include_idle
         self.trie = StackTrie()
         self.samples = 0
         self._stop = threading.Event()
@@ -77,7 +79,14 @@ class StackSampler:
             for tid, frame in sys._current_frames().items():
                 if tid == me or (self._only and tid not in self._only):
                     continue
-                self.trie.insert(_frames_of(frame))
+                frames = _frames_of(frame)
+                # Parked helper threads (pool workers on queue.get,
+                # selector loops) carry the same weight as the busy
+                # thread if sampled blindly; drop them so hot_path()
+                # names the hotspot, not an idle _worker frame.
+                if not self._include_idle and is_idle_stack(frames):
+                    continue
+                self.trie.insert(frames)
             self.samples += 1
 
     # -- results ---------------------------------------------------------

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import DistributionStrategy, NodeType
+from dlrover_tpu.common.global_context import parse_bool as _parse_bool
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.node import NodeGroupResource, NodeResource
 from dlrover_tpu.scheduler.k8s_client import ELASTICJOB_PLURAL, get_k8s_client
@@ -70,8 +71,8 @@ class JobArgs:
             relaunch_on_worker_failure=int(
                 spec.get("relaunchOnWorkerFailure", 3)
             ),
-            remove_exited_node=bool(spec.get("removeExitedNode", True)),
-            cordon_fault_node=bool(spec.get("cordonFaultNode", False)),
+            remove_exited_node=_parse_bool(spec.get("removeExitedNode", True)),
+            cordon_fault_node=_parse_bool(spec.get("cordonFaultNode", False)),
         )
         for rtype, rspec in spec.get("replicaSpecs", {}).items():
             template = rspec.get("template", {})

@@ -789,3 +789,22 @@ def test_priority_class_and_relaunch_defaults_flow_from_cr():
     scaler._create_pod(Node(NodeType.WORKER, 0))
     pod = transport.pods["llama-elastic-worker-0"]
     assert pod["spec"]["priorityClassName"] == "high-priority-tpu"
+
+
+def test_loosely_typed_bool_strings_in_cr_spec():
+    """"false"/"0" strings in a hand-written manifest must parse as False
+    (bool("false") is True; the parser must not use raw bool())."""
+    import copy
+
+    cr = copy.deepcopy(ELASTICJOB_CR)
+    cr["spec"]["removeExitedNode"] = "false"
+    cr["spec"]["cordonFaultNode"] = "true"
+    args = JobArgs.from_elasticjob_cr(cr)
+    assert args.remove_exited_node is False
+    assert args.cordon_fault_node is True
+
+    cr["spec"]["removeExitedNode"] = "0"
+    cr["spec"]["cordonFaultNode"] = "no"
+    args = JobArgs.from_elasticjob_cr(cr)
+    assert args.remove_exited_node is False
+    assert args.cordon_fault_node is False

@@ -15,11 +15,11 @@ from dlrover_tpu.profiler.analysis import (
 )
 
 DUMP_RANK0 = """\
-Thread 0x00007f11 (most recent call first):
+Current thread 0x00007f11 (most recent call first):
   File "/app/dlrover_tpu/ops/ring_attention.py", line 88 in _ring_step
   File "/app/train.py", line 40 in train_step
   File "/app/train.py", line 80 in main
-Current thread 0x00007f22 (most recent call first):
+Thread 0x00007f22 (most recent call first):
   File "/usr/lib/python3.11/threading.py", line 320 in wait
   File "/app/dlrover_tpu/checkpoint/engine.py", line 100 in _stage_loop
 """
@@ -57,8 +57,10 @@ def test_load_stacks_from_bundle_json(tmp_path):
     bundle = {"stacks": {"101": DUMP_RANK0, "102": DUMP_RANK1}}
     p = tmp_path / "bundle.json"
     p.write_text(json.dumps(bundle))
+    # main_only: rank0's Current thread + rank1's fallback (no Current
+    # marker -> non-idle stacks); rank0's idle checkpoint waiter dropped
     trie = load_stacks(str(p))
-    assert trie.total == 3
+    assert trie.total == 2
     assert trie.hot_path()[-1].startswith("_ring_step")
 
 
@@ -66,7 +68,8 @@ def test_load_stacks_from_dir(tmp_path):
     (tmp_path / "hang_stacks-101.txt").write_text(DUMP_RANK0)
     (tmp_path / "hang_stacks-102.txt").write_text(DUMP_RANK1)
     trie = load_stacks(str(tmp_path))
-    assert trie.total == 3
+    assert trie.total == 2
+    assert trie.hot_path()[-1].startswith("_ring_step")
 
 
 def test_analyze_timeline_stats_occupancy_and_gaps():
@@ -138,3 +141,63 @@ def test_stack_sampler_dump(tmp_path):
     s.dump(str(p))
     text = p.read_text()
     assert "samples @" in text
+
+
+def test_stack_sampler_ignores_parked_pool_threads():
+    """ADVICE r3: idle thread-pool workers must not outweigh the busy
+    thread — hot_path() names the hotspot even with a parked executor
+    in-process (the state every real JAX worker is in)."""
+    import concurrent.futures
+    import time
+
+    from dlrover_tpu.profiler.stack_sampler import StackSampler
+
+    def hot_spin(until):
+        while time.time() < until:
+            sum(range(200))
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+    # Materialize the worker threads, then leave them parked on queue.get.
+    for _ in pool.map(lambda x: x, range(4)):
+        pass
+    try:
+        with StackSampler(interval=0.002) as s:
+            hot_spin(time.time() + 0.4)
+        hot = s.hot_path()
+        assert any("hot_spin" in fr for fr in hot), hot
+        assert not any("_worker" in fr for fr in hot), hot
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_hang_trie_main_thread_only():
+    """ADVICE r3: hang-dump summarization weights only the 'Current
+    thread' section so stuck_at names the hung collective, not an idle
+    helper frame replicated across every worker."""
+    from dlrover_tpu.profiler.analysis import StackTrie, is_idle_stack
+
+    dump = "\n".join(
+        [
+            'Thread 0x01 (most recent call first):',
+            '  File "queue.py", line 171 in get',
+            '  File "thread.py", line 90 in _worker',
+            '  File "threading.py", line 975 in run',
+            'Thread 0x02 (most recent call first):',
+            '  File "queue.py", line 171 in get',
+            '  File "thread.py", line 90 in _worker',
+            '  File "threading.py", line 975 in run',
+            'Current thread 0x03 (most recent call first):',
+            '  File "comm.py", line 12 in psum',
+            '  File "train.py", line 44 in step',
+        ]
+    )
+    trie = StackTrie()
+    # Two workers, each with 2 idle helper threads + 1 stuck main thread.
+    trie.add_dump(dump, main_only=True)
+    trie.add_dump(dump, main_only=True)
+    hot = trie.hot_path()
+    assert hot and "psum" in hot[-1], hot
+
+    assert is_idle_stack(["run (threading.py:975)", "_worker (thread.py:90)",
+                          "get (queue.py:171)"])
+    assert not is_idle_stack(["step (train.py:44)", "psum (comm.py:12)"])
