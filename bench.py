@@ -289,6 +289,7 @@ def main():
             blocking = min(pauses)
             ckpt = {
                 "blocking_save_s": round(blocking, 4),
+                "stage_mode": engine.last_stage_mode,
                 "vs_baseline": (round(BASELINE_CKPT_S / max(blocking, 1e-9),
                                       3) if nparams >= 1e9 else None),
                 "staged_gb": round(param_bytes / 2**30, 3),

@@ -108,6 +108,11 @@ class Checkpointer:
             logger.exception("orbax fallback restore failed")
         return None
 
+    def wait_staging(self, timeout: Optional[float] = None):
+        """Join any in-flight background stage (and, in bare runs without
+        an agent saver, its inline persist); re-raises a staging failure."""
+        self._engine.wait_staging(timeout)
+
     def committed_step(self) -> int:
         return self._engine.committed_step()
 

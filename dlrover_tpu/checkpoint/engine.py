@@ -98,23 +98,33 @@ class CheckpointEngine:
         self._awaiting_persist = -1
         self._master_client = master_client
         self.latest_saved_step = -1
-        # Async staging: the device->host snapshot happens synchronously
-        # (donation-safe — the trainer's jitted step donates state buffers
-        # via donate_argnums, which invalidates the source arrays the
-        # moment the next step runs, so holding references is NOT enough),
-        # but the transfers for all leaves are issued together via
-        # copy_to_host_async so they overlap, and the expensive part — the
-        # host->shm memcpy — runs in a background thread. torch engines
-        # must block for the whole shm stage (in-place optimizer updates;
-        # the reference blocks ~0.5 s here, flash_checkpoint.md); we block
-        # only for the d2h transfer.
+        # Async staging (default ON): the training pause is one jitted
+        # device-side copy of the state into fresh (non-donated) HBM
+        # buffers — milliseconds, independent of the d2h link — after
+        # which the d2h transfer and the host->shm memcpy both run in a
+        # background thread against the snapshot. Donation safety: the
+        # trainer's jitted step donates state buffers via donate_argnums,
+        # which invalidates the source arrays the moment the next step
+        # runs; the snapshot's buffers are XLA outputs with no
+        # input-output aliasing, so they survive any later donation.
+        # When HBM headroom cannot fit a second copy of the state the
+        # stage degrades to blocking for the d2h transfer (the round-3
+        # behavior); torch engines block for the whole shm stage
+        # (reference blocks ~0.5 s, flash_checkpoint.md:362-415).
         if async_staging is None:
             async_staging = (
-                os.environ.get("DLROVER_TPU_ASYNC_STAGING", "0") == "1"
+                os.environ.get("DLROVER_TPU_ASYNC_STAGING", "1") != "0"
             )
         self._async_staging = bool(async_staging)
+        self._device_snapshot_enabled = (
+            os.environ.get("DLROVER_TPU_DEVICE_SNAPSHOT", "1") != "0"
+        )
+        self._snap_fn = None
         self._staging_thread: Optional[threading.Thread] = None
         self._staging_error: Optional[BaseException] = None
+        #: how the last save staged: "device_snapshot" (pause = HBM copy),
+        #: "host_gather" (pause = d2h transfer), or "sync"
+        self.last_stage_mode = ""
 
     # -- IPC (lazy: standalone use without an agent works too) --------------
 
@@ -252,28 +262,116 @@ class CheckpointEngine:
                 "previous background staging failed (%s); continuing", e
             )
         self._staging_error = None
-        # Donation-safe snapshot: d2h transfers happen HERE, synchronously,
-        # before the caller's next (buffer-donating) train step can run.
-        # Only host memory is touched after this point.
-        try:
-            snapshot = self._gather_local_shards(state)
-        except Exception as e:
-            logger.warning("device->host snapshot of step %s failed: %s",
-                           step, e)
-            # surface on the next wait_staging/load/close — a silently
-            # dead snapshot path would let a job train for hours while
-            # believing it is checkpointing
-            self._staging_error = e
-            return time.time() - t0
+        # Preferred: device-side snapshot — blocking cost is one HBM->HBM
+        # copy; the d2h transfer moves to the background thread, so the
+        # training pause is independent of the host link speed.
+        payload = self._snapshot_on_device(state)
+        on_device = payload is not None
+        self.last_stage_mode = "device_snapshot" if on_device else "host_gather"
+        if not on_device:
+            # Fallback (no headroom / no device arrays / snapshot off):
+            # d2h transfers happen HERE, synchronously, before the
+            # caller's next (buffer-donating) train step can run. Only
+            # host memory is touched after this point.
+            try:
+                payload = self._gather_local_shards(state)
+            except Exception as e:
+                logger.warning("device->host snapshot of step %s failed: %s",
+                               step, e)
+                # surface on the next wait_staging/load/close — a silently
+                # dead snapshot path would let a job train for hours while
+                # believing it is checkpointing
+                self._staging_error = e
+                return time.time() - t0
         pause = time.time() - t0
         self._staging_thread = threading.Thread(
             target=self._stage_in_background,
-            args=(step, snapshot, persist, pause),
+            args=(step, payload, on_device, persist, pause),
             name="ckpt-staging",
             daemon=True,
         )
         self._staging_thread.start()
         return time.time() - t0
+
+    # -- device-side snapshot ----------------------------------------------
+
+    def _snapshot_on_device(self, state):
+        """Copy every device-array leaf into fresh HBM buffers via one
+        jitted copy (milliseconds). Returns the snapshot pytree, or None
+        when the engine should fall back to the blocking d2h stage
+        (snapshot disabled, nothing on device, insufficient HBM headroom,
+        or the copy itself failed, e.g. a racing allocation OOMed it)."""
+        if not self._device_snapshot_enabled:
+            return None
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten(state)
+        idx = [
+            i
+            for i, leaf in enumerate(flat)
+            if isinstance(leaf, jax.Array)
+            and hasattr(leaf, "addressable_shards")
+        ]
+        if not idx:
+            return None
+        if not self._hbm_headroom_ok([flat[i] for i in idx]):
+            logger.warning(
+                "insufficient HBM headroom for a device-side checkpoint "
+                "snapshot; blocking for the d2h transfer instead"
+            )
+            return None
+        if self._snap_fn is None:
+            import jax.numpy as jnp
+
+            # jnp.copy under jit lowers to a real copy op: without
+            # donation XLA never aliases an entry parameter into an
+            # output buffer, so the results are independent of the
+            # (soon-to-be-donated) source arrays.
+            self._snap_fn = jax.jit(
+                lambda xs: [jnp.copy(x) for x in xs]
+            )
+        try:
+            copies = self._snap_fn([flat[i] for i in idx])
+            jax.block_until_ready(copies)
+        except Exception as e:
+            logger.warning(
+                "device-side snapshot failed (%s); blocking for the d2h "
+                "transfer instead", e
+            )
+            return None
+        for i, c in zip(idx, copies):
+            flat[i] = c
+        return jax.tree_util.tree_unflatten(treedef, flat)
+
+    @staticmethod
+    def _hbm_headroom_ok(arrays, slack: float = 1.15) -> bool:
+        """Check each local device can hold a second copy of its shards.
+        Optimistic when the backend exposes no memory stats (CPU)."""
+        need: Dict[Any, int] = {}
+        for leaf in arrays:
+            seen = set()
+            for shard in leaf.addressable_shards:
+                ranges = _index_to_ranges(shard.index, leaf.shape)
+                if ranges in seen:
+                    continue
+                seen.add(ranges)
+                nbytes = int(
+                    np.prod(shard.data.shape, dtype=np.int64)
+                    * shard.data.dtype.itemsize
+                )
+                need[shard.device] = need.get(shard.device, 0) + nbytes
+        for dev, nbytes in need.items():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                continue
+            if not stats:
+                continue
+            limit = stats.get("bytes_limit")
+            used = stats.get("bytes_in_use")
+            if limit and used is not None and (limit - used) < nbytes * slack:
+                return False
+        return True
 
     def wait_staging(self, timeout: Optional[float] = None):
         """Join any in-flight background stage; re-raise its failure.
@@ -292,17 +390,24 @@ class CheckpointEngine:
             raise err
 
     def _stage_in_background(
-        self, step: int, snapshot, persist: bool, pause: float
+        self, step: int, payload, on_device: bool, persist: bool,
+        pause: float
     ):
         try:
+            if on_device:
+                # d2h off the training critical path: the source is the
+                # private device snapshot, untouchable by donation.
+                payload = self._gather_local_shards(payload)
             self._wait_pending_persist()
-            self._write_shm(step, snapshot)
+            self._write_shm(step, payload)
             if persist:
                 self._queue_persist(step)
             self._report_save(step, pause)
         except BaseException as e:  # surfaced on the next wait_staging
             logger.exception("background staging of step %s failed", step)
             self._staging_error = e
+        finally:
+            payload = None  # free the snapshot's HBM buffers promptly
 
     def _report_save(self, step: int, blocking: float):
         if self._master_client is not None:
@@ -312,6 +417,7 @@ class CheckpointEngine:
                 pass
 
     def _stage_sync(self, step: int, state: Any):
+        self.last_stage_mode = "sync"
         self._wait_pending_persist()
         self._write_shm(step, self._gather_local_shards(state))
 

@@ -47,6 +47,7 @@ def test_replica_roundtrip_with_replacement_node_id(job_env):
     state = _state()
     engine = CheckpointEngine(ckpt_dir)
     engine.save_to_memory(21, state)
+    engine.wait_staging()
 
     # two replica managers = two hosts' savers
     m0 = ReplicaManager()
