@@ -190,9 +190,13 @@ class MasterClient:
         return self._client.report(params)
 
     def get_task(self, dataset_name: str) -> msg.Task:
+        # retries sized to ride out a master relaunch (~20s of backoff):
+        # the data path stalling through the gap is what lets workers
+        # keep training across an operator-relaunched master
         return self._client.get(
             msg.TaskRequest(dataset_name=dataset_name, node_id=self.node_id),
             timeout=60,
+            retries=6,
         )
 
     def report_task_result(self, dataset_name: str, task_id: int, success: bool = True):
@@ -202,7 +206,8 @@ class MasterClient:
                 task_id=task_id,
                 node_id=self.node_id,
                 success=success,
-            )
+            ),
+            retries=6,
         )
 
     def get_shard_checkpoint(self, dataset_name: str) -> str:

@@ -53,10 +53,25 @@ class DistributedJobMaster:
         self.job_args = job_args
         self._client = k8s_client or get_k8s_client(job_args.namespace)
 
+        # durable continuity state (shard queues, goodput ledger, relaunch
+        # budgets) — survives an operator-relaunched master pod
+        from dlrover_tpu.master.state_store import (
+            MasterStateManager,
+            create_state_backend,
+        )
+
+        self.state_manager = MasterStateManager(
+            create_state_backend(job_args.job_name, self._client),
+            job_uid=job_args.job_uid,
+        )
+
         self.speed_monitor = SpeedMonitor()
         worker_spec = job_args.worker_spec
         self.speed_monitor.set_target_worker_num(worker_spec.group.count)
-        self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
+        self.task_manager = TaskManager(
+            speed_monitor=self.speed_monitor,
+            state_manager=self.state_manager,
+        )
 
         self.rdzv_managers = {
             RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
@@ -137,6 +152,7 @@ class DistributedJobMaster:
             job_auto_scaler=self.job_auto_scaler,
             error_monitor=self.error_monitor,
             resource_optimizer=optimizer,
+            state_manager=self.state_manager,
         )
         # data shards of dead workers go back to the todo queue
         # (reference TaskRescheduleCallback, event_callback.py:111-130)
@@ -176,6 +192,22 @@ class DistributedJobMaster:
         self._stop_requested = threading.Event()
 
     def prepare(self):
+        # master relaunch: resume shard queues + goodput ledger BEFORE the
+        # port opens — surviving workers' get_task retries hammer the
+        # address the moment it serves, and an empty task registry reads
+        # as end-of-data
+        restored = self.task_manager.restore_from_state()
+        speed_state = self.state_manager.load_speed()
+        if speed_state:
+            self.speed_monitor.import_state(speed_state)
+        if restored or speed_state:
+            logger.info(
+                "master state restored: %s datasets, global_step=%s",
+                restored,
+                self.speed_monitor.completed_global_step,
+            )
+            # the gap while no master was serving is downtime
+            self.speed_monitor.mark_downtime_start()
         self._server.start()
         if isinstance(self.scaler, PodScaler):
             self.scaler.set_master_addr(self._resolve_master_addr())
@@ -203,6 +235,12 @@ class DistributedJobMaster:
     def run(self, poll_interval: float = 5.0) -> int:
         try:
             while not self._stop_requested.wait(poll_interval):
+                # continuity snapshot: ledger + budgets (shard queues are
+                # write-through at dispatch/report time)
+                self.state_manager.save_speed(
+                    self.speed_monitor.export_state()
+                )
+                self.job_manager.persist_node_state()
                 stop, reason, message = self.job_manager.should_early_stop()
                 if stop:
                     logger.error("early stop: %s (%s)", reason, message)
@@ -221,6 +259,10 @@ class DistributedJobMaster:
                     break
         finally:
             self._report_job_outcome()
+            if self._exit_reason == JobExitReason.SUCCEEDED:
+                # finished jobs must not leave shard state a future
+                # same-named job would mistakenly resume from
+                self.state_manager.clear()
             self.stop()
         logger.info("distributed master exiting: %s", self._exit_reason)
         return self._exit_code

@@ -37,12 +37,28 @@ class LocalJobMaster:
         min_node_num: Optional[int] = None,
         rdzv_waiting_timeout: float = 60,
     ):
+        import os
+
+        from dlrover_tpu.common.constants import NodeEnv
         from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
+        from dlrover_tpu.master.state_store import (
+            MasterStateManager,
+            create_state_backend,
+        )
         from dlrover_tpu.master.stats.job_collector import JobMetricCollector
 
+        # continuity state: memory by default (dies with the process, the
+        # standalone contract); DLROVER_TPU_STATE_BACKEND=file makes a
+        # killed-and-relaunched master resume shard queues and the ledger
+        self.state_manager = MasterStateManager(
+            create_state_backend(os.environ.get(NodeEnv.JOB_NAME, "local"))
+        )
         self.speed_monitor = SpeedMonitor()
         self.speed_monitor.set_target_worker_num(node_num)
-        self.task_manager = TaskManager(speed_monitor=self.speed_monitor)
+        self.task_manager = TaskManager(
+            speed_monitor=self.speed_monitor,
+            state_manager=self.state_manager,
+        )
         self.error_monitor = ErrorMonitor()
         self.metric_collector = JobMetricCollector(
             speed_monitor=self.speed_monitor
@@ -88,6 +104,19 @@ class LocalJobMaster:
         self._exit_reason = ""
 
     def prepare(self):
+        # restore BEFORE serving: surviving workers retry get_task against
+        # this address, and an empty registry reads as end-of-data
+        restored = self.task_manager.restore_from_state()
+        speed_state = self.state_manager.load_speed()
+        if speed_state:
+            self.speed_monitor.import_state(speed_state)
+        if restored or speed_state:
+            logger.info(
+                "local master resumed state: %s datasets, global_step=%s",
+                restored,
+                self.speed_monitor.completed_global_step,
+            )
+            self.speed_monitor.mark_downtime_start()
         self._server.start()
         self.task_manager.start()
         self.job_manager.start()
@@ -100,6 +129,7 @@ class LocalJobMaster:
         try:
             while True:
                 time.sleep(poll_interval)
+                self.state_manager.save_speed(self.speed_monitor.export_state())
                 if self.job_manager.all_workers_succeeded():
                     self._exit_reason = JobExitReason.SUCCEEDED
                     break
@@ -113,6 +143,8 @@ class LocalJobMaster:
                         self._exit_reason = JobExitReason.SUCCEEDED
                         break
         finally:
+            if self._exit_reason == JobExitReason.SUCCEEDED:
+                self.state_manager.clear()
             self.stop()
         logger.info("local master exiting: %s", self._exit_reason)
         return self._exit_code

@@ -144,3 +144,32 @@ class SpeedMonitor:
     def reset_running_speed(self):
         with self._lock:
             self._samples.clear()
+
+    # -- master-relaunch continuity -------------------------------------
+
+    def export_state(self) -> Dict:
+        """Durable ledger snapshot: global step, training-start epoch and
+        downtime totals survive a master relaunch, so goodput keeps its
+        true denominator instead of restarting from the relaunch time."""
+        with self._lock:
+            return {
+                "global_step": self._global_step,
+                "start_training_time": self._start_training_time,
+                "total_downtime": self._total_downtime,
+                "downtime_events": self._downtime_events,
+                "downtime_start": self._downtime_start,
+            }
+
+    def import_state(self, state: Dict):
+        with self._lock:
+            self._global_step = max(
+                self._global_step, int(state.get("global_step", 0))
+            )
+            start = float(state.get("start_training_time", 0.0))
+            if start > 0.0:
+                self._start_training_time = start
+            self._total_downtime = float(state.get("total_downtime", 0.0))
+            self._downtime_events = int(state.get("downtime_events", 0))
+            # a downtime bracket that was open when the old master died
+            # stays open — the relaunch gap itself is downtime
+            self._downtime_start = float(state.get("downtime_start", 0.0))

@@ -51,9 +51,12 @@ class DistributedJobManager(JobManager):
         pending_timeout: Optional[float] = None,
         error_monitor=None,
         resource_optimizer=None,
+        state_manager=None,
     ):
         super().__init__(job_args, speed_monitor, error_monitor)
         self._scaler = scaler
+        #: durable node-registry persistence (master relaunch continuity)
+        self._state_manager = state_manager
         self._watcher = watcher
         self._rdzv_managers = rdzv_managers or {}
         self._job_auto_scaler = job_auto_scaler
@@ -115,7 +118,8 @@ class DistributedJobManager(JobManager):
         self._start_ts = time.time()
         self._stop_evt.clear()
         self._scaler.start()
-        self._init_nodes()
+        if not self._restore_nodes_from_state():
+            self._init_nodes()
         if self._watcher is not None:
             # reconcile against pods that already exist (master restart)
             for node in self._watcher.list():
@@ -155,6 +159,78 @@ class DistributedJobManager(JobManager):
             plan.node_group_resources[rtype] = spec.group
         if not plan.empty():
             self._scaler.scale(plan)
+
+    # -- master-relaunch continuity -----------------------------------------
+
+    def export_node_state(self) -> Dict:
+        """Relaunch budgets + id sequence, the registry facts a relaunched
+        master cannot rebuild from a pod list (reference keeps these only
+        in memory; a master restart resets every budget there)."""
+        types: Dict[str, Dict] = {}
+        with self._lock:
+            for rtype, nodes in self._job_context.job_nodes().items():
+                recs = []
+                max_id = -1
+                for node in nodes.values():
+                    max_id = max(max_id, node.id)
+                    if node.is_released:
+                        continue
+                    recs.append(
+                        {
+                            "id": node.id,
+                            "relaunch_count": node.relaunch_count,
+                            "max_relaunch_count": node.max_relaunch_count,
+                            "memory_mb": node.config_resource.memory_mb or 0,
+                        }
+                    )
+                types[rtype] = {"max_id": max_id, "nodes": recs}
+        return {"types": types}
+
+    def persist_node_state(self):
+        if self._state_manager is not None:
+            self._state_manager.save_nodes(self.export_node_state())
+
+    def _restore_nodes_from_state(self) -> bool:
+        """Relaunched master: re-plan the persisted registry (existing pods
+        survive creation as 409-adopt; the watcher re-list sets real
+        statuses) instead of resetting ids and budgets to the job spec."""
+        if self._state_manager is None:
+            return False
+        state = self._state_manager.load_nodes()
+        if not state or not state.get("types"):
+            return False
+        plan = ScalePlan()
+        for rtype, tinfo in state["types"].items():
+            spec = self._job_args.replicas.get(rtype)
+            if spec is None:
+                continue
+            for rec in tinfo.get("nodes", []):
+                node = Node(
+                    node_type=rtype,
+                    node_id=int(rec["id"]),
+                    config_resource=copy.copy(spec.group.node_resource),
+                    max_relaunch_count=int(
+                        rec.get("max_relaunch_count", spec.restart_count)
+                    ),
+                )
+                node.relaunch_count = int(rec.get("relaunch_count", 0))
+                if rec.get("memory_mb"):
+                    node.config_resource = copy.copy(node.config_resource)
+                    node.config_resource.memory_mb = float(rec["memory_mb"])
+                self._job_context.update_node(node)
+                plan.launch_nodes.append(node)
+            self._job_context.set_id_floor(
+                rtype, int(tinfo.get("max_id", -1)) + 1
+            )
+            plan.node_group_resources[rtype] = spec.group
+        if plan.empty():
+            return False
+        logger.info(
+            "restored node registry from master state: %s",
+            {t: len(i.get("nodes", [])) for t, i in state["types"].items()},
+        )
+        self._scaler.scale(plan)
+        return True
 
     # -- event processing ---------------------------------------------------
 
@@ -313,6 +389,7 @@ class DistributedJobManager(JobManager):
         )
         plan = ScalePlan(launch_nodes=[new_node], remove_nodes=[node])
         self._scaler.scale(plan)
+        self.persist_node_state()
 
     def _bump_oom_memory(self, node: Node, new_node: Node):
         """Ask the optimizer (local heuristic or brain-backed) for an OOM
@@ -384,6 +461,7 @@ class DistributedJobManager(JobManager):
                 len(plan.remove_nodes),
             )
             self._scaler.scale(plan)
+            self.persist_node_state()
 
     # -- periodic monitoring ------------------------------------------------
 

@@ -65,6 +65,10 @@ class JobContext:
         self._action_queue = DiagnosisActionQueue()
         self._failed_locating: set = set()
         self.job_stage: str = ""
+        #: per-type lower bound for new ids — set on master relaunch so
+        #: replacement nodes never reuse an id whose (released) pod the
+        #: restored registry no longer tracks
+        self._id_floor: Dict[str, int] = {}
 
     @classmethod
     def singleton_instance(cls) -> "JobContext":
@@ -121,11 +125,21 @@ class JobContext:
     def next_node_id(self, node_type: str) -> int:
         with self._lock:
             nodes = self._nodes.get(node_type, {})
-            return max(nodes.keys(), default=-1) + 1
+            return max(
+                max(nodes.keys(), default=-1) + 1,
+                self._id_floor.get(node_type, 0),
+            )
+
+    def set_id_floor(self, node_type: str, floor: int):
+        with self._lock:
+            self._id_floor[node_type] = max(
+                self._id_floor.get(node_type, 0), floor
+            )
 
     def clear(self):
         with self._lock:
             self._nodes.clear()
+            self._id_floor.clear()
 
     # -- diagnosis actions -------------------------------------------------
 

@@ -201,6 +201,21 @@ class LocalJobManager(JobManager):
             node = self.add_node(node_type, node_id)
         return node
 
+    def collect_node_heartbeat(
+        self, node_type: str, node_id: int, ts: float
+    ) -> Optional[DiagnosisAction]:
+        """A heartbeat from an unknown node re-adopts it: agents only
+        report their address once at boot, so a relaunched master learns
+        its surviving workers from their next heartbeat."""
+        self.get_or_register_node(node_type, node_id)
+        return super().collect_node_heartbeat(node_type, node_id, ts)
+
+    def handle_node_succeeded(self, node_type: str, node_id: int):
+        # re-adopt before marking: a worker that outlived a master
+        # relaunch must still conclude the job when it finishes
+        self.get_or_register_node(node_type, node_id)
+        super().handle_node_succeeded(node_type, node_id)
+
     def handle_node_event(self, event: NodeEvent):
         node = self._job_context.get_node(event.node.type, event.node.id)
         if node is None:

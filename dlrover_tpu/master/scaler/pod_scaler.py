@@ -151,10 +151,17 @@ class PodScaler(Scaler):
             try:
                 self._create_pod(node)
             except Exception as e:
+                if isinstance(e, K8sApiError) and e.status == 409:
+                    # pod already exists — a relaunched master re-planning
+                    # live workers; the watcher re-list adopts it
+                    logger.info(
+                        "pod %s exists; adopting", self.pod_name(node)
+                    )
+                    continue
                 if (
                     isinstance(e, K8sApiError)
                     and 400 <= e.status < 500
-                    and e.status not in (409, 429)
+                    and e.status != 429
                 ):
                     # permanently rejected spec (e.g. 422 validation):
                     # requeueing would hot-loop forever and the job would

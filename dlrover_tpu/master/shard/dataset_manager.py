@@ -41,6 +41,13 @@ class DatasetShardCheckpoint:
     epoch: int = 0
     completed_records: int = 0
     partition_offsets: Dict = field(default_factory=dict)  # streaming only
+    #: in-flight task identity for master-relaunch continuity:
+    #: [[task_id, node_id, partition, start, end], ...] — lets a restored
+    #: master keep live workers' tasks as *doing* (their late success
+    #: reports then complete normally, exactly-once) instead of
+    #: re-queueing them blind
+    doing_meta: List = field(default_factory=list)
+    task_id_seq: int = 0
 
     def to_json(self) -> str:
         return json.dumps(
@@ -51,6 +58,8 @@ class DatasetShardCheckpoint:
                 "epoch": self.epoch,
                 "completed_records": self.completed_records,
                 "partition_offsets": self.partition_offsets,
+                "doing_meta": self.doing_meta,
+                "task_id_seq": self.task_id_seq,
             }
         )
 
@@ -64,6 +73,8 @@ class DatasetShardCheckpoint:
             epoch=d.get("epoch", 0),
             completed_records=d.get("completed_records", 0),
             partition_offsets=d.get("partition_offsets", {}),
+            doing_meta=d.get("doing_meta", []),
+            task_id_seq=d.get("task_id_seq", 0),
         )
 
 
@@ -179,16 +190,46 @@ class BatchDatasetManager:
                 ],
                 epoch=self._splitter.epoch,
                 completed_records=self._completed_records,
+                doing_meta=[
+                    [d.task.task_id, d.node_id, d.task.partition,
+                     d.task.shard_start, d.task.shard_end]
+                    for d in self._doing.values()
+                ],
+                task_id_seq=self._task_id_seq,
             )
 
-    def restore_checkpoint(self, ckpt: DatasetShardCheckpoint):
-        """Doing shards are treated as undone and go back to todo."""
+    def restore_checkpoint(
+        self, ckpt: DatasetShardCheckpoint, keep_doing: bool = False
+    ):
+        """Default: doing shards are treated as undone and go back to todo
+        (worker restart). ``keep_doing`` (master relaunch with workers
+        still alive): in-flight tasks are rebuilt as *doing* under their
+        original ids, so live workers' late reports complete them
+        exactly-once; the timeout scan requeues any whose worker truly
+        died."""
         with self._lock:
             self._splitter.epoch = ckpt.epoch
             self._todo.clear()
             self._doing.clear()
             self._completed_records = ckpt.completed_records
-            for start, end in list(ckpt.doing) + list(ckpt.todo):
+            self._task_id_seq = max(self._task_id_seq, ckpt.task_id_seq)
+            doing = list(ckpt.doing)
+            if keep_doing and ckpt.doing_meta:
+                doing = []
+                for task_id, node_id, partition, start, end in ckpt.doing_meta:
+                    task = Task(
+                        task_id=int(task_id),
+                        task_type=self.task_type,
+                        dataset_name=self.dataset_name,
+                        shard_start=start,
+                        shard_end=end,
+                        partition=str(partition or ""),
+                        epoch=ckpt.epoch,
+                    )
+                    self._doing[task.task_id] = DoingTask(
+                        task, int(node_id), time.time()
+                    )
+            for start, end in doing + list(ckpt.todo):
                 task = Task(
                     task_id=self._task_id_seq,
                     task_type=self.task_type,
@@ -249,15 +290,40 @@ class StreamingDatasetManager(BatchDatasetManager):
                 epoch=self._splitter.epoch,
                 completed_records=self._completed_records,
                 partition_offsets=self._splitter.offsets,
+                doing_meta=[
+                    [d.task.task_id, d.node_id, d.task.partition,
+                     d.task.shard_start, d.task.shard_end]
+                    for d in self._doing.values()
+                ],
+                task_id_seq=self._task_id_seq,
             )
 
-    def restore_checkpoint(self, ckpt: DatasetShardCheckpoint):
+    def restore_checkpoint(
+        self, ckpt: DatasetShardCheckpoint, keep_doing: bool = False
+    ):
         with self._lock:
             self._todo.clear()
             self._doing.clear()
             self._completed_records = ckpt.completed_records
+            self._task_id_seq = max(self._task_id_seq, ckpt.task_id_seq)
             self._splitter.reset_offsets(ckpt.partition_offsets)
-            for partition, start, end in list(ckpt.doing) + list(ckpt.todo):
+            doing = list(ckpt.doing)
+            if keep_doing and ckpt.doing_meta:
+                doing = []
+                for task_id, node_id, partition, start, end in ckpt.doing_meta:
+                    task = Task(
+                        task_id=int(task_id),
+                        task_type=self.task_type,
+                        dataset_name=self.dataset_name,
+                        shard_start=start,
+                        shard_end=end,
+                        partition=str(partition or ""),
+                        epoch=ckpt.epoch,
+                    )
+                    self._doing[task.task_id] = DoingTask(
+                        task, int(node_id), time.time()
+                    )
+            for partition, start, end in doing + list(ckpt.todo):
                 task = Task(
                     task_id=self._task_id_seq,
                     task_type=self.task_type,

@@ -7,6 +7,7 @@ exposes dataset checkpoint/restore for job-level resume.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -23,44 +24,136 @@ from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
 
 
 class TaskManager:
-    def __init__(self, worker_restart_timeout: float = 0.0, speed_monitor=None):
+    def __init__(
+        self,
+        worker_restart_timeout: float = 0.0,
+        speed_monitor=None,
+        state_manager=None,
+    ):
         self._datasets: Dict[str, BatchDatasetManager] = {}
+        self._params: Dict[str, DatasetShardParams] = {}
         self._lock = threading.Lock()
         self._worker_restart_timeout = worker_restart_timeout
         self._speed_monitor = speed_monitor
+        #: durable write-through target (master relaunch continuity);
+        #: None = in-memory only (local master)
+        self._state_manager = state_manager
         self._task_timeout = DefaultValues.TASK_TIMEOUT_SECS
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # persistence runs on a coalescing writer thread: every dispatch/
+        # report marks its dataset dirty and the writer drains immediately
+        # — RPC handlers never pay the serialize+fsync/API-server cost,
+        # and a burst of task RPCs collapses into one write per dataset.
+        # The loss window (master killed between mutation and drain) is
+        # sub-ms and degrades to at-least-once re-dispatch, never loss.
+        self._dirty: set = set()
+        self._dirty_evt = threading.Event()
+        self._writer: Optional[threading.Thread] = None
 
     def new_dataset(self, params: DatasetShardParams):
         with self._lock:
             if params.dataset_name in self._datasets:
                 return
-            splitter = new_dataset_splitter(
-                params.storage_type,
-                params.dataset_name,
-                params.dataset_size,
-                params.shard_size,
-                params.num_epochs,
-                params.shuffle,
-                partition_offsets=params.partition_offsets or None,
+            self._register(params)
+        self._persist(params.dataset_name)
+
+    def _register(self, params: DatasetShardParams):
+        splitter = new_dataset_splitter(
+            params.storage_type,
+            params.dataset_name,
+            params.dataset_size,
+            params.shard_size,
+            params.num_epochs,
+            params.shuffle,
+            partition_offsets=params.partition_offsets or None,
+        )
+        task_type = "eval" if "eval" in params.dataset_name else "train"
+        manager_cls = (
+            StreamingDatasetManager
+            if params.storage_type == "streaming"
+            else BatchDatasetManager
+        )
+        self._datasets[params.dataset_name] = manager_cls(task_type, splitter)
+        self._params[params.dataset_name] = params
+        logger.info(
+            "registered dataset %s: size=%s shard=%s epochs=%s",
+            params.dataset_name,
+            params.dataset_size,
+            params.shard_size,
+            params.num_epochs,
+        )
+
+    def _persist(self, dataset_name: str):
+        """Mark the dataset dirty; the writer thread drains immediately.
+        Runs AFTER the in-memory mutation: a master killed in between
+        re-dispatches at most the un-persisted change (at-least-once)."""
+        if self._state_manager is None:
+            return
+        self._dirty.add(dataset_name)
+        self._dirty_evt.set()
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="task-state-writer",
+                daemon=True,
             )
-            task_type = "eval" if "eval" in params.dataset_name else "train"
-            manager_cls = (
-                StreamingDatasetManager
-                if params.storage_type == "streaming"
-                else BatchDatasetManager
+            self._writer.start()
+
+    def _writer_loop(self):
+        while not self._stop.is_set():
+            if not self._dirty_evt.wait(timeout=1.0):
+                continue
+            self._dirty_evt.clear()
+            self.flush_state()
+
+    def flush_state(self):
+        """Synchronously persist every dirty dataset (writer drain; also
+        the deterministic barrier for tests and shutdown)."""
+        if self._state_manager is None:
+            return
+        import dataclasses
+
+        while self._dirty:
+            name = self._dirty.pop()
+            ds = self._datasets.get(name)
+            params = self._params.get(name)
+            if ds is None or params is None:
+                continue
+            self._state_manager.save_dataset(
+                name,
+                dataclasses.asdict(params),
+                ds.checkpoint().to_json(),
             )
-            self._datasets[params.dataset_name] = manager_cls(
-                task_type, splitter
-            )
-            logger.info(
-                "registered dataset %s: size=%s shard=%s epochs=%s",
-                params.dataset_name,
-                params.dataset_size,
-                params.shard_size,
-                params.num_epochs,
-            )
+
+    def restore_from_state(self) -> int:
+        """Master relaunch: rebuild every persisted dataset with its shard
+        queues, keeping live workers' in-flight tasks as doing. Returns
+        the number of datasets restored."""
+        if self._state_manager is None:
+            return 0
+        restored = 0
+        for name, doc in self._state_manager.load_datasets().items():
+            try:
+                params = DatasetShardParams(**doc["params"])
+                ckpt = DatasetShardCheckpoint.from_json(
+                    json.dumps(doc["ckpt"])
+                )
+                with self._lock:
+                    if name not in self._datasets:
+                        self._register(params)
+                    self._datasets[name].restore_checkpoint(
+                        ckpt, keep_doing=True
+                    )
+                restored += 1
+                logger.info(
+                    "restored dataset %s from master state: epoch=%s "
+                    "todo=%s doing=%s completed_records=%s",
+                    name, ckpt.epoch, len(ckpt.todo), len(ckpt.doing_meta)
+                    or len(ckpt.doing), ckpt.completed_records,
+                )
+            except Exception:
+                logger.exception("dataset %s state restore failed", name)
+        return restored
 
     def has_dataset(self, name: str) -> bool:
         return name in self._datasets
@@ -69,13 +162,18 @@ class TaskManager:
         ds = self._datasets.get(dataset_name)
         if ds is None:
             return Task()
-        return ds.get_task(node_id)
+        task = ds.get_task(node_id)
+        if not task.empty:
+            self._persist(dataset_name)
+        return task
 
     def report_dataset_task(self, dataset_name: str, task_id: int, success: bool):
         ds = self._datasets.get(dataset_name)
         if ds is None:
             return False
         known, _ = ds.report_task_status(task_id, success)
+        if known:
+            self._persist(dataset_name)
         return known
 
     def get_epoch(self, dataset_name: str) -> int:
@@ -94,8 +192,9 @@ class TaskManager:
             return all(ds.completed() for ds in self._datasets.values())
 
     def remove_node_tasks(self, node_id: int):
-        for ds in self._datasets.values():
-            ds.reset_worker_tasks(node_id)
+        for name, ds in list(self._datasets.items()):
+            if ds.reset_worker_tasks(node_id):
+                self._persist(name)
 
     # -- checkpoint -------------------------------------------------------
 
@@ -123,10 +222,12 @@ class TaskManager:
 
     def stop(self):
         self._stop.set()
+        self._dirty_evt.set()
+        self.flush_state()
 
     def _scan_loop(self):
         while not self._stop.wait(30):
-            for ds in list(self._datasets.values()):
+            for name, ds in list(self._datasets.items()):
                 stale = ds.reset_timeout_tasks(self._task_timeout)
                 if stale:
                     logger.warning(
@@ -134,3 +235,4 @@ class TaskManager:
                         ds.dataset_name,
                         stale,
                     )
+                    self._persist(name)

@@ -222,6 +222,29 @@ class K8sClient:
                 return None
             raise
 
+    # -- configmaps (master state continuity) --------------------------------
+
+    def _cm_path(self, name: str = "") -> str:
+        base = f"/api/v1/namespaces/{self.namespace}/configmaps"
+        return f"{base}/{name}" if name else base
+
+    def create_config_map(self, cm: Dict) -> Dict:
+        return self._transport.request("POST", self._cm_path(), body=cm)
+
+    def get_config_map(self, name: str) -> Optional[Dict]:
+        try:
+            return self._transport.request("GET", self._cm_path(name))
+        except K8sApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def patch_config_map(self, name: str, patch: Dict) -> Dict:
+        """Strategic-merge patch; a ``data`` key set to None deletes it."""
+        return self._transport.request(
+            "PATCH", self._cm_path(name), body=patch
+        )
+
     # -- events -------------------------------------------------------------
 
     def create_event(self, event: Dict) -> Dict:

@@ -1,0 +1,40 @@
+"""Standalone master process for the kill-the-master chaos scenario.
+
+Runs a LocalJobMaster on a FIXED port (so a relaunched master is
+reachable at the same address, like the k8s master Service) with the
+continuity state backend taken from the environment
+(DLROVER_TPU_STATE_BACKEND/DLROVER_TPU_STATE_DIR). Prints READY when
+serving; exits with the job outcome.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+from dlrover_tpu.master.local_master import start_local_master
+
+
+def main() -> int:
+    port = int(sys.argv[1])
+    node_num = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    master = start_local_master(
+        port=port, node_num=node_num, min_node_num=1, rdzv_waiting_timeout=8
+    )
+    print(f"READY port={master.port}", flush=True)
+    code = master.run(poll_interval=0.5)
+    print(
+        "MASTER_EXIT "
+        f"global_step={master.speed_monitor.completed_global_step} "
+        f"downtime={master.speed_monitor.total_downtime():.3f} "
+        f"goodput={master.speed_monitor.goodput():.4f}",
+        flush=True,
+    )
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""Shard-accounting workload for the kill-the-master chaos scenario.
+
+Processes a bounded dataset through the ShardingClient, logging every
+shard range it trains on — the test asserts each range was processed
+exactly once across a master SIGKILL + relaunch. A small per-shard sleep
+keeps the run long enough for the kill window.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import dlrover_tpu.train as dtrain
+
+ctx = dtrain.init(local_device_count=1)
+
+from dlrover_tpu.train.data import ShardingClient
+
+DATASET = "shards-train"
+DATASET_SIZE = int(os.environ.get("DLROVER_TPU_TEST_DATASET_SIZE", "96"))
+SHARD_SIZE = int(os.environ.get("DLROVER_TPU_TEST_SHARD_SIZE", "8"))
+SHARD_SLEEP = float(os.environ.get("DLROVER_TPU_TEST_SHARD_SLEEP", "0.4"))
+
+client = ShardingClient(DATASET, ctx.client)
+client.register_dataset(DATASET_SIZE, SHARD_SIZE, num_epochs=1)
+
+step = 0
+for task in client.iter_tasks():
+    print(
+        f"[shards] processing {task.shard_start}:{task.shard_end} "
+        f"task_id={task.task_id}",
+        flush=True,
+    )
+    time.sleep(SHARD_SLEEP)
+    step += 1
+    ctx.report_step(step, force=True)
+
+print(f"[shards] done: tasks={step}", flush=True)

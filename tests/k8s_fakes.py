@@ -20,6 +20,7 @@ class FakeTransport:
         self.services: Dict[str, dict] = {}
         self.crs: Dict[str, Dict[str, dict]] = {}  # plural -> name -> cr
         self.nodes: Dict[str, dict] = {}  # cluster nodes (cordon target)
+        self.configmaps: Dict[str, dict] = {}
         self.events: List[dict] = []
         self._watch_queues: Dict[str, "queue.Queue"] = {}
 
@@ -40,6 +41,8 @@ class FakeTransport:
             return self._handle(
                 self.services, method, parts, body, "services", params
             )
+        if "/configmaps" in path:
+            return self._handle_configmap(method, parts, body)
         if "/events" in path:
             self.events.append(body)
             return body
@@ -88,6 +91,40 @@ class FakeTransport:
             target = store.setdefault(name, {})
             target.update(body or {})
             return target
+        raise K8sApiError(405, "MethodNotAllowed")
+
+    def _handle_configmap(self, method, parts, body):
+        """ConfigMaps get real strategic-merge PATCH semantics: ``data``
+        keys merge (None deletes) rather than replacing the whole map —
+        the contract the master state backend relies on."""
+        idx = parts.index("configmaps")
+        name = parts[idx + 1] if len(parts) > idx + 1 else ""
+        if method == "GET":
+            if name not in self.configmaps:
+                raise K8sApiError(404, "NotFound")
+            return self.configmaps[name]
+        if method == "POST":
+            obj_name = body.get("metadata", {}).get("name", "")
+            if obj_name in self.configmaps:
+                raise K8sApiError(409, "AlreadyExists")
+            self.configmaps[obj_name] = body
+            return body
+        if method == "PATCH":
+            if name not in self.configmaps:
+                raise K8sApiError(404, "NotFound")
+            target = self.configmaps[name]
+            data = target.setdefault("data", {})
+            for k, v in (body or {}).get("data", {}).items():
+                if v is None:
+                    data.pop(k, None)
+                else:
+                    data[k] = v
+            return target
+        if method == "DELETE":
+            if name not in self.configmaps:
+                raise K8sApiError(404, "NotFound")
+            del self.configmaps[name]
+            return {}
         raise K8sApiError(405, "MethodNotAllowed")
 
     def _stream(self, resource: str):

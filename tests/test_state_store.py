@@ -1,0 +1,235 @@
+"""Master state continuity (VERDICT r3 missing #2): durable backends,
+task-queue write-through, ledger and relaunch-budget restore.
+
+Parity: reference keeps state backends (``dlrover/python/util/state``) and
+dataset-shard checkpoints (``master/shard/base_dataset_manager.py:60-91``)
+but loses them when the master pod dies; here the same state survives a
+master relaunch.
+"""
+
+import json
+
+import pytest
+
+from dlrover_tpu.common.messages import DatasetShardParams
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.shard.task_manager import TaskManager
+from dlrover_tpu.master.state_store import (
+    ConfigMapStateBackend,
+    FileStateBackend,
+    MasterStateManager,
+    MemoryStateBackend,
+)
+from tests.k8s_fakes import make_fake_client
+
+
+@pytest.mark.parametrize("backend_kind", ["memory", "file", "configmap"])
+def test_backend_roundtrip(backend_kind, tmp_path):
+    if backend_kind == "memory":
+        b = MemoryStateBackend()
+    elif backend_kind == "file":
+        b = FileStateBackend(str(tmp_path / "state"))
+    else:
+        client, _ = make_fake_client()
+        b = ConfigMapStateBackend(client, "dlrover-state-j1")
+    b.set("tasks/train", json.dumps({"a": 1}))
+    b.set("tasks/eval", json.dumps({"b": 2}))
+    b.set("speed", json.dumps({"step": 5}))
+    assert json.loads(b.get("tasks/train")) == {"a": 1}
+    assert sorted(b.keys("tasks/")) == ["tasks/eval", "tasks/train"]
+    # second write must not clobber sibling keys (strategic merge)
+    b.set("tasks/train", json.dumps({"a": 9}))
+    assert json.loads(b.get("tasks/eval")) == {"b": 2}
+    b.delete("tasks/eval")
+    assert b.get("tasks/eval") is None
+    assert b.get("nope") is None
+
+
+def _task_manager(backend):
+    return TaskManager(state_manager=MasterStateManager(backend))
+
+
+def test_task_state_survives_master_relaunch(tmp_path):
+    """Kill-the-master semantics: a fresh TaskManager on the same backend
+    resumes with completed shards gone, in-flight shards still *doing*
+    under their original ids (live workers' late reports complete them),
+    and the rest in todo — no shard dispatched twice."""
+    backend = FileStateBackend(str(tmp_path))
+    tm1 = _task_manager(backend)
+    tm1.new_dataset(DatasetShardParams(
+        dataset_name="train", dataset_size=64, shard_size=16, num_epochs=1,
+    ))
+    t0 = tm1.get_dataset_task(0, "train")   # -> completed
+    t1 = tm1.get_dataset_task(1, "train")   # -> in flight across the kill
+    assert tm1.report_dataset_task("train", t0.task_id, True)
+    tm1.flush_state()  # writer drain barrier
+
+    # "master killed here" — new process, same backend
+    tm2 = _task_manager(backend)
+    assert tm2.restore_from_state() == 1
+    assert tm2.completed_records("train") == 16
+
+    # the live worker's in-flight task completes exactly-once
+    assert tm2.report_dataset_task("train", t1.task_id, True)
+    assert tm2.completed_records("train") == 32
+
+    # remaining shards dispatch each range exactly once, no repeats
+    seen = {(t0.shard_start, t0.shard_end), (t1.shard_start, t1.shard_end)}
+    while True:
+        t = tm2.get_dataset_task(0, "train")
+        if t.empty:
+            break
+        rng = (t.shard_start, t.shard_end)
+        assert rng not in seen, f"shard {rng} double-dispatched"
+        seen.add(rng)
+        tm2.report_dataset_task("train", t.task_id, True)
+    tm2.flush_state()
+    assert seen == {(0, 16), (16, 32), (32, 48), (48, 64)}
+    assert tm2.finished()
+
+
+def test_task_restore_requeues_after_timeout(tmp_path):
+    """A restored doing task whose worker really died is reclaimed by the
+    timeout scan, not lost."""
+    backend = FileStateBackend(str(tmp_path))
+    tm1 = _task_manager(backend)
+    tm1.new_dataset(DatasetShardParams(
+        dataset_name="train", dataset_size=32, shard_size=16, num_epochs=1,
+    ))
+    t = tm1.get_dataset_task(7, "train")
+    tm1.flush_state()
+
+    tm2 = _task_manager(backend)
+    tm2.restore_from_state()
+    ds = tm2._datasets["train"]
+    assert ds.reset_timeout_tasks(timeout_s=0.0) == [t.task_id]
+    redispatched = tm2.get_dataset_task(1, "train")
+    assert (redispatched.shard_start, redispatched.shard_end) == (
+        t.shard_start, t.shard_end,
+    )
+
+
+def test_streaming_dataset_state_roundtrip(tmp_path):
+    backend = FileStateBackend(str(tmp_path))
+    tm1 = _task_manager(backend)
+    tm1.new_dataset(DatasetShardParams(
+        dataset_name="stream", dataset_size=0, shard_size=8,
+        storage_type="streaming", partition_offsets={"p0": 0, "p1": 100},
+    ))
+    t = tm1.get_dataset_task(0, "stream")
+    assert not t.empty
+    tm1.flush_state()
+
+    tm2 = _task_manager(backend)
+    assert tm2.restore_from_state() == 1
+    # in-flight offset range preserved as doing under its original id
+    assert tm2.report_dataset_task("stream", t.task_id, True)
+
+
+def test_speed_ledger_continuity():
+    sm1 = SpeedMonitor()
+    sm1.collect_global_step(10, timestamp=1000.0)
+    sm1.mark_downtime_start(ts=1010.0)
+    sm1.mark_downtime_end(ts=1020.0)
+    state = sm1.export_state()
+
+    sm2 = SpeedMonitor()
+    sm2.import_state(state)
+    assert sm2.completed_global_step == 10
+    assert sm2.start_training_time == 1000.0
+    assert sm2.total_downtime() == pytest.approx(10.0)
+    assert sm2.avg_downtime() == pytest.approx(10.0)
+    # stale step reports from before the kill are ignored
+    sm2.collect_global_step(9)
+    assert sm2.completed_global_step == 10
+
+
+def test_node_budget_state_roundtrip():
+    from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
+    from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+    from dlrover_tpu.master.scaler.pod_scaler import PodScaler
+    from dlrover_tpu.scheduler.job import JobArgs
+    from tests.k8s_fakes import ELASTICJOB_CR
+
+    JobContext.reset_singleton()
+    try:
+        client, _ = make_fake_client()
+        args = JobArgs.from_elasticjob_cr(ELASTICJOB_CR)
+        backend = MemoryStateBackend()
+        state_mgr = MasterStateManager(backend)
+        mgr = DistributedJobManager(
+            job_args=args,
+            scaler=PodScaler(args, client, master_addr="m:1"),
+            state_manager=state_mgr,
+        )
+        ctx = get_job_context()
+        from dlrover_tpu.common.constants import NodeType
+        from dlrover_tpu.common.node import Node
+
+        n0 = Node(NodeType.WORKER, 0, max_relaunch_count=3)
+        n0.relaunch_count = 2
+        n5 = Node(NodeType.WORKER, 5, max_relaunch_count=3)
+        ctx.update_node(n0)
+        ctx.update_node(n5)
+        mgr.persist_node_state()
+
+        # relaunched master: fresh context, same backend
+        JobContext.reset_singleton()
+        mgr2 = DistributedJobManager(
+            job_args=args,
+            scaler=PodScaler(args, client, master_addr="m:1"),
+            state_manager=state_mgr,
+        )
+        assert mgr2._restore_nodes_from_state()
+        ctx2 = get_job_context()
+        restored = ctx2.workers()
+        assert restored[0].relaunch_count == 2  # budget survived
+        assert set(restored) == {0, 5}
+        # id sequence continues past the persisted max, never reusing 1-4
+        assert ctx2.next_node_id(NodeType.WORKER) == 6
+    finally:
+        JobContext.reset_singleton()
+
+
+@pytest.mark.parametrize("backend_kind", ["file", "configmap"])
+def test_key_encoding_roundtrips_nasty_dataset_names(backend_kind, tmp_path):
+    """Dataset names containing '/', '.', '__' must survive the backend's
+    key encoding exactly (code-review r4: the old '__'<->'/' and '.'<->'/'
+    decodes were lossy)."""
+    if backend_kind == "file":
+        b = FileStateBackend(str(tmp_path))
+    else:
+        client, _ = make_fake_client()
+        b = ConfigMapStateBackend(client, "dlrover-state-enc")
+    names = ["imagenet__v2", "train.v1", "data/sub/set", "a.b__c/d"]
+    for n in names:
+        b.set(f"tasks/{n}", json.dumps({"name": n}))
+    got = sorted(b.keys("tasks/"))
+    assert got == sorted(f"tasks/{n}" for n in names), got
+    for n in names:
+        assert json.loads(b.get(f"tasks/{n}"))["name"] == n
+
+
+def test_stale_job_uid_state_is_dropped(tmp_path):
+    """A re-created same-named job (new uid) must not resume the dead
+    predecessor's mid-epoch state."""
+    backend = FileStateBackend(str(tmp_path))
+    old = MasterStateManager(backend, job_uid="uid-old")
+    tm1 = TaskManager(state_manager=old)
+    tm1.new_dataset(DatasetShardParams(
+        dataset_name="train", dataset_size=64, shard_size=16, num_epochs=1,
+    ))
+    tm1.get_dataset_task(0, "train")
+    tm1.flush_state()
+    old.save_speed({"global_step": 50})
+
+    fresh = MasterStateManager(backend, job_uid="uid-new")
+    tm2 = TaskManager(state_manager=fresh)
+    assert tm2.restore_from_state() == 0
+    assert fresh.load_speed() is None
+
+    # while a SAME-uid relaunched master does resume
+    again = MasterStateManager(backend, job_uid="uid-old")
+    tm3 = TaskManager(state_manager=again)
+    assert tm3.restore_from_state() == 1
+    assert again.load_speed()["global_step"] == 50
