@@ -52,10 +52,16 @@ CREATE TABLE IF NOT EXISTS runtime_metrics (
     cpu REAL,
     mem_avg REAL,
     mem_max REAL,
-    duty REAL
+    duty REAL,
+    host_metrics TEXT DEFAULT ''
 );
 CREATE INDEX IF NOT EXISTS rm_job ON runtime_metrics(job_uuid, ts);
 """
+
+_MIGRATIONS = [
+    # pre-round-4 databases lack the per-host metric feed column
+    "ALTER TABLE runtime_metrics ADD COLUMN host_metrics TEXT DEFAULT ''",
+]
 
 
 class BrainDataStore:
@@ -65,6 +71,11 @@ class BrainDataStore:
         self._lock = threading.Lock()
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            for mig in _MIGRATIONS:
+                try:
+                    self._conn.execute(mig)
+                except sqlite3.OperationalError:
+                    pass  # fresh schema already has it
             self._conn.commit()
 
     def upsert_job(
@@ -114,12 +125,14 @@ class BrainDataStore:
                 s.memory_mb_avg,
                 s.memory_mb_max,
                 s.tpu_duty_cycle_avg,
+                json.dumps(s.host_metrics) if s.host_metrics else "",
             )
             for s in samples
         ]
         with self._lock:
             self._conn.executemany(
-                "INSERT INTO runtime_metrics VALUES(?,?,?,?,?,?,?,?,?)", rows
+                "INSERT INTO runtime_metrics VALUES(?,?,?,?,?,?,?,?,?,?)",
+                rows,
             )
             self._conn.commit()
 
@@ -127,7 +140,8 @@ class BrainDataStore:
         with self._lock:
             rows = self._conn.execute(
                 """SELECT ts, worker_num, speed, global_step, cpu, mem_avg,
-                   mem_max, duty FROM runtime_metrics WHERE job_uuid=?
+                   mem_max, duty, host_metrics
+                   FROM runtime_metrics WHERE job_uuid=?
                    ORDER BY ts DESC LIMIT ?""",
                 (job_uuid, limit),
             ).fetchall()
@@ -141,6 +155,7 @@ class BrainDataStore:
                 memory_mb_avg=r[5],
                 memory_mb_max=r[6],
                 tpu_duty_cycle_avg=r[7],
+                host_metrics=json.loads(r[8]) if r[8] else {},
             )
             for r in rows
         ]
@@ -162,6 +177,26 @@ class BrainDataStore:
             "max_workers": row[1],
             "node_unit": row[2],
         }
+
+    def tpu_type_outcomes(self, tpu_type: str, limit: int = 20) -> List[int]:
+        """Final worker counts of recent successful jobs on the same slice
+        type — the slice-keyed cold-start table (reference keys its
+        cold-create table by resource class)."""
+        with self._lock:
+            rows = self._conn.execute(
+                """SELECT final_workers FROM jobs
+                   WHERE tpu_type=? AND status='succeeded' AND final_workers>0
+                   ORDER BY finished_at DESC LIMIT ?""",
+                (tpu_type, limit),
+            ).fetchall()
+        return [int(r[0]) for r in rows]
+
+    def job_tpu_type(self, job_uuid: str) -> str:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT tpu_type FROM jobs WHERE uuid=?", (job_uuid,)
+            ).fetchone()
+        return row[0] or "" if row else ""
 
     def peak_memory(self, job_name: str) -> float:
         """Max observed host memory across past runs of this job name."""

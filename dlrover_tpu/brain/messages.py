@@ -25,6 +25,8 @@ class RuntimeSample:
     memory_mb_avg: float = 0.0
     memory_mb_max: float = 0.0
     tpu_duty_cycle_avg: float = 0.0
+    #: per-host feed for hot-host detection: host -> [cpu%, mem_mb, duty]
+    host_metrics: Dict[str, List[float]] = field(default_factory=dict)
 
 
 @message
@@ -71,6 +73,8 @@ class BrainOptimizeRequest:
     # horizon. 0 disables the gate.
     restart_cost_s: float = 0.0
     recoup_horizon_s: float = 1800.0
+    #: slice type (e.g. v5p-32) for slice-keyed cold-start sizing
+    tpu_type: str = ""
 
 
 @message
@@ -79,12 +83,16 @@ class BrainResourcePlan:
     memory_mb_per_host: float = 0.0
     paral_config: Dict = field(default_factory=dict)
     comment: str = ""
+    #: hosts the hot-host guard flagged (cpu pegged, TPU duty lagging) —
+    #: the master cordons/migrates these
+    hot_hosts: List[str] = field(default_factory=list)
 
     def empty(self) -> bool:
         return (
             self.worker_count <= 0
             and self.memory_mb_per_host <= 0
             and not self.paral_config
+            and not self.hot_hosts
         )
 
 

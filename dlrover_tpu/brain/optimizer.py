@@ -1,15 +1,26 @@
-"""Brain optimizer framework: pluggable per-stage algorithms.
+"""Brain optimizer framework: named algorithms in configurable per-stage
+chains.
 
-Parity: reference ``dlrover/go/brain/pkg/optimizer`` (base_optimizer.go:
-40-48 dispatch + ``optalgorithm/`` implementations). The reference's 18
-algorithms are PS-era (PS cold-create/hot-resource/OOM, worker create);
-the TPU set replaces PS math with what matters on slices: throughput
-scaling fits for worker count, history-based cold starts, and
-memory-bump OOM recovery.
+Parity: reference ``dlrover/go/brain/pkg/optimizer`` — ``base_optimizer.go:
+40-48`` dispatches a *configured chain* of named algorithms per stage, and
+``optalgorithm/`` ships 18 implementations. The reference's algorithms are
+PS-era (PS cold-create / hot-PS / PS-OOM); the TPU translations here keep
+the same architecture (registry + chain + config override) with slice-era
+math: throughput-scaling fits for worker count, job- and slice-type
+history cold starts, host-memory right-sizing, hot-host detection from the
+per-host metric feed, and goodput/saturation growth gates.
+
+Chain semantics (reference ``optimize_algorithm.go``): each algorithm
+receives the plan produced so far and refines it — producers fill empty
+fields, gates veto or shrink a growth the producers proposed. Chains are
+configurable per stage through the datastore's master-config table under
+``brain.chain.<stage>`` (comma-separated algorithm names), so an operator
+can re-order, drop, or extend a chain without redeploying.
 """
 
 from __future__ import annotations
 
+import statistics
 from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.brain.datastore import BrainDataStore
@@ -23,17 +34,56 @@ from dlrover_tpu.common.log import logger
 STAGE_CREATE = "job_stage_create"
 STAGE_SAMPLE = "job_stage_sample"
 STAGE_RUNNING = "job_stage_running"
+STAGE_OOM = "job_stage_oom"
 
-Algorithm = Callable[[BrainDataStore, BrainOptimizeRequest], BrainResourcePlan]
+#: name -> fn(store, req, plan) mutating/refining the plan in place
+Algorithm = Callable[
+    [BrainDataStore, BrainOptimizeRequest, BrainResourcePlan], None
+]
 _ALGORITHMS: Dict[str, Algorithm] = {}
 
+DEFAULT_CHAINS: Dict[str, List[str]] = {
+    STAGE_CREATE: [
+        "job_history_cold_start",
+        "slice_coldstart_sizing",
+        "conservative_create",
+        "worker_create_resource",
+    ],
+    STAGE_SAMPLE: [
+        "throughput_fit_scaling",
+        "sample_step_up",
+        "init_adjust_resource",
+        "cluster_saturation_gate",
+        "goodput_growth_gate",
+    ],
+    STAGE_RUNNING: [
+        "throughput_fit_scaling",
+        "hot_host_guard",
+        "speed_anomaly_guard",
+        "cluster_saturation_gate",
+        "goodput_growth_gate",
+    ],
+    STAGE_OOM: [
+        "oom_host_memory_bump",
+        "oom_hbm_paral_adjust",
+    ],
+}
 
-def algorithm(stage: str):
+
+def algorithm(name: str):
     def wrap(fn: Algorithm) -> Algorithm:
-        _ALGORITHMS[stage] = fn
+        _ALGORITHMS[name] = fn
         return fn
 
     return wrap
+
+
+def algorithm_names() -> List[str]:
+    return sorted(_ALGORITHMS)
+
+
+def _note(plan: BrainResourcePlan, text: str):
+    plan.comment = f"{plan.comment}; {text}" if plan.comment else text
 
 
 def _round_to_unit(n: int, req: BrainOptimizeRequest) -> int:
@@ -48,26 +98,17 @@ def _round_to_unit(n: int, req: BrainOptimizeRequest) -> int:
     return max(unit, min(floored, max((hi // unit) * unit, unit)))
 
 
-@algorithm(STAGE_CREATE)
-def create_plan(
-    store: BrainDataStore, req: BrainOptimizeRequest
-) -> BrainResourcePlan:
-    """Cold start: reuse the last successful same-named job's final
-    worker count; else be conservative (min) so the SAMPLE stage can
-    measure before scaling out."""
-    history = store.similar_job_outcome(req.job_name)
-    if history is not None:
-        n = _round_to_unit(history["final_workers"], req)
-        return BrainResourcePlan(
-            worker_count=n, comment=f"history: {history['final_workers']}"
-        )
-    n = _round_to_unit(req.min_workers or req.node_unit, req)
-    return BrainResourcePlan(worker_count=n, comment="cold start: min")
+# ---------------------------------------------------------------------------
+# scaling fit (shared by sample/running producers)
+# ---------------------------------------------------------------------------
 
 
 def fit_scaling(samples: List[RuntimeSample]) -> Optional[Tuple[float, float]]:
     """Fit speed(n) ≈ a·n / (1 + b·n) (serial-fraction model) from
-    (worker_num, speed) observations. Returns (a, b) or None."""
+    (worker_num, speed) observations. Robustness: per-n medians (not
+    means), outliers beyond 3x/⅓x of the per-n median dropped, and
+    degenerate sets (single n, non-positive intercept) return None so
+    callers hold instead of acting on a garbage fit."""
     points: Dict[int, List[float]] = {}
     for s in samples:
         if s.worker_num > 0 and s.speed_steps_per_sec > 0:
@@ -77,9 +118,10 @@ def fit_scaling(samples: List[RuntimeSample]) -> Optional[Tuple[float, float]]:
     # linearize: n/speed = (1/a) + (b/a)·n  -> least squares on (n, n/speed)
     xs, ys = [], []
     for n, speeds in points.items():
-        avg = sum(speeds) / len(speeds)
+        med = statistics.median(speeds)
+        kept = [v for v in speeds if med / 3.0 <= v <= med * 3.0] or [med]
         xs.append(float(n))
-        ys.append(n / avg)
+        ys.append(n / statistics.median(kept))
     n_pts = len(xs)
     sx = sum(xs)
     sy = sum(ys)
@@ -93,8 +135,9 @@ def fit_scaling(samples: List[RuntimeSample]) -> Optional[Tuple[float, float]]:
     if intercept <= 0:
         return None
     a = 1.0 / intercept
-    b = slope * a
-    return a, max(0.0, b)
+    if a <= 0:
+        return None
+    return a, max(0.0, slope * a)
 
 
 def predicted_speed(a: float, b: float, n: int) -> float:
@@ -109,146 +152,350 @@ def cluster_saturated(store: BrainDataStore) -> bool:
     return bool(state) and state["tpu_chips_pending"] > 0
 
 
-@algorithm(STAGE_SAMPLE)
-def sample_plan(
-    store: BrainDataStore, req: BrainOptimizeRequest
-) -> BrainResourcePlan:
-    """Early training: scale toward max in node_unit increments while
-    each increment still pays (predicted marginal speedup ≥ 5%/host)."""
-    samples = store.job_samples(req.job_uuid, limit=200)
-    fit = fit_scaling(samples)
-    if fit is None:
-        # not enough variety yet: step one unit toward max to generate it
-        # (growth, so the saturation gate applies; shrink paths never gate)
-        if cluster_saturated(store):
-            return BrainResourcePlan(comment="cluster saturated; hold")
-        n = _round_to_unit(
-            (req.current_workers or req.min_workers) + req.node_unit, req
-        )
-        return BrainResourcePlan(worker_count=n, comment="sampling: +unit")
-    return _scale_by_fit(fit, req, store)
+# ---------------------------------------------------------------------------
+# CREATE-stage producers
+# ---------------------------------------------------------------------------
 
 
-@algorithm(STAGE_RUNNING)
-def running_plan(
-    store: BrainDataStore, req: BrainOptimizeRequest
-) -> BrainResourcePlan:
-    samples = store.job_samples(req.job_uuid, limit=500)
-    fit = fit_scaling(samples)
-    if fit is None:
-        return BrainResourcePlan(comment="no fit; hold")
-    return _scale_by_fit(fit, req, store)
+@algorithm("job_history_cold_start")
+def job_history_cold_start(store, req, plan):
+    """Reuse the last successful same-named job's final worker count
+    (reference ``optimize_job_ps_create_resource.go`` consults history)."""
+    if plan.worker_count > 0:
+        return
+    history = store.similar_job_outcome(req.job_name)
+    if history is not None:
+        plan.worker_count = _round_to_unit(history["final_workers"], req)
+        _note(plan, f"history: {history['final_workers']}")
 
 
-def _growth_recoups_restart(
-    fit: Tuple[float, float],
-    req: BrainOptimizeRequest,
-    current: int,
-    target: int,
-) -> bool:
-    """Goodput-aware growth gate: scaling up forces a re-rendezvous +
-    recompile + restore costing ``restart_cost_s`` of downtime at the
-    CURRENT speed; the extra throughput must win that back within the
-    recoup horizon, or the scale-up lowers goodput (the ≥95% north star
-    the reference reports — README.md:46-48 there). Shrinks never gate:
-    they are forced by capacity, not chosen."""
-    cost = req.restart_cost_s
-    horizon = req.recoup_horizon_s
-    if cost <= 0 or horizon <= 0:
-        return True  # gate disabled or no restart ever observed
-    a, b = fit
-    v_cur = predicted_speed(a, b, current)
-    v_new = predicted_speed(a, b, target)
-    # steps lost while the world re-forms vs steps gained afterwards
-    lost = v_cur * cost
-    gained = (v_new - v_cur) * max(horizon - cost, 0.0)
-    return gained > lost
+@algorithm("slice_coldstart_sizing")
+def slice_coldstart_sizing(store, req, plan):
+    """No same-name history: size from what same-slice-type jobs settled
+    at — the TPU translation of the reference's cold-create resource
+    tables (``optimize_job_ps_cold_create_resource.go`` keyed its cold
+    table by resource class; ours is keyed by tpu_type)."""
+    if plan.worker_count > 0:
+        return
+    tpu_type = req.tpu_type or store.job_tpu_type(req.job_uuid)
+    if not tpu_type:
+        return
+    outcomes = store.tpu_type_outcomes(tpu_type)
+    if not outcomes:
+        return
+    n = int(statistics.median(outcomes))
+    plan.worker_count = _round_to_unit(n, req)
+    _note(plan, f"slice cold start ({tpu_type}): median {n} of "
+                f"{len(outcomes)} runs")
 
 
-def _scale_by_fit(
-    fit: Tuple[float, float],
-    req: BrainOptimizeRequest,
-    store: Optional[BrainDataStore] = None,
-) -> BrainResourcePlan:
-    """Pick the largest worker count whose marginal goodput per added
+@algorithm("conservative_create")
+def conservative_create(store, req, plan):
+    """Last resort: start at min so the SAMPLE stage can measure before
+    scaling out."""
+    if plan.worker_count > 0:
+        return
+    plan.worker_count = _round_to_unit(req.min_workers or req.node_unit, req)
+    _note(plan, "cold start: min")
+
+
+@algorithm("worker_create_resource")
+def worker_create_resource(store, req, plan):
+    """Host memory request from historic peaks x1.5 (reference
+    ``optimize_job_worker_create_resource.go`` sizes worker memory from
+    the job's past runs)."""
+    if plan.memory_mb_per_host > 0:
+        return
+    peak = store.peak_memory(req.job_name)
+    if peak > 0:
+        plan.memory_mb_per_host = 1.5 * peak
+        _note(plan, f"mem from history peak {peak:.0f}MB x1.5")
+
+
+# ---------------------------------------------------------------------------
+# SAMPLE/RUNNING producers
+# ---------------------------------------------------------------------------
+
+
+@algorithm("sample_step_up")
+def sample_step_up(store, req, plan):
+    """Not enough sample variety for a fit yet: step one node_unit toward
+    max to generate it."""
+    if plan.worker_count > 0:
+        return
+    if "_fit" in plan.paral_config:
+        return  # fit exists; the fit producer owns the decision
+    if not plan.paral_config.get("_fit_attempted"):
+        # standalone chain (fit producer not configured): check ourselves
+        if fit_scaling(store.job_samples(req.job_uuid, limit=200)):
+            return
+    n = _round_to_unit(
+        (req.current_workers or req.min_workers) + req.node_unit, req
+    )
+    if n != req.current_workers:
+        plan.worker_count = n
+        _note(plan, "sampling: +unit")
+
+
+@algorithm("throughput_fit_scaling")
+def throughput_fit_scaling(store, req, plan):
+    """Pick the largest worker count whose marginal throughput per added
     host clears 5% of a host's base throughput (reference analogue:
-    worker speed-ratio thresholding, local_optimizer.go/py)."""
+    worker speed-ratio thresholding, ``optimize_job_worker_resource.go``)."""
+    samples = store.job_samples(req.job_uuid, limit=500)
+    plan.paral_config["_fit_attempted"] = True
+    fit = fit_scaling(samples)
+    if fit is None:
+        _note(plan, "no fit")
+        return
     a, b = fit
     current = req.current_workers or req.min_workers or 1
     best = current
     unit = max(1, req.node_unit)
     lo = max(unit, req.min_workers or unit)
     hi = req.max_workers or current
-    candidates = range(lo, hi + 1, unit)
     base = predicted_speed(a, b, 1)
-    prev_speed = predicted_speed(a, b, current)
-    for n in candidates:
+    for n in range(lo, hi + 1, unit):
         if n <= best:
             continue
         gain = predicted_speed(a, b, n) - predicted_speed(a, b, best)
         if gain >= 0.05 * base * (n - best):
             best = n
     if best == current:
-        return BrainResourcePlan(comment=f"hold at {current}")
-    if best > current and store is not None and cluster_saturated(store):
-        # shrink plans still pass: they relieve the pressure
-        return BrainResourcePlan(
-            comment=f"cluster saturated; hold at {current} (wanted {best})"
-        )
-    if best > current and not _growth_recoups_restart(fit, req, current, best):
-        return BrainResourcePlan(
-            comment=(
-                f"growth {current}->{best} would not recoup the "
-                f"{req.restart_cost_s:.0f}s restart within "
-                f"{req.recoup_horizon_s:.0f}s; hold"
-            )
-        )
-    return BrainResourcePlan(
-        worker_count=_round_to_unit(best, req),
-        comment=f"fit a={a:.3g} b={b:.3g}: {current}->{best} "
-        f"(pred {prev_speed:.2f}->{predicted_speed(a, b, best):.2f} steps/s)",
+        _note(plan, f"hold at {current}")
+        return
+    plan.worker_count = _round_to_unit(best, req)
+    plan.paral_config.setdefault("_fit", (a, b))
+    _note(
+        plan,
+        f"fit a={a:.3g} b={b:.3g}: {current}->{best} "
+        f"(pred {predicted_speed(a, b, current):.2f}->"
+        f"{predicted_speed(a, b, best):.2f} steps/s)",
     )
 
 
-def oom_recovery_plan(
-    store: BrainDataStore, req: BrainOptimizeRequest
-) -> BrainResourcePlan:
-    """Host OOM: bump host memory to max(2x observed peak, 1.5x historic
-    peak) (reference adjust_oom_resource, job.py:313-395). HBM OOM: more
-    host RAM cannot help — halve micro-batch, double grad-accum so the
-    global batch is preserved (matches the local optimizer's HBM path)."""
-    if not req.host_oom:
-        return BrainResourcePlan(
-            paral_config={
-                "micro_batch_scale": 0.5,
-                "grad_accum_scale": 2.0,
-                "restart": True,
-            },
-            comment="hbm oom: micro-batch/2, grad-accum x2",
+@algorithm("init_adjust_resource")
+def init_adjust_resource(store, req, plan):
+    """First real samples in: right-size host memory to observed peak
+    x1.3 (reference ``optimize_job_ps_init_adjust_resource.go`` — adjust
+    the guessed create-time resource once reality reports in)."""
+    samples = store.job_samples(req.job_uuid, limit=50)
+    peak = max((s.memory_mb_max for s in samples), default=0.0)
+    if peak > 0 and plan.memory_mb_per_host <= 0:
+        plan.memory_mb_per_host = 1.3 * peak
+        _note(plan, f"mem right-size: observed peak {peak:.0f}MB x1.3")
+
+
+# ---------------------------------------------------------------------------
+# RUNNING guards
+# ---------------------------------------------------------------------------
+
+
+@algorithm("hot_host_guard")
+def hot_host_guard(store, req, plan):
+    """Hot-host detection (reference ``optimize_job_hot_ps_resource.go``:
+    a PS whose CPU pegs while others idle gets more resource; the TPU
+    translation: a *host* whose CPU pegs while its TPU duty-cycle lags
+    the fleet is contended — name it so the master can cordon/migrate).
+    Requires the per-host metric feed (host_metrics on samples)."""
+    samples = store.job_samples(req.job_uuid, limit=20)
+    per_host: Dict[str, List[List[float]]] = {}
+    for s in samples:
+        for host, vals in (s.host_metrics or {}).items():
+            per_host.setdefault(host, []).append(vals)
+    if len(per_host) < 2:
+        return
+    duty_by_host = {
+        h: statistics.median(v[2] for v in vals if len(v) > 2)
+        for h, vals in per_host.items()
+        if any(len(v) > 2 for v in vals)
+    }
+    cpu_by_host = {
+        h: statistics.median(v[0] for v in vals if v)
+        for h, vals in per_host.items()
+    }
+    if not duty_by_host:
+        return
+    fleet_duty = statistics.median(duty_by_host.values())
+    hot = [
+        h
+        for h in duty_by_host
+        if cpu_by_host.get(h, 0.0) >= 90.0
+        and duty_by_host[h] < 0.5 * fleet_duty
+        and fleet_duty > 0
+    ]
+    if hot:
+        plan.hot_hosts = sorted(hot)
+        _note(plan, f"hot hosts (cpu pegged, duty lagging): {sorted(hot)}")
+
+
+@algorithm("speed_anomaly_guard")
+def speed_anomaly_guard(store, req, plan):
+    """Throughput collapsed at an unchanged worker count -> the cause is
+    not scale, it is a sick node or input stall; flag for the diagnosis
+    pipeline instead of letting the fit request more hosts."""
+    samples = store.job_samples(req.job_uuid, limit=100)
+    cur_n = req.current_workers
+    history = [
+        s.speed_steps_per_sec
+        for s in samples
+        if s.worker_num == cur_n and s.speed_steps_per_sec > 0
+    ]
+    if len(history) < 6:
+        return
+    # samples come newest-first from the store
+    recent = statistics.median(history[:3])
+    baseline = statistics.median(history[3:])
+    if baseline > 0 and recent < 0.5 * baseline:
+        plan.paral_config["speed_anomaly"] = True
+        if plan.worker_count > cur_n:
+            plan.worker_count = 0  # veto growth while sick
+        _note(
+            plan,
+            f"speed anomaly: {recent:.2f} vs baseline {baseline:.2f} "
+            "steps/s; growth vetoed, diagnose first",
         )
+
+
+# ---------------------------------------------------------------------------
+# growth gates (shared by sample/running)
+# ---------------------------------------------------------------------------
+
+
+@algorithm("cluster_saturation_gate")
+def cluster_saturation_gate(store, req, plan):
+    """Growth only: a saturated cluster turns scale-ups into Pending
+    pods. Shrinks pass — they relieve the pressure."""
+    current = req.current_workers or req.min_workers or 1
+    if plan.worker_count > current and cluster_saturated(store):
+        _note(plan, f"cluster saturated; hold at {current} "
+                    f"(wanted {plan.worker_count})")
+        plan.worker_count = 0
+
+
+@algorithm("goodput_growth_gate")
+def goodput_growth_gate(store, req, plan):
+    """Goodput-aware growth gate: scaling up forces a re-rendezvous +
+    recompile + restore costing ``restart_cost_s`` of downtime at the
+    CURRENT speed; the extra throughput must win that back within the
+    recoup horizon, or the scale-up lowers goodput (the ≥95% north star
+    the reference reports — README.md:46-48 there). Shrinks never gate."""
+    current = req.current_workers or req.min_workers or 1
+    target = plan.worker_count
+    if target <= current:
+        return
+    cost = req.restart_cost_s
+    horizon = req.recoup_horizon_s
+    if cost <= 0 or horizon <= 0:
+        return  # gate disabled or no restart ever observed
+    fit = plan.paral_config.get("_fit") or fit_scaling(
+        store.job_samples(req.job_uuid, limit=500)
+    )
+    if fit is None:
+        return
+    a, b = fit
+    v_cur = predicted_speed(a, b, current)
+    v_new = predicted_speed(a, b, target)
+    lost = v_cur * cost
+    gained = (v_new - v_cur) * max(horizon - cost, 0.0)
+    if gained <= lost:
+        _note(
+            plan,
+            f"growth {current}->{target} would not recoup the "
+            f"{cost:.0f}s restart within {horizon:.0f}s; hold",
+        )
+        plan.worker_count = 0
+
+
+# ---------------------------------------------------------------------------
+# OOM chain
+# ---------------------------------------------------------------------------
+
+
+@algorithm("oom_host_memory_bump")
+def oom_host_memory_bump(store, req, plan):
+    """Host OOM: bump host memory to max(2x observed peak, 1.5x historic
+    peak) (reference ``optimize_job_ps_oom_resource.go`` /
+    ``optimize_job_worker_create_oom_resource.go``)."""
+    if not req.host_oom:
+        return
     peak = store.peak_memory(req.job_name)
     samples = store.job_samples(req.job_uuid, limit=50)
     current_peak = max((s.memory_mb_max for s in samples), default=0.0)
     target = max(2 * current_peak, 1.5 * peak)
     if target <= 0:
         target = 2 * 16 * 1024  # no data: double a 16GB default
-    return BrainResourcePlan(
-        memory_mb_per_host=target,
-        comment=f"host oom recovery: mem -> {target:.0f}MB",
+    plan.memory_mb_per_host = target
+    _note(plan, f"host oom recovery: mem -> {target:.0f}MB")
+
+
+@algorithm("oom_hbm_paral_adjust")
+def oom_hbm_paral_adjust(store, req, plan):
+    """HBM OOM: more host RAM cannot help — halve micro-batch, double
+    grad-accum so the global batch is preserved."""
+    if req.host_oom:
+        return
+    plan.paral_config.update(
+        {"micro_batch_scale": 0.5, "grad_accum_scale": 2.0, "restart": True}
     )
+    _note(plan, "hbm oom: micro-batch/2, grad-accum x2")
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
 
 
 class BrainOptimizer:
-    """Dispatch: stage -> algorithm (reference BaseOptimizer.Optimize)."""
+    """Chain dispatch: stage -> configured list of named algorithms
+    (reference ``BaseOptimizer.Optimize`` over its algorithm config)."""
+
+    CHAIN_CONFIG_PREFIX = "brain.chain."
 
     def __init__(self, store: BrainDataStore):
         self._store = store
 
+    def chain_for(self, stage: str, job_name: str = "") -> List[str]:
+        """Operator override from master-config (``brain.chain.<stage>``
+        = "algo1,algo2"), else the built-in default."""
+        cfg = self._store.master_config(job_name)
+        raw = cfg.get(f"{self.CHAIN_CONFIG_PREFIX}{stage}", "")
+        if raw:
+            names = [n.strip() for n in raw.split(",") if n.strip()]
+            known = [n for n in names if n in _ALGORITHMS]
+            unknown = set(names) - set(known)
+            if unknown:
+                logger.warning("unknown brain algorithms ignored: %s",
+                               sorted(unknown))
+            if known:
+                return known
+        return DEFAULT_CHAINS.get(stage, [])
+
     def optimize(self, req: BrainOptimizeRequest) -> BrainResourcePlan:
-        if req.oom_nodes:
-            return oom_recovery_plan(self._store, req)
-        algo = _ALGORITHMS.get(req.stage)
-        if algo is None:
-            logger.warning("no algorithm for stage %r", req.stage)
-            return BrainResourcePlan(comment=f"unknown stage {req.stage}")
-        return algo(self._store, req)
+        stage = STAGE_OOM if req.oom_nodes else req.stage
+        chain = self.chain_for(stage, req.job_name)
+        if not chain:
+            logger.warning("no algorithm chain for stage %r", stage)
+            return BrainResourcePlan(comment=f"unknown stage {stage}")
+        plan = BrainResourcePlan()
+        for name in chain:
+            try:
+                _ALGORITHMS[name](self._store, req, plan)
+            except Exception:
+                logger.exception("brain algorithm %s failed; continuing",
+                                 name)
+        plan.paral_config.pop("_fit", None)
+        plan.paral_config.pop("_fit_attempted", None)
+        return plan
+
+
+# -- compatibility shim: the pre-chain entry point used by older callers ----
+
+
+def oom_recovery_plan(
+    store: BrainDataStore, req: BrainOptimizeRequest
+) -> BrainResourcePlan:
+    plan = BrainResourcePlan()
+    oom_host_memory_bump(store, req, plan)
+    oom_hbm_paral_adjust(store, req, plan)
+    return plan

@@ -40,6 +40,7 @@ class JobAutoScaler:
         self._interval_override = interval_secs
         self._sample_after_steps_override = sample_after_steps
         self._job_context = get_job_context()
+        self._cordoned_hot_hosts: set = set()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started_ts = 0.0
@@ -132,6 +133,8 @@ class JobAutoScaler:
         scale_plan = ScalePlan()
         if plan is None or plan.empty() and not plan.paral_config:
             return scale_plan
+        if plan.hot_hosts:
+            self._cordon_hot_hosts(plan.hot_hosts)
         scale_plan.node_group_resources = dict(plan.node_group_resources)
         scale_plan.paral_config = dict(plan.paral_config)
         if plan.paral_config:
@@ -139,6 +142,21 @@ class JobAutoScaler:
         if not scale_plan.empty():
             self._scaler.scale(scale_plan)
         return scale_plan
+
+    def _cordon_hot_hosts(self, hosts: list):
+        """Brain-flagged contended hosts (cpu pegged, TPU duty lagging):
+        cordon so relaunches/scale-ups land elsewhere (the TPU translation
+        of the reference's hot-PS resource move)."""
+        for host in hosts:
+            if host in self._cordoned_hot_hosts:
+                continue
+            try:
+                self._scaler.cordon(host)
+                self._cordoned_hot_hosts.add(host)
+                logger.warning("cordoned hot host %s (brain hot-host guard)",
+                               host)
+            except Exception:
+                logger.exception("cordon of hot host %s failed", host)
 
     def _push_paral_config(self, cfg: dict):
         from dlrover_tpu.common.messages import ParallelConfig

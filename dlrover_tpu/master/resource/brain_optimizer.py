@@ -140,6 +140,7 @@ class BrainResourceOptimizer(ResourceOptimizer):
                     oom_nodes=oom_nodes or [],
                     host_oom=host_oom,
                     restart_cost_s=self._restart_cost_s,
+                    tpu_type=self._tpu_type,
                 )
             )
         except Exception as e:
@@ -184,6 +185,8 @@ class BrainResourceOptimizer(ResourceOptimizer):
                 )
         if plan.paral_config:
             out.paral_config = dict(plan.paral_config)
+        if plan.hot_hosts:
+            out.hot_hosts = list(plan.hot_hosts)
         return out
 
     def generate_opt_plan(self, stage: str, stats: WorkerStats) -> ResourcePlan:

@@ -23,9 +23,16 @@ class ResourcePlan:
     node_resources: Dict[str, NodeResource] = field(default_factory=dict)  # per-node overrides, keyed by node name
     paral_config: Dict = field(default_factory=dict)  # runtime tunables (batch, accum)
     comment: str = ""
+    #: contended hosts the brain's hot-host guard flagged — the
+    #: autoscaler cordons these so replacements land elsewhere
+    hot_hosts: List[str] = field(default_factory=list)
 
     def empty(self) -> bool:
-        return not self.node_group_resources and not self.node_resources
+        return (
+            not self.node_group_resources
+            and not self.node_resources
+            and not self.hot_hosts
+        )
 
     def merge(self, other: "ResourcePlan") -> "ResourcePlan":
         merged = ResourcePlan(
@@ -34,6 +41,7 @@ class ResourcePlan:
             paral_config=dict(self.paral_config),
             comment=self.comment or other.comment,
         )
+        merged.hot_hosts = sorted(set(self.hot_hosts) | set(other.hot_hosts))
         merged.node_group_resources.update(other.node_group_resources)
         merged.node_resources.update(other.node_resources)
         merged.paral_config.update(other.paral_config)

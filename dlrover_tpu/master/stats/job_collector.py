@@ -29,6 +29,8 @@ class JobRuntimeSample:
     memory_mb_avg: float = 0.0
     memory_mb_max: float = 0.0
     tpu_duty_cycle_avg: float = 0.0
+    #: host -> [cpu%, mem_mb, duty] — the hot-host detection feed
+    host_metrics: Dict[str, List[float]] = field(default_factory=dict)
 
 
 @dataclass
@@ -92,6 +94,7 @@ class BrainStatsReporter(StatsReporter):
                 memory_mb_avg=sample.memory_mb_avg,
                 memory_mb_max=sample.memory_mb_max,
                 tpu_duty_cycle_avg=sample.tpu_duty_cycle_avg,
+                host_metrics=sample.host_metrics,
             )
         )
 
@@ -140,6 +143,15 @@ class JobMetricCollector:
             for n in workers
             if n.used_resource.tpu_duty_cycle
         ]
+        host_metrics = {
+            (n.host_node or n.name or f"{n.type}-{n.id}"): [
+                n.used_resource.cpu,
+                n.used_resource.memory_mb,
+                n.used_resource.tpu_duty_cycle,
+            ]
+            for n in workers
+            if n.used_resource.cpu or n.used_resource.memory_mb
+        }
         sample = JobRuntimeSample(
             timestamp=time.time(),
             worker_num=len(workers),
@@ -147,6 +159,7 @@ class JobMetricCollector:
             memory_mb_avg=sum(mems) / len(mems) if mems else 0.0,
             memory_mb_max=max(mems, default=0.0),
             tpu_duty_cycle_avg=sum(duties) / len(duties) if duties else 0.0,
+            host_metrics=host_metrics,
         )
         if self._speed_monitor is not None:
             sample.speed_steps_per_sec = self._speed_monitor.running_speed()
