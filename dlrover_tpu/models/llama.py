@@ -76,11 +76,21 @@ class LlamaConfig:
     # pipeline parallelism: microbatches in flight per step (0 → pp size).
     # More microbatches shrink the GPipe bubble (pp-1)/(n_micro+pp-1).
     pp_microbatches: int = 0
+    # pipeline schedule: "gpipe" (all-forward-then-backward; simplest,
+    # activation memory grows with n_micro) or "1f1b" (one-forward-
+    # one-backward steady state; at most pp microbatches of boundary
+    # activations live per stage — the Megatron default the reference's
+    # checkpoint layer assumes)
+    pp_schedule: str = "gpipe"
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "mlp"):
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: expected 'all' or 'mlp'"
+            )
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pp_schedule={self.pp_schedule!r}: expected 'gpipe' or '1f1b'"
             )
 
     @property
@@ -226,8 +236,12 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
                            block_k=cfg.attn_block_k)
 
 
-def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x):
-    """One block: pre-norm attention + pre-norm swiglu, residual adds."""
+def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x,
+                   attn_fn=None):
+    """One block: pre-norm attention + pre-norm swiglu, residual adds.
+    ``attn_fn`` overrides the attention implementation — the pp stages
+    pass a manual-axis ring/flash closure since they already sit inside a
+    shard_map."""
     dt = cfg.dtype
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -238,7 +252,10 @@ def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x):
     v = (y @ lp["wv"].astype(dt)).reshape(b, s, kvh, hd)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
-    attn = _attention(cfg, mesh, q, k, v).reshape(b, s, h * hd)
+    if attn_fn is None:
+        attn = _attention(cfg, mesh, q, k, v).reshape(b, s, h * hd)
+    else:
+        attn = attn_fn(q, k, v).reshape(b, s, h * hd)
     x = x + attn @ lp["wo"].astype(dt)
 
     y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -289,10 +306,19 @@ def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
         vocab=cfg.vocab_size,
         n_layers=cfg.n_layers,
     )
-    if mc.pp > 1 and (mc.sp > 1 or cfg.attn_impl in ("ring", "ulysses")):
+    if mc.pp > 1 and mc.sp > 1 and cfg.attn_impl == "ulysses":
         raise ValueError(
-            "pipeline parallelism does not compose with sp attention "
-            "(ring/ulysses run their own shard_map); use pp with tp/fsdp/dp"
+            "pp x sp composes via ring attention only (the pp stages run "
+            "ring inside their own manual region; ulysses' all_to_all "
+            "layout is not plumbed there) — set attn_impl to 'auto'/'ring'"
+        )
+    if mc.pp > 1 and mc.sp > 1 and cfg.pp_schedule == "1f1b":
+        raise ValueError(
+            "pp x sp requires pp_schedule='gpipe': 1f1b gates each tick's "
+            "slab behind lax.cond with a pp-rank-dependent predicate, and "
+            "ring attention's sp collectives inside a divergent cond "
+            "deadlock on TPU (XLA cannot partition them); gpipe's ticks "
+            "are unconditional, so sp composes there"
         )
 
 
@@ -332,14 +358,25 @@ def forward(
 def _ce_sums(logits: jnp.ndarray, tokens: jnp.ndarray):
     """(sum of next-token NLL, count of valid targets); pad tokens < 0
     are ignored. ``logits``/``tokens`` are (mb, s, vocab)/(mb, s)."""
-    logits = logits[:, :-1]
-    targets = tokens[:, 1:]
+    return _ce_sums_shifted(logits[:, :-1], tokens[:, 1:])
+
+
+def _ce_sums_shifted(logits: jnp.ndarray, targets: jnp.ndarray):
+    """CE sums against PRE-shifted targets (``_shift_targets``) — the form
+    the pp stages use: with the sequence axis sharded (sp) the next-token
+    shift must happen globally before sharding, not per-chunk."""
     valid = (targets >= 0).astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(
         logits, jnp.maximum(targets, 0)[..., None], axis=-1
     )[..., 0]
     return jnp.sum((logz - gold) * valid), jnp.sum(valid)
+
+
+def _shift_targets(tokens: jnp.ndarray) -> jnp.ndarray:
+    """targets[i] = tokens[i+1], last position padded invalid (-1)."""
+    pad = jnp.full(tokens.shape[:-1] + (1,), -1, tokens.dtype)
+    return jnp.concatenate([tokens[..., 1:], pad], axis=-1)
 
 
 def loss_fn(
@@ -362,31 +399,59 @@ def _pp_loss(
     cfg: LlamaConfig,
     mesh: Mesh,
 ) -> jnp.ndarray:
-    """GPipe over the ``pp`` mesh axis, TPU-native.
+    """Entry: the pp schedules use partial-manual shard_map, whose eager
+    execution path is unsupported in current JAX when the mesh carries
+    extra (auto) axes — always route through a (cached) jit; under the
+    trainer's jit this is just an inlined call, and direct eager calls
+    (tests, notebooks) keep working."""
+    return _jitted_pp_loss(cfg, mesh)(params, tokens)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_pp_loss(cfg: LlamaConfig, mesh: Mesh):
+    return jax.jit(
+        functools.partial(_pp_loss_impl, cfg=cfg, mesh=mesh)
+    )
+
+
+def _pp_loss_impl(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """Pipeline parallelism over the ``pp`` mesh axis, TPU-native.
 
     The reference is only checkpoint-aware of PP (megatron_dist_ckpt.py:
     262,489 there — Megatron owns the schedule); here the schedule itself
     is built from JAX primitives: layer-stacked params are sharded
-    ``P(pp)`` on the layer axis so each stage holds a contiguous slab,
-    and a ``shard_map`` manual over ONLY the pp axis (tp/fsdp stay
-    automatic inside) runs the classic pipeline: ``n_micro + pp - 1``
-    ticks of (run my slab) → (``ppermute`` the activation to the next
-    stage). Autodiff through scan + ppermute yields the reverse pipeline
-    for backward. The bubble is the standard (pp-1)/(T) — raise
-    ``cfg.pp_microbatches`` to shrink it.
+    ``P(pp)`` on the layer axis so each stage holds a contiguous slab, and
+    a ``shard_map`` manual over the pp (and, when present, sp) axes runs
+    the schedule; tp/fsdp stay automatic inside the stages.
 
-    Constraints: sp/ring attention is not composed with pp (ring runs its
-    own shard_map); validated in ``validate_for_mesh``.
+    Two schedules (``cfg.pp_schedule``):
+
+    - **gpipe**: ``n_micro + pp - 1`` ticks of (run my slab) →
+      (``ppermute`` the activation onward); autodiff through scan +
+      ppermute yields the reverse pipeline. Simplest; activation memory
+      grows with ``n_micro``.
+    - **1f1b**: explicit fused forward+backward schedule (``_pp_1f1b``) —
+      one-forward-one-backward in steady state, at most ``pp`` microbatch
+      boundary activations live per stage.
+
+    **sp composition**: with sp>1 the stages run manual over {pp, sp};
+    the sequence axis is sharded and attention is ring attention on the
+    sp axis directly (it is written to be called inside a manual region).
     """
-    from jax import shard_map
-
     pp_size = mesh.shape[PP]
+    sp_size = mesh.shape.get(SP, 1)
     n_micro = cfg.pp_microbatches or pp_size
     b, s = tokens.shape
     if b % n_micro:
         raise ValueError(f"batch={b} not divisible by pp_microbatches={n_micro}")
     mb = b // n_micro
     validate_for_mesh(cfg, mesh, seq_len=s)
+    s_local = s // sp_size
 
     from jax.sharding import NamedSharding
 
@@ -397,28 +462,91 @@ def _pp_loss(
     # partitioner under the manual pp axis)
     x_micro = lax.with_sharding_constraint(
         x.reshape(n_micro, mb, s, cfg.dim),
-        NamedSharding(mesh, P(None, BATCH_AXES, None, None)),
+        NamedSharding(mesh, P(None, BATCH_AXES, SP, None)),
     )
-    tok_micro = lax.with_sharding_constraint(
-        tokens.reshape(n_micro, mb, s),
-        NamedSharding(mesh, P(None, BATCH_AXES, None)),
+    # next-token shift happens globally BEFORE any seq sharding
+    tgt_micro = lax.with_sharding_constraint(
+        _shift_targets(tokens).reshape(n_micro, mb, s),
+        NamedSharding(mesh, P(None, BATCH_AXES, SP)),
     )
-    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
-    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
+    if cfg.pp_schedule == "1f1b":
+        static = _PPStatic(cfg, mesh, pp_size, sp_size, n_micro, mb, s_local)
+        return _pp_1f1b_call(
+            static, params["layers"], x_micro,
+            params["final_norm"], params["lm_head"], tgt_micro,
+        )
+    return _pp_gpipe(
+        cfg, mesh, pp_size, sp_size, n_micro, mb, s_local,
+        params, x_micro, tgt_micro,
+    )
 
-    # mesh=None inside the manual-pp region: NamedSharding constraints on
-    # the concrete mesh clash with the Manual-pp context mesh; tp/fsdp
-    # placement inside stages is propagated by XLA from the param
-    # shardings instead (sp/ring is validated off under pp)
-    layer_fn = _maybe_remat(
-        cfg, functools.partial(_decoder_layer, cfg, None, inv_freq, positions)
+
+def _pp_axis_names(mesh: Mesh, sp_size: int):
+    return {PP} | ({SP} if sp_size > 1 else set())
+
+
+def _pp_data_specs(sp_size: int):
+    """in_specs for (x_micro, tgt_micro) under the manual region: split
+    the seq axis over sp when composing, nothing otherwise (dp/fsdp/tp
+    stay automatic)."""
+    if sp_size > 1:
+        return P(None, None, SP, None), P(None, None, SP)
+    return P(), P()
+
+
+def _stage_layer_fn(cfg: LlamaConfig, mb: int, s_local: int, sp_size: int):
+    """Build the per-stage decoder-layer fn INSIDE the manual region:
+    positions carry each sp rank's global sequence offset, and attention
+    is ring-on-sp (already inside the manual axes) or flash."""
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
+    if sp_size > 1:
+        offset = lax.axis_index(SP) * s_local
+        attn_fn = functools.partial(
+            ring_attention, axis_name=SP, causal=True,
+            block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
+        )
+    else:
+        offset = 0
+        attn_fn = None  # _attention(mesh=None) -> flash
+    positions = jnp.broadcast_to(
+        jnp.arange(s_local, dtype=jnp.int32) + offset, (mb, s_local)
     )
+    # mesh=None inside the manual region: NamedSharding constraints on
+    # the concrete mesh clash with the Manual context mesh; tp/fsdp
+    # placement inside stages is propagated by XLA from the param
+    # shardings instead
+    return _maybe_remat(
+        cfg,
+        functools.partial(
+            _decoder_layer, cfg, None, inv_freq, positions, attn_fn=attn_fn
+        ),
+    )
+
+
+def _head_loss_sums(cfg: LlamaConfig, out, final_norm, lm_head, tgt):
+    """(nll_sum, n_valid) of one microbatch's slab output."""
+    h = rms_norm(out, final_norm, cfg.norm_eps)
+    logits = lax.dot_general(
+        h, lm_head.astype(h.dtype),
+        (((h.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return _ce_sums_shifted(logits, tgt)
+
+
+def _pp_gpipe(
+    cfg, mesh, pp_size, sp_size, n_micro, mb, s_local, params,
+    x_micro, tgt_micro,
+) -> jnp.ndarray:
+    from jax import shard_map
 
     n_ticks = n_micro + pp_size - 1
     fwd_perm = [(i, i + 1) for i in range(pp_size - 1)]
+    loss_axes = (PP, SP) if sp_size > 1 else PP
 
-    def stage(layers_local, x_mb, tok_mb, final_norm, lm_head):
+    def stage(layers_local, x_mb, tgt_mb, final_norm, lm_head):
         rank = lax.axis_index(PP)
+        layer_fn = _stage_layer_fn(cfg, mb, s_local, sp_size)
 
         def run_slab(h):
             def body(carry, lp):
@@ -445,8 +573,8 @@ def _pp_loss(
             return (recv_next, outs), None
 
         init = (
-            jnp.zeros((mb, s, cfg.dim), cfg.dtype),
-            jnp.zeros((n_micro, mb, s, cfg.dim), cfg.dtype),
+            jnp.zeros((mb, s_local, cfg.dim), cfg.dtype),
+            jnp.zeros((n_micro, mb, s_local, cfg.dim), cfg.dtype),
         )
         (_, outs), _ = lax.scan(
             tick, init, jnp.arange(n_ticks, dtype=jnp.int32)
@@ -461,43 +589,296 @@ def _pp_loss(
         rows = n_micro * mb
         pad = (-rows) % pp_size
         is_last = (rank == pp_size - 1).astype(outs.dtype)
-        outs_flat = outs.reshape(rows, s, cfg.dim) * is_last
-        toks_flat = tok_mb.reshape(rows, s)
+        outs_flat = outs.reshape(rows, s_local, cfg.dim) * is_last
+        tgts_flat = tgt_mb.reshape(rows, s_local)
         if pad:
             outs_flat = jnp.concatenate(
-                [outs_flat, jnp.zeros((pad, s, cfg.dim), outs_flat.dtype)]
+                [outs_flat, jnp.zeros((pad, s_local, cfg.dim), outs_flat.dtype)]
             )
-            toks_flat = jnp.concatenate(
-                [toks_flat, jnp.full((pad, s), -1, toks_flat.dtype)]
+            tgts_flat = jnp.concatenate(
+                [tgts_flat, jnp.full((pad, s_local), -1, tgts_flat.dtype)]
             )
         chunk = (rows + pad) // pp_size
         my_rows = lax.psum_scatter(
             outs_flat, PP, scatter_dimension=0, tiled=True
         )
-        my_toks = lax.dynamic_slice_in_dim(toks_flat, rank * chunk, chunk, 0)
-        h = rms_norm(my_rows, final_norm, cfg.norm_eps)
-        logits = lax.dot_general(
-            h, lm_head.astype(h.dtype),
-            (((h.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        my_tgts = lax.dynamic_slice_in_dim(tgts_flat, rank * chunk, chunk, 0)
+        nll_sum, n_valid = _head_loss_sums(
+            cfg, my_rows, final_norm, lm_head, my_tgts
         )
-        nll_sum, n_valid = _ce_sums(logits, my_toks)
-        nll_sum = lax.psum(nll_sum, PP)
-        n_valid = lax.psum(n_valid, PP)
+        nll_sum = lax.psum(nll_sum, loss_axes)
+        n_valid = lax.psum(n_valid, loss_axes)
         return nll_sum / jnp.maximum(n_valid, 1.0)
 
+    x_spec, t_spec = _pp_data_specs(sp_size)
     pipe = shard_map(
         stage,
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(PP), params["layers"]),
-            P(), P(), P(), P(),
+            x_spec, t_spec, P(), P(),
         ),
         out_specs=P(),
-        axis_names={PP},
+        axis_names=_pp_axis_names(mesh, sp_size),
         check_vma=False,
     )
     return pipe(
-        params["layers"], x_micro, tok_micro,
+        params["layers"], x_micro, tgt_micro,
         params["final_norm"], params["lm_head"],
     )
+
+
+# ---------------------------------------------------------------------------
+# 1F1B: fused forward+backward pipeline schedule
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _PPStatic:
+    """Hashable schedule geometry for the custom_vjp nondiff arg."""
+
+    cfg: LlamaConfig
+    mesh: Mesh
+    pp: int
+    sp: int
+    n_micro: int
+    mb: int
+    s_local: int
+
+
+def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
+                 tgt_micro):
+    """One fused pass computing (loss, grads) under the 1F1B schedule.
+
+    Timeline (half-step ticks, T = 2*(n_micro + pp - 1)): stage r runs the
+    forward of microbatch i at tick ``r + 2i`` and its backward at tick
+    ``(2*pp - 1 - r) + 2i`` — warmup of depth pp-r, then strict
+    one-forward-one-backward alternation, then cooldown. Each stage keeps
+    at most ``pp`` saved boundary activations (``act_buf``); the backward
+    recomputes the slab interior from the saved input (the same remat
+    policy as forward), exactly Megatron's memory profile.
+
+    Gradients are produced manually inside the schedule (``jax.vjp`` per
+    slab, head grads at the last stage's forward tick) because fwd and
+    bwd of *different* microbatches must interleave within one scan —
+    jax.grad over a forward-only schedule can only produce GPipe.
+    """
+    cfg, mesh = static.cfg, static.mesh
+    pp_size, sp_size = static.pp, static.sp
+    n_micro, mb, s_local = static.n_micro, static.mb, static.s_local
+    from jax import shard_map
+
+    T = 2 * (n_micro + pp_size - 1)
+    fwd_perm = [(i, i + 1) for i in range(pp_size - 1)]
+    bwd_perm = [(i + 1, i) for i in range(pp_size - 1)]
+    loss_axes = (PP, SP) if sp_size > 1 else PP
+    f32 = jnp.float32
+
+    def stage(layers_local, x_mb, tgt_mb, final_norm, lm_head):
+        rank = lax.axis_index(PP)
+        is_first = rank == 0
+        is_last = rank == pp_size - 1
+        layer_fn = _stage_layer_fn(cfg, mb, s_local, sp_size)
+
+        def run_slab(layers_, h):
+            def body(carry, lp):
+                return layer_fn(lp, carry), None
+
+            out, _ = lax.scan(body, h, layers_)
+            return out
+
+        act_shape = (mb, s_local, cfg.dim)
+
+        def head_grads(out, tgt):
+            """Last stage only: loss sums + d(nll)/d(out, final_norm,
+            lm_head) for one microbatch."""
+
+            def nll_of(o, fn, lm):
+                nll, nv = _head_loss_sums(cfg, o, fn, lm, tgt)
+                return nll, nv
+
+            (nll, nv), grads = jax.value_and_grad(
+                nll_of, argnums=(0, 1, 2), has_aux=True
+            )(out, final_norm, lm_head)
+            return nll, nv, grads[0].astype(cfg.dtype), grads[1], grads[2]
+
+        def zero_head(out, tgt):
+            return (
+                jnp.zeros((), f32), jnp.zeros((), f32),
+                jnp.zeros(act_shape, cfg.dtype),
+                jnp.zeros_like(final_norm), jnp.zeros_like(lm_head),
+            )
+
+        g_layers0 = jax.tree.map(jnp.zeros_like, layers_local)
+
+        def tick(carry, t):
+            (recv_act, recv_grad, act_buf, gin_buf,
+             g_layers, g_fn, g_lm, g_x, nll, nv) = carry
+
+            tf = t - rank
+            do_fwd = (tf >= 0) & (tf < 2 * n_micro) & (tf % 2 == 0)
+            i_f = jnp.clip(tf // 2, 0, n_micro - 1)
+            tb = t - (2 * pp_size - 1 - rank)
+            do_bwd = (tb >= 0) & (tb < 2 * n_micro) & (tb % 2 == 0)
+            i_b = jnp.clip(tb // 2, 0, n_micro - 1)
+
+            # ---- forward op (heavy compute only when scheduled) -------
+            def fwd_branch(ops):
+                act_buf, gin_buf, nll, nv, g_fn, g_lm = ops
+                inp = jnp.where(
+                    is_first,
+                    lax.dynamic_index_in_dim(x_mb, i_f, keepdims=False),
+                    recv_act,
+                )
+                out = run_slab(layers_local, inp)
+                act_buf = lax.dynamic_update_index_in_dim(
+                    act_buf, inp, i_f % pp_size, 0
+                )
+                tgt = lax.dynamic_index_in_dim(tgt_mb, i_f, keepdims=False)
+                nll_i, nv_i, d_out, d_fn, d_lm = lax.cond(
+                    is_last, head_grads, zero_head, out, tgt
+                )
+                gin_buf = lax.dynamic_update_index_in_dim(
+                    gin_buf, d_out, i_f % pp_size, 0
+                )
+                return (act_buf, gin_buf, nll + nll_i, nv + nv_i,
+                        jax.tree.map(jnp.add, g_fn, d_fn),
+                        jax.tree.map(jnp.add, g_lm, d_lm)), out
+
+            def fwd_skip(ops):
+                return ops, jnp.zeros(act_shape, cfg.dtype)
+
+            (act_buf, gin_buf, nll, nv, g_fn, g_lm), out = lax.cond(
+                do_fwd, fwd_branch, fwd_skip,
+                (act_buf, gin_buf, nll, nv, g_fn, g_lm),
+            )
+            # collective OUTSIDE the cond: every rank participates
+            recv_act = lax.ppermute(out, PP, fwd_perm)
+
+            # ---- backward op ------------------------------------------
+            def bwd_branch(ops):
+                g_layers, g_x = ops
+                g_out = jnp.where(
+                    is_last,
+                    lax.dynamic_index_in_dim(
+                        gin_buf, i_b % pp_size, keepdims=False
+                    ),
+                    recv_grad,
+                )
+                inp = lax.dynamic_index_in_dim(
+                    act_buf, i_b % pp_size, keepdims=False
+                )
+                _, pull = jax.vjp(run_slab, layers_local, inp)
+                gl, gx = pull(g_out)
+                g_layers = jax.tree.map(jnp.add, g_layers, gl)
+                g_x = jnp.where(
+                    is_first,
+                    lax.dynamic_update_index_in_dim(
+                        g_x, gx.astype(g_x.dtype), i_b, 0
+                    ),
+                    g_x,
+                )
+                return (g_layers, g_x), gx
+
+            def bwd_skip(ops):
+                return ops, jnp.zeros(act_shape, cfg.dtype)
+
+            (g_layers, g_x), gx = lax.cond(
+                do_bwd, bwd_branch, bwd_skip, (g_layers, g_x)
+            )
+            recv_grad = lax.ppermute(gx, PP, bwd_perm)
+
+            return (recv_act, recv_grad, act_buf, gin_buf,
+                    g_layers, g_fn, g_lm, g_x, nll, nv), None
+
+        init = (
+            jnp.zeros(act_shape, cfg.dtype),                    # recv_act
+            jnp.zeros(act_shape, cfg.dtype),                    # recv_grad
+            jnp.zeros((pp_size,) + act_shape, cfg.dtype),       # act_buf
+            jnp.zeros((pp_size,) + act_shape, cfg.dtype),       # gin_buf
+            g_layers0,
+            jnp.zeros_like(final_norm),
+            jnp.zeros_like(lm_head),
+            jnp.zeros((n_micro,) + act_shape, cfg.dtype),       # g_x
+            jnp.zeros((), f32),                                 # nll
+            jnp.zeros((), f32),                                 # nv
+        )
+        (_, _, _, _, g_layers, g_fn, g_lm, g_x, nll, nv), _ = lax.scan(
+            tick, init, jnp.arange(T, dtype=jnp.int32)
+        )
+        nll = lax.psum(nll, loss_axes)
+        nv = lax.psum(nv, loss_axes)
+        loss = nll / jnp.maximum(nv, 1.0)
+        # d(mean)/d(sums): grads above are for nll_sum; scale to the mean
+        scale = (1.0 / jnp.maximum(nv, 1.0)).astype(f32)
+        g_layers = jax.tree.map(
+            lambda a: (a.astype(f32) * scale).astype(a.dtype), g_layers
+        )
+        g_x = (g_x.astype(f32) * scale).astype(cfg.dtype)
+        g_fn = g_fn * scale
+        g_lm = (g_lm.astype(f32) * scale).astype(g_lm.dtype)
+        if sp_size > 1:
+            # every sp rank ran the full slab on its seq chunk: layer/head
+            # grads sum over sp (g_x stays per-chunk: it is seq-sharded)
+            g_layers = jax.tree.map(
+                lambda a: lax.psum(a, SP), g_layers
+            )
+            g_fn = lax.psum(g_fn, SP)
+            g_lm = lax.psum(g_lm, SP)
+        # g_x / head grads are real on one pp rank only; psum replicates
+        g_x = lax.psum(g_x, PP)
+        g_fn = lax.psum(g_fn, PP)
+        g_lm = lax.psum(g_lm, PP)
+        return loss, g_layers, g_x, g_fn, g_lm
+
+    x_spec, t_spec = _pp_data_specs(sp_size)
+    layer_specs = jax.tree.map(lambda _: P(PP), layers)
+    pipe = shard_map(
+        stage,
+        mesh=mesh,
+        in_specs=(layer_specs, x_spec, t_spec, P(), P()),
+        out_specs=(P(), layer_specs, x_spec, P(), P()),
+        axis_names=_pp_axis_names(mesh, sp_size),
+        check_vma=False,
+    )
+    loss, g_layers, g_x, g_fn, g_lm = pipe(
+        layers, x_micro, tgt_micro, final_norm, lm_head
+    )
+    return loss, (g_layers, g_x, g_fn, g_lm)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _pp_1f1b_call(static, layers, x_micro, final_norm, lm_head, tgt_micro):
+    loss, _ = _pp_1f1b_run(
+        static, layers, x_micro, final_norm, lm_head, tgt_micro
+    )
+    return loss
+
+
+def _pp_1f1b_fwd(static, layers, x_micro, final_norm, lm_head, tgt_micro):
+    loss, grads = _pp_1f1b_run(
+        static, layers, x_micro, final_norm, lm_head, tgt_micro
+    )
+    return loss, grads
+
+
+def _pp_1f1b_bwd(static, res, g):
+    g_layers, g_x, g_fn, g_lm = res
+    g = g.astype(jnp.float32)
+
+    def scale(t):
+        return jax.tree.map(
+            lambda a: (a.astype(jnp.float32) * g).astype(a.dtype), t
+        )
+
+    import numpy as np
+
+    # integer targets take a symbolic-zero cotangent (float0)
+    tgt_zero = np.zeros(
+        (static.n_micro, static.mb, static.s_local * static.sp),
+        jax.dtypes.float0,
+    )
+    return scale(g_layers), scale(g_x), scale(g_fn), scale(g_lm), tgt_zero
+
+
+_pp_1f1b_call.defvjp(_pp_1f1b_fwd, _pp_1f1b_bwd)
