@@ -120,3 +120,27 @@ class DiagnosisAgent:
                     self._client.report_diagnosis_data(
                         "HangDumpRecord", json.dumps(bundle)
                     )
+
+    def collect_and_ship_dump(
+        self, reason: str = "master_request", min_interval: float = 20.0
+    ) -> bool:
+        """Master-orchestrated synchronized dump (CollectHangDump action):
+        capture this host's worker stacks + pending programs NOW and ship
+        them, regardless of the local hang heuristic. A short cooldown
+        absorbs a re-broadcast while the previous dump is in flight."""
+        import json
+        import time
+
+        if self._hang_dumper is None:
+            logger.warning("collect-dump requested but no hang dumper wired")
+            return False
+        now = time.time()
+        if now - getattr(self, "_last_forced_dump", 0.0) < min_interval:
+            return False
+        self._last_forced_dump = now
+        bundle = self._hang_dumper.dump(reason=reason)
+        self._client.report_diagnosis_data(
+            "HangDumpRecord", json.dumps(bundle)
+        )
+        logger.info("shipped master-requested hang dump (%s)", reason)
+        return True

@@ -516,6 +516,15 @@ class ElasticAgent:
             logger.warning("master requested node relaunch; stopping agent")
             self._relaunch_requested = True
             self._stop_evt.set()
+        elif cls == "CollectHangDump":
+            # synchronized cross-node dump: off the heartbeat thread (the
+            # dump settles ~1.5s waiting for SIGUSR2 stacks to land)
+            threading.Thread(
+                target=self._diagnosis.collect_and_ship_dump,
+                kwargs={"reason": action.action_content or "master_request"},
+                name="collect-dump",
+                daemon=True,
+            ).start()
 
     def _install_signal_handlers(self):
         if threading.current_thread() is not threading.main_thread():

@@ -21,6 +21,10 @@ class ActionCls:
     RESTART_WORKER = "RestartWorker"  # in-place process restart by the agent
     RELAUNCH_WORKER = "RelaunchWorker"  # node replaced by the platform
     MASTER_STOP_JOB = "StopJob"
+    #: master-orchestrated synchronized debug dump: every agent captures
+    #: its workers' stacks + pending programs NOW and ships them back
+    #: (reference manager.cc:454-464 all-rank gdb/py-spy dump)
+    COLLECT_DUMP = "CollectHangDump"
 
 
 DEFAULT_ACTION_EXPIRY_SECS = 120.0
@@ -57,6 +61,18 @@ def relaunch_worker(
 ) -> DiagnosisAction:
     return DiagnosisAction(
         action_cls=ActionCls.RELAUNCH_WORKER,
+        action_content=reason,
+        instance=node_id,
+        expired_ts=time.time() + expiry,
+    )
+
+
+def collect_dump(
+    node_id: int, reason: str = "hang",
+    expiry: float = DEFAULT_ACTION_EXPIRY_SECS,
+) -> DiagnosisAction:
+    return DiagnosisAction(
+        action_cls=ActionCls.COLLECT_DUMP,
         action_content=reason,
         instance=node_id,
         expired_ts=time.time() + expiry,

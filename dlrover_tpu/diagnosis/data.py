@@ -81,6 +81,7 @@ class TpuMetricsRecord(DiagnosisData):
         hang: bool = False,
         step_latency_ms: float = 0.0,
         device_duty_cycle: float = 0.0,
+        mfu: float = 0.0,
         **kw,
     ):
         kw.setdefault("data_type", DiagnosisDataType.TPU_METRICS)
@@ -88,12 +89,17 @@ class TpuMetricsRecord(DiagnosisData):
         self.hang = hang
         self.step_latency_ms = step_latency_ms
         self.device_duty_cycle = device_duty_cycle
+        #: live MFU from the interposer's per-program cost attribution
+        #: (0 when the profiler has no peak configured) — the straggler
+        #: ranking signal
+        self.mfu = mfu
         if not self.data_content:
             self.data_content = json.dumps(
                 {
                     "hang": hang,
                     "step_latency_ms": step_latency_ms,
                     "device_duty_cycle": device_duty_cycle,
+                    "mfu": mfu,
                 }
             )
 
@@ -118,6 +124,7 @@ class TpuMetricsRecord(DiagnosisData):
                     rec.device_duty_cycle = inner.get(
                         "device_duty_cycle", rec.device_duty_cycle
                     )
+                    rec.mfu = inner.get("mfu", rec.mfu)
                 except (ValueError, TypeError):
                     pass
         return rec

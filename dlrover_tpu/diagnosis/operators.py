@@ -159,14 +159,26 @@ def classify_log(text: str) -> Optional[str]:
 
 
 class ResolveTrainingHangOperator(InferenceOperator):
-    """Confirmed hang -> action fact (restart all workers to break it).
+    """Confirmed hang -> orchestrated all-rank dump, THEN restart.
 
-    If the agents shipped hang bundles (``HangDumpRecord``: all-rank
-    faulthandler stacks + pending device programs), summarize them into
-    the action config — the dominant shared stack path and the pending
-    program names — so the restart event names WHERE the fleet is stuck
-    (reference ``manager.cc:393-414``: pending-kernel print + all-rank
-    stack dumps on hang)."""
+    Two-phase (reference ``manager.cc:454-464``: on hang the daemon runs
+    gdb/py-spy against every rank before recovery):
+
+    1. first cycle with a confirmed hang: emit ``collect_dumps`` — the
+       master broadcasts a CollectHangDump action to every agent, which
+       captures its workers' stacks + pending programs and ships them
+       back;
+    2. once every metrics-reporting node's dump arrived (or the wait
+       budget lapsed): emit ``restart_all`` with the summarized dominant
+       stack, pending program names, and the mfu straggler ranking — the
+       restart event names WHERE the fleet is stuck and WHO is slow.
+    """
+
+    def __init__(self, data_manager, dump_wait_secs: float = 45.0):
+        super().__init__(data_manager)
+        self._dump_wait = dump_wait_secs
+        self._dump_requested_at = 0.0
+        self._last_hang_seen = 0.0
 
     def is_compatible(self, inference: Inference) -> bool:
         return inference == Inference(
@@ -174,20 +186,65 @@ class ResolveTrainingHangOperator(InferenceOperator):
         )
 
     def infer(self, inferences: List[Inference]) -> List[Inference]:
+        now = time.time()
+        # episode boundary: this resolver only runs while a hang is
+        # confirmed, so a long gap since the last confirmation means the
+        # previous episode cleared without a restart — start fresh rather
+        # than summarizing its stale dumps into the NEW wedge's restart
+        if (
+            self._last_hang_seen
+            and now - self._last_hang_seen > 2 * self._dump_wait + 60.0
+        ):
+            self._dump_requested_at = 0.0
+        self._last_hang_seen = now
+        if self._dump_requested_at == 0.0:
+            self._dump_requested_at = now
+            return [
+                Inference(
+                    InferenceName.ACTION, InferenceAttribute.IS,
+                    "collect_dumps",
+                ).with_config(reason="training_hang")
+            ]
+        if now - self._dump_requested_at < self._dump_wait:
+            fresh = self._fresh_dump_nodes()
+            reporting = self._data_manager.latest_per_node(
+                DiagnosisDataType.TPU_METRICS
+            )
+            if reporting and not set(reporting).issubset(fresh):
+                return []  # dumps still in flight; hold the restart
         cfg = {"reason": "training_hang"}
         try:
             # agent-shipped JSON; malformed shapes must never block the
-            # restart_all action that breaks the actual hang
-            cfg.update(self._summarize_dumps())
+            # restart_all action that breaks the actual hang. Only this
+            # episode's dumps are summarized — agents may have auto-dumped
+            # locally shortly BEFORE the master's request (same episode),
+            # hence the grace window; it stays below the episode gap so a
+            # cleared hang's dumps can never leak into a new one.
+            cfg.update(self._summarize_dumps(
+                min_ts=self._dump_requested_at - 2 * self._dump_wait
+            ))
         except Exception as e:
             logger.warning("hang-dump summarization failed: %s", e)
+        self._dump_requested_at = 0.0
         return [
             Inference(
                 InferenceName.ACTION, InferenceAttribute.IS, "restart_all"
             ).with_config(**cfg)
         ]
 
-    def _summarize_dumps(self) -> dict:
+    def _fresh_dump_nodes(self) -> set:
+        from dlrover_tpu.diagnosis.data import HangDumpRecord
+
+        return {
+            node_id
+            for node_id, rec in self._data_manager.latest_per_node(
+                DiagnosisDataType.HANG_DUMP
+            ).items()
+            if isinstance(rec, HangDumpRecord)
+            and rec.timestamp >= self._dump_requested_at
+        }
+
+    def _summarize_dumps(self, min_ts: float = 0.0) -> dict:
         from dlrover_tpu.diagnosis.data import HangDumpRecord
         from dlrover_tpu.profiler.analysis import StackTrie
 
@@ -196,7 +253,7 @@ class ResolveTrainingHangOperator(InferenceOperator):
             for r in self._data_manager.latest_per_node(
                 DiagnosisDataType.HANG_DUMP
             ).values()
-            if isinstance(r, HangDumpRecord)
+            if isinstance(r, HangDumpRecord) and r.timestamp >= min_ts
         ]
         if not dumps:
             return {}
@@ -221,7 +278,30 @@ class ResolveTrainingHangOperator(InferenceOperator):
         if pending_names:
             # config values travel as strings; keep the list greppable
             out["pending_programs"] = ",".join(sorted(pending_names)[:8])
+        ranking = rank_stragglers_by_mfu(self._data_manager)
+        if ranking:
+            out["mfu_ranking"] = ",".join(
+                f"{nid}:{mfu:.3f}" for nid, mfu in ranking[:8]
+            )
+            out["slowest_node"] = str(ranking[0][0])
         return out
+
+
+def rank_stragglers_by_mfu(data_manager) -> List:
+    """[(node_id, mfu)] slowest-first from the interposer's live MFU gauge
+    (per-program cost attribution / peak) — the diagnosis straggler
+    ranking the reference derives from per-kernel throughput buckets
+    (``common/bvar_prometheus.cc``)."""
+    from dlrover_tpu.diagnosis.data import TpuMetricsRecord
+
+    latest = data_manager.latest_per_node(DiagnosisDataType.TPU_METRICS)
+    ranked = [
+        (node_id, float(rec.mfu))
+        for node_id, rec in latest.items()
+        if isinstance(rec, TpuMetricsRecord) and rec.mfu > 0
+    ]
+    ranked.sort(key=lambda kv: kv[1])
+    return ranked
 
 
 class ResolveFailureNodeOperator(InferenceOperator):

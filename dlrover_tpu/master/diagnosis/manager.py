@@ -107,7 +107,20 @@ class DiagnosisManager:
         if fact.name != InferenceName.ACTION or fact.attribution != InferenceAttribute.IS:
             return
         cfg = fact.config()
-        if fact.description == "restart_all":
+        if fact.description == "collect_dumps":
+            # orchestrated all-rank debug dump: every agent captures its
+            # workers' stacks and ships them before the restart decision
+            for node in self._job_context.workers().values():
+                self._job_context.enqueue_action(
+                    actions.collect_dump(
+                        node.id, reason=cfg.get("reason", "hang")
+                    )
+                )
+            logger.warning(
+                "diagnosis: hang confirmed -> requested synchronized dump "
+                "from %d workers", len(self._job_context.workers()),
+            )
+        elif fact.description == "restart_all":
             # the hang resolver may have summarized shipped hang dumps —
             # carry the stuck frame into the action reason and the event
             # log so the restart names WHERE the fleet was parked
@@ -115,16 +128,24 @@ class DiagnosisManager:
             stuck_at = cfg.get("stuck_at", "")
             if stuck_at:
                 reason = f"{reason} @ {stuck_at}"
+            slowest = cfg.get("slowest_node", "")
+            if slowest:
+                reason = f"{reason} [slowest node {slowest}]"
             for node in self._job_context.workers().values():
                 self._job_context.enqueue_action(
                     actions.restart_worker(node.id, reason=reason)
                 )
             logger.warning(
-                "diagnosis: training hang -> restart all workers%s%s",
+                "diagnosis: training hang -> restart all workers%s%s%s",
                 f" (stuck at {stuck_at})" if stuck_at else "",
                 (
                     f" (pending: {cfg['pending_programs']})"
                     if cfg.get("pending_programs")
+                    else ""
+                ),
+                (
+                    f" (mfu ranking slowest-first: {cfg['mfu_ranking']})"
+                    if cfg.get("mfu_ranking")
                     else ""
                 ),
             )

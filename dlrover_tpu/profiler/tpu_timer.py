@@ -58,24 +58,40 @@ def interposer_env(
     real_plugin: str = "",
     port: int = DEFAULT_PORT,
     hang_timeout_secs: int = 300,
+    peak_tflops: float = 0.0,
 ) -> Dict[str, str]:
     """Env vars that route JAX's TPU plugin loading through the interposer.
 
     JAX resolves libtpu via ``TPU_LIBRARY_PATH``; pointing it at the shim
     and telling the shim where the real plugin lives is the whole trick —
     the TPU-native analogue of the reference's LD_PRELOAD launch wrapper.
+
+    ``peak_tflops`` (else env ``DLROVER_TPU_PEAK_TFLOPS``, else the
+    accelerator selector on the pod via ``DLROVER_TPU_ACCELERATOR``)
+    enables the interposer's live MFU gauge: per-program utilization =
+    compiler-reported FLOPs / measured latency / peak.
     """
     real_plugin = real_plugin or find_libtpu()
     if not real_plugin:
         logger.warning("libtpu not found; tpu_timer interposer disabled")
         return {}
     lib = build_native()
-    return {
+    if peak_tflops <= 0:
+        peak_tflops = float(os.environ.get("DLROVER_TPU_PEAK_TFLOPS", "0"))
+    if peak_tflops <= 0:
+        from dlrover_tpu.utils.tpu_info import peak_bf16_flops
+
+        kind = os.environ.get("DLROVER_TPU_ACCELERATOR", "")
+        peak_tflops = peak_bf16_flops(kind) / 1e12
+    env = {
         "TPU_LIBRARY_PATH": lib,
         "DLROVER_TPU_TIMER_REAL_PLUGIN": real_plugin,
         "DLROVER_TPU_TIMER_PORT": str(port),
         "DLROVER_TPU_TIMER_HANG_SECS": str(hang_timeout_secs),
     }
+    if peak_tflops > 0:
+        env["DLROVER_TPU_TIMER_PEAK_TFLOPS"] = f"{peak_tflops:g}"
+    return env
 
 
 def _http_get(port: int, path: str, timeout: float = 2.0) -> str:
@@ -143,6 +159,7 @@ class TpuTimerMetricsSource:
                 exec_total += p.get("execute_total", 0)
                 exec_us += p.get("execute_us_sum", 0)
         avg_ms = (exec_us / exec_total / 1000.0) if exec_total else 0.0
+        mfus = [m["mfu"] for m in scrapes if m.get("mfu", 0) > 0]
         return {
             "hang": any(bool(m.get("hang", 0)) for m in scrapes),
             "step_latency_ms": avg_ms,
@@ -151,6 +168,12 @@ class TpuTimerMetricsSource:
                 max(m.get("oldest_pending_us", 0) for m in scrapes)
             ),
             "execute_total": int(exec_total),
+            # live MFU (per-program cost attribution / peak): min across
+            # local ranks — the slowest chip is the host's effective rate
+            "mfu": min(mfus) if mfus else 0.0,
+            "device_flops_total": sum(
+                m.get("device_flops_total", 0) for m in scrapes
+            ),
         }
 
 

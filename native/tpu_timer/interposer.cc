@@ -40,6 +40,8 @@ std::mutex g_info_mu;
 struct ExecInfo {
   std::string name;
   int num_outputs = 0;
+  double flops = 0;  // compiler cost analysis (per execution)
+  double bytes = 0;
 };
 std::unordered_map<PJRT_LoadedExecutable*, ExecInfo> g_exec_info;
 
@@ -82,6 +84,38 @@ ExecInfo DescribeExecutable(PJRT_LoadedExecutable* loaded) {
   } else {
     info.num_outputs = (int)no.num_outputs;
   }
+  // Per-program FLOPs/bytes from the compiler's HLO cost analysis — free
+  // at compile interception, and what turns raw timings into a live MFU
+  // gauge and straggler ranking (reference extracts GEMM shapes per
+  // launch, xpu_timer/nvidia/hook.cc:54-580; a TPU program is the whole
+  // fused graph so the compiler's totals are the right granularity).
+  if (g_real->struct_size >=
+          PJRT_STRUCT_SIZE(PJRT_Api, PJRT_Executable_GetCostAnalysis) &&
+      g_real->PJRT_Executable_GetCostAnalysis != nullptr) {
+    PJRT_Executable_GetCostAnalysis_Args ca;
+    memset(&ca, 0, sizeof(ca));
+    ca.struct_size = PJRT_Executable_GetCostAnalysis_Args_STRUCT_SIZE;
+    ca.executable = ge.executable;
+    if (PJRT_Error* err = g_real->PJRT_Executable_GetCostAnalysis(&ca)) {
+      FreeError(err);
+    } else {
+      for (size_t i = 0; i < ca.num_properties; i++) {
+        const PJRT_NamedValue& p = ca.properties[i];
+        std::string key(p.name, p.name_size);
+        double val = 0;
+        if (p.type == PJRT_NamedValue_kFloat)
+          val = p.float_value;
+        else if (p.type == PJRT_NamedValue_kInt64)
+          val = (double)p.int64_value;
+        else
+          continue;
+        if (key == "flops")
+          info.flops = val;
+        else if (key == "bytes accessed")
+          info.bytes = val;
+      }
+    }
+  }
   return info;
 }
 
@@ -93,6 +127,7 @@ PJRT_Error* WrappedCompile(PJRT_Client_Compile_Args* args) {
   if (err == nullptr && args->executable != nullptr) {
     ExecInfo info = DescribeExecutable(args->executable);
     mgr.RecordCompile(info.name, dur);
+    mgr.RegisterCost(info.name, info.flops, info.bytes);
     std::lock_guard<std::mutex> lock(g_info_mu);
     g_exec_info[args->executable] = std::move(info);
   } else {
@@ -106,6 +141,7 @@ PJRT_Error* WrappedDeserializeAndLoad(
   PJRT_Error* err = g_real->PJRT_Executable_DeserializeAndLoad(args);
   if (err == nullptr && args->loaded_executable != nullptr) {
     ExecInfo info = DescribeExecutable(args->loaded_executable);
+    TimerManager::Get().RegisterCost(info.name, info.flops, info.bytes);
     std::lock_guard<std::mutex> lock(g_info_mu);
     g_exec_info[args->loaded_executable] = std::move(info);
   }
@@ -192,6 +228,7 @@ PJRT_Error* WrappedExecute(PJRT_LoadedExecutable_Execute_Args* args) {
     ExecInfo info = DescribeExecutable(args->executable);
     name = info.name;
     num_outputs = info.num_outputs;
+    mgr.RegisterCost(info.name, info.flops, info.bytes);
     std::lock_guard<std::mutex> lock(g_info_mu);
     g_exec_info[args->executable] = std::move(info);
   }

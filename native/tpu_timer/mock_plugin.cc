@@ -55,6 +55,31 @@ PJRT_Error* MockNumOutputs(PJRT_Executable_NumOutputs_Args* args) {
   return nullptr;
 }
 
+// Cost analysis like a real backend: flops + bytes accessed (floats).
+PJRT_Error* MockGetCostAnalysis(PJRT_Executable_GetCostAnalysis_Args* args) {
+  static PJRT_NamedValue props[2];
+  static bool init = [] {
+    memset(props, 0, sizeof(props));
+    props[0].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    props[0].name = "flops";
+    props[0].name_size = 5;
+    props[0].type = PJRT_NamedValue_kFloat;
+    props[0].float_value = 2.5e9f;
+    props[0].value_size = 1;
+    props[1].struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    props[1].name = "bytes accessed";
+    props[1].name_size = 14;
+    props[1].type = PJRT_NamedValue_kFloat;
+    props[1].float_value = 1.25e8f;
+    props[1].value_size = 1;
+    return true;
+  }();
+  (void)init;
+  args->num_properties = 2;
+  args->properties = props;
+  return nullptr;
+}
+
 PJRT_Error* MockExecDestroy(PJRT_LoadedExecutable_Destroy_Args* args) {
   delete reinterpret_cast<MockExecutable*>(args->executable);
   return nullptr;
@@ -113,6 +138,7 @@ extern "C" const PJRT_Api* GetPjrtApi() {
     g_api.PJRT_LoadedExecutable_GetExecutable = &MockGetExecutable;
     g_api.PJRT_Executable_Name = &MockName;
     g_api.PJRT_Executable_NumOutputs = &MockNumOutputs;
+    g_api.PJRT_Executable_GetCostAnalysis = &MockGetCostAnalysis;
     g_api.PJRT_LoadedExecutable_Destroy = &MockExecDestroy;
     g_api.PJRT_LoadedExecutable_Execute = &MockExecute;
     g_api.PJRT_Buffer_ReadyEvent = &MockReadyEvent;

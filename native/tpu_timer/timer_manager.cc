@@ -23,6 +23,8 @@ TimerManager::TimerManager() : t0_ns_(MonotonicNs()) {
   int64_t secs = env ? std::atoll(env) : 300;
   if (secs <= 0) secs = 300;
   hang_timeout_us_ = secs * 1000000LL;
+  const char* peak = std::getenv("DLROVER_TPU_TIMER_PEAK_TFLOPS");
+  peak_tflops_ = peak ? std::atof(peak) : 0.0;
   watcher_ = std::thread([this] { WatchLoop(); });
 }
 
@@ -45,6 +47,15 @@ void TimerManager::RecordCompile(const std::string& name, int64_t dur_us) {
   }
 }
 
+void TimerManager::RegisterCost(const std::string& name, double flops,
+                                double bytes) {
+  if (flops <= 0 && bytes <= 0) return;
+  std::lock_guard<std::mutex> lock(mu_);
+  auto& s = exec_stats_[name];
+  s.flops = flops;
+  s.bytes = bytes;
+}
+
 uint64_t TimerManager::BeginExecute(const std::string& name) {
   std::lock_guard<std::mutex> lock(mu_);
   uint64_t token = next_token_++;
@@ -62,6 +73,15 @@ void TimerManager::EndExecute(uint64_t token, bool error) {
   s.total_us += dur;
   if ((uint64_t)dur > s.max_us) s.max_us = dur;
   if (error) s.errors++;
+  if (!error && s.flops > 0 && dur > 0) {
+    device_flops_total_ += s.flops;
+    if (peak_tflops_ > 0) {
+      // achieved TFLOP/s of this completion vs peak -> live MFU sample
+      double util = (s.flops / dur) / 1e6 / peak_tflops_;
+      s.util_ema = s.util_ema == 0 ? util : 0.8 * s.util_ema + 0.2 * util;
+      mfu_ema_ = mfu_ema_ == 0 ? util : 0.8 * mfu_ema_ + 0.2 * util;
+    }
+  }
   if (tracing_.load()) {
     trace_.push_back({it->second.name, "execute", it->second.start_us, dur});
     if (trace_.size() > trace_cap_) trace_.pop_front();
@@ -148,8 +168,25 @@ std::string TimerManager::PrometheusText() {
     if (age > oldest) oldest = age;
   }
   out << "dlrover_tpu_timer_oldest_pending_us " << oldest << "\n";
+  out << "dlrover_tpu_timer_device_flops_total " << device_flops_total_
+      << "\n";
+  if (peak_tflops_ > 0) {
+    out << "dlrover_tpu_timer_peak_tflops " << peak_tflops_ << "\n";
+    out << "dlrover_tpu_timer_mfu " << mfu_ema_ << "\n";
+  }
   AppendStats(out, "dlrover_tpu_timer_execute", exec_stats_);
   AppendStats(out, "dlrover_tpu_timer_compile", compile_stats_);
+  for (const auto& kv : exec_stats_) {
+    const auto& s = kv.second;
+    if (s.flops <= 0 && s.bytes <= 0) continue;
+    out << "dlrover_tpu_timer_program_flops{program=\"" << kv.first << "\"} "
+        << s.flops << "\n";
+    out << "dlrover_tpu_timer_program_bytes{program=\"" << kv.first << "\"} "
+        << s.bytes << "\n";
+    if (peak_tflops_ > 0 && s.util_ema > 0)
+      out << "dlrover_tpu_timer_program_utilization{program=\"" << kv.first
+          << "\"} " << s.util_ema << "\n";
+  }
   return out.str();
 }
 

@@ -33,6 +33,16 @@ struct ProgramStats {
   uint64_t total_us = 0;
   uint64_t max_us = 0;
   uint64_t errors = 0;
+  // Per-execution cost from the compiler's HLO cost analysis
+  // (PJRT_Executable_GetCostAnalysis), attached at compile interception —
+  // the TPU analogue of the reference's per-launch GEMM M/N/K extraction
+  // (xpu_timer/nvidia/hook.cc:54-580): flops/bytes are per *program*
+  // here because TPU programs are whole fused graphs, not kernels.
+  double flops = 0;
+  double bytes = 0;
+  // EMA of achieved-flops / peak per completion (live MFU, 0..1);
+  // only maintained when peak_tflops is configured.
+  double util_ema = 0;
 };
 
 class TimerManager {
@@ -41,6 +51,8 @@ class TimerManager {
 
   // -- recording ------------------------------------------------------------
   void RecordCompile(const std::string& name, int64_t dur_us);
+  // Attach compiler cost-analysis numbers to a program's timer record.
+  void RegisterCost(const std::string& name, double flops, double bytes);
   // Returns a token identifying the pending execution.
   uint64_t BeginExecute(const std::string& name);
   void EndExecute(uint64_t token, bool error);
@@ -86,6 +98,12 @@ class TimerManager {
   std::deque<TraceEvent> trace_;  // bounded ring
   uint64_t next_token_ = 1;
   size_t trace_cap_ = 100000;
+
+  // live MFU: peak from env DLROVER_TPU_TIMER_PEAK_TFLOPS (0 = unset,
+  // per-program utilization then unavailable but flops/bytes still export)
+  double peak_tflops_ = 0;
+  double device_flops_total_ = 0;  // sum of completed executions' flops
+  double mfu_ema_ = 0;             // flops-weighted live MFU across programs
 
   std::atomic<bool> hang_{false};
   std::atomic<bool> tracing_{true};

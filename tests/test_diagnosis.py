@@ -162,10 +162,34 @@ def test_manager_hang_restarts_all():
                 node_id=i,
             )
         )
+    # phase 1: the master orchestrates a synchronized all-rank dump
+    mgr.diagnose_once()
+    for i in range(2):
+        action = ctx.next_action(i)
+        assert action is not None
+        assert action.action_cls == actions.ActionCls.COLLECT_DUMP
+    # agents ship their dumps back
+    for i in range(2):
+        mgr.collect_diagnosis_data(
+            msg.DiagnosisReportData(
+                data_cls="HangDumpRecord",
+                data_content=json.dumps({
+                    "reason": "master_request",
+                    "stacks": {str(100 + i): (
+                        'Current thread 0x1 (most recent call first):\n'
+                        '  File "c.py", line 1 in psum\n'
+                    )},
+                    "pending": {},
+                }),
+                node_id=i,
+            )
+        )
+    # phase 2: every reporting node's dump arrived -> restart with stacks
     mgr.diagnose_once()
     for i in range(2):
         action = ctx.next_action(i)
         assert action is not None and action.action_cls == actions.ActionCls.RESTART_WORKER
+        assert "psum" in action.action_content  # all-rank stacks attached
 
 
 def test_parse_report_types():
@@ -224,7 +248,9 @@ def test_hang_resolver_summarizes_hang_dumps():
     dm = DiagnosisDataManager()
     dm.store_data(rec)
     op = ResolveTrainingHangOperator(dm)
-    (fact,) = op.infer([])
+    (first,) = op.infer([])
+    assert first.description == "collect_dumps"  # phase 1: orchestrate
+    (fact,) = op.infer([])  # dump already present and fresh -> resolve
     cfg = fact.config()
     assert fact.description == "restart_all"
     assert cfg["stuck_at"].startswith("_ring_step")
@@ -234,6 +260,142 @@ def test_hang_resolver_summarizes_hang_dumps():
 
 def test_hang_resolver_without_dumps_keeps_plain_action():
     dm = DiagnosisDataManager()
-    (fact,) = ResolveTrainingHangOperator(dm).infer([])
+    op = ResolveTrainingHangOperator(dm, dump_wait_secs=0.0)
+    (first,) = op.infer([])
+    assert first.description == "collect_dumps"
+    (fact,) = op.infer([])  # wait budget 0 and nothing arrived -> restart
     assert fact.description == "restart_all"
     assert "stuck_at" not in fact.config()
+
+
+def test_cross_node_dump_orchestration_e2e(tmp_path):
+    """VERDICT r3 #8 end to end over the real RPC stack: two hosts with
+    genuinely wedged worker processes report hang metrics; the master
+    broadcasts CollectHangDump on heartbeats; each agent SIGUSR2-dumps its
+    real workers and ships the bundle; the master's diagnosis record then
+    contains BOTH ranks' stacks and the restart names the wedge frame."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    import time as _time
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from dlrover_tpu.agent.diagnosis_agent import DiagnosisAgent
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.master.local_master import start_local_master
+    from dlrover_tpu.profiler.hang_dump import HangDumper
+
+    master = start_local_master(node_num=2)
+    workers = []
+    try:
+        agents = {}
+        for node_id in range(2):
+            stack_dir = str(tmp_path / f"node{node_id}")
+            prog = textwrap.dedent(f"""
+                import sys, time
+                sys.path.insert(0, {repr(str(REPO))})
+                from dlrover_tpu.profiler.hang_dump import install_stack_dump_handler
+                install_stack_dump_handler({stack_dir!r})
+                def wedged_collective():
+                    time.sleep(120)
+                print('READY', flush=True)
+                wedged_collective()
+            """)
+            p = subprocess.Popen(
+                [sys.executable, "-c", prog], stdout=subprocess.PIPE,
+                text=True,
+            )
+            assert p.stdout.readline().strip() == "READY"
+            workers.append(p)
+            client = MasterClient(
+                f"127.0.0.1:{master.port}", node_id=node_id
+            )
+            agent = DiagnosisAgent(client=client, node_id=node_id)
+            agent.set_hang_dumper(HangDumper(
+                stack_dir, worker_pids=[p.pid], settle_secs=1.0,
+            ))
+            agent.set_metrics_source(lambda: {"hang": True, "mfu": 0.0})
+            agents[node_id] = (agent, client)
+            # ship hang metrics (would normally come from the interposer);
+            # the dumper's cooldown blocks the LOCAL auto-dump path so the
+            # dumps in this test can only come from the master's broadcast
+            agents[node_id][0]._hang_dumper._last_dump = _time.time()
+            client.report_diagnosis_data(
+                "TpuMetricsRecord",
+                json.dumps({"hang": True, "mfu": 0.05 + 0.1 * node_id}),
+            )
+
+        # agents' heartbeat loops register the nodes with the master
+        for _, client in agents.values():
+            client.report_heartbeat()
+
+        # phase 1: hang confirmed -> master broadcasts the dump request
+        master.diagnosis_manager.diagnose_once()
+        for node_id, (agent, client) in agents.items():
+            actions_out = client.report_heartbeat()
+            kinds = [a.action_cls for a in actions_out]
+            assert "CollectHangDump" in kinds, kinds
+            # the elastic agent would dispatch this; call the same handler
+            agent.collect_and_ship_dump(reason="master_request")
+
+        # both ranks' dumps are now in the master's diagnosis record
+        dm = master.diagnosis_manager.data_manager
+        from dlrover_tpu.diagnosis.data import DiagnosisDataType
+
+        dumps = dm.latest_per_node(DiagnosisDataType.HANG_DUMP)
+        assert set(dumps) == {0, 1}, dumps.keys()
+        for rec in dumps.values():
+            assert any(
+                "wedged_collective" in text for text in rec.stacks.values()
+            ), rec.stacks
+
+        # phase 2: resolution restarts all with the wedge frame + ranking
+        master.diagnosis_manager.diagnose_once()
+        from dlrover_tpu.master.node.job_context import get_job_context
+
+        restart_seen = 0
+        for node_id in range(2):
+            while True:
+                action = get_job_context().next_action(node_id)
+                if action is None:
+                    break
+                if action.action_cls == "RestartWorker":
+                    restart_seen += 1
+                    assert "wedged_collective" in action.action_content
+        assert restart_seen == 2
+    finally:
+        for p in workers:
+            p.kill()
+        master.stop()
+
+
+def test_hang_resolver_new_episode_discards_stale_dumps():
+    """Code-review r4: a hang that clears without a restart must not leak
+    its dumps into a later, unrelated hang — the resolver re-orchestrates
+    collection for the new episode."""
+    from dlrover_tpu.diagnosis.data import HangDumpRecord
+
+    dm = DiagnosisDataManager()
+    op = ResolveTrainingHangOperator(dm, dump_wait_secs=0.0)
+    (first,) = op.infer([])
+    assert first.description == "collect_dumps"
+    # stale dump from this (soon aborted) episode
+    old = HangDumpRecord(stacks={"1": (
+        'Current thread 0x1 (most recent call first):\n'
+        '  File "old.py", line 1 in old_wedge\n')})
+    old.node_id = 0
+    old.timestamp = time.time() - 500.0
+    dm.store_data(old)
+
+    # episode clears: resolver silent for > 2*wait+60 seconds
+    op._last_hang_seen = time.time() - 200.0
+    op._dump_requested_at = time.time() - 500.0
+
+    # new hang: phase 1 again (no stale summarize)
+    (fact,) = op.infer([])
+    assert fact.description == "collect_dumps"
+    # wait budget 0, nothing fresh arrived -> restart WITHOUT old frames
+    (fact,) = op.infer([])
+    assert fact.description == "restart_all"
+    assert "old_wedge" not in fact.config().get("stuck_at", "")
