@@ -189,6 +189,11 @@ class MasterClient:
     def report_dataset_shard_params(self, params: msg.DatasetShardParams):
         return self._client.report(params)
 
+    def report_model_info(self, **fields) -> None:
+        self._client.report(
+            msg.ModelInfoReport(node_id=self.node_id, **fields)
+        )
+
     def get_task(self, dataset_name: str) -> msg.Task:
         # retries sized to ride out a master relaunch (~20s of backoff):
         # the data path stalling through the gap is what lets workers

@@ -656,6 +656,20 @@ class CheckpointEngine:
                     logger.warning("checkpoint missing leaf %s; keeping target", key)
                     out_leaves.append(t_leaf)
                     continue
+                if (
+                    hasattr(t_leaf, "shape")
+                    and tuple(full.shape) != tuple(t_leaf.shape)
+                ):
+                    # same leaf path but a different tensor shape: this is
+                    # NOT our checkpoint (e.g. a stale shm segment from an
+                    # unrelated job reusing the name) — refuse the whole
+                    # restore so the caller falls through to storage/orbax
+                    logger.warning(
+                        "checkpoint leaf %s shape %s != target %s; "
+                        "rejecting this source",
+                        key, tuple(full.shape), tuple(t_leaf.shape),
+                    )
+                    return None
                 if not covers_target(t_leaf, key):
                     logger.info(
                         "staged shards do not cover leaf %s for the current "

@@ -202,6 +202,13 @@ class ModelInfoReport:
     param_count: int = 0
     flops_per_step: float = 0.0
     batch_size: int = 0
+    # transformer shape for the master's activation-memory model
+    # (hyperparams.ModelProfile)
+    seq_len: int = 0
+    hidden_dim: int = 0
+    n_layers: int = 0
+    n_heads: int = 0
+    remat: bool = True
 
 
 @message
@@ -375,6 +382,7 @@ class ParallelConfig:
     dataloader_num_workers: int = 0
     dataloader_version: int = 0
     optimizer_learning_rate: float = 0.0
+    optimizer_weight_decay: float = 0.0
     grad_accum_steps: int = 0
     optimizer_version: int = 0
     # relative adjustments (OOM recovery plans): applied to the worker's

@@ -121,11 +121,7 @@ class DistributedJobMaster:
                 node_unit=job_args.node_unit,
             )
         self.optimizer = optimizer
-        self.job_auto_scaler = JobAutoScaler(
-            optimizer=optimizer,
-            scaler=self.scaler,
-            speed_monitor=self.speed_monitor,
-        )
+        from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
         from dlrover_tpu.master.monitor.error_monitor import K8sErrorMonitor
         from dlrover_tpu.master.stats.job_collector import (
             BrainStatsReporter,
@@ -142,6 +138,13 @@ class DistributedJobMaster:
             reporters.append(BrainStatsReporter(optimizer))
         self.metric_collector = JobMetricCollector(
             speed_monitor=self.speed_monitor, reporters=reporters
+        )
+        self.job_auto_scaler = JobAutoScaler(
+            optimizer=optimizer,
+            scaler=self.scaler,
+            speed_monitor=self.speed_monitor,
+            strategy_generator=SimpleStrategyGenerator(),
+            metric_collector=self.metric_collector,
         )
         self.job_manager = DistributedJobManager(
             job_args=job_args,
@@ -184,6 +187,7 @@ class DistributedJobMaster:
             diagnosis_manager=self.diagnosis_manager,
             kv_store=self.kv_store,
             sync_service=self.sync_service,
+            metric_collector=self.metric_collector,
         )
         self._server = RpcServer(self.servicer, port=port)
         self.port = self._server.port

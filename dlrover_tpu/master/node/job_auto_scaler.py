@@ -32,6 +32,9 @@ class JobAutoScaler:
         speed_monitor=None,
         interval_secs: Optional[float] = None,
         sample_after_steps: Optional[int] = None,
+        strategy_generator=None,
+        metric_collector=None,
+        refine_cooldown_secs: float = 300.0,
     ):
         self._optimizer = optimizer
         self._scaler = scaler
@@ -39,6 +42,12 @@ class JobAutoScaler:
         # None → read the runtime-mutable global context each cycle
         self._interval_override = interval_secs
         self._sample_after_steps_override = sample_after_steps
+        #: hyperparam refinement (reference simple_strategy_generator):
+        #: model-aware batch growth from observed memory headroom
+        self._strategy_generator = strategy_generator
+        self._metric_collector = metric_collector
+        self._refine_cooldown = refine_cooldown_secs
+        self._last_refine_ts = 0.0
         self._job_context = get_job_context()
         self._cordoned_hot_hosts: set = set()
         self._stop_evt = threading.Event()
@@ -127,7 +136,77 @@ class JobAutoScaler:
         stage = self._current_stage()
         plan = self._optimizer.generate_opt_plan(stage, stats)
         scale_plan = self.execute_job_optimization_plan(plan)
+        if stage == JobOptStage.RUNNING:
+            self.maybe_refine_hyperparams()
         return scale_plan
+
+    def maybe_refine_hyperparams(self):
+        """Runtime batch growth from observed memory headroom, with
+        lr/weight-decay sqrt coupling (reference
+        ``simple_strategy_generator.py:83-166``); pushed to workers via
+        the versioned paral-config channel."""
+        import time
+
+        if self._strategy_generator is None or self._metric_collector is None:
+            return
+        if time.time() - self._last_refine_ts < self._refine_cooldown:
+            return
+        profile_d = self._metric_collector.metrics.model_profile
+        if not profile_d:
+            return
+        from dlrover_tpu.master.hyperparams import ModelProfile
+
+        mp = ModelProfile(
+            param_count=self._metric_collector.metrics.model_params,
+            seq_len=int(profile_d.get("seq_len", 0)),
+            hidden_dim=int(profile_d.get("hidden_dim", 0)),
+            n_layers=int(profile_d.get("n_layers", 0)),
+            n_heads=int(profile_d.get("n_heads", 0)),
+            remat=bool(profile_d.get("remat", True)),
+        )
+        workers = [
+            n for n in self._job_context.workers().values()
+            if not n.is_released
+        ]
+        used = max(
+            (n.used_resource.memory_mb for n in workers
+             if n.used_resource.memory_mb), default=0.0,
+        )
+        total = min(
+            (n.config_resource.memory_mb for n in workers
+             if n.config_resource.memory_mb), default=0.0,
+        )
+        if used <= 0 or total <= 0:
+            return
+        current: dict = {}
+        for node in workers:
+            if node.paral_config:
+                current = {
+                    k: v for k, v in node.paral_config.items()
+                    if k != "dataloader_version"
+                }
+                break
+        if not current.get("dataloader_batch_size"):
+            current["dataloader_batch_size"] = int(
+                profile_d.get("batch_size", 0)
+            )
+        suggestion = self._strategy_generator.refine_strategy(
+            current, mp, host_mem_used_mb=used, host_mem_total_mb=total
+        )
+        if suggestion is None:
+            return
+        self._last_refine_ts = time.time()
+        cfg = {**current, **suggestion.to_paral_config()}
+        logger.info(
+            "hyperparam refinement: batch %s->%s (headroom %.0fMB), "
+            "lr->%g, accum->%s",
+            current.get("dataloader_batch_size"),
+            suggestion.micro_batch_size,
+            total - used,
+            suggestion.learning_rate,
+            suggestion.grad_accum_steps,
+        )
+        self._push_paral_config(cfg)
 
     def execute_job_optimization_plan(self, plan: ResourcePlan) -> ScalePlan:
         scale_plan = ScalePlan()

@@ -34,7 +34,9 @@ class MasterServicer:
         kv_store: Optional[KVStoreService] = None,
         sync_service: Optional[SyncService] = None,
         elastic_run_configs: Optional[Dict] = None,
+        metric_collector=None,
     ):
+        self._metric_collector = metric_collector
         self._task_manager = task_manager
         self._job_manager = job_manager
         self._speed_monitor = speed_monitor
@@ -270,6 +272,19 @@ class MasterServicer:
         return msg.SimpleResponse()
 
     def _report_model_info(self, request: msg.ModelInfoReport):
+        if self._metric_collector is not None:
+            self._metric_collector.set_model_info(
+                request.param_count,
+                request.flops_per_step,
+                profile={
+                    "seq_len": request.seq_len,
+                    "hidden_dim": request.hidden_dim,
+                    "n_layers": request.n_layers,
+                    "n_heads": request.n_heads,
+                    "remat": request.remat,
+                    "batch_size": request.batch_size,
+                },
+            )
         return msg.SimpleResponse()
 
     def _report_node_check_status(self, request: msg.NodeCheckStatusReport):

@@ -39,6 +39,9 @@ class JobMetrics:
 
     model_params: int = 0
     model_flops_per_step: float = 0.0
+    #: transformer shape reported by the workers (ModelInfoReport) —
+    #: feeds the hyperparam strategy's activation-memory model
+    model_profile: Dict = field(default_factory=dict)
     samples: List[JobRuntimeSample] = field(default_factory=list)
     max_samples: int = 512
 
@@ -126,9 +129,12 @@ class JobMetricCollector:
     def stop(self):
         self._stop_evt.set()
 
-    def set_model_info(self, params: int, flops_per_step: float = 0.0):
+    def set_model_info(self, params: int, flops_per_step: float = 0.0,
+                       profile: Optional[Dict] = None):
         self.metrics.model_params = params
         self.metrics.model_flops_per_step = flops_per_step
+        if profile:
+            self.metrics.model_profile = dict(profile)
 
     def collect_once(self) -> JobRuntimeSample:
         workers = self._job_context.running_nodes(NodeType.WORKER)

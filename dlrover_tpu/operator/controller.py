@@ -78,6 +78,94 @@ def master_service_name(job_name: str) -> str:
     return f"elasticjob-{job_name}-master"
 
 
+class LeaderLease:
+    """Dependency-free leader election over a ConfigMap record
+    (reference: controller-runtime's Lease-based election,
+    ``go/elasticjob`` manager options). Exactly one operator replica
+    holds the lease; the others watch and take over when the holder
+    stops renewing."""
+
+    def __init__(
+        self,
+        client: K8sClient,
+        name: str = "dlrover-tpu-operator-leader",
+        identity: str = "",
+        lease_secs: float = 30.0,
+    ):
+        import os
+        import socket
+
+        self._client = client
+        self._name = name
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        self._lease_secs = lease_secs
+        self.is_leader = False
+
+    def try_acquire(self) -> bool:
+        """Acquire/renew; ANY failure (including transport-level errors)
+        demotes this replica — a leader that cannot renew must assume it
+        lost the lease rather than keep acting. Takeover is read-patch-
+        verify: merge-patch has no compare-and-swap, so after patching we
+        re-read and only lead if our identity stuck (two simultaneous
+        takeover attempts resolve to the last writer; the loser's verify
+        read demotes it within the same cycle)."""
+        now = time.time()
+        try:
+            cm = self._client.get_config_map(self._name)
+            if cm is None:
+                try:
+                    self._client.create_config_map({
+                        "apiVersion": "v1",
+                        "kind": "ConfigMap",
+                        "metadata": {"name": self._name},
+                        "data": {"holder": self.identity,
+                                 "renewTime": str(now)},
+                    })
+                except K8sApiError as e:
+                    if e.status != 409:
+                        raise
+                    self.is_leader = False
+                    return False  # lost the creation race
+                self.is_leader = True
+                logger.info("leader lease acquired by %s", self.identity)
+                return True
+            data = cm.get("data") or {}
+            holder = data.get("holder", "")
+            renew = float(data.get("renewTime", "0") or 0)
+            if holder == self.identity or now - renew > self._lease_secs:
+                if holder and holder != self.identity:
+                    logger.warning(
+                        "taking over stale leader lease from %s", holder
+                    )
+                self._client.patch_config_map(
+                    self._name,
+                    {"data": {"holder": self.identity,
+                              "renewTime": str(now)}},
+                )
+                # verify: last writer wins; everyone else demotes
+                check = self._client.get_config_map(self._name) or {}
+                won = (check.get("data") or {}).get("holder") == self.identity
+                if won and not self.is_leader:
+                    logger.info("leader lease held by %s", self.identity)
+                self.is_leader = won
+                return won
+        except Exception as e:
+            logger.warning("leader lease cycle failed (%s); demoting", e)
+        self.is_leader = False
+        return False
+
+    def release(self):
+        if not self.is_leader:
+            return
+        try:
+            self._client.patch_config_map(
+                self._name, {"data": {"holder": "", "renewTime": "0"}}
+            )
+        except Exception:
+            pass
+        self.is_leader = False
+
+
 class ElasticJobController:
     """Level-triggered reconcile: watch events only *enqueue* a job name;
     every reconcile re-reads actual state and converges it (the
@@ -89,11 +177,20 @@ class ElasticJobController:
         master_image: str = "",
         resync_interval: float = 30.0,
         master_restart_limit: int = 3,
+        leader_election: bool = False,
+        lease_secs: float = 30.0,
     ):
         self._client = client
         self._master_image = master_image
         self._resync = resync_interval
         self._master_restart_limit = master_restart_limit
+        #: singleton guard: with election on, reconciles run only while
+        #: this replica holds the lease (a second operator replica idles)
+        self._lease: Optional[LeaderLease] = (
+            LeaderLease(client, lease_secs=lease_secs)
+            if leader_election
+            else None
+        )
         self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
         self._stop_evt = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -104,13 +201,16 @@ class ElasticJobController:
 
     def start(self):
         self._stop_evt.clear()
-        for name, target in (
+        threads = [
             ("ejc-worker", self._worker_loop),
             ("ejc-job-watch", self._watch_jobs),
             ("ejc-pod-watch", self._watch_pods),
             ("ejc-plan-watch", self._watch_scaleplans),
             ("ejc-resync", self._resync_loop),
-        ):
+        ]
+        if self._lease is not None:
+            threads.append(("ejc-lease", self._lease_loop))
+        for name, target in threads:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
@@ -118,6 +218,19 @@ class ElasticJobController:
     def stop(self):
         self._stop_evt.set()
         self._queue.put(None)
+        if self._lease is not None:
+            self._lease.release()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._lease is None or self._lease.is_leader
+
+    def _lease_loop(self):
+        # renew at a third of the lease so one missed cycle never loses it
+        interval = max(1.0, self._lease._lease_secs / 3.0)
+        self._lease.try_acquire()
+        while not self._stop_evt.wait(interval):
+            self._lease.try_acquire()
 
     # ------------------------------------------------------------------
     # watch → enqueue
@@ -190,6 +303,8 @@ class ElasticJobController:
             name = self._queue.get()
             if name is None:
                 return
+            if not self.is_leader:
+                continue  # a non-leader replica drains but never acts
             try:
                 self.reconcile_once(name)
             except Exception:
@@ -210,6 +325,18 @@ class ElasticJobController:
 
         status = job.setdefault("status", {})
         phase = status.get("phase", "")
+        errors = validate_elasticjob(job)
+        if errors:
+            if phase in ("", JobPhase.CREATED, JobPhase.PENDING):
+                # never started: reject outright (webhook semantics)
+                self._reject_invalid_job(job, errors)
+                return
+            # live job edited into an invalid state: degrade + warn but
+            # NEVER kill the running workload over a spec typo — the
+            # reference's webhook would have refused the edit, leaving
+            # the stored (old) spec intact
+            self._warn_invalid_edit(job, errors)
+
         if not phase:
             self._initialize_job(job)
             phase = JobPhase.CREATED
@@ -243,6 +370,88 @@ class ElasticJobController:
 
     # -- init / status ---------------------------------------------------
 
+    def _reject_invalid_job(self, job: Dict, errors: List[str]):
+        """Malformed CR: fail the job with a Degraded condition and a k8s
+        Event naming every problem (the reference rejects these at its
+        admission webhook, ``go/elasticjob`` webhook scaffolding; without
+        a webhook the reconcile is the enforcement point)."""
+        status = job.setdefault("status", {})
+        if status.get("phase") == JobPhase.FAILED:
+            return  # already rejected; don't spam events on resync
+        msg = "; ".join(errors)[:900]
+        self._set_condition(job, "Degraded", True, "InvalidSpec", msg)
+        self._set_phase(job, JobPhase.FAILED, "InvalidSpec", msg)
+        self._emit_event(job, "Warning", "InvalidSpec", msg)
+        logger.error(
+            "elasticjob %s rejected: %s", job["metadata"]["name"], msg
+        )
+
+    def _warn_invalid_edit(self, job: Dict, errors: List[str]):
+        msg = "; ".join(errors)[:900]
+        conditions = job.get("status", {}).get("conditions", [])
+        existing = next(
+            (c for c in conditions if c.get("type") == "Degraded"), None
+        )
+        if existing and existing.get("message") == msg:
+            return  # already reported this exact problem; no event spam
+        self._set_condition(job, "Degraded", True, "InvalidSpecEdit", msg)
+        self._patch_status(job)
+        self._emit_event(job, "Warning", "InvalidSpecEdit", msg)
+        logger.warning(
+            "elasticjob %s has an invalid live edit (job left running): %s",
+            job["metadata"]["name"], msg,
+        )
+
+    def _emit_event(self, job: Dict, etype: str, reason: str, message: str):
+        name = job["metadata"]["name"]
+        try:
+            self._client.create_event({
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {
+                    "name": f"{name}-{reason.lower()}-{int(time.time())}",
+                    "namespace": self._client.namespace,
+                },
+                "involvedObject": {
+                    "apiVersion": f"{GROUP}/{VERSION}",
+                    "kind": "ElasticJob",
+                    "name": name,
+                    "namespace": self._client.namespace,
+                    "uid": job.get("metadata", {}).get("uid", ""),
+                },
+                "reason": reason,
+                "message": message[:1024],
+                "type": etype,
+                "source": {"component": "dlrover-tpu-operator"},
+                "count": 1,
+            })
+        except Exception:
+            logger.debug("could not emit event %s for %s", reason, name)
+
+    def _set_condition(
+        self, job: Dict, ctype: str, value: bool, reason: str, msg: str
+    ):
+        """Maintain available/progressing/degraded conditions by TYPE
+        (update-in-place, transition time only on change) — the
+        controller-runtime conventions the reference's CRD status carries."""
+        conditions = job.setdefault("status", {}).setdefault("conditions", [])
+        status_str = "True" if value else "False"
+        for cond in conditions:
+            if cond.get("type") == ctype:
+                if cond.get("status") != status_str:
+                    cond["lastTransitionTime"] = _now_iso()
+                cond.update(
+                    {"status": status_str, "reason": reason, "message": msg}
+                )
+                return
+        conditions.append({
+            "type": ctype,
+            "status": status_str,
+            "reason": reason,
+            "message": msg,
+            "lastTransitionTime": _now_iso(),
+        })
+
     def _initialize_job(self, job: Dict):
         now = _now_iso()
         status = job.setdefault("status", {})
@@ -252,6 +461,8 @@ class ElasticJobController:
             "conditions": [_condition(JobPhase.CREATED, "JobCreated",
                                       "ElasticJob created")],
         })
+        self._set_condition(job, "Progressing", True, "JobCreated",
+                            "bringing up the job master")
         self._patch_status(job)
 
     def _patch_status(self, job: Dict):
@@ -290,16 +501,27 @@ class ElasticJobController:
                               "name": master["metadata"]["name"]}
         }
         if mphase == "Succeeded":
+            self._set_condition(job, "Available", False, "JobFinished",
+                                "job completed")
+            self._set_condition(job, "Progressing", False, "JobFinished", "")
             self._set_phase(job, JobPhase.SUCCEEDED, "MasterSucceeded",
                             f"job {name} completed")
             self._stop_running_pods(job)
         elif mphase == "Running":
+            self._set_condition(job, "Available", True, "MasterRunning",
+                                "master serving")
+            self._set_condition(job, "Progressing", False, "MasterRunning", "")
+            self._set_condition(job, "Degraded", False, "MasterRunning", "")
             self._set_phase(job, JobPhase.RUNNING, "MasterRunning",
                             f"job {name} is running")
         elif mphase == "Pending":
+            self._set_condition(job, "Progressing", True, "MasterPending",
+                                "master pod pending")
             if job["status"].get("phase") in ("", JobPhase.CREATED):
                 self._set_phase(job, JobPhase.PENDING, "MasterPending",
                                 f"job {name} is pending")
+            else:
+                self._patch_status(job)
         else:
             self._patch_status(job)
 
@@ -337,10 +559,22 @@ class ElasticJobController:
                 "master %s failed (%s); relaunching as index %d",
                 master["metadata"]["name"], reason, idx + 1,
             )
+            self._set_condition(
+                job, "Degraded", True, "MasterRelaunching",
+                f"master failed ({reason}); relaunching as index {idx + 1}",
+            )
+            self._set_condition(job, "Available", False,
+                                "MasterRelaunching", "")
             self._client.delete_pod(master["metadata"]["name"],
                                     grace_seconds=0)
             self._ensure_master(job, index=idx + 1)
         else:
+            self._set_condition(
+                job, "Degraded", True, reason or "MasterFailed",
+                "master failure is fatal or out of restart budget",
+            )
+            self._set_condition(job, "Available", False,
+                                reason or "MasterFailed", "")
             self._set_phase(
                 job, JobPhase.FAILED, reason or "MasterFailed",
                 f"master failed ({reason or 'fatal'}), "
@@ -366,6 +600,11 @@ class ElasticJobController:
             if e.status != 409:  # already exists: lost a race with ourselves
                 raise
         job.setdefault("status", {})["masterRelaunchCount"] = index + 1
+        self._set_condition(
+            job, "Progressing", True,
+            "MasterCreating" if index == 0 else "MasterRelaunching",
+            f"master pod {name} created",
+        )
         self._patch_status(job)
         self._ensure_master_service(job)
         logger.info("created master pod %s for job %s", name, job_name)
@@ -544,6 +783,56 @@ class ElasticJobController:
 # ----------------------------------------------------------------------
 # helpers
 # ----------------------------------------------------------------------
+
+def validate_elasticjob(job: Dict) -> List[str]:
+    """Structural validation of an ElasticJob spec (the checks the
+    reference's admission webhook scaffolding is meant to enforce).
+    Returns human-readable problems; empty means valid."""
+    errors: List[str] = []
+    spec = job.get("spec")
+    if not isinstance(spec, dict) or not spec:
+        return ["spec is missing or empty"]
+    replica_specs = spec.get("replicaSpecs")
+    if not isinstance(replica_specs, dict) or not replica_specs:
+        return ["spec.replicaSpecs is missing or empty"]
+    for rtype, rspec in replica_specs.items():
+        if not isinstance(rspec, dict):
+            errors.append(f"replicaSpecs.{rtype} is not a mapping")
+            continue
+        try:
+            replicas = int(rspec.get("replicas", 0))
+        except (TypeError, ValueError):
+            errors.append(f"replicaSpecs.{rtype}.replicas is not an integer")
+            continue
+        if replicas < 0:
+            errors.append(f"replicaSpecs.{rtype}.replicas is negative")
+        try:
+            lo = int(rspec.get("minReplicas", replicas))
+            hi = int(rspec.get("maxReplicas", replicas))
+        except (TypeError, ValueError):
+            errors.append(
+                f"replicaSpecs.{rtype}.min/maxReplicas are not integers"
+            )
+            continue
+        if lo > hi:
+            errors.append(
+                f"replicaSpecs.{rtype}: minReplicas {lo} > maxReplicas {hi}"
+            )
+        template = rspec.get("template")
+        if template is not None and not isinstance(template, dict):
+            errors.append(f"replicaSpecs.{rtype}.template is not a mapping")
+    if NodeType.WORKER not in replica_specs:
+        errors.append("spec.replicaSpecs has no 'worker' entry")
+    try:
+        if int(spec.get("nodeUnit", 1)) < 1:
+            errors.append("spec.nodeUnit must be >= 1")
+    except (TypeError, ValueError):
+        errors.append("spec.nodeUnit is not an integer")
+    mode = spec.get("scalePlanMode", "direct")
+    if mode not in ("direct", "crd"):
+        errors.append(f"spec.scalePlanMode {mode!r} not in (direct, crd)")
+    return errors
+
 
 def _now_iso() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

@@ -84,6 +84,36 @@ class WorkerContext:
     def restart_count(self) -> int:
         return self.env.restart_count
 
+    def report_model_info(
+        self,
+        param_count: int = 0,
+        flops_per_step: float = 0.0,
+        batch_size: int = 0,
+        seq_len: int = 0,
+        hidden_dim: int = 0,
+        n_layers: int = 0,
+        n_heads: int = 0,
+        remat: bool = True,
+    ):
+        """Describe the model to the master (chief only): feeds the
+        hyperparam strategy's activation-memory sizing and the MFU
+        accounting (reference report_model_info)."""
+        if self.client is None or not self.is_chief:
+            return
+        try:
+            self.client.report_model_info(
+                param_count=param_count,
+                flops_per_step=flops_per_step,
+                batch_size=batch_size,
+                seq_len=seq_len,
+                hidden_dim=hidden_dim,
+                n_layers=n_layers,
+                n_heads=n_heads,
+                remat=remat,
+            )
+        except Exception as e:
+            logger.warning("model info report failed: %s", e)
+
     def report_step(self, step: int, force: bool = False):
         """Throttled global-step report feeding the master's SpeedMonitor."""
         if self.client is None:

@@ -86,6 +86,7 @@ class ElasticTrainer:
         self.worker_ctx = worker_ctx
         self._step_fn = None
         self._host_step = 0
+        self._applied_config_version = 0
 
     # ---- elastic global-batch math (reference trainer.py:307-327) ------
     @property
@@ -115,6 +116,12 @@ class ElasticTrainer:
             "params": params,
             "opt": opt_state,
             "step": jnp.zeros((), jnp.int32),
+            # runtime lr multiplier (master paral-config pushes): applied
+            # to the optimizer's updates inside the jitted step, so the
+            # master's sqrt-coupled lr actually takes effect without
+            # recompiling (the wd term follows lr — exact decoupled-wd
+            # rescaling would need a rebuilt optimizer)
+            "lr_scale": jnp.ones((), jnp.float32),
         }
 
     def _build_step(self):
@@ -152,12 +159,20 @@ class ElasticTrainer:
             updates, opt_state = self.optimizer.update(
                 grads, state["opt"], state["params"]
             )
+            lr_scale = state.get("lr_scale")
+            if lr_scale is not None:
+                updates = jax.tree.map(
+                    lambda u: u * lr_scale.astype(u.dtype), updates
+                )
             params = optax.apply_updates(state["params"], updates)
-            return {
+            out = {
                 "params": params,
                 "opt": opt_state,
                 "step": state["step"] + 1,
-            }, loss_sum * scale
+            }
+            if lr_scale is not None:
+                out["lr_scale"] = lr_scale
+            return out, loss_sum * scale
 
         # state keeps the shardings its arrays already carry (params placed
         # by the caller, opt state born sharded in init_state).
@@ -168,6 +183,44 @@ class ElasticTrainer:
             donate_argnums=(0,),
         )
 
+    def apply_paral_config(self, state: dict, config: dict) -> dict:
+        """Apply a master-pushed runtime config to the train state: a new
+        ``optimizer_learning_rate`` becomes an update multiplier relative
+        to the configured base lr (the schedule shape is preserved). The
+        dataloader fields are consumed by ``ElasticDataLoader``."""
+        new_lr = float(config.get("optimizer_learning_rate", 0.0) or 0.0)
+        if new_lr > 0 and self.tc.learning_rate > 0 and "lr_scale" in state:
+            scale = new_lr / self.tc.learning_rate
+            if abs(scale - float(state["lr_scale"])) > 1e-9:
+                state = {
+                    **state,
+                    "lr_scale": jnp.asarray(scale, jnp.float32),
+                }
+                from dlrover_tpu.common.log import logger as _logger
+
+                _logger.info(
+                    "runtime lr update: base=%g -> %g (scale %.4f)",
+                    self.tc.learning_rate, new_lr, scale,
+                )
+        return state
+
+    def poll_runtime_config(
+        self, state: dict, every_steps: int = 100
+    ) -> dict:
+        """Cheap per-step hook: every ``every_steps`` host steps re-read
+        the agent-pushed paral config file and apply optimizer changes."""
+        if self._host_step % max(1, every_steps):
+            return state
+        from dlrover_tpu.agent.paral_config_tuner import read_paral_config
+
+        config = read_paral_config()
+        version = int(config.get("optimizer_version", 0) or
+                      config.get("dataloader_version", 0) or 0)
+        if config and version != self._applied_config_version:
+            self._applied_config_version = version
+            state = self.apply_paral_config(state, config)
+        return state
+
     def step(self, state: dict, batch) -> Tuple[dict, jnp.ndarray]:
         """One optimizer step = ``accum_steps`` microbatches.
 
@@ -176,6 +229,8 @@ class ElasticTrainer:
         (images, labels) tuples for CV."""
         if self._step_fn is None:
             self._step_fn = self._build_step()
+        if self.worker_ctx is not None:
+            state = self.poll_runtime_config(state)
         new_state, loss = self._step_fn(state, batch)
         # host-side step counter: reading new_state["step"] would block on
         # the just-dispatched computation and kill async dispatch

@@ -79,7 +79,8 @@ void TimerManager::EndExecute(uint64_t token, bool error) {
       // achieved TFLOP/s of this completion vs peak -> live MFU sample
       double util = (s.flops / dur) / 1e6 / peak_tflops_;
       s.util_ema = s.util_ema == 0 ? util : 0.8 * s.util_ema + 0.2 * util;
-      mfu_ema_ = mfu_ema_ == 0 ? util : 0.8 * mfu_ema_ + 0.2 * util;
+      mfu_num_ = 0.8 * mfu_num_ + 0.2 * util * s.flops;
+      mfu_den_ = 0.8 * mfu_den_ + 0.2 * s.flops;
     }
   }
   if (tracing_.load()) {
@@ -172,7 +173,8 @@ std::string TimerManager::PrometheusText() {
       << "\n";
   if (peak_tflops_ > 0) {
     out << "dlrover_tpu_timer_peak_tflops " << peak_tflops_ << "\n";
-    out << "dlrover_tpu_timer_mfu " << mfu_ema_ << "\n";
+    out << "dlrover_tpu_timer_mfu "
+        << (mfu_den_ > 0 ? mfu_num_ / mfu_den_ : 0.0) << "\n";
   }
   AppendStats(out, "dlrover_tpu_timer_execute", exec_stats_);
   AppendStats(out, "dlrover_tpu_timer_compile", compile_stats_);

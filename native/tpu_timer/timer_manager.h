@@ -103,7 +103,11 @@ class TimerManager {
   // per-program utilization then unavailable but flops/bytes still export)
   double peak_tflops_ = 0;
   double device_flops_total_ = 0;  // sum of completed executions' flops
-  double mfu_ema_ = 0;             // flops-weighted live MFU across programs
+  // flops-weighted live MFU across programs: decayed numerator
+  // (util*flops) over decayed denominator (flops), so a chatty tiny
+  // program cannot drown out the train step's utilization
+  double mfu_num_ = 0;
+  double mfu_den_ = 0;
 
   std::atomic<bool> hang_{false};
   std::atomic<bool> tracing_{true};

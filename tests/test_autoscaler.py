@@ -123,3 +123,168 @@ def test_strategy_generator():
     assert s.learning_rate > 3e-4  # scaled up with world size
     cfg = s.to_paral_config()
     assert cfg["grad_accum_steps"] == s.grad_accum_steps
+
+
+def test_activation_memory_model_and_initial_cap():
+    """Model-aware sizing (reference simple_strategy_generator.py:104-115):
+    a big activation footprint caps the per-chip micro batch; remat
+    shrinks the resident set."""
+    from dlrover_tpu.master.hyperparams import (
+        ModelProfile,
+        activation_bytes_per_sample,
+    )
+
+    mp = ModelProfile(seq_len=2048, hidden_dim=4096, n_layers=32,
+                      n_heads=32, remat=False)
+    full = activation_bytes_per_sample(mp)
+    mp_remat = ModelProfile(seq_len=2048, hidden_dim=4096, n_layers=32,
+                            n_heads=32, remat=True)
+    remat = activation_bytes_per_sample(mp_remat)
+    assert full > remat > 0
+    assert full / remat > 5  # remat keeps ~boundaries + one layer
+
+    gen = SimpleStrategyGenerator(hbm_per_chip_gb=16, chips_per_host=4)
+    s = gen.generate_opt_strategy(
+        global_batch_size=4096, world_hosts=2, model=mp,
+    )
+    # the cap bit: accum makes up what the micro batch gave away
+    assert s.micro_batch_size * 2 * s.grad_accum_steps >= 4096
+    cap = int(16e9 * 0.25 / full)
+    assert s.micro_batch_size <= max(1, cap) * 4
+
+    # incomplete profile -> no cap applied
+    assert activation_bytes_per_sample(ModelProfile()) == 0.0
+
+
+def test_refine_strategy_accum_shift_preserves_global_batch():
+    """Growth by accum shift: micro-batch doubles, accum halves — global
+    batch (and lr!) untouched; bounded by the analytic HBM cap and the
+    host-RAM floor."""
+    import pytest
+
+    from dlrover_tpu.master.hyperparams import ModelProfile
+
+    gen = SimpleStrategyGenerator(host_memory_floor_mb=2400)
+    mp = ModelProfile(seq_len=128, hidden_dim=256, n_layers=4, n_heads=4)
+    current = {
+        "dataloader_batch_size": 8,
+        "optimizer_learning_rate": 1e-3,
+        "optimizer_weight_decay": 0.1,
+        "grad_accum_steps": 4,
+        "dataloader_num_workers": 4,
+    }
+    s = gen.refine_strategy(
+        current, mp, host_mem_used_mb=10_000, host_mem_total_mb=64_000,
+    )
+    assert s is not None
+    assert s.micro_batch_size == 16 and s.grad_accum_steps == 2
+    # global batch invariant -> optimizer untouched
+    assert s.learning_rate == pytest.approx(1e-3)
+    assert s.weight_decay == pytest.approx(0.1)
+
+    # below the host floor: hold
+    assert gen.refine_strategy(
+        current, mp, host_mem_used_mb=63_000, host_mem_total_mb=64_000,
+    ) is None
+    # odd accum > 1: no exact shift -> hold
+    assert gen.refine_strategy(
+        {**current, "grad_accum_steps": 3}, mp, 10_000, 64_000,
+    ) is None
+    # unknown model: hold
+    from dlrover_tpu.master.hyperparams import ModelProfile as MP
+    assert gen.refine_strategy(current, MP(), 0, 64_000) is None
+
+
+def test_refine_strategy_global_growth_couples_lr_and_respects_hbm():
+    """At accum==1 growth really doubles the global batch: lr/wd scale by
+    sqrt(2); the analytic HBM activation cap gates it."""
+    import pytest
+
+    from dlrover_tpu.master.hyperparams import (
+        ModelProfile,
+        activation_bytes_per_sample,
+    )
+
+    gen = SimpleStrategyGenerator(hbm_per_chip_gb=95, chips_per_host=4,
+                                  host_memory_floor_mb=2400)
+    mp = ModelProfile(seq_len=128, hidden_dim=256, n_layers=4, n_heads=4)
+    current = {
+        "dataloader_batch_size": 8,
+        "optimizer_learning_rate": 1e-3,
+        "optimizer_weight_decay": 0.1,
+        "grad_accum_steps": 1,
+    }
+    s = gen.refine_strategy(current, mp, 10_000, 64_000)
+    assert s is not None
+    assert s.micro_batch_size == 16 and s.grad_accum_steps == 1
+    assert s.learning_rate == pytest.approx(1e-3 * 2**0.5)
+    assert s.weight_decay == pytest.approx(0.1 * 2**0.5)
+
+    # HBM cap: a chip too small for the doubled activations -> hold
+    act = activation_bytes_per_sample(mp)
+    tiny_hbm_gb = 4 * act / 0.25 / 1e9 * 0.9  # just under the need
+    gen_small = SimpleStrategyGenerator(
+        hbm_per_chip_gb=tiny_hbm_gb, chips_per_host=4,
+    )
+    assert gen_small.refine_strategy(current, mp, 10_000, 64_000) is None
+
+
+def test_autoscaler_refines_hyperparams_from_model_report():
+    """End of the loop: worker reports model shape -> servicer stores it
+    in the collector -> the autoscaler's RUNNING cycle grows the batch
+    from observed headroom and pushes the versioned paral config."""
+    import pytest
+
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.master.hyperparams import SimpleStrategyGenerator
+    from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.servicer import MasterServicer
+    from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
+    class FakeScaler:
+        def scale(self, plan):
+            pass
+
+        def cordon(self, host):
+            pass
+
+    JobContext.reset_singleton()
+    try:
+        ctx = get_job_context()
+        collector = JobMetricCollector()
+        servicer = MasterServicer(metric_collector=collector)
+        servicer.report(msg.ModelInfoReport(
+            node_id=0, param_count=10_000_000, batch_size=8,
+            seq_len=128, hidden_dim=256, n_layers=4, n_heads=4,
+        ))
+        assert collector.metrics.model_profile["seq_len"] == 128
+
+        for i in range(2):
+            node = Node(NodeType.WORKER, i, status=NodeStatus.RUNNING)
+            node.config_resource.memory_mb = 64_000
+            node.used_resource.memory_mb = 10_000
+            node.paral_config = {
+                "dataloader_batch_size": 8,
+                "optimizer_learning_rate": 1e-3,
+                "grad_accum_steps": 4,
+            }
+            ctx.update_node(node)
+
+        auto = JobAutoScaler(
+            optimizer=LocalOptimizer(min_workers=1, max_workers=2),
+            scaler=FakeScaler(),
+            strategy_generator=SimpleStrategyGenerator(),
+            metric_collector=collector,
+            refine_cooldown_secs=0.0,
+        )
+        auto.maybe_refine_hyperparams()
+        for n in ctx.workers().values():
+            # accum shift: batch doubled, accum halved, lr untouched
+            assert n.paral_config["dataloader_batch_size"] == 16
+            assert n.paral_config["grad_accum_steps"] == 2
+            assert n.paral_config["dataloader_version"] >= 1
+            assert n.paral_config["optimizer_learning_rate"] == pytest.approx(
+                1e-3
+            )
+    finally:
+        JobContext.reset_singleton()

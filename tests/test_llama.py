@@ -195,3 +195,36 @@ def test_sharded_loss_ulysses_matches_single_device(toks):
         lambda p, t: llama.loss_fn(p, t, cfg, mesh)
     )(sharded, toks))
     np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_runtime_lr_scale_applied_through_paral_config():
+    """Code-review r4: a master-pushed optimizer_learning_rate must reach
+    the jitted step — the update multiplier scales the applied updates
+    without recompiling; scale 0 freezes the params."""
+    import numpy as np
+
+    mc = MeshConfig(dp=1, fsdp=1, sp=1, tp=1)
+    mesh = build_mesh(mc, devices=jax.devices()[:1])
+    specs = llama.param_specs(CFG)
+    tc = TrainConfig(global_batch_size=4, micro_batch_size=4,
+                     learning_rate=1e-2, warmup_steps=0, total_steps=50)
+    batch = jax.random.randint(jax.random.key(9), (1, 4, 16), 0,
+                               CFG.vocab_size)
+
+    def one_step(lr_cfg):
+        local = llama.init_params(CFG, jax.random.key(0))
+        sharded = jax.device_put(local, named_shardings(mesh, specs))
+        tr = ElasticTrainer(
+            lambda p, t: llama.loss_fn(p, t, CFG, mesh), specs, mesh, mc, tc
+        )
+        state = tr.init_state(sharded)
+        before = np.asarray(state["params"]["final_norm"]).copy()
+        if lr_cfg:
+            state = tr.apply_paral_config(state, lr_cfg)
+        state, _ = tr.step(state, batch)
+        return before, np.asarray(state["params"]["final_norm"])
+
+    b0, base = one_step({})
+    _, frozen = one_step({"optimizer_learning_rate": 1e-2 * 1e-12})
+    np.testing.assert_allclose(frozen, b0, atol=1e-7)  # ~zero lr: no move
+    assert np.abs(base - b0).max() > 1e-5               # normal lr moves

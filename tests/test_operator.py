@@ -223,3 +223,152 @@ def test_event_driven_loop_reconciles_from_watch(ctrl):
         transport.end_watch(ELASTICJOB_PLURAL)
         transport.end_watch("pods")
         transport.end_watch(SCALEPLAN_PLURAL)
+
+
+# -- round-4 hardening (VERDICT r3 #9) --------------------------------------
+
+
+def test_malformed_cr_rejected_with_event_and_degraded_condition(ctrl):
+    controller, client, transport = ctrl
+    cr = transport.crs[ELASTICJOB_PLURAL][JOB]
+    cr["spec"]["replicaSpecs"]["worker"]["minReplicas"] = 9
+    cr["spec"]["replicaSpecs"]["worker"]["maxReplicas"] = 2
+    cr["spec"]["nodeUnit"] = 0
+    controller.reconcile_once(JOB)
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    assert job["status"]["phase"] == JobPhase.FAILED
+    degraded = next(
+        c for c in job["status"]["conditions"] if c["type"] == "Degraded"
+    )
+    assert degraded["status"] == "True"
+    assert degraded["reason"] == "InvalidSpec"
+    assert "minReplicas 9 > maxReplicas 2" in degraded["message"]
+    assert "nodeUnit" in degraded["message"]
+    # one warning event naming the problems; no master pod ever created
+    events = [e for e in transport.events if e["reason"] == "InvalidSpec"]
+    assert len(events) == 1 and events[0]["type"] == "Warning"
+    assert master_pod_name(JOB, 0) not in transport.pods
+    # resync does not spam another event
+    controller.reconcile_once(JOB)
+    assert len(
+        [e for e in transport.events if e["reason"] == "InvalidSpec"]
+    ) == 1
+
+
+def test_missing_worker_spec_rejected(ctrl):
+    controller, client, transport = ctrl
+    cr = transport.crs[ELASTICJOB_PLURAL][JOB]
+    cr["spec"]["replicaSpecs"] = {"evaluator": {"replicas": 1}}
+    controller.reconcile_once(JOB)
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    assert job["status"]["phase"] == JobPhase.FAILED
+    assert "no 'worker' entry" in job["status"]["conditions"][-1]["message"]
+
+
+def test_status_conditions_track_lifecycle(ctrl):
+    """Available/Progressing/Degraded conditions by type, updated in
+    place with transition semantics (controller-runtime conventions)."""
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+
+    def cond(ctype):
+        job = transport.crs[ELASTICJOB_PLURAL][JOB]
+        return next(
+            (c for c in job["status"].get("conditions", [])
+             if c["type"] == ctype), None,
+        )
+
+    assert cond("Progressing")["status"] == "True"
+
+    _set_master_phase(transport, 0, "Running")
+    controller.reconcile_once(JOB)
+    assert cond("Available")["status"] == "True"
+    assert cond("Progressing")["status"] == "False"
+    assert cond("Degraded")["status"] == "False"
+
+    # retryable master failure: degraded + unavailable while relaunching
+    _set_master_phase(transport, 0, "Failed", reason="Evicted")
+    controller.reconcile_once(JOB)
+    assert cond("Degraded")["status"] == "True"
+    assert cond("Degraded")["reason"] == "MasterRelaunching"
+    assert cond("Available")["status"] == "False"
+
+    # replacement comes up: healthy again
+    _set_master_phase(transport, 1, "Running")
+    controller.reconcile_once(JOB)
+    assert cond("Available")["status"] == "True"
+    assert cond("Degraded")["status"] == "False"
+    # conditions are unique per type (updated, not appended)
+    job = transport.crs[ELASTICJOB_PLURAL][JOB]
+    types = [c["type"] for c in job["status"]["conditions"]]
+    assert len([t for t in types if t == "Degraded"]) == 1
+
+
+def test_leader_lease_singleton_guard():
+    """Two operator replicas on one API server: exactly one reconciles;
+    when the leader releases, the standby takes over."""
+    from dlrover_tpu.operator.controller import LeaderLease
+
+    client, transport = make_fake_client()
+    a = LeaderLease(client, identity="op-a", lease_secs=30)
+    b = LeaderLease(client, identity="op-b", lease_secs=30)
+    assert a.try_acquire() is True
+    assert b.try_acquire() is False
+    assert a.try_acquire() is True  # renew keeps it
+
+    # holder stops renewing: standby takes over after expiry
+    cm = transport.configmaps["dlrover-tpu-operator-leader"]
+    cm["data"]["renewTime"] = "1.0"  # long expired
+    assert b.try_acquire() is True
+    assert b.is_leader and not a.try_acquire()
+
+    # controllers gate reconciles on the lease
+    transport.crs.setdefault(ELASTICJOB_PLURAL, {})[JOB] = copy.deepcopy(
+        ELASTICJOB_CR
+    )
+    follower = ElasticJobController(client, leader_election=True)
+    follower._lease.identity = "op-c"
+    assert follower.is_leader is False  # op-b holds the lease
+
+
+def test_scaleplan_race_during_master_relaunch(ctrl):
+    """Reconcile race (VERDICT r3 #9): a crd-mode ScalePlan lands while
+    the master pod is being relaunched. One reconcile pass must do BOTH —
+    recreate the master under the next index AND execute the plan — with
+    no duplicate pods and consistent statuses."""
+    controller, client, transport = ctrl
+    controller.reconcile_once(JOB)
+    _set_master_phase(transport, 0, "Running")
+    controller.reconcile_once(JOB)
+
+    # master dies (retryable) and, concurrently, the (old) master's
+    # scale-out intent is still pending as a ScalePlan CR
+    _set_master_phase(transport, 0, "Failed", reason="Evicted")
+    transport.crs.setdefault(SCALEPLAN_PLURAL, {})["plan-race"] = {
+        "metadata": {"name": "plan-race",
+                     "labels": {LABEL_JOB_KEY: JOB, "scale-type": "auto"}},
+        "spec": {
+            "ownerJob": JOB,
+            "createPods": [
+                {"type": "worker", "id": 4, "rankIndex": 4},
+                {"type": "worker", "id": 5, "rankIndex": 5},
+            ],
+            "removePods": [],
+        },
+    }
+    controller.reconcile_once(JOB)
+
+    # master relaunched under index 1
+    assert master_pod_name(JOB, 1) in transport.pods
+    assert master_pod_name(JOB, 0) not in transport.pods
+    # the plan executed exactly once
+    assert f"{JOB}-worker-4" in transport.pods
+    assert f"{JOB}-worker-5" in transport.pods
+    plan = transport.crs[SCALEPLAN_PLURAL]["plan-race"]
+    assert plan["status"]["phase"] == JobPhase.SUCCEEDED
+
+    # a second pass (resync) is a no-op: no duplicates, no index bump
+    n_pods = len(transport.pods)
+    controller.reconcile_once(JOB)
+    assert len(transport.pods) == n_pods
+    assert master_pod_name(JOB, 2) not in transport.pods

@@ -1,0 +1,117 @@
+"""External Orbax interop across process trees and mesh shapes
+(VERDICT r3 #10): checkpoints written by a PLAIN Orbax job (no
+dlrover_tpu imports) restore through our facade into a different mesh
+shape, and our Orbax emissions restore in a plain-Orbax process — the
+migration story the reference's HF/Megatron adapters play.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    not __import__("importlib").util.find_spec("orbax"),
+    reason="orbax not installed",
+)
+
+
+def _run(prog: str, n_devices: int) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(prog)],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, f"{out.stdout[-2000:]}\n{out.stderr[-3000:]}"
+    return out
+
+
+def test_plain_orbax_checkpoint_restores_into_different_mesh(tmp_path):
+    """A vanilla Orbax job (8-device dp mesh, zero dlrover_tpu imports)
+    writes a sharded checkpoint; a separate process restores it through
+    the Checkpointer facade onto a 4-device dp x tp mesh."""
+    ckpt_dir = str(tmp_path)
+    _run(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp, numpy as np
+        import orbax.checkpoint as ocp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+        state = {{
+            "w": jax.device_put(
+                jnp.arange(64.0).reshape(8, 8), NamedSharding(mesh, P("dp"))
+            ),
+            "step": jnp.array(7),
+        }}
+        ocp.PyTreeCheckpointer().save({ckpt_dir!r} + "/orbax-7", state)
+        print("plain orbax saved")
+    """, n_devices=8)
+
+    _run(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp, numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from dlrover_tpu.checkpoint.checkpointer import Checkpointer
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("dp", "tp"))
+        target = {{
+            "w": jax.device_put(
+                jnp.zeros((8, 8)), NamedSharding(mesh, P("dp", "tp"))
+            ),
+            "step": jnp.array(0),
+        }}
+        step, restored = Checkpointer({ckpt_dir!r}).load(target=target)
+        assert step == 7, step
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(64.0).reshape(8, 8)
+        )
+        assert restored["w"].sharding == target["w"].sharding
+        assert int(restored["step"]) == 7
+        print("restored across mesh shapes OK")
+    """, n_devices=4)
+
+
+def test_our_orbax_emission_restores_in_plain_orbax_process(tmp_path):
+    """Reverse direction: our OrbaxCheckpointer writes from a 4-device
+    mesh; a plain-Orbax process (different device count, no dlrover_tpu)
+    reads it back with stock APIs."""
+    ckpt_dir = str(tmp_path)
+    _run(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp, numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from dlrover_tpu.checkpoint.orbax_interop import OrbaxCheckpointer
+
+        mesh = Mesh(np.array(jax.devices()).reshape(4), ("dp",))
+        state = {{
+            "w": jax.device_put(
+                jnp.arange(32.0).reshape(4, 8), NamedSharding(mesh, P("dp"))
+            ),
+        }}
+        OrbaxCheckpointer({ckpt_dir!r}).save(3, state)
+        print("ours saved")
+    """, n_devices=4)
+
+    _run(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import orbax.checkpoint as ocp
+
+        restored = ocp.PyTreeCheckpointer().restore({ckpt_dir!r} + "/orbax-3")
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(32.0).reshape(4, 8)
+        )
+        print("plain orbax read ours OK")
+    """, n_devices=8)
