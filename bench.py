@@ -224,11 +224,19 @@ def main():
         candidates = [("tiny_cpu", llama.LlamaConfig.tiny(), 2)]
         seq, timed_steps = 128, 3
 
-    trainer = state = batch = None
-    step_s = float("nan")
-    model_name = "none"
-    cfg = None
-    best_rate = 0.0
+    def _free(*trees):
+        """Release a candidate's device arrays before the next candidate
+        builds — retaining a 1.2B state (params + Adam moments) would OOM
+        every same-size rival and silently shrink the sweep to one
+        config."""
+        for tree in trees:
+            for leaf in jax.tree.leaves(tree):
+                try:
+                    leaf.delete()
+                except Exception:
+                    pass
+
+    results = []  # (rate, name, cfg, micro, step_s)
     measured = 0
     # sweep: measure up to 3 fitting candidates and keep the fastest
     # (model FLOPs/s, so differently-sized candidates compare fairly)
@@ -257,15 +265,24 @@ def main():
         rate = _model_flops_per_step(cand, cand_micro, seq) / c_step_s
         print(f"candidate {name}: {rate / 1e12:.2f} model TFLOP/s "
               f"({c_step_s:.3f}s/step)", file=sys.stderr)
+        results.append((rate, name, cand, cand_micro, c_step_s))
         measured += 1
-        if rate > best_rate:
-            best_rate = rate
-            trainer, state, batch, step_s = (
-                c_trainer, c_state, c_batch, c_step_s
-            )
-            model_name, cfg, micro = name, cand, cand_micro
+        _free(c_state, c_batch)
+        del c_trainer, c_state, c_batch
         if measured >= max_measured:
             break
+
+    trainer = state = batch = None
+    step_s = float("nan")
+    model_name = "none"
+    cfg = None
+    if results:
+        _, model_name, cfg, micro, step_s = max(results, key=lambda r: r[0])
+        # rebuild the winner (its arrays were freed during the sweep) for
+        # the flash-checkpoint measurement below; untimed
+        trainer, state, batch, _ = _run_mfu(
+            jax, jnp, llama, cfg, micro, seq, 1
+        )
     if cfg is None:
         print(json.dumps({
             "metric": "train_step_mfu", "value": 0.0, "unit": "fraction",

@@ -17,9 +17,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import (
-    DefaultValues,
     JobExitReason,
-    JobStage,
     NodeEventType,
     NodeExitReason,
     NodeStatus,
@@ -66,6 +64,17 @@ class DistributedJobManager(JobManager):
         self._pending_timeout_override = pending_timeout
         #: feeds the OOM-split recovery path on OOMKilled relaunches
         self._resource_optimizer = resource_optimizer
+        #: per-type lifecycle policies (reference worker/ps/chief manager
+        #: split); unknown types fall back to the worker policy
+        from dlrover_tpu.master.node.replica_manager import (
+            make_replica_manager,
+        )
+
+        self._replica_managers = {
+            rtype: make_replica_manager(rtype, job_args, resource_optimizer)
+            for rtype in (job_args.replicas if job_args else {})
+        }
+        self._make_replica_manager = make_replica_manager
         self._stop_evt = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
         self._start_ts = 0.0
@@ -305,7 +314,7 @@ class DistributedJobManager(JobManager):
                 f"(reason={reason}, relaunch={node.relaunch_count}/"
                 f"{node.max_relaunch_count})"
             )
-            if node.critical:
+            if self._replica_manager(node.type).is_critical(node):
                 # non-critical fatal failures attrite toward the
                 # insufficient-worker early stop instead
                 logger.error(msg)
@@ -323,45 +332,28 @@ class DistributedJobManager(JobManager):
         node.is_released = True
         self._scaler.scale(ScalePlan(remove_nodes=[node]))
 
+    def _replica_manager(self, node_type: str):
+        mgr = self._replica_managers.get(node_type)
+        if mgr is None:
+            mgr = self._make_replica_manager(
+                node_type, self._job_args, self._resource_optimizer
+            )
+            self._replica_managers[node_type] = mgr
+        return mgr
+
     def _should_relaunch(self, node: Node) -> bool:
-        """Reference ``_should_relaunch`` :849-910, condensed to the policy:
-        never for clean exits or fatal user errors; preemption and hardware
-        faults always relaunch (the platform's fault, budget-free);
-        everything else (OOM, external kill, unknown) relaunches while
-        budget remains."""
-        if node.status == NodeStatus.SUCCEEDED or node.is_released:
-            return False
-        if not node.relaunchable:
-            return False
-        if get_master_config().relaunch_always:
-            return True  # operator override: budget and reason ignored
-        reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
-        if reason == NodeExitReason.FATAL_ERROR:
-            return False
-        if reason in (NodeExitReason.PREEMPTED, NodeExitReason.HARDWARE_ERROR):
-            return True
-        if reason in NodeExitReason.RELAUNCHABLE:
-            return node.relaunch_count < node.max_relaunch_count
-        return False
+        """Per-type relaunch policy (``replica_manager.py``)."""
+        return self._replica_manager(node.type).should_relaunch(node)
 
     def _relaunch_node(self, node: Node):
-        """Exit reason → differentiated relaunch plan:
-
-        - PREEMPTED / HARDWARE_ERROR: plain relaunch, budget untouched;
-        - OOM: relaunch with a memory bump from the resource optimizer's
-          OOM-split path (reference ``resource/job.py:313-395``
-          ``adjust_oom_resource``); consumes budget;
-        - anything else relaunchable: plain relaunch, consumes budget.
-        """
+        """Budget/resource prep is the type's policy
+        (``ReplicaManager.prepare_replacement``); pod orchestration —
+        cordon, scale plan, persistence — stays here."""
         with self._lock:
             new_id = self._job_context.next_node_id(node.type)
         new_node = node.get_relaunch_node_info(new_id)
         reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
-        if reason in (NodeExitReason.PREEMPTED, NodeExitReason.HARDWARE_ERROR):
-            # the platform's fault, not the host's
-            new_node.relaunch_count = node.relaunch_count
-        elif reason == NodeExitReason.OOM:
-            self._bump_oom_memory(node, new_node)
+        self._replica_manager(node.type).prepare_replacement(node, new_node)
         if (
             reason == NodeExitReason.HARDWARE_ERROR
             and self._job_args.cordon_fault_node
@@ -390,28 +382,6 @@ class DistributedJobManager(JobManager):
         plan = ScalePlan(launch_nodes=[new_node], remove_nodes=[node])
         self._scaler.scale(plan)
         self.persist_node_state()
-
-    def _bump_oom_memory(self, node: Node, new_node: Node):
-        """Ask the optimizer (local heuristic or brain-backed) for an OOM
-        recovery resource; fall back to a 2x bump."""
-        name = node.name or f"{node.type}-{node.id}"
-        current = node.config_resource.memory_mb or 0.0
-        target = 0.0
-        if self._resource_optimizer is not None:
-            try:
-                plan = self._resource_optimizer.generate_oom_recovery_plan(
-                    [name], JobStage.RUNNING, host_oom=True
-                )
-                for res in plan.node_resources.values():
-                    target = max(target, res.memory_mb)
-            except Exception:
-                logger.exception("oom recovery plan failed; using 2x bump")
-        if target <= current:
-            target = (current or DefaultValues.MB_DEFAULT_HOST_MEMORY) * 2
-        # never mutate in place: config_resource may be shared with the
-        # job spec and sibling nodes (init passes the group resource)
-        new_node.config_resource = copy.copy(new_node.config_resource)
-        new_node.config_resource.memory_mb = target
 
     # -- manual scale plans -------------------------------------------------
 

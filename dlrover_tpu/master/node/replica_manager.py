@@ -1,0 +1,149 @@
+"""Per-replica-type lifecycle policy managers.
+
+Parity: reference ``master/node/worker.py`` / ``ps.py`` / ``chief``
+(per-type ReplicaManager subclasses the DistributedJobManager dispatches
+to). The TPU build scopes out the PS family, but keeps the *abstraction*:
+each node type registers a policy object deciding whether a dead node
+relaunches and how its replacement is prepared, so future replica types
+(evaluators, data workers, sidecar services) plug in without touching the
+job manager's orchestration.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, Optional, Type
+
+from dlrover_tpu.common.constants import (
+    DefaultValues,
+    JobStage,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.global_context import get_master_config
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.node import Node
+
+_REGISTRY: Dict[str, Type["ReplicaManager"]] = {}
+
+
+def replica_manager(node_type: str) -> Callable:
+    def wrap(cls: Type["ReplicaManager"]) -> Type["ReplicaManager"]:
+        _REGISTRY[node_type] = cls
+        cls.node_type = node_type
+        return cls
+
+    return wrap
+
+
+def make_replica_manager(
+    node_type: str, job_args=None, resource_optimizer=None
+) -> "ReplicaManager":
+    cls = _REGISTRY.get(node_type, WorkerReplicaManager)
+    return cls(job_args=job_args, resource_optimizer=resource_optimizer)
+
+
+class ReplicaManager:
+    """Policy for one replica type; the job manager owns orchestration."""
+
+    node_type = NodeType.WORKER
+
+    def __init__(self, job_args=None, resource_optimizer=None):
+        self._job_args = job_args
+        self._resource_optimizer = resource_optimizer
+
+    # -- relaunch policy -------------------------------------------------
+
+    def should_relaunch(self, node: Node) -> bool:
+        """Reference ``_should_relaunch`` :849-910, condensed: never for
+        clean exits or fatal user errors; preemption and hardware faults
+        always relaunch (the platform's fault, budget-free); everything
+        else (OOM, external kill, unknown) relaunches while budget
+        remains. The common guards (terminal state, released, the
+        operator's relaunch_always override) live HERE; subclasses only
+        override the reason policy."""
+        if node.status == NodeStatus.SUCCEEDED or node.is_released:
+            return False
+        if not node.relaunchable:
+            return False
+        if get_master_config().relaunch_always:
+            return True  # operator override: budget and reason ignored
+        reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
+        return self._reason_allows_relaunch(node, reason)
+
+    def _reason_allows_relaunch(self, node: Node, reason: str) -> bool:
+        if reason == NodeExitReason.FATAL_ERROR:
+            return False
+        if reason in (NodeExitReason.PREEMPTED, NodeExitReason.HARDWARE_ERROR):
+            return True
+        if reason in NodeExitReason.RELAUNCHABLE:
+            return node.relaunch_count < node.max_relaunch_count
+        return False
+
+    def prepare_replacement(self, node: Node, new_node: Node) -> None:
+        """Exit reason → differentiated replacement prep:
+
+        - PREEMPTED / HARDWARE_ERROR: plain relaunch, budget untouched;
+        - OOM: memory bump from the resource optimizer's OOM-split path
+          (reference ``resource/job.py:313-395`` adjust_oom_resource);
+          consumes budget;
+        - anything else relaunchable: plain relaunch, consumes budget.
+        """
+        reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
+        if reason in (NodeExitReason.PREEMPTED, NodeExitReason.HARDWARE_ERROR):
+            # the platform's fault, not the host's
+            new_node.relaunch_count = node.relaunch_count
+        elif reason == NodeExitReason.OOM:
+            self._bump_oom_memory(node, new_node)
+
+    def is_critical(self, node: Node) -> bool:
+        """Does this node's unrecoverable failure fail the JOB (vs
+        attriting toward the insufficient-worker early stop)?"""
+        return bool(node.critical)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _bump_oom_memory(self, node: Node, new_node: Node):
+        """Ask the optimizer (local heuristic or brain-backed) for an OOM
+        recovery resource; fall back to a 2x bump."""
+        name = node.name or f"{node.type}-{node.id}"
+        current = node.config_resource.memory_mb or 0.0
+        target = 0.0
+        if self._resource_optimizer is not None:
+            try:
+                plan = self._resource_optimizer.generate_oom_recovery_plan(
+                    [name], JobStage.RUNNING, host_oom=True
+                )
+                for res in plan.node_resources.values():
+                    target = max(target, res.memory_mb)
+            except Exception:
+                logger.exception("oom recovery plan failed; using 2x bump")
+        if target <= current:
+            target = (current or DefaultValues.MB_DEFAULT_HOST_MEMORY) * 2
+        # never mutate in place: config_resource may be shared with the
+        # job spec and sibling nodes (init passes the group resource)
+        new_node.config_resource = copy.copy(new_node.config_resource)
+        new_node.config_resource.memory_mb = target
+
+
+@replica_manager(NodeType.WORKER)
+class WorkerReplicaManager(ReplicaManager):
+    """The default: full relaunch policy + OOM bumps."""
+
+
+@replica_manager("evaluator")
+class EvaluatorReplicaManager(ReplicaManager):
+    """Side-car evaluation replicas: never critical to the job, and only
+    platform faults earn a replacement — a crashing eval script must not
+    burn cluster capacity on retries the way training workers do. (The
+    operator's relaunch_always override still applies via the base
+    guards.)"""
+
+    def _reason_allows_relaunch(self, node: Node, reason: str) -> bool:
+        return reason in (
+            NodeExitReason.PREEMPTED, NodeExitReason.HARDWARE_ERROR
+        )
+
+    def is_critical(self, node: Node) -> bool:
+        return False

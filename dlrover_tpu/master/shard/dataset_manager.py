@@ -48,6 +48,11 @@ class DatasetShardCheckpoint:
     #: re-queueing them blind
     doing_meta: List = field(default_factory=list)
     task_id_seq: int = 0
+    #: what ``epoch`` counts — "pass" (default; full data passes) or a
+    #: splitter-specific unit like the table splitter's "subepoch".
+    #: Restores convert when the units disagree (e.g. a checkpoint from a
+    #: build whose table epoch meant full passes).
+    epoch_unit: str = "pass"
 
     def to_json(self) -> str:
         return json.dumps(
@@ -60,6 +65,7 @@ class DatasetShardCheckpoint:
                 "partition_offsets": self.partition_offsets,
                 "doing_meta": self.doing_meta,
                 "task_id_seq": self.task_id_seq,
+                "epoch_unit": self.epoch_unit,
             }
         )
 
@@ -75,6 +81,7 @@ class DatasetShardCheckpoint:
             partition_offsets=d.get("partition_offsets", {}),
             doing_meta=d.get("doing_meta", []),
             task_id_seq=d.get("task_id_seq", 0),
+            epoch_unit=d.get("epoch_unit", "pass"),
         )
 
 
@@ -196,6 +203,7 @@ class BatchDatasetManager:
                     for d in self._doing.values()
                 ],
                 task_id_seq=self._task_id_seq,
+                epoch_unit=getattr(self._splitter, "EPOCH_UNIT", "pass"),
             )
 
     def restore_checkpoint(
@@ -208,7 +216,7 @@ class BatchDatasetManager:
         exactly-once; the timeout scan requeues any whose worker truly
         died."""
         with self._lock:
-            self._splitter.epoch = ckpt.epoch
+            self._splitter.restore_epoch(ckpt.epoch, ckpt.epoch_unit)
             self._todo.clear()
             self._doing.clear()
             self._completed_records = ckpt.completed_records

@@ -59,6 +59,15 @@ class DatasetSplitter(ABC):
     def epoch_finished(self) -> bool:
         return self.epoch >= self._num_epochs
 
+    #: what ``epoch`` counts for this splitter (checkpoint unit tag)
+    EPOCH_UNIT = "pass"
+
+    def restore_epoch(self, epoch: int, unit: str = "pass"):
+        """Adopt a checkpointed epoch counter, converting between units
+        when the checkpoint was written by a splitter counting
+        differently (see ``TableDatasetSplitter``)."""
+        self.epoch = int(epoch)
+
 
 class TextDatasetSplitter(DatasetSplitter):
     """Shards by record line-number ranges, with optional shuffle.
@@ -105,9 +114,78 @@ class TextDatasetSplitter(DatasetSplitter):
         return list(self._shards)
 
 
-class TableDatasetSplitter(TextDatasetSplitter):
-    """Table (row-range) splitter; identical math, kept for API parity
-    (reference ``TableDatasetSplitter`` :144)."""
+class TableDatasetSplitter(DatasetSplitter):
+    """Row-range splitter for table storage (Hive/BigQuery-style) with
+    huge-dataset sub-epochs.
+
+    Reference ``TableDatasetSplitter`` :144: when a table has more shards
+    than ``max_shard_count``, each logical epoch is split into sub-epochs
+    and ``create_shards`` materializes only one sub-epoch's shard objects
+    — a trillion-row table never holds its whole shard list in master
+    memory. ``epoch`` counts sub-epochs (the unit the task manager
+    checkpoints/restores); ``logical_epoch`` is the data pass."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        dataset_size: int,
+        shard_size: int,
+        num_epochs: int = 1,
+        shuffle: bool = False,
+        seed: int = 0,
+        max_shard_count: int = 100_000,
+    ):
+        super().__init__(dataset_name, dataset_size, shard_size, num_epochs)
+        self._shuffle = shuffle
+        self._seed = seed
+        self._max_shard_count = max(1, max_shard_count)
+        shard_count = -(-dataset_size // max(1, shard_size))
+        self._subepochs = max(1, -(-shard_count // self._max_shard_count))
+        # epoch_finished() compares against sub-epoch counts
+        self._num_epochs = num_epochs * self._subepochs
+        self._shards: List[Shard] = []
+        if self._subepochs > 1:
+            logger.info(
+                "table dataset %s: %s shards split into %s sub-epochs "
+                "of <=%s shards",
+                dataset_name, shard_count, self._subepochs,
+                self._max_shard_count,
+            )
+
+    EPOCH_UNIT = "subepoch"
+
+    @property
+    def logical_epoch(self) -> int:
+        return self.epoch // self._subepochs
+
+    def restore_epoch(self, epoch: int, unit: str = "pass"):
+        """A checkpoint whose epoch counted full passes (older build, or
+        a text-splitter checkpoint) converts into sub-epochs."""
+        if unit != self.EPOCH_UNIT:
+            epoch = int(epoch) * self._subepochs
+        self.epoch = int(epoch)
+
+    def create_shards(self) -> bool:
+        if self.epoch_finished():
+            return False
+        sub = self.epoch % self._subepochs
+        rows_per_sub = self._max_shard_count * self.shard_size
+        base = sub * rows_per_sub
+        stop = min(self.dataset_size, base + rows_per_sub)
+        shards = [
+            Shard(name=self.dataset_name, start=s,
+                  end=min(s + self.shard_size, stop))
+            for s in range(base, stop, self.shard_size)
+        ]
+        if self._shuffle:
+            rng = random.Random(self._seed + self.epoch)
+            rng.shuffle(shards)
+        self._shards = shards
+        self.epoch += 1
+        return True
+
+    def get_shards(self) -> List[Shard]:
+        return list(self._shards)
 
 
 class StreamingDatasetSplitter(DatasetSplitter):

@@ -47,3 +47,55 @@ def test_factory():
         "streaming", "d", -1, 5, partition_offsets={"p": 0}
     )
     assert isinstance(s, StreamingDatasetSplitter)
+
+
+def test_table_splitter_subepochs_bound_memory():
+    """Huge table: each create_shards materializes <= max_shard_count
+    shards; the sub-epochs tile the table exactly once per logical epoch
+    (reference TableDatasetSplitter :144)."""
+    from dlrover_tpu.master.shard.dataset_splitter import TableDatasetSplitter
+
+    sp = TableDatasetSplitter(
+        "t", dataset_size=100, shard_size=10, num_epochs=1,
+        max_shard_count=4,
+    )
+    seen = []
+    rounds = 0
+    while sp.create_shards():
+        rounds += 1
+        shards = sp.get_shards()
+        assert len(shards) <= 4
+        seen += [(s.start, s.end) for s in shards]
+    assert rounds == 3  # 10 shards -> sub-epochs of 4+4+2
+    assert sorted(seen) == [(i, i + 10) for i in range(0, 100, 10)]
+    assert sp.logical_epoch == 1
+    assert sp.epoch_finished()
+
+
+def test_table_splitter_shuffle_within_subepoch():
+    from dlrover_tpu.master.shard.dataset_splitter import TableDatasetSplitter
+
+    sp = TableDatasetSplitter(
+        "t", dataset_size=1000, shard_size=10, num_epochs=1, shuffle=True,
+    )
+    assert sp.create_shards()
+    starts = [s.start for s in sp.get_shards()]
+    assert sorted(starts) == list(range(0, 1000, 10))
+    assert starts != sorted(starts)  # actually shuffled
+
+
+def test_table_splitter_restores_pass_unit_checkpoints():
+    """A checkpoint whose epoch counted full passes (older build / text
+    splitter) converts to sub-epochs on restore; same-unit restores adopt
+    verbatim."""
+    from dlrover_tpu.master.shard.dataset_splitter import TableDatasetSplitter
+
+    sp = TableDatasetSplitter(
+        "t", dataset_size=100, shard_size=10, num_epochs=3,
+        max_shard_count=4,  # -> 3 sub-epochs per pass
+    )
+    sp.restore_epoch(2, unit="pass")   # 2 full passes consumed
+    assert sp.epoch == 6 and sp.logical_epoch == 2
+    assert not sp.epoch_finished()
+    sp.restore_epoch(7, unit="subepoch")
+    assert sp.epoch == 7 and sp.logical_epoch == 2

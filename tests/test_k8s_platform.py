@@ -808,3 +808,45 @@ def test_loosely_typed_bool_strings_in_cr_spec():
     args = JobArgs.from_elasticjob_cr(cr)
     assert args.remove_exited_node is False
     assert args.cordon_fault_node is False
+
+
+def test_replica_manager_per_type_policies():
+    """SURVEY §2.4 per-type manager abstraction: worker policy relaunches
+    an OOM within budget (with a memory bump); the evaluator policy only
+    replaces platform faults and is never job-critical."""
+    from dlrover_tpu.common.constants import NodeExitReason, NodeStatus
+    from dlrover_tpu.master.node.replica_manager import (
+        EvaluatorReplicaManager,
+        WorkerReplicaManager,
+        make_replica_manager,
+    )
+
+    worker_mgr = make_replica_manager(NodeType.WORKER)
+    assert isinstance(worker_mgr, WorkerReplicaManager)
+    eval_mgr = make_replica_manager("evaluator")
+    assert isinstance(eval_mgr, EvaluatorReplicaManager)
+    # unknown types fall back to the worker policy
+    assert isinstance(make_replica_manager("databot"), WorkerReplicaManager)
+
+    def dead(node_type, reason):
+        n = Node(node_type, 0, max_relaunch_count=3)
+        n.update_status(NodeStatus.FAILED)
+        n.exit_reason = reason
+        return n
+
+    oom = dead(NodeType.WORKER, NodeExitReason.OOM)
+    assert worker_mgr.should_relaunch(oom) is True
+    new = oom.get_relaunch_node_info(1)
+    worker_mgr.prepare_replacement(oom, new)
+    assert new.config_resource.memory_mb > 0  # OOM bump applied
+    assert new.relaunch_count == 1            # budget consumed
+
+    # evaluator: crash = no retry; preemption = replace, budget-free
+    assert eval_mgr.should_relaunch(
+        dead("evaluator", NodeExitReason.OOM)) is False
+    pre = dead("evaluator", NodeExitReason.PREEMPTED)
+    assert eval_mgr.should_relaunch(pre) is True
+    new = pre.get_relaunch_node_info(1)
+    eval_mgr.prepare_replacement(pre, new)
+    assert new.relaunch_count == 0
+    assert eval_mgr.is_critical(pre) is False

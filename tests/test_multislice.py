@@ -146,3 +146,37 @@ def test_rendezvous_world_is_slice_contiguous():
         "slice-a", "slice-a", "slice-b", "slice-b",
     ]
     assert [m.node_id for m in ordered] == [3, 1, 2, 0]
+
+
+def test_multislice_train_loss_and_grads_match_single_device():
+    """Numerical parity over the hybrid mesh (r3 weak #6: multislice was
+    only device-order asserts + dryrun): loss AND grads of the sharded
+    model on a 2-slice dp x tp mesh equal the single-device model."""
+    import jax.numpy as jnp
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel import named_shardings
+
+    cfg = llama.LlamaConfig.tiny(n_layers=2, n_heads=4, n_kv_heads=2)
+    params = llama.init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (8, 16), 0, cfg.vocab_size)
+    ref = float(llama.loss_fn(params, toks, cfg))
+    g_ref = jax.grad(lambda p: llama.loss_fn(p, toks, cfg))(params)
+
+    mesh = build_mesh(
+        MeshConfig(dp=4, fsdp=1, ep=1, sp=1, tp=2),
+        devices=jax.devices()[:8], n_slices=2,
+    )
+    sharded = jax.device_put(
+        params, named_shardings(mesh, llama.param_specs(cfg))
+    )
+    got = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh))(sharded, toks))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    g = jax.jit(
+        jax.grad(lambda p: llama.loss_fn(p, toks, cfg, mesh)))(sharded)
+    err = max(
+        float(jnp.max(jnp.abs(a - b)))
+        for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_ref))
+    )
+    assert err < 1e-4, err
