@@ -282,7 +282,7 @@ class PodScaler(Scaler):
 
     def _inject_resources(self, pod_spec: Dict, node: Node):
         """Node-specific resource overrides (e.g. the OOM-relaunch memory
-        bump, dist_job_manager._bump_oom_memory) take precedence over the
+        bump, replica_manager.ReplicaManager._bump_oom_memory) take precedence over the
         template's requests — reference pod_scaler.py per-node resources.
         Applied to the main container only: bumping a sidecar's request
         too would inflate the pod's aggregate and risk unschedulability."""

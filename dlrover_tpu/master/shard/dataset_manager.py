@@ -49,10 +49,13 @@ class DatasetShardCheckpoint:
     doing_meta: List = field(default_factory=list)
     task_id_seq: int = 0
     #: what ``epoch`` counts — "pass" (default; full data passes) or a
-    #: splitter-specific unit like the table splitter's "subepoch".
-    #: Restores convert when the units disagree (e.g. a checkpoint from a
-    #: build whose table epoch meant full passes).
+    #: splitter-specific unit like the table splitter's "subepoch" — plus
+    #: the writer's sub-units-per-pass factor. Restores convert when the
+    #: unit or factor disagrees (older build, table resized, shard-count
+    #: cap changed), rounding down to completed passes so data is re-read
+    #: rather than skipped.
     epoch_unit: str = "pass"
+    epoch_factor: int = 1
 
     def to_json(self) -> str:
         return json.dumps(
@@ -66,6 +69,7 @@ class DatasetShardCheckpoint:
                 "doing_meta": self.doing_meta,
                 "task_id_seq": self.task_id_seq,
                 "epoch_unit": self.epoch_unit,
+                "epoch_factor": self.epoch_factor,
             }
         )
 
@@ -82,6 +86,7 @@ class DatasetShardCheckpoint:
             doing_meta=d.get("doing_meta", []),
             task_id_seq=d.get("task_id_seq", 0),
             epoch_unit=d.get("epoch_unit", "pass"),
+            epoch_factor=d.get("epoch_factor", 1),
         )
 
 
@@ -204,6 +209,9 @@ class BatchDatasetManager:
                 ],
                 task_id_seq=self._task_id_seq,
                 epoch_unit=getattr(self._splitter, "EPOCH_UNIT", "pass"),
+                epoch_factor=int(
+                    getattr(self._splitter, "EPOCH_FACTOR", 1)
+                ),
             )
 
     def restore_checkpoint(
@@ -216,7 +224,9 @@ class BatchDatasetManager:
         exactly-once; the timeout scan requeues any whose worker truly
         died."""
         with self._lock:
-            self._splitter.restore_epoch(ckpt.epoch, ckpt.epoch_unit)
+            self._splitter.restore_epoch(
+                ckpt.epoch, ckpt.epoch_unit, ckpt.epoch_factor
+            )
             self._todo.clear()
             self._doing.clear()
             self._completed_records = ckpt.completed_records

@@ -61,11 +61,16 @@ class DatasetSplitter(ABC):
 
     #: what ``epoch`` counts for this splitter (checkpoint unit tag)
     EPOCH_UNIT = "pass"
+    #: sub-units per data pass the writer used (1 for pass-counting)
+    EPOCH_FACTOR = 1
 
-    def restore_epoch(self, epoch: int, unit: str = "pass"):
-        """Adopt a checkpointed epoch counter, converting between units
-        when the checkpoint was written by a splitter counting
-        differently (see ``TableDatasetSplitter``)."""
+    def restore_epoch(self, epoch: int, unit: str = "pass", factor: int = 1):
+        """Adopt a checkpointed epoch counter, converting between units.
+        A sub-epoch-counted checkpoint converts to completed passes
+        (rounding DOWN: the partial pass re-reads — at-least-once, never
+        silently skipped)."""
+        if unit == "subepoch":
+            epoch = int(epoch) // max(1, int(factor))
         self.epoch = int(epoch)
 
 
@@ -155,15 +160,25 @@ class TableDatasetSplitter(DatasetSplitter):
     EPOCH_UNIT = "subepoch"
 
     @property
+    def EPOCH_FACTOR(self) -> int:  # noqa: N802 — checkpoint metadata tag
+        return self._subepochs
+
+    @property
     def logical_epoch(self) -> int:
         return self.epoch // self._subepochs
 
-    def restore_epoch(self, epoch: int, unit: str = "pass"):
-        """A checkpoint whose epoch counted full passes (older build, or
-        a text-splitter checkpoint) converts into sub-epochs."""
+    def restore_epoch(self, epoch: int, unit: str = "pass", factor: int = 1):
+        """Unit/factor-aware adoption: pass-counted checkpoints multiply
+        into sub-epochs; sub-epoch checkpoints written under a DIFFERENT
+        factor (table grew, max_shard_count changed) convert through
+        completed passes, rounding DOWN so the partial pass re-reads
+        (at-least-once) instead of being skipped."""
+        epoch = int(epoch)
         if unit != self.EPOCH_UNIT:
-            epoch = int(epoch) * self._subepochs
-        self.epoch = int(epoch)
+            epoch = epoch * self._subepochs
+        elif int(factor) != self._subepochs:
+            epoch = (epoch // max(1, int(factor))) * self._subepochs
+        self.epoch = epoch
 
     def create_shards(self) -> bool:
         if self.epoch_finished():

@@ -73,6 +73,11 @@ void TimerManager::EndExecute(uint64_t token, bool error) {
   s.total_us += dur;
   if ((uint64_t)dur > s.max_us) s.max_us = dur;
   if (error) s.errors++;
+  int bucket = 0;
+  while (bucket < kLatencyBuckets - 1 &&
+         dur > (kLatencyBase << bucket))
+    bucket++;
+  s.lat_buckets[bucket]++;
   if (!error && s.flops > 0 && dur > 0) {
     device_flops_total_ += s.flops;
     if (peak_tflops_ > 0) {
@@ -138,6 +143,26 @@ void TimerManager::WatchLoop() {
   }
 }
 
+// Bucket-interpolated quantile in us (upper-bound linear within bucket).
+static int64_t Quantile(const ProgramStats& s, double q) {
+  if (s.count == 0) return 0;
+  uint64_t target = (uint64_t)(q * s.count);
+  if (target >= s.count) target = s.count - 1;
+  uint64_t cum = 0;
+  for (int i = 0; i < kLatencyBuckets; i++) {
+    cum += s.lat_buckets[i];
+    if (target < cum) {
+      int64_t hi = kLatencyBase << i;
+      if (i == kLatencyBuckets - 1) return (int64_t)s.max_us;
+      int64_t lo = i == 0 ? 0 : (kLatencyBase << (i - 1));
+      uint64_t in_bucket = s.lat_buckets[i];
+      uint64_t rank = target - (cum - in_bucket);
+      return lo + (hi - lo) * (int64_t)(rank + 1) / (int64_t)in_bucket;
+    }
+  }
+  return (int64_t)s.max_us;
+}
+
 static void AppendStats(
     std::ostringstream& out, const char* metric,
     const std::unordered_map<std::string, ProgramStats>& stats) {
@@ -178,6 +203,31 @@ std::string TimerManager::PrometheusText() {
   }
   AppendStats(out, "dlrover_tpu_timer_execute", exec_stats_);
   AppendStats(out, "dlrover_tpu_timer_compile", compile_stats_);
+  // Prometheus histogram + quantile gauges per program (reference:
+  // per-kernel bvar latency quantiles, common/bvar_prometheus.cc)
+  for (const auto& kv : exec_stats_) {
+    const auto& s = kv.second;
+    if (s.count == 0) continue;
+    uint64_t cum = 0;
+    for (int i = 0; i < kLatencyBuckets; i++) {
+      cum += s.lat_buckets[i];
+      out << "dlrover_tpu_timer_execute_latency_us_bucket{program=\""
+          << kv.first << "\",le=\"";
+      if (i == kLatencyBuckets - 1)
+        out << "+Inf";
+      else
+        out << (kLatencyBase << i);
+      out << "\"} " << cum << "\n";
+    }
+    out << "dlrover_tpu_timer_execute_latency_us_count{program=\""
+        << kv.first << "\"} " << s.count << "\n";
+    out << "dlrover_tpu_timer_execute_latency_us_sum{program=\""
+        << kv.first << "\"} " << s.total_us << "\n";
+    out << "dlrover_tpu_timer_execute_latency_us_p50{program=\""
+        << kv.first << "\"} " << Quantile(s, 0.50) << "\n";
+    out << "dlrover_tpu_timer_execute_latency_us_p99{program=\""
+        << kv.first << "\"} " << Quantile(s, 0.99) << "\n";
+  }
   for (const auto& kv : exec_stats_) {
     const auto& s = kv.second;
     if (s.flops <= 0 && s.bytes <= 0) continue;

@@ -28,11 +28,20 @@ struct TraceEvent {
   int64_t dur_us;
 };
 
+//: log2 latency buckets: upper bounds 64us << i (64us .. ~2.1s), last
+//: bucket is +Inf. Fixed-size so recording is a shift + increment —
+//: the reference exports brpc-bvar latency quantiles per kernel
+//: (common/bvar_prometheus.cc); these buckets power the same
+//: p50/p99 gauges plus a real Prometheus histogram series.
+constexpr int kLatencyBuckets = 16;
+constexpr int64_t kLatencyBase = 64;  // us
+
 struct ProgramStats {
   uint64_t count = 0;
   uint64_t total_us = 0;
   uint64_t max_us = 0;
   uint64_t errors = 0;
+  uint64_t lat_buckets[kLatencyBuckets] = {0};
   // Per-execution cost from the compiler's HLO cost analysis
   // (PJRT_Executable_GetCostAnalysis), attached at compile interception —
   // the TPU analogue of the reference's per-launch GEMM M/N/K extraction

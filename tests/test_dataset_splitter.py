@@ -97,5 +97,17 @@ def test_table_splitter_restores_pass_unit_checkpoints():
     sp.restore_epoch(2, unit="pass")   # 2 full passes consumed
     assert sp.epoch == 6 and sp.logical_epoch == 2
     assert not sp.epoch_finished()
-    sp.restore_epoch(7, unit="subepoch")
+    # same unit, same factor: adopt verbatim
+    sp.restore_epoch(7, unit="subepoch", factor=3)
     assert sp.epoch == 7 and sp.logical_epoch == 2
+    # same unit, DIFFERENT factor (table resized / cap changed): convert
+    # through completed passes, rounding down — re-read, never skip
+    sp.restore_epoch(7, unit="subepoch", factor=4)  # 1 pass + 3/4
+    assert sp.epoch == 3 and sp.logical_epoch == 1
+    # and a pass-counting splitter restoring a subepoch checkpoint
+    from dlrover_tpu.master.shard.dataset_splitter import TextDatasetSplitter
+
+    txt = TextDatasetSplitter("t", dataset_size=100, shard_size=10,
+                              num_epochs=3)
+    txt.restore_epoch(7, unit="subepoch", factor=3)
+    assert txt.epoch == 2  # completed passes only

@@ -361,3 +361,30 @@ def test_mfu_straggler_ranking_feeds_diagnosis():
         json.dumps({"hang": False, "mfu": 0.37, "node_id": 5})
     )
     assert rec.mfu == 0.37
+
+
+def test_latency_histogram_and_quantiles(native):
+    """Per-program latency histogram + p50/p99 gauges (reference bvar
+    latency quantiles, common/bvar_prometheus.cc): the mock's ~20ms
+    executions land in the (16384, 32768] bucket and the quantiles
+    interpolate inside it."""
+    port = find_free_port()
+    r = run_harness(native, port, execs=5, settle_ms=400)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert ('dlrover_tpu_timer_execute_latency_us_bucket'
+            '{program="mock_program",le="32768"} 5') in out
+    assert ('dlrover_tpu_timer_execute_latency_us_bucket'
+            '{program="mock_program",le="16384"} 0') in out
+    assert ('dlrover_tpu_timer_execute_latency_us_bucket'
+            '{program="mock_program",le="+Inf"} 5') in out
+
+    def gauge(name):
+        return float(next(
+            l for l in out.splitlines()
+            if l.startswith(f"dlrover_tpu_timer_execute_latency_us_{name}")
+        ).rsplit(" ", 1)[1])
+
+    assert gauge("count") == 5
+    assert 16384 < gauge("p50") <= 32768
+    assert gauge("p50") <= gauge("p99") <= 32768
