@@ -48,3 +48,35 @@ def test_dict_payload():
     out = deserialize(serialize(m))
     assert out.world["1"] == [1, 4, "ip2", 2]
     assert out.completed
+
+
+def test_round4_wire_fields_roundtrip():
+    """Round-4 additions survive the wire: model shape on
+    ModelInfoReport, per-host metric feed on RuntimeSample, hot_hosts on
+    BrainResourcePlan, weight decay on ParallelConfig."""
+    from dlrover_tpu.brain.messages import BrainResourcePlan, RuntimeSample
+    from dlrover_tpu.common import messages as msg
+
+    m = msg.ModelInfoReport(
+        node_id=3, param_count=7, seq_len=2048, hidden_dim=4096,
+        n_layers=32, n_heads=32, remat=False,
+    )
+    out = deserialize(serialize(m))
+    assert out.seq_len == 2048 and out.remat is False
+
+    s = RuntimeSample(
+        worker_num=4, speed_steps_per_sec=2.5,
+        host_metrics={"h0": [90.0, 8000.0, 0.3]},
+    )
+    out = deserialize(serialize(s))
+    assert out.host_metrics == {"h0": [90.0, 8000.0, 0.3]}
+
+    p = BrainResourcePlan(hot_hosts=["h3", "h7"], comment="hot")
+    out = deserialize(serialize(p))
+    assert out.hot_hosts == ["h3", "h7"] and not out.empty()
+
+    c = msg.ParallelConfig(
+        optimizer_learning_rate=1e-3, optimizer_weight_decay=0.05,
+    )
+    out = deserialize(serialize(c))
+    assert out.optimizer_weight_decay == 0.05
