@@ -368,6 +368,11 @@ def main():
         "tokens_per_step": micro * seq,
         "step_time_s": round(step_s, 4),
         "achieved_tflops": round(achieved / 1e12, 2),
+        "sweep": [
+            {"name": n, "model_tflops": round(r / 1e12, 2),
+             "step_s": round(t, 4)}
+            for r, n, _, _, t in results
+        ],
         "ckpt": ckpt,
     }
     result = {
