@@ -306,12 +306,6 @@ def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
         vocab=cfg.vocab_size,
         n_layers=cfg.n_layers,
     )
-    if mc.pp > 1 and mc.sp > 1 and cfg.attn_impl == "ulysses":
-        raise ValueError(
-            "pp x sp composes via ring attention only (the pp stages run "
-            "ring inside their own manual region; ulysses' all_to_all "
-            "layout is not plumbed there) — set attn_impl to 'auto'/'ring'"
-        )
     if mc.pp > 1 and mc.sp > 1 and cfg.pp_schedule == "1f1b":
         raise ValueError(
             "pp x sp requires pp_schedule='gpipe': 1f1b gates each tick's "
@@ -440,8 +434,10 @@ def _pp_loss_impl(
       boundary activations live per stage.
 
     **sp composition**: with sp>1 the stages run manual over {pp, sp};
-    the sequence axis is sharded and attention is ring attention on the
-    sp axis directly (it is written to be called inside a manual region).
+    the sequence axis is sharded and attention runs on the sp axis
+    directly — ring (ppermute K/V hops) or ulysses (all-to-all head
+    scatter) per ``attn_impl``; both are written to be called inside a
+    manual region.
     """
     pp_size = mesh.shape[PP]
     sp_size = mesh.shape.get(SP, 1)
@@ -501,8 +497,12 @@ def _stage_layer_fn(cfg: LlamaConfig, mb: int, s_local: int, sp_size: int):
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
     if sp_size > 1:
         offset = lax.axis_index(SP) * s_local
+        if cfg.attn_impl == "ulysses":
+            from dlrover_tpu.ops.ulysses import ulysses_attention as sp_attn
+        else:
+            sp_attn = ring_attention
         attn_fn = functools.partial(
-            ring_attention, axis_name=SP, causal=True,
+            sp_attn, axis_name=SP, causal=True,
             block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
         )
     else:

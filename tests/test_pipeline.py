@@ -163,10 +163,6 @@ def test_pp_validation_errors(params, toks):
         llama.loss_fn(params, toks, cfg, mesh)
     mc = MeshConfig(dp=1, pp=2, fsdp=1, sp=2, tp=1).resolve(4)
     mesh_sp = build_mesh(mc, devices=jax.devices()[:4])
-    # pp x sp under ulysses is rejected (ring-only composition)
-    cfg_u = llama.LlamaConfig.tiny(n_layers=4, attn_impl="ulysses")
-    with pytest.raises(ValueError, match="ring"):
-        llama.loss_fn(params, toks, cfg_u, mesh_sp)
     # pp x sp under 1f1b is rejected (collectives in divergent cond)
     cfg_1 = llama.LlamaConfig.tiny(n_layers=4, pp_schedule="1f1b")
     with pytest.raises(ValueError, match="gpipe"):
@@ -273,3 +269,23 @@ def test_1f1b_trainer_step_converges(toks):
         state, loss = tr.step(state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_gpipe_composes_with_sp_ulysses(params, toks):
+    """pp x sp also works under ulysses attention (the all-to-alls run on
+    the manual sp axis exactly like ring's ppermutes)."""
+    cfg = llama.LlamaConfig.tiny(n_layers=4, attn_impl="ulysses")
+    base = llama.LlamaConfig.tiny(n_layers=4)
+    ref = float(llama.loss_fn(params, toks, base))
+    g_ref = jax.grad(lambda p: llama.loss_fn(p, toks, base))(params)
+    mc = MeshConfig(dp=1, pp=2, fsdp=1, sp=2, tp=1).resolve(4)
+    mesh = build_mesh(mc, devices=jax.devices()[:4])
+    sharded = jax.device_put(
+        params, named_shardings(mesh, llama.param_specs(cfg, pp=2))
+    )
+    got = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh))(sharded, toks))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    g = jax.jit(
+        jax.grad(lambda p: llama.loss_fn(p, toks, cfg, mesh)))(sharded)
+    assert _grad_err(g, g_ref) < 1e-3
