@@ -206,3 +206,52 @@ def test_prefetch_pytree_sharding():
     out = list(prefetch_to_device(iter(batches), size=1, sharding=sh))
     assert out[0]["x"].sharding == sh["x"]
     assert isinstance(out[0]["y"], jax.Array)
+
+
+def test_runtime_lr_chain_end_to_end(local_master, tmp_path, monkeypatch):
+    """Round 4: the hyperparam refinement's lr lands in the trainer —
+    master pushes optimizer fields, the tuner writes the file, and the
+    trainer's poll applies the update multiplier without recompiling."""
+    import jax
+    import jax.numpy as jnp
+
+    from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
+    from dlrover_tpu.master.resource.optimizer import LocalOptimizer
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    client = MasterClient(f"127.0.0.1:{local_master.port}", node_id=0)
+    client.report_node_address("127.0.0.1")
+
+    auto = JobAutoScaler(
+        optimizer=LocalOptimizer(), scaler=_NoopScaler(),
+        speed_monitor=local_master.speed_monitor,
+    )
+    auto._push_paral_config({
+        "dataloader_batch_size": 4,
+        "optimizer_learning_rate": 2e-2,  # 2x the trainer's base lr
+        "grad_accum_steps": 1,
+    })
+    path = str(tmp_path / "paral.json")
+    tuner = ParalConfigTuner(client, "j", 0, path=path, interval=3600)
+    assert tuner.poll_once() is True
+    monkeypatch.setenv(PARAL_CONFIG_PATH_ENV, path)
+
+    cfg = llama.LlamaConfig.tiny()
+    mc = MeshConfig(dp=1, fsdp=1, sp=1, tp=1)
+    mesh = build_mesh(mc, devices=jax.devices()[:1])
+    specs = llama.param_specs(cfg)
+    params = jax.device_put(
+        llama.init_params(cfg, jax.random.key(0)),
+        named_shardings(mesh, specs),
+    )
+    tc = TrainConfig(global_batch_size=4, micro_batch_size=4,
+                     learning_rate=1e-2, warmup_steps=0, total_steps=10)
+    tr = ElasticTrainer(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh), specs, mesh, mc, tc,
+        worker_ctx=object(),  # non-None enables the per-step poll
+    )
+    state = tr.init_state(params)
+    state = tr.poll_runtime_config(state, every_steps=1)
+    assert float(state["lr_scale"]) == 2.0
