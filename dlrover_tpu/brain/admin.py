@@ -1,0 +1,62 @@
+"""Brain admin CLI: read/write runtime config over the brain's RPC port.
+
+The operability surface for the runtime-mutable knobs — master tunables
+(``common/global_context.py`` keys) and brain algorithm chains
+(``brain.chain.<stage>``) — without touching the database or redeploying:
+
+    python -m dlrover_tpu.brain.admin --addr brain:50051 \
+        set brain.chain.job_stage_running \
+        "throughput_fit_scaling,goodput_growth_gate"
+
+    python -m dlrover_tpu.brain.admin --addr brain:50051 get [--job llama]
+    python -m dlrover_tpu.brain.admin list-algorithms
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dlrover_tpu.brain import messages as bmsg
+from dlrover_tpu.rpc.transport import RpcClient
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("dlrover_tpu brain admin")
+    p.add_argument("--addr", default="127.0.0.1:50051")
+    p.add_argument("--job", default="", help="'' = cluster-wide default")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("set", help="write a config override")
+    sp.add_argument("key")
+    sp.add_argument("value")
+    sub.add_parser("get", help="read effective config for --job")
+    sub.add_parser("list-algorithms", help="registered brain algorithms")
+    args = p.parse_args(argv)
+
+    if args.cmd == "list-algorithms":
+        from dlrover_tpu.brain.optimizer import DEFAULT_CHAINS, algorithm_names
+
+        print(json.dumps(
+            {"algorithms": algorithm_names(), "default_chains": DEFAULT_CHAINS},
+            indent=2,
+        ))
+        return 0
+
+    client = RpcClient(args.addr, timeout=10.0)
+    if args.cmd == "set":
+        resp = client.report(bmsg.BrainConfigUpdate(
+            job_name=args.job, key=args.key, value=args.value,
+        ))
+        if not resp.success:
+            print(f"rejected: {resp.reason}", file=sys.stderr)
+            return 1
+        print(f"ok: {args.job or '<cluster>'}[{args.key}] = {args.value!r}")
+        return 0
+    resp = client.get(bmsg.BrainConfigRequest(job_name=args.job))
+    print(json.dumps(resp.values, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

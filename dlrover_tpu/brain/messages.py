@@ -104,6 +104,17 @@ class BrainOptimizeResponse:
 
 
 @message
+class BrainConfigUpdate:
+    """report: admin write of a master-config override (e.g. a
+    ``brain.chain.<stage>`` algorithm chain) — the runtime-mutable knob
+    path; ``job_name=''`` sets the cluster-wide default."""
+
+    job_name: str = ""
+    key: str = ""
+    value: str = ""
+
+
+@message
 class BrainConfigRequest:
     """get: master tunable overrides for a job (consumed by
     ``common/global_context.py``; the reference's

@@ -60,6 +60,17 @@ class BrainServicer:
             if request.samples:
                 self.store.append_samples(request.job_uuid, request.samples)
             return SimpleResponse()
+        if isinstance(request, bmsg.BrainConfigUpdate):
+            if not request.key:
+                return SimpleResponse(success=False, reason="empty key")
+            self.store.set_master_config(
+                request.key, request.value, request.job_name
+            )
+            logger.info(
+                "config update: %s[%s] = %r",
+                request.job_name or "<cluster>", request.key, request.value,
+            )
+            return SimpleResponse()
         if isinstance(request, bmsg.BrainJobEndReport):
             self.store.finish_job(
                 request.job_uuid,

@@ -543,3 +543,39 @@ def test_hot_hosts_flow_to_autoscaler_cordon():
         assert merged.hot_hosts == ["a", "b"]
     finally:
         server.stop()
+
+
+def test_admin_cli_rewires_chain_over_rpc(capsys):
+    """Operability path: the admin CLI writes a chain override through
+    the brain's RPC port, and the next optimize uses it."""
+    from dlrover_tpu.brain.admin import main as admin_main
+
+    server = BrainServer(port=0)
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        server.store.upsert_job("j1", "train")
+        server.store.append_samples(
+            "j1", [sample(n, 9.9 * n / (1 + 0.01 * n)) for n in (1, 2, 4)]
+        )
+        opt = BrainOptimizer(server.store)
+        assert opt.optimize(req(STAGE_RUNNING, cur=4)).worker_count == 8
+
+        assert admin_main([
+            "--addr", addr, "set",
+            "brain.chain.job_stage_running", "speed_anomaly_guard",
+        ]) == 0
+        assert opt.optimize(req(STAGE_RUNNING, cur=4)).worker_count == 0
+
+        assert admin_main(["--addr", addr, "get"]) == 0
+        out = capsys.readouterr().out
+        assert "speed_anomaly_guard" in out
+
+        assert admin_main(["list-algorithms"]) == 0
+        out = capsys.readouterr().out
+        assert "goodput_growth_gate" in out
+
+        # empty key rejected
+        assert admin_main(["--addr", addr, "set", "", "x"]) == 1
+    finally:
+        server.stop()
