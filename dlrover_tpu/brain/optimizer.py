@@ -249,6 +249,10 @@ def throughput_fit_scaling(store, req, plan):
         _note(plan, "no fit")
         return
     a, b = fit
+    # record the fit before any early return: a hold decision must also
+    # stop sample_step_up from blindly stepping +unit (the marker is what
+    # tells it the fit producer owns the decision)
+    plan.paral_config.setdefault("_fit", (a, b))
     current = req.current_workers or req.min_workers or 1
     best = current
     unit = max(1, req.node_unit)
@@ -265,7 +269,6 @@ def throughput_fit_scaling(store, req, plan):
         _note(plan, f"hold at {current}")
         return
     plan.worker_count = _round_to_unit(best, req)
-    plan.paral_config.setdefault("_fit", (a, b))
     _note(
         plan,
         f"fit a={a:.3g} b={b:.3g}: {current}->{best} "
@@ -486,6 +489,7 @@ class BrainOptimizer:
                                  name)
         plan.paral_config.pop("_fit", None)
         plan.paral_config.pop("_fit_attempted", None)
+        plan.paral_config.pop("speed_anomaly", None)
         return plan
 
 

@@ -210,8 +210,12 @@ class DistributedJobMaster:
                 restored,
                 self.speed_monitor.completed_global_step,
             )
-            # the gap while no master was serving is downtime
-            self.speed_monitor.mark_downtime_start()
+            # the gap while no master was serving is downtime — backdated
+            # to the old master's last ledger snapshot, so the death→
+            # relaunch window is counted even when the previous bracket
+            # was closed (downtime_start == 0 in the snapshot)
+            snap_ts = float((speed_state or {}).get("snapshot_time", 0.0))
+            self.speed_monitor.mark_downtime_start(ts=snap_ts or None)
         self._server.start()
         if isinstance(self.scaler, PodScaler):
             self.scaler.set_master_addr(self._resolve_master_addr())

@@ -107,7 +107,11 @@ class SpeedMonitor:
     def mark_downtime_end(self, ts: Optional[float] = None):
         with self._lock:
             if self._downtime_start > 0.0:
-                self._total_downtime += (ts or time.time()) - self._downtime_start
+                # clamp: downtime_start may come from the OLD master pod's
+                # clock (relaunch backdating); skew must never subtract
+                self._total_downtime += max(
+                    0.0, (ts or time.time()) - self._downtime_start
+                )
                 self._downtime_start = 0.0
                 self._downtime_events += 1
 
@@ -131,14 +135,14 @@ class SpeedMonitor:
                 return 0.0
             down = self._total_downtime
             if self._downtime_start > 0.0:
-                down += now - self._downtime_start
+                down += max(0.0, now - self._downtime_start)
             return max(0.0, min(1.0, (wall - down) / wall))
 
     def total_downtime(self) -> float:
         with self._lock:
             down = self._total_downtime
             if self._downtime_start > 0.0:
-                down += time.time() - self._downtime_start
+                down += max(0.0, time.time() - self._downtime_start)
             return down
 
     def reset_running_speed(self):
@@ -158,6 +162,9 @@ class SpeedMonitor:
                 "total_downtime": self._total_downtime,
                 "downtime_events": self._downtime_events,
                 "downtime_start": self._downtime_start,
+                # when the old master dies with no open bracket, the
+                # restore path backdates the relaunch gap to this stamp
+                "snapshot_time": time.time(),
             }
 
     def import_state(self, state: Dict):

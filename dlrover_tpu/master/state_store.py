@@ -246,6 +246,12 @@ class MasterStateManager:
     def __init__(self, backend: MasterStateBackend, job_uid: str = ""):
         self._backend = backend
         self._job_uid = job_uid
+        # last-written fingerprints: the run loop calls save_speed/
+        # save_nodes every poll, but a ConfigMap backend turns each call
+        # into an API-server PATCH — skip the write when nothing changed
+        self._last_written: Dict[str, str] = {}
+        self._speed_written_at = 0.0
+        self._nodes_written_at = 0.0
 
     @property
     def backend(self) -> MasterStateBackend:
@@ -289,11 +295,25 @@ class MasterStateManager:
     # -- speed / goodput ledger -----------------------------------------
 
     def save_speed(self, state: Dict):
+        # snapshot_time moves every export; exclude it from the dirty
+        # check so an otherwise-idle ledger doesn't rewrite each poll
+        fp = json.dumps(
+            {k: v for k, v in state.items() if k != "snapshot_time"},
+            sort_keys=True,
+        )
+        now = time.time()
+        # refresh snapshot_time at least each minute even when idle, so
+        # the relaunch-downtime backdating stays accurate to ~1 min
+        fresh = now - self._speed_written_at < 60.0
+        if self._last_written.get(self.K_SPEED) == fp and fresh:
+            return
         try:
             self._backend.set(
                 self.K_SPEED,
                 json.dumps({**state, "job_uid": self._job_uid}),
             )
+            self._last_written[self.K_SPEED] = fp
+            self._speed_written_at = now
         except Exception:
             logger.exception("speed ledger persist failed")
 
@@ -307,11 +327,21 @@ class MasterStateManager:
     # -- node registry / relaunch budgets --------------------------------
 
     def save_nodes(self, state: Dict):
+        fp = json.dumps(state, sort_keys=True, default=str)
+        now = time.time()
+        # periodic escape hatch: if the backend key was externally lost
+        # (ConfigMap deleted/recreated), an unchanged registry must still
+        # be re-persisted within a minute
+        fresh = now - self._nodes_written_at < 60.0
+        if self._last_written.get(self.K_NODES) == fp and fresh:
+            return
         try:
             self._backend.set(
                 self.K_NODES,
                 json.dumps({**state, "job_uid": self._job_uid}),
             )
+            self._last_written[self.K_NODES] = fp
+            self._nodes_written_at = now
         except Exception:
             logger.exception("node registry persist failed")
 

@@ -104,11 +104,12 @@ class LeaderLease:
     def try_acquire(self) -> bool:
         """Acquire/renew; ANY failure (including transport-level errors)
         demotes this replica — a leader that cannot renew must assume it
-        lost the lease rather than keep acting. Takeover is read-patch-
-        verify: merge-patch has no compare-and-swap, so after patching we
-        re-read and only lead if our identity stuck (two simultaneous
-        takeover attempts resolve to the last writer; the loser's verify
-        read demotes it within the same cycle)."""
+        lost the lease rather than keep acting. Takeover is true
+        compare-and-swap: a PUT replace carrying the ``resourceVersion``
+        from the read — the API server rejects a concurrent writer with
+        409 Conflict, so at most one replica's takeover lands and the
+        loser demotes in the same cycle (matching controller-runtime's
+        Lease-based election semantics)."""
         now = time.time()
         try:
             cm = self._client.get_config_map(self._name)
@@ -137,18 +138,25 @@ class LeaderLease:
                     logger.warning(
                         "taking over stale leader lease from %s", holder
                     )
-                self._client.patch_config_map(
-                    self._name,
-                    {"data": {"holder": self.identity,
-                              "renewTime": str(now)}},
-                )
-                # verify: last writer wins; everyone else demotes
-                check = self._client.get_config_map(self._name) or {}
-                won = (check.get("data") or {}).get("holder") == self.identity
-                if won and not self.is_leader:
+                meta = dict(cm.get("metadata") or {})
+                meta["name"] = self._name
+                try:
+                    self._client.replace_config_map(self._name, {
+                        "apiVersion": "v1",
+                        "kind": "ConfigMap",
+                        "metadata": meta,  # carries resourceVersion: CAS
+                        "data": {"holder": self.identity,
+                                 "renewTime": str(now)},
+                    })
+                except K8sApiError as e:
+                    if e.status != 409:
+                        raise
+                    self.is_leader = False
+                    return False  # another replica's CAS landed first
+                if not self.is_leader:
                     logger.info("leader lease held by %s", self.identity)
-                self.is_leader = won
-                return won
+                self.is_leader = True
+                return True
         except Exception as e:
             logger.warning("leader lease cycle failed (%s); demoting", e)
         self.is_leader = False
@@ -228,9 +236,27 @@ class ElasticJobController:
     def _lease_loop(self):
         # renew at a third of the lease so one missed cycle never loses it
         interval = max(1.0, self._lease._lease_secs / 3.0)
-        self._lease.try_acquire()
-        while not self._stop_evt.wait(interval):
-            self._lease.try_acquire()
+        was_leader = False
+        while True:
+            now_leader = self._lease.try_acquire()
+            if now_leader and not was_leader:
+                # events drained while follower were dropped; a fresh
+                # leader must resync everything it may have missed
+                # (controller-runtime starts reconciling only after the
+                # election for the same reason)
+                self._enqueue_all_jobs()
+            was_leader = now_leader
+            if self._stop_evt.wait(interval):
+                return
+
+    def _enqueue_all_jobs(self):
+        try:
+            for cr in self._client.list_custom_resources(ELASTICJOB_PLURAL):
+                name = cr.get("metadata", {}).get("name", "")
+                if name:
+                    self._queue.put(name)
+        except Exception:
+            logger.exception("leadership-gain resync list failed")
 
     # ------------------------------------------------------------------
     # watch → enqueue
@@ -290,13 +316,7 @@ class ElasticJobController:
 
     def _resync_loop(self):
         while not self._stop_evt.wait(self._resync):
-            try:
-                for cr in self._client.list_custom_resources(ELASTICJOB_PLURAL):
-                    name = cr.get("metadata", {}).get("name", "")
-                    if name:
-                        self._queue.put(name)
-            except Exception:
-                logger.exception("elasticjob resync list failed")
+            self._enqueue_all_jobs()
 
     def _worker_loop(self):
         while not self._stop_evt.is_set():

@@ -245,6 +245,12 @@ class K8sClient:
             "PATCH", self._cm_path(name), body=patch
         )
 
+    def replace_config_map(self, name: str, cm: Dict) -> Dict:
+        """PUT replace. When ``cm.metadata.resourceVersion`` is set the API
+        server enforces optimistic concurrency: a stale version gets 409
+        Conflict — the compare-and-swap primitive merge-patch lacks."""
+        return self._transport.request("PUT", self._cm_path(name), body=cm)
+
     # -- events -------------------------------------------------------------
 
     def create_event(self, event: Dict) -> Dict:

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# TPU tunnel watcher (r5 verdict item 1: "run the bench early and
+# repeatedly ... one wedged tunnel must not poison the process").
+#
+# Loops forever: probe the axon tunnel in a throwaway subprocess with a
+# hard timeout; the moment it answers, run the full bench (which persists
+# BENCH_TPU_LAST.json on success) and keep a copy of every successful
+# run under bench_runs/. Probes and benches are all subprocesses — a
+# wedged PJRT client dies with its process, never with the watcher.
+set -u
+cd "$(dirname "$0")/.."
+LOG=${TPU_WATCH_LOG:-/tmp/tpu_watcher.log}
+RUNS_DIR=bench_runs
+mkdir -p "$RUNS_DIR"
+
+probe() {
+    timeout "${TPU_PROBE_TIMEOUT:-240}" python - <<'EOF' >/dev/null 2>&1
+import jax
+jax.devices()
+assert jax.default_backend() == "tpu"
+EOF
+}
+
+echo "[$(date +%FT%T)] watcher up (pid $$)" >>"$LOG"
+n=0
+while true; do
+    n=$((n + 1))
+    if probe; then
+        echo "[$(date +%FT%T)] probe $n: TPU ALIVE - running bench" >>"$LOG"
+        out="$RUNS_DIR/bench_$(date +%s).json"
+        if timeout "${TPU_BENCH_TIMEOUT:-3600}" python bench.py \
+                >"$out" 2>>"$LOG"; then
+            # check the TOP-LEVEL backend: a CPU fallback embeds the
+            # cached TPU blob whose text would fool a plain grep
+            if python -c "
+import json, sys
+d = json.load(open('$out'))
+sys.exit(0 if d.get('detail', {}).get('backend') == 'tpu' else 1)
+" 2>>"$LOG"; then
+                echo "[$(date +%FT%T)] bench OK -> $out" >>"$LOG"
+                cp "$out" BENCH_TPU_FRESH.json
+                # success: slow down, but keep refreshing (a fresher
+                # number is strictly better, and the tunnel may die again)
+                sleep "${TPU_WATCH_OK_SLEEP:-1800}"
+                continue
+            fi
+            echo "[$(date +%FT%T)] bench ran but backend!=tpu" >>"$LOG"
+        else
+            echo "[$(date +%FT%T)] bench failed/timed out" >>"$LOG"
+        fi
+    else
+        echo "[$(date +%FT%T)] probe $n: tunnel down" >>"$LOG"
+    fi
+    sleep "${TPU_WATCH_SLEEP:-180}"
+done

@@ -107,6 +107,7 @@ class FakeTransport:
             obj_name = body.get("metadata", {}).get("name", "")
             if obj_name in self.configmaps:
                 raise K8sApiError(409, "AlreadyExists")
+            body.setdefault("metadata", {})["resourceVersion"] = "1"
             self.configmaps[obj_name] = body
             return body
         if method == "PATCH":
@@ -119,13 +120,35 @@ class FakeTransport:
                     data.pop(k, None)
                 else:
                     data[k] = v
+            self._bump_version(target)
             return target
+        if method == "PUT":
+            # real API-server optimistic concurrency: a PUT carrying a
+            # stale resourceVersion gets 409 Conflict
+            if name not in self.configmaps:
+                raise K8sApiError(404, "NotFound")
+            current = self.configmaps[name]
+            sent = (body.get("metadata") or {}).get("resourceVersion")
+            have = (current.get("metadata") or {}).get("resourceVersion")
+            if sent is not None and sent != have:
+                raise K8sApiError(409, "Conflict")
+            # version continues from the STORED object — an unconditional
+            # PUT must not reset it and revive older readers' CAS tokens
+            body.setdefault("metadata", {})["resourceVersion"] = have or "0"
+            self.configmaps[name] = body
+            self._bump_version(body)
+            return body
         if method == "DELETE":
             if name not in self.configmaps:
                 raise K8sApiError(404, "NotFound")
             del self.configmaps[name]
             return {}
         raise K8sApiError(405, "MethodNotAllowed")
+
+    @staticmethod
+    def _bump_version(obj: dict):
+        meta = obj.setdefault("metadata", {})
+        meta["resourceVersion"] = str(int(meta.get("resourceVersion", "0")) + 1)
 
     def _stream(self, resource: str):
         """Iterate watch lines pushed by the test until a None sentinel."""

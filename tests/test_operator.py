@@ -304,6 +304,32 @@ def test_status_conditions_track_lifecycle(ctrl):
     assert len([t for t in types if t == "Degraded"]) == 1
 
 
+def test_leader_lease_takeover_is_compare_and_swap(monkeypatch):
+    """ADVICE r4: two standbys that BOTH read the expired lease before
+    either writes must not both become leader — the PUT carries the
+    read's resourceVersion, so the second write gets 409 and demotes in
+    the same cycle."""
+    from dlrover_tpu.operator.controller import LeaderLease
+
+    client, transport = make_fake_client()
+    a = LeaderLease(client, identity="op-a", lease_secs=30)
+    assert a.try_acquire() is True
+    name = "dlrover-tpu-operator-leader"
+    transport.configmaps[name]["data"]["renewTime"] = "1.0"  # expired
+
+    # interleaving: b and c both read the expired record, then both write
+    stale_read = copy.deepcopy(transport.configmaps[name])
+    b = LeaderLease(client, identity="op-b", lease_secs=30)
+    c = LeaderLease(client, identity="op-c", lease_secs=30)
+    assert b.try_acquire() is True  # b's CAS lands first
+    monkeypatch.setattr(
+        client, "get_config_map", lambda _n: copy.deepcopy(stale_read)
+    )
+    assert c.try_acquire() is False  # c's PUT is stale -> 409 -> demote
+    assert c.is_leader is False
+    assert transport.configmaps[name]["data"]["holder"] == "op-b"
+
+
 def test_leader_lease_singleton_guard():
     """Two operator replicas on one API server: exactly one reconciles;
     when the leader releases, the standby takes over."""
