@@ -357,6 +357,49 @@ def main():
             engine.close()
             shutil.rmtree(ckpt_dir, ignore_errors=True)
 
+    # ---- interposer leg: same winner config THROUGH the native PJRT
+    # wrapper (r4 weak #4: it had only ever wrapped the mock plugin).
+    # Subprocess: plugin registration is once-per-process.
+    interposed = {}
+    if on_tpu:
+        import subprocess
+
+        env = dict(os.environ)
+        # parent's sitecustomize gate OFF so the child can register the
+        # interposer-wrapped plugin itself
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = "/root/.axon_site" + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        probe_script = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts",
+            "interposed_probe.py",
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, probe_script, model_name,
+                 str(timed_steps)],
+                capture_output=True, text=True, timeout=900, env=env,
+            )
+            line = (proc.stdout.strip().splitlines() or [""])[-1]
+            interposed = json.loads(line) if line.startswith("{") else {
+                "error": f"rc={proc.returncode}",
+                "tail": proc.stderr[-500:],
+            }
+        except subprocess.TimeoutExpired:
+            interposed = {"error": "interposed probe timed out"}
+        except (OSError, ValueError) as e:
+            interposed = {"error": f"{type(e).__name__}: {e}"}
+        if "step_time_s" in interposed:
+            interposed["overhead_pct"] = round(
+                (interposed["step_time_s"] - step_s) / step_s * 100, 2
+            )
+            gauge = (interposed.get("interposer_metrics") or {}).get("mfu")
+            if gauge is not None:
+                interposed["gauge_vs_computed_mfu"] = round(
+                    gauge - interposed.get("computed_mfu", 0.0), 4
+                )
+
     detail = {
         "backend": jax.default_backend(),
         "device_kind": getattr(dev, "device_kind", "?"),
@@ -374,6 +417,7 @@ def main():
             for r, n, _, _, t in results
         ],
         "ckpt": ckpt,
+        **({"interposer": interposed} if interposed else {}),
     }
     result = {
         "metric": "train_step_mfu",

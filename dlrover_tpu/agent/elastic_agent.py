@@ -383,6 +383,10 @@ class ElasticAgent:
                 NodeEnv.RESTART_COUNT: str(self._restart_count),
                 "DLROVER_TPU_ACCELERATOR": self._config.accelerator,
                 "DLROVER_TPU_LOCAL_RANK": str(local_rank),
+                # distinct TPU slices in the seated world: training code
+                # sizes the multislice mesh's DCN axis from this, so a
+                # slice-count resize flows through re-rendezvous
+                "DLROVER_TPU_NUM_SLICES": str(world.n_slices),
                 # workers install a SIGUSR2 faulthandler writing here; the
                 # agent's HangDumper signals + collects on a detected hang
                 "DLROVER_TPU_STACK_DIR": os.path.join(self._log_dir, "hang"),

@@ -38,6 +38,16 @@ class CommWorld:
     coordinator_addr: str = ""
     members: Dict[int, Tuple[int, int, str, int]] = field(default_factory=dict)
     # members: node_rank -> (node_id, local_world_size, ip, port)
+    slice_names: Dict[int, str] = field(default_factory=dict)
+    # slice_names: node_rank -> TPU slice the node belongs to ("" if N/A)
+
+    @property
+    def n_slices(self) -> int:
+        """Distinct TPU slices in the seated world (>=1). Drives the DCN
+        axis of the multislice mesh — slice-count elasticity means this
+        changes across re-rendezvous."""
+        names = {s for s in self.slice_names.values() if s}
+        return max(len(names), 1)
 
 
 class MasterRendezvousHandler:
@@ -102,8 +112,13 @@ class MasterRendezvousHandler:
     def _build_comm_world(self, resp) -> CommWorld:
         members: Dict[int, Tuple[int, int, str, int]] = {}
         for rank_str, info in resp.world.items():
-            node_id, local_ws, ip, port = info
+            node_id, local_ws, ip, port = info[:4]
             members[int(rank_str)] = (node_id, local_ws, ip, port)
+        slice_names = {
+            int(rank): name or ""
+            for rank, name in (getattr(resp, "slice_names", None)
+                               or {}).items()
+        }
         my_rank = -1
         for rank in sorted(members):
             if members[rank][0] == self._client.node_id:
@@ -122,6 +137,7 @@ class MasterRendezvousHandler:
             process_id_base=process_id_base,
             coordinator_addr=resp.coordinator_addr,
             members=members,
+            slice_names=slice_names,
         )
         logger.info(
             "node %s: rendezvous %s round %s -> rank %s/%s, coordinator %s",

@@ -116,5 +116,5 @@ class Checkpointer:
     def committed_step(self) -> int:
         return self._engine.committed_step()
 
-    def close(self):
-        self._engine.close()
+    def close(self, unlink_shm: bool = False):
+        self._engine.close(unlink_shm=unlink_shm)

@@ -438,6 +438,7 @@ class CheckpointEngine:
                 shard_info=shard_info,
                 world_size=jax.process_count(),
                 process_id=self.process_id,
+                ckpt_dir=os.path.abspath(self.ckpt_dir),
             )
         finally:
             if lock is not None:
@@ -514,6 +515,17 @@ class CheckpointEngine:
         import jax
 
         meta = self._shm.read_meta()
+        if (
+            meta is not None and meta.ckpt_dir
+            and meta.ckpt_dir != os.path.abspath(self.ckpt_dir)
+        ):
+            # a different job's Checkpointer (same shm key: default job
+            # name) staged this segment — it is not ours to restore
+            logger.info(
+                "staged shm belongs to %s (this engine: %s); ignoring",
+                meta.ckpt_dir, os.path.abspath(self.ckpt_dir),
+            )
+            meta = None
         step = -1
         if meta is not None and meta.world_size == jax.process_count():
             step = meta.step
@@ -720,7 +732,11 @@ class CheckpointEngine:
         except (FileNotFoundError, ValueError):
             return -1
 
-    def close(self):
+    def close(self, unlink_shm: bool = False):
+        """``unlink_shm=True`` also removes the shm segment — for
+        short-lived tools (benches, dryruns) whose staged state must not
+        outlive them; training processes keep the segment so the agent's
+        saver can ship it after a crash."""
         try:
             self.wait_staging(timeout=300)
         except Exception as e:
@@ -729,7 +745,7 @@ class CheckpointEngine:
             self._event_queue.close()
         if self._shm_lock is not None:
             self._shm_lock.close()
-        self._shm.close()
+        self._shm.close(unlink=unlink_shm)
 
 
 def _place_like(t_leaf, full: np.ndarray):

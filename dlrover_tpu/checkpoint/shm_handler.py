@@ -112,6 +112,10 @@ class CheckpointMeta:
     world_size: int = 1
     process_id: int = 0
     total_bytes: int = 0
+    # which checkpoint DIRECTORY the staged state belongs to: shm names
+    # key on (job, node, process), so two Checkpointers with the default
+    # job name but different directories would otherwise cross-restore
+    ckpt_dir: str = ""
 
     def to_json(self) -> str:
         return json.dumps(
@@ -123,6 +127,7 @@ class CheckpointMeta:
                 "world_size": self.world_size,
                 "process_id": self.process_id,
                 "total_bytes": self.total_bytes,
+                "ckpt_dir": self.ckpt_dir,
             }
         )
 
@@ -137,6 +142,7 @@ class CheckpointMeta:
             world_size=d.get("world_size", 1),
             process_id=d.get("process_id", 0),
             total_bytes=d.get("total_bytes", 0),
+            ckpt_dir=d.get("ckpt_dir", ""),
         )
 
 
@@ -269,6 +275,7 @@ class SharedMemoryHandler:
         shard_info: Optional[Dict[str, Tuple[Tuple[int, ...], Tuple]]] = None,
         world_size: int = 1,
         process_id: int = 0,
+        ckpt_dir: str = "",
     ):
         """Copy leaves into shm and publish the header."""
         total = sum(int(a.nbytes) for _, a in named_leaves)
@@ -307,6 +314,7 @@ class SharedMemoryHandler:
             world_size=world_size,
             process_id=process_id,
             total_bytes=offset - HEADER_SPACE,
+            ckpt_dir=ckpt_dir,
         )
         header = meta.to_json().encode()
         if _LEN_SIZE + len(header) > HEADER_SPACE:

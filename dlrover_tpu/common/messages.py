@@ -72,6 +72,10 @@ class CommWorldResponse:
     world: Dict = field(default_factory=dict)
     coordinator_addr: str = ""
     completed: bool = False
+    # node_rank -> TPU slice name; a SEPARATE field (not a 5th world
+    # element) so agents still running the 4-tuple unpack survive a
+    # version-skewed master relaunch (serde drops unknown fields)
+    slice_names: Dict = field(default_factory=dict)
 
 
 @message

@@ -165,12 +165,20 @@ class MasterServicer:
             str(rank): [m.node_id, m.process_num, m.node_ip, m.node_port]
             for rank, m in world.items()
         }
+        # slice names ride a separate field, so agents can size the DCN
+        # axis of a multislice mesh from the live world (slice-count
+        # elasticity) while old agents' 4-tuple unpack keeps working
+        slice_names = {
+            str(rank): getattr(m, "slice_name", "") or ""
+            for rank, m in world.items()
+        }
         return msg.CommWorldResponse(
             rdzv_round=rdzv_round,
             group=group,
             world=wire_world,
             coordinator_addr=coord,
             completed=bool(world),
+            slice_names=slice_names,
         )
 
     def _num_nodes_waiting(self, request: msg.NumNodesWaitingRequest):

@@ -42,7 +42,7 @@ from dlrover_tpu.ops import (
     rms_norm,
     rope_frequencies,
 )
-from dlrover_tpu.parallel.mesh import BATCH_AXES, FSDP, PP, SP, TP
+from dlrover_tpu.parallel.mesh import BATCH_AXES, DP, EP, FSDP, PP, SP, TP
 
 Params = Dict[str, Any]
 
@@ -82,6 +82,12 @@ class LlamaConfig:
     # activations live per stage — the Megatron default the reference's
     # checkpoint layer assumes)
     pp_schedule: str = "gpipe"
+    # virtual pipeline stages per rank (interleaved 1F1B). v>1 cuts the
+    # pipeline bubble by a factor v: the model is split into pp*v chunks,
+    # chunk c on rank c%pp, and the static schedule tables interleave
+    # chunks inside warmup/cooldown (parallel/pp_schedule.py; reference
+    # parity: megatron_dist_ckpt.py:262,489 virtual-stage checkpoints)
+    pp_virtual_stages: int = 1
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "mlp"):
@@ -91,6 +97,13 @@ class LlamaConfig:
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"pp_schedule={self.pp_schedule!r}: expected 'gpipe' or '1f1b'"
+            )
+        if self.pp_virtual_stages < 1:
+            raise ValueError("pp_virtual_stages must be >= 1")
+        if self.pp_virtual_stages > 1 and self.pp_schedule != "1f1b":
+            raise ValueError(
+                "pp_virtual_stages > 1 is the interleaved schedule; it "
+                "requires pp_schedule='1f1b'"
             )
 
     @property
@@ -314,6 +327,41 @@ def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
             "deadlock on TPU (XLA cannot partition them); gpipe's ticks "
             "are unconditional, so sp composes there"
         )
+    if (
+        cfg.pp_schedule == "1f1b" and mc.pp > 2 and mc.tp > 1
+        and mc.dp * mc.fsdp > 1
+    ):
+        # Empirical XLA limitation (r5 16/32-device stress dryruns): the
+        # cond-gated 1f1b schedules at pp>=4 combined with tp plus a
+        # second data axis hit a GSPMD partition-group CHECK crash
+        # (spmd_partitioner_util.cc:495) while compiling the fused
+        # fwd+bwd module — a hard process abort, structure-dependent.
+        # gpipe composes fine on the same meshes (unconditional ticks),
+        # as does 1f1b with tp folded into fsdp or pp<=2.
+        raise ValueError(
+            f"pp_schedule='1f1b' with pp={mc.pp}, tp={mc.tp} and "
+            f"dp*fsdp={mc.dp * mc.fsdp} crashes XLA's SPMD partitioner "
+            "(grouped-collective CHECK). Use pp_schedule='gpipe' for "
+            "this mesh, or drop tp (shard those dims over fsdp instead)"
+        )
+    v = cfg.pp_virtual_stages
+    if v > 1 and mc.pp > 1 and mc.sp > 1:
+        raise ValueError(
+            "interleaved 1f1b (pp_virtual_stages > 1) does not compose "
+            "with sp yet; use plain gpipe for pp x sp long-context runs"
+        )
+    if v > 1 and mc.pp > 1:
+        if cfg.n_layers % (mc.pp * v):
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by pp*virtual_"
+                f"stages={mc.pp * v} (interleaved 1f1b chunking)"
+            )
+        n_micro = cfg.pp_microbatches or mc.pp
+        if n_micro % mc.pp:
+            raise ValueError(
+                f"interleaved 1f1b needs pp_microbatches % pp == 0 "
+                f"(got {n_micro} % {mc.pp})"
+            )
 
 
 def forward(
@@ -373,6 +421,55 @@ def _shift_targets(tokens: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([tokens[..., 1:], pad], axis=-1)
 
 
+def _record_sp_comm(cfg: LlamaConfig, mesh: Mesh, batch: int, seq: int,
+                    n_layers: int = 0, calls_per_loss: int = 1):
+    """Trace-time comm inventory (profiler/comm.py) for the sp-attention
+    collectives: ring kv hops or ulysses all-to-alls. Recorded HERE —
+    not inside the ops — because the layer body traces once under
+    ``lax.scan``, so only the model knows the per-step multiplicity
+    (layers x pipeline ticks). Byte counts are forward-pass volumes;
+    the backward roughly doubles them (documented in the tutorial)."""
+    sp = mesh.shape.get(SP, 1)
+    if sp <= 1:
+        return
+    from dlrover_tpu.profiler.comm import record_collective
+
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = "ring"
+    if impl not in ("ring", "ulysses"):
+        return
+    L = n_layers or cfg.n_layers
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    tp = mesh.shape.get(TP, 1)
+    data = max(
+        mesh.shape.get(DP, 1) * mesh.shape.get(FSDP, 1)
+        * mesh.shape.get(EP, 1), 1,
+    )
+    bl = max(batch // data, 1)
+    s_local = seq // sp
+    hd = cfg.head_dim
+    hkv_l = max(cfg.n_kv_heads // tp, 1)
+    if impl == "ring":
+        per_hop = 2 * bl * s_local * hkv_l * hd * itemsize  # K and V
+        record_collective(
+            "ring_attention.kv_hop", "ppermute", SP, per_hop,
+            count=sp * L * calls_per_loss, per="loss_call",
+        )
+    else:
+        h_l = max(cfg.n_heads // tp, 1)
+        q_b = bl * s_local * h_l * hd * itemsize
+        kv_b = bl * s_local * hkv_l * hd * itemsize
+        record_collective(
+            "ulysses.head_scatter", "all_to_all", SP, q_b + 2 * kv_b,
+            count=L * calls_per_loss, per="loss_call",
+        )
+        record_collective(
+            "ulysses.head_gather", "all_to_all", SP, q_b,
+            count=L * calls_per_loss, per="loss_call",
+        )
+
+
 def loss_fn(
     params: Params,
     tokens: jnp.ndarray,  # (b, s) int32; next-token targets derived inside
@@ -382,6 +479,8 @@ def loss_fn(
     """Mean next-token cross-entropy (pad tokens < 0 are ignored)."""
     if mesh is not None and mesh.shape.get(PP, 1) > 1:
         return _pp_loss(params, tokens, cfg, mesh)
+    if mesh is not None:
+        _record_sp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
     logits = forward(params, tokens, cfg, mesh)
     nll_sum, n_valid = _ce_sums(logits, tokens)
     return nll_sum / jnp.maximum(n_valid, 1.0)
@@ -465,11 +564,45 @@ def _pp_loss_impl(
         _shift_targets(tokens).reshape(n_micro, mb, s),
         NamedSharding(mesh, P(None, BATCH_AXES, SP)),
     )
+    # per-collective attribution (trace-time; profiler/comm.py): each
+    # tick moves one (mb, s_local, dim) activation along the pp ring;
+    # 1f1b-family schedules add the mirrored grad hop
+    from dlrover_tpu.profiler.comm import record_collective
+
+    act_bytes = mb * s_local * cfg.dim * jnp.dtype(cfg.dtype).itemsize
     if cfg.pp_schedule == "1f1b":
+        if cfg.pp_virtual_stages > 1:
+            from dlrover_tpu.parallel.pp_schedule import (
+                build_interleaved_tables,
+            )
+
+            n_ticks = build_interleaved_tables(
+                pp_size, cfg.pp_virtual_stages, n_micro
+            ).T
+        else:
+            n_ticks = 2 * (n_micro + pp_size - 1)
+        record_collective("pp.act_hop", "ppermute", PP, act_bytes,
+                          count=n_ticks, per="loss_call")
+        record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
+                          count=n_ticks, per="loss_call")
         static = _PPStatic(cfg, mesh, pp_size, sp_size, n_micro, mb, s_local)
         return _pp_1f1b_call(
             static, params["layers"], x_micro,
             params["final_norm"], params["lm_head"], tgt_micro,
+        )
+    n_ticks = n_micro + pp_size - 1
+    record_collective("pp.act_hop", "ppermute", PP, act_bytes,
+                      count=n_ticks, per="loss_call")
+    # gpipe's backward is pure autodiff: AD transposes every ppermute
+    # into a reverse hop of the same size, once per tick
+    record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
+                      count=n_ticks, per="loss_call")
+    if sp_size > 1:
+        # gpipe x sp composition: each tick runs a slab of L/pp layers
+        # with ring/ulysses attention inside
+        _record_sp_comm(
+            cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
+            calls_per_loss=n_ticks,
         )
     return _pp_gpipe(
         cfg, mesh, pp_size, sp_size, n_micro, mb, s_local,
@@ -668,6 +801,11 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
     n_micro, mb, s_local = static.n_micro, static.mb, static.s_local
     from jax import shard_map
 
+    if cfg.pp_virtual_stages > 1:
+        return _pp_interleaved_run(
+            static, layers, x_micro, final_norm, lm_head, tgt_micro
+        )
+
     T = 2 * (n_micro + pp_size - 1)
     fwd_perm = [(i, i + 1) for i in range(pp_size - 1)]
     bwd_perm = [(i + 1, i) for i in range(pp_size - 1)]
@@ -844,6 +982,249 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
     loss, g_layers, g_x, g_fn, g_lm = pipe(
         layers, x_micro, tgt_micro, final_norm, lm_head
     )
+    return loss, (g_layers, g_x, g_fn, g_lm)
+
+
+def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
+                        lm_head, tgt_micro):
+    """Interleaved (virtual-stage) 1F1B: one fused pass computing
+    (loss, grads) from the static op tables of
+    ``parallel/pp_schedule.py``.
+
+    The model's ``pp * v`` chunks are placed chunk ``c`` -> rank
+    ``c % pp`` (Megatron layout), so every activation/grad hop is a
+    uniform wrapping ring ``ppermute`` (+1 fwd, -1 bwd) and the bubble
+    shrinks by the factor ``v`` the step-count model proves
+    (``PPScheduleTables.bubble_ticks``). Each scan tick looks up its op
+    in the tables: a forward of (microbatch ``f_i``, virtual stage
+    ``f_u``) and/or a buffer store of the activation arriving on the
+    wire. Buffers are ``(v, n_slots)`` slots keyed ``(u, i % n_slots)``
+    — the builder proves slot liveness never overlaps.
+
+    Layer params stay CANONICALLY ordered in the train state (so
+    checkpoints are layout-independent); the rank-major gather needed by
+    the ``P(pp)`` sharding happens here, and gradients are scattered
+    back through the inverse permutation.
+
+    Reference parity: the reference handles virtual PP stages only in
+    its Megatron checkpoint integration
+    (``megatron_dist_ckpt.py:262,489``); the schedule itself is this
+    repo's TPU-native construction.
+    """
+    import numpy as np
+
+    from dlrover_tpu.parallel.pp_schedule import (
+        build_interleaved_tables,
+        interleave_layer_perm,
+    )
+
+    cfg, mesh = static.cfg, static.mesh
+    pp_size, sp_size = static.pp, static.sp
+    n_micro, mb, s_local = static.n_micro, static.mb, static.s_local
+    v = cfg.pp_virtual_stages
+    if sp_size > 1:
+        raise ValueError("interleaved 1f1b does not compose with sp yet")
+    from jax import shard_map
+
+    tables = build_interleaved_tables(pp_size, v, n_micro)
+    dev_tables = {
+        k: jnp.asarray(val) for k, val in tables.as_device_tables().items()
+    }
+    S = tables.n_slots
+    Lc = cfg.n_layers // (pp_size * v)
+    perm = interleave_layer_perm(cfg.n_layers, pp_size, v)
+    inv_perm = np.argsort(perm)
+    layers_rm = jax.tree.map(lambda a: a[perm], layers)  # rank-major
+
+    ring_fwd = [(i, (i + 1) % pp_size) for i in range(pp_size)]
+    ring_bwd = [(i, (i - 1) % pp_size) for i in range(pp_size)]
+    f32 = jnp.float32
+
+    def stage(layers_local, x_mb, tgt_mb, final_norm, lm_head):
+        rank = lax.axis_index(PP)
+        is_last = rank == pp_size - 1
+        layer_fn = _stage_layer_fn(cfg, mb, s_local, 1)
+        act_shape = (mb, s_local, cfg.dim)
+
+        def run_chunk(layers_, h):
+            def body(carry, lp):
+                return layer_fn(lp, carry), None
+
+            out, _ = lax.scan(body, h, layers_)
+            return out
+
+        def chunk_params(u):
+            return jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, u * Lc, Lc, 0),
+                layers_local,
+            )
+
+        def b_get(buf, u, s):
+            return lax.dynamic_slice(
+                buf, (u, s, 0, 0, 0), (1, 1) + act_shape
+            ).reshape(act_shape)
+
+        def b_set(buf, val, u, s):
+            return lax.dynamic_update_slice(
+                buf, val[None, None], (u, s, 0, 0, 0)
+            )
+
+        def head_grads(out, tgt):
+            def nll_of(o, fn, lm):
+                nll, nv = _head_loss_sums(cfg, o, fn, lm, tgt)
+                return nll, nv
+
+            (nll, nv), grads = jax.value_and_grad(
+                nll_of, argnums=(0, 1, 2), has_aux=True
+            )(out, final_norm, lm_head)
+            return nll, nv, grads[0].astype(cfg.dtype), grads[1], grads[2]
+
+        def zero_head(out, tgt):
+            return (
+                jnp.zeros((), f32), jnp.zeros((), f32),
+                jnp.zeros(act_shape, cfg.dtype),
+                jnp.zeros_like(final_norm), jnp.zeros_like(lm_head),
+            )
+
+        def tick(carry, xs):
+            (wire_f, wire_b, recv_act, recv_grad, act_saved,
+             g_layers, g_fn, g_lm, g_x, nll, nv) = carry
+
+            # -- ring delivery of the previous tick's outputs ----------
+            win_f = lax.ppermute(wire_f, PP, ring_fwd)
+            win_b = lax.ppermute(wire_b, PP, ring_bwd)
+
+            def pick(name):
+                return lax.dynamic_index_in_dim(
+                    xs[name], rank, keepdims=False
+                )
+
+            recv_act = lax.cond(
+                pick("rf_do"),
+                lambda b: b_set(b, win_f, pick("rf_u"), pick("rf_s")),
+                lambda b: b, recv_act,
+            )
+            recv_grad = lax.cond(
+                pick("rb_do"),
+                lambda b: b_set(b, win_b, pick("rb_u"), pick("rb_s")),
+                lambda b: b, recv_grad,
+            )
+
+            f_i, f_u = pick("f_i"), pick("f_u")
+            b_i, b_u = pick("b_i"), pick("b_u")
+
+            # -- forward chunk op --------------------------------------
+            def fwd_branch(ops):
+                recv_act, act_saved, recv_grad, g_fn, g_lm, nll, nv = ops
+                inp = jnp.where(
+                    (rank == 0) & (f_u == 0),
+                    lax.dynamic_index_in_dim(x_mb, f_i, keepdims=False),
+                    b_get(recv_act, f_u, f_i % S),
+                )
+                out = run_chunk(chunk_params(f_u), inp)
+                act_saved = b_set(act_saved, inp, f_u, f_i % S)
+                is_lastc = is_last & (f_u == v - 1)
+                tgt = lax.dynamic_index_in_dim(tgt_mb, f_i, keepdims=False)
+                nll_i, nv_i, d_out, d_fn, d_lm = lax.cond(
+                    is_lastc, head_grads, zero_head, out, tgt
+                )
+                recv_grad = lax.cond(
+                    is_lastc,
+                    lambda b: b_set(b, d_out, v - 1, f_i % S),
+                    lambda b: b, recv_grad,
+                )
+                return (recv_act, act_saved, recv_grad, g_fn + d_fn,
+                        g_lm + d_lm, nll + nll_i, nv + nv_i), out
+
+            def fwd_skip(ops):
+                return ops, jnp.zeros(act_shape, cfg.dtype)
+
+            (recv_act, act_saved, recv_grad, g_fn, g_lm, nll, nv), wire_f = (
+                lax.cond(
+                    pick("f_do"), fwd_branch, fwd_skip,
+                    (recv_act, act_saved, recv_grad, g_fn, g_lm, nll, nv),
+                )
+            )
+
+            # -- backward chunk op -------------------------------------
+            def bwd_branch(ops):
+                g_layers, g_x = ops
+                g_out = b_get(recv_grad, b_u, b_i % S)
+                inp = b_get(act_saved, b_u, b_i % S)
+                _, pull = jax.vjp(run_chunk, chunk_params(b_u), inp)
+                gl, gx = pull(g_out)
+
+                def acc(dst, g):
+                    cur = lax.dynamic_slice_in_dim(dst, b_u * Lc, Lc, 0)
+                    return lax.dynamic_update_slice_in_dim(
+                        dst, cur + g, b_u * Lc, 0
+                    )
+
+                g_layers = jax.tree.map(acc, g_layers, gl)
+                g_x = jnp.where(
+                    (rank == 0) & (b_u == 0),
+                    lax.dynamic_update_index_in_dim(
+                        g_x, gx.astype(g_x.dtype), b_i, 0
+                    ),
+                    g_x,
+                )
+                return (g_layers, g_x), gx
+
+            def bwd_skip(ops):
+                return ops, jnp.zeros(act_shape, cfg.dtype)
+
+            (g_layers, g_x), wire_b = lax.cond(
+                pick("b_do"), bwd_branch, bwd_skip, (g_layers, g_x)
+            )
+
+            return (wire_f, wire_b, recv_act, recv_grad, act_saved,
+                    g_layers, g_fn, g_lm, g_x, nll, nv), None
+
+        init = (
+            jnp.zeros(act_shape, cfg.dtype),              # wire_f
+            jnp.zeros(act_shape, cfg.dtype),              # wire_b
+            jnp.zeros((v, S) + act_shape, cfg.dtype),     # recv_act
+            jnp.zeros((v, S) + act_shape, cfg.dtype),     # recv_grad
+            jnp.zeros((v, S) + act_shape, cfg.dtype),     # act_saved
+            jax.tree.map(jnp.zeros_like, layers_local),
+            jnp.zeros_like(final_norm),
+            jnp.zeros_like(lm_head),
+            jnp.zeros((n_micro,) + act_shape, cfg.dtype),  # g_x
+            jnp.zeros((), f32),                            # nll
+            jnp.zeros((), f32),                            # nv
+        )
+        carry, _ = lax.scan(tick, init, dev_tables)
+        (_, _, _, _, _, g_layers, g_fn, g_lm, g_x, nll, nv) = carry
+        nll = lax.psum(nll, PP)
+        nv = lax.psum(nv, PP)
+        loss = nll / jnp.maximum(nv, 1.0)
+        scale = (1.0 / jnp.maximum(nv, 1.0)).astype(f32)
+        g_layers = jax.tree.map(
+            lambda a: (a.astype(f32) * scale).astype(a.dtype), g_layers
+        )
+        g_x = (g_x.astype(f32) * scale).astype(cfg.dtype)
+        g_fn = g_fn * scale
+        g_lm = (g_lm.astype(f32) * scale).astype(g_lm.dtype)
+        # g_x / head grads are real on one pp rank only; psum replicates
+        g_x = lax.psum(g_x, PP)
+        g_fn = lax.psum(g_fn, PP)
+        g_lm = lax.psum(g_lm, PP)
+        return loss, g_layers, g_x, g_fn, g_lm
+
+    layer_specs = jax.tree.map(lambda _: P(PP), layers_rm)
+    pipe = shard_map(
+        stage,
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P(), P(), P()),
+        out_specs=(P(), layer_specs, P(), P(), P()),
+        axis_names={PP},
+        check_vma=False,
+    )
+    loss, g_layers_rm, g_x, g_fn, g_lm = pipe(
+        layers_rm, x_micro, tgt_micro, final_norm, lm_head
+    )
+    # grads back to the canonical layer order of the train state
+    g_layers = jax.tree.map(lambda a: a[inv_perm], g_layers_rm)
     return loss, (g_layers, g_x, g_fn, g_lm)
 
 

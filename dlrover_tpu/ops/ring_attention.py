@@ -48,6 +48,10 @@ def ring_attention(
     b, s_local, h, d = q.shape
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
+    # NB: comm attribution for the ring hops is recorded at the MODEL
+    # layer (models/llama.py), which knows the per-step multiplicity
+    # (n_layers x microbatches); this body traces once inside lax.scan,
+    # so a record here could not count executions.
 
     def chunk_attn(kc, vc, src):
         """(out (b,s,h,d) f32, lse (b,h,s) f32) for this kv chunk."""

@@ -76,6 +76,9 @@ def ulysses_attention(
             f"ulysses needs heads divisible by sp: h={h} hkv={hkv} sp={sp}"
             " (use ring attention for fewer KV heads than sp)"
         )
+    # NB: comm attribution for the all-to-alls is recorded at the MODEL
+    # layer (models/llama.py), which knows the per-step multiplicity
+    # (n_layers x microbatches); this body traces once per layer scan.
     qg = _a2a_scatter_heads(q, axis_name)
     kg = _a2a_scatter_heads(k, axis_name)
     vg = _a2a_scatter_heads(v, axis_name)

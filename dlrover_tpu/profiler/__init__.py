@@ -20,3 +20,13 @@ from dlrover_tpu.profiler.analysis import (  # noqa: F401
     analyze_timeline,
     matmul_bench,
 )
+from dlrover_tpu.profiler.comm import (  # noqa: F401
+    CollectiveEvent,
+    CommLedger,
+    axis_links,
+    collective_scope,
+    comm_ledger,
+    measure_axis_bandwidth,
+    measure_mesh_bandwidths,
+    record_collective,
+)

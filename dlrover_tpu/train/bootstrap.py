@@ -39,6 +39,9 @@ class WorkerEnv:
     restart_count: int = 0
     accelerator: str = "tpu"
     local_rank: int = 0
+    # distinct TPU slices in the current world (agent-injected; sizes
+    # the multislice mesh's DCN axis, changing across slice resizes)
+    num_slices: int = 1
 
     @classmethod
     def from_env(cls) -> "WorkerEnv":
@@ -55,6 +58,7 @@ class WorkerEnv:
             restart_count=int(e.get(NodeEnv.RESTART_COUNT, "0")),
             accelerator=e.get("DLROVER_TPU_ACCELERATOR", "tpu"),
             local_rank=int(e.get("DLROVER_TPU_LOCAL_RANK", "0")),
+            num_slices=int(e.get("DLROVER_TPU_NUM_SLICES", "1") or 1),
         )
 
 

@@ -87,6 +87,34 @@ class ElasticTrainer:
         self._step_fn = None
         self._host_step = 0
         self._applied_config_version = 0
+        self._maybe_serve_comm_metrics()
+
+    def _maybe_serve_comm_metrics(self):
+        """Worker-side /metrics for the per-collective ledger
+        (profiler/comm.py), opted in with
+        ``DLROVER_TPU_COMM_METRICS_PORT`` (0 = ephemeral port)."""
+        import os
+
+        port = os.getenv("DLROVER_TPU_COMM_METRICS_PORT")
+        if port is None:
+            return
+        try:
+            port_num = int(port)
+        except ValueError:
+            logger.warning(
+                "DLROVER_TPU_COMM_METRICS_PORT=%r is not a port; comm "
+                "metrics disabled", port,
+            )
+            return
+        from dlrover_tpu.profiler.comm import start_metrics_server
+
+        try:
+            _, bound = start_metrics_server(port_num)
+            from dlrover_tpu.common.log import logger as _logger
+
+            _logger.info("comm metrics on 127.0.0.1:%d/metrics", bound)
+        except OSError:
+            pass  # port taken (another trainer in-process)
 
     # ---- elastic global-batch math (reference trainer.py:307-327) ------
     @property
@@ -108,21 +136,71 @@ class ElasticTrainer:
         return self.accum_steps, self.tc.micro_batch_size * dp
 
     def init_state(self, params: PyTree) -> dict:
-        # jit so adam's mu/nu are born with the params' shardings (XLA
-        # propagates input shardings — optimizer state is ZeRO-sharded for
-        # free whenever params carry fsdp specs).
-        opt_state = jax.jit(self.optimizer.init)(params)
+        # EAGER init so adam's mu/nu are born with the params' shardings:
+        # eager zeros_like follows its input's sharding exactly
+        # (optimizer state is ZeRO-sharded for free whenever params carry
+        # fsdp specs), whereas jit(opt.init) leaves the OUTPUT shardings
+        # to XLA, which has been seen to choose SingleDeviceSharding for
+        # some leaves — poisoning every later restore that places leaves
+        # by this target's sharding (resized-world restore path).
+        self._record_data_parallel_comm(params)
+        opt_state = self.optimizer.init(params)
+        # scalars born mesh-replicated, not on the default device: a
+        # checkpoint restore places leaves by the target's sharding, and
+        # a single-device-committed scalar (adam's count, step, lr_scale)
+        # next to mesh-wide params makes the jitted step reject the
+        # state (resized-world restore path)
+        repl = NamedSharding(self.mesh, P())
+        opt_state = jax.tree.map(
+            lambda l: jax.device_put(l, repl) if getattr(l, "ndim", None)
+            == 0 else l,
+            opt_state,
+        )
         return {
             "params": params,
             "opt": opt_state,
-            "step": jnp.zeros((), jnp.int32),
+            "step": jax.device_put(jnp.zeros((), jnp.int32), repl),
             # runtime lr multiplier (master paral-config pushes): applied
             # to the optimizer's updates inside the jitted step, so the
             # master's sqrt-coupled lr actually takes effect without
             # recompiling (the wd term follows lr — exact decoupled-wd
             # rescaling would need a rebuilt optimizer)
-            "lr_scale": jnp.ones((), jnp.float32),
+            "lr_scale": jax.device_put(jnp.ones((), jnp.float32), repl),
         }
+
+    def _record_data_parallel_comm(self, params: PyTree):
+        """Analytic per-step inventory of the collectives XLA inserts
+        for the data axes (profiler/comm.py). These aren't explicit in
+        our code — fsdp re-gathers parameters fwd+bwd and reduce-
+        scatters gradients; dp all-reduces gradients — so the byte
+        counts come from the parameter tree, the same way the
+        reference derives NCCL bus bandwidth from algorithm formulas
+        rather than observed packets (xpu_timer parse_params.cc)."""
+        from dlrover_tpu.profiler.comm import comm_ledger, record_collective
+
+        # a new trainer means a new program inventory: drop rows from any
+        # previous mesh/config so /metrics never mixes dead and live
+        # configurations (elastic resize, bench candidate sweeps)
+        comm_ledger.clear()
+        comm_ledger.set_accum_steps(self.accum_steps)
+        shape = dict(self.mesh.shape)
+        param_bytes = sum(
+            l.size * l.dtype.itemsize for l in jax.tree.leaves(params)
+        )
+        if shape.get("fsdp", 1) > 1:
+            record_collective(
+                "fsdp.param_all_gather", "all_gather", "fsdp",
+                nbytes=param_bytes, count=2 * self.accum_steps,
+            )
+            record_collective(
+                "fsdp.grad_reduce_scatter", "reduce_scatter", "fsdp",
+                nbytes=param_bytes, count=1,
+            )
+        if shape.get("dp", 1) > 1:
+            record_collective(
+                "dp.grad_allreduce", "psum", "dp",
+                nbytes=param_bytes, count=1,
+            )
 
     def _build_step(self):
         accum = self.accum_steps
@@ -194,7 +272,10 @@ class ElasticTrainer:
             if abs(scale - float(state["lr_scale"])) > 1e-9:
                 state = {
                     **state,
-                    "lr_scale": jnp.asarray(scale, jnp.float32),
+                    "lr_scale": jax.device_put(
+                        jnp.asarray(scale, jnp.float32),
+                        NamedSharding(self.mesh, P()),
+                    ),
                 }
                 from dlrover_tpu.common.log import logger as _logger
 

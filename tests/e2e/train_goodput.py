@@ -1,0 +1,70 @@
+"""Paced training script for the goodput-percentage chaos run.
+
+Reports every step to the master's SpeedMonitor; crashes the chief once
+at ``DLROVER_TPU_TEST_CRASH_STEP`` (restart 0 only); resumes from the
+flash checkpoint after the agent restarts it. The surrounding test
+computes goodput % from the master's ledger over the whole run
+(reference claim: 69% -> 95%+ goodput, ``README.md:46-48``).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import dlrover_tpu.train as dtrain
+
+ctx = dtrain.init(local_device_count=2)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.checkpoint import Checkpointer, StorageType
+
+TOTAL_STEPS = int(os.environ.get("DLROVER_TPU_TEST_STEPS", "240"))
+STEP_SLEEP = float(os.environ.get("DLROVER_TPU_TEST_STEP_SLEEP", "1.0"))
+CRASH_STEP = int(os.environ.get("DLROVER_TPU_TEST_CRASH_STEP", "-1"))
+CKPT_DIR = os.environ["DLROVER_TPU_TEST_CKPT_DIR"]
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+repl = NamedSharding(mesh, P())
+state = {
+    "w": jax.device_put(jnp.zeros((32,)), NamedSharding(mesh, P("dp"))),
+    "step": jax.device_put(jnp.array(0), repl),
+}
+
+ckpt = Checkpointer(CKPT_DIR)
+restored = ckpt.load(target=state)
+start_step = 0
+if restored is not None:
+    start_step, state = restored
+    print(f"[goodput] resumed from step {start_step}", flush=True)
+else:
+    print("[goodput] cold start", flush=True)
+
+
+@jax.jit
+def train_step(state):
+    return {"w": state["w"] + 0.5, "step": state["step"] + 1}
+
+
+for step in range(start_step + 1, TOTAL_STEPS + 1):
+    t0 = time.time()
+    state = train_step(state)
+    jax.block_until_ready(state["w"])
+    # persist cheaply every few steps so a crash resumes near the front
+    if step % 5 == 0:
+        ckpt.save(step, state, StorageType.DISK)
+    if step == CRASH_STEP and ctx.restart_count == 0 and ctx.is_chief:
+        print(f"[goodput] injected crash at step {step}", flush=True)
+        os._exit(23)
+    ctx.report_step(step, force=True)
+    time.sleep(max(0.0, STEP_SLEEP - (time.time() - t0)))
+
+print(f"[goodput] done: step={int(state['step'])}", flush=True)

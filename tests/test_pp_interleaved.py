@@ -1,0 +1,237 @@
+"""Interleaved (virtual-stage) 1F1B: schedule-table model + executor.
+
+VERDICT r4 item 8. The step-count model proves the bubble shrinks by
+the virtual-stage factor v; the executor tests prove loss/grad parity
+with the single-device model and with plain 1F1B, and a converging
+trainer step. Reference parity: the reference handles virtual PP only
+in its Megatron checkpoint integration (megatron_dist_ckpt.py:262,489);
+the schedule here is repo-native (parallel/pp_schedule.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+from dlrover_tpu.parallel.pp_schedule import (
+    build_interleaved_tables,
+    interleave_layer_perm,
+    plain_1f1b_chunk_ticks,
+)
+from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+
+# ---------------------------------------------------------------------------
+# step-count model
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,v,n_micro", [
+    (2, 2, 4), (4, 2, 8), (4, 2, 4), (4, 4, 8), (4, 2, 16), (8, 2, 16),
+    (4, 3, 12),
+])
+def test_bubble_shrinks_by_virtual_stage_factor(pp, v, n_micro):
+    t = build_interleaved_tables(pp, v, n_micro)
+    plain_bubble = plain_1f1b_chunk_ticks(pp, v, n_micro) - 2 * n_micro * v
+    assert plain_bubble == 2 * v * (pp - 1)
+    # the Megatron-order schedule hits the ideal makespan exactly:
+    # bubble = 2*(pp-1) chunk-ticks — the full factor-v reduction
+    assert t.bubble_ticks == 2 * (pp - 1), (t.T, t.bubble_ticks)
+    assert t.T < plain_1f1b_chunk_ticks(pp, v, n_micro)
+    # every rank runs exactly 2*n_micro*v ops
+    assert int(t.f_do.sum()) == n_micro * v * pp
+    assert int(t.b_do.sum()) == n_micro * v * pp
+
+
+def test_schedule_op_tables_are_dependency_consistent():
+    """Forward of (i, c) must happen >= 1 tick after forward of
+    (i, c-1) (ring latency), backwards mirrored."""
+    pp, v, n = 4, 2, 8
+    t = build_interleaved_tables(pp, v, n)
+    tf = {}
+    tb = {}
+    for tick in range(t.T):
+        for r in range(pp):
+            if t.f_do[tick, r]:
+                c = t.f_u[tick, r] * pp + r
+                tf[(t.f_i[tick, r], c)] = tick
+            if t.b_do[tick, r]:
+                c = t.b_u[tick, r] * pp + r
+                tb[(t.b_i[tick, r], c)] = tick
+    C = pp * v
+    for i in range(n):
+        for c in range(1, C):
+            assert tf[(i, c)] >= tf[(i, c - 1)] + 1, (i, c)
+        for c in range(C - 1):
+            assert tb[(i, c)] >= tb[(i, c + 1)] + 1, (i, c)
+        assert tb[(i, C - 1)] > tf[(i, C - 1)]
+
+
+def test_layer_perm_roundtrip():
+    perm = interleave_layer_perm(8, pp=2, v=2)
+    # rank 0 slab: chunks 0 (layers 0,1) and 2 (layers 4,5)
+    assert list(perm) == [0, 1, 4, 5, 2, 3, 6, 7]
+    inv = np.argsort(perm)
+    x = np.arange(8)
+    assert list(x[perm][inv]) == list(x)
+
+
+def test_schedule_rejects_bad_geometry():
+    with pytest.raises(ValueError):
+        build_interleaved_tables(4, 1, 8)     # v=1 is plain
+    with pytest.raises(ValueError):
+        build_interleaved_tables(1, 2, 8)     # no pipeline
+    with pytest.raises(ValueError):
+        build_interleaved_tables(4, 2, 6)     # n_micro % pp != 0
+
+
+def test_1f1b_pp4_tp_with_data_axes_is_gated():
+    """r5 stress-dryrun finding: 1f1b (plain or interleaved) at pp>=4
+    with tp>1 plus another data axis aborts XLA's GSPMD partitioner
+    (spmd_partitioner_util.cc partition-group CHECK). validate_for_mesh
+    must turn that process abort into a ValueError suggesting gpipe."""
+    from dlrover_tpu.parallel import MeshConfig, build_mesh
+
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=8, pp_schedule="1f1b", pp_microbatches=4
+    )
+    mc = MeshConfig(dp=1, pp=4, fsdp=1, sp=1, tp=2).resolve(8)
+    mesh = build_mesh(mc, devices=jax.devices()[:8])
+    # pp=4 x tp=2 with dp*fsdp == 1 is fine (covered by other tests)
+    llama.validate_for_mesh(cfg, mesh, seq_len=16)  # no raise
+
+    # the gated 16-device shape can't be built on the 8-device test
+    # world; validate_for_mesh only reads mesh.shape, so a stub works
+    class _WideMesh:
+        shape = {"dp": 2, "pp": 4, "fsdp": 1, "ep": 1, "sp": 1, "tp": 2}
+
+    with pytest.raises(ValueError, match="gpipe"):
+        llama.validate_for_mesh(cfg, _WideMesh(), seq_len=16)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        llama.LlamaConfig.tiny(pp_virtual_stages=2)  # needs 1f1b
+    with pytest.raises(ValueError):
+        llama.LlamaConfig.tiny(pp_virtual_stages=0)
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=4, pp_schedule="1f1b", pp_virtual_stages=2
+    )
+    assert cfg.pp_virtual_stages == 2
+
+
+# ---------------------------------------------------------------------------
+# executor numerics
+# ---------------------------------------------------------------------------
+
+def _mesh(pp, tp=1):
+    mc = MeshConfig(dp=1, pp=pp, fsdp=1, sp=1, tp=tp).resolve(pp * tp)
+    return mc, build_mesh(mc, devices=jax.devices()[: pp * tp])
+
+
+@pytest.mark.parametrize("pp,v,n_layers,n_micro", [
+    (2, 2, 4, 2),
+    (2, 2, 4, 4),
+    (4, 2, 8, 4),
+])
+def test_interleaved_loss_matches_single_device(pp, v, n_layers, n_micro):
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=n_layers, pp_microbatches=n_micro,
+        pp_schedule="1f1b", pp_virtual_stages=v,
+    )
+    ref_cfg = llama.LlamaConfig.tiny(n_layers=n_layers)
+    params = llama.init_params(ref_cfg, jax.random.key(0))
+    toks = jax.random.randint(
+        jax.random.key(1), (4, 16), 0, cfg.vocab_size
+    )
+    ref = float(llama.loss_fn(params, toks, ref_cfg))
+    _, mesh = _mesh(pp)
+    sharded = jax.device_put(
+        params, named_shardings(mesh, llama.param_specs(cfg, pp=pp))
+    )
+    got = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh)
+    )(sharded, toks))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_interleaved_grads_match_single_device():
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=4, pp_schedule="1f1b", pp_virtual_stages=2,
+        pp_microbatches=2,
+    )
+    ref_cfg = llama.LlamaConfig.tiny(n_layers=4)
+    params = llama.init_params(ref_cfg, jax.random.key(0))
+    toks = jax.random.randint(
+        jax.random.key(1), (4, 16), 0, cfg.vocab_size
+    )
+    ref_grads = jax.grad(lambda p: llama.loss_fn(p, toks, ref_cfg))(params)
+    _, mesh = _mesh(2, tp=2)
+    sharded = jax.device_put(
+        params, named_shardings(mesh, llama.param_specs(cfg, pp=2))
+    )
+    got = jax.jit(
+        jax.grad(lambda p: llama.loss_fn(p, toks, cfg, mesh))
+    )(sharded)
+    for (ka, a), (kb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(ref_grads),
+        jax.tree_util.tree_leaves_with_path(got),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5,
+            err_msg=str(ka),
+        )
+
+
+def test_interleaved_matches_plain_1f1b():
+    n_micro = 4
+    cfg_p = llama.LlamaConfig.tiny(
+        n_layers=4, pp_microbatches=n_micro, pp_schedule="1f1b"
+    )
+    cfg_i = llama.LlamaConfig.tiny(
+        n_layers=4, pp_microbatches=n_micro, pp_schedule="1f1b",
+        pp_virtual_stages=2,
+    )
+    params = llama.init_params(cfg_p, jax.random.key(0))
+    toks = jax.random.randint(
+        jax.random.key(1), (4, 16), 0, cfg_p.vocab_size
+    )
+    _, mesh = _mesh(2, tp=2)
+    sharded = jax.device_put(
+        params, named_shardings(mesh, llama.param_specs(cfg_p, pp=2))
+    )
+    plain = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg_p, mesh)
+    )(sharded, toks))
+    inter = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg_i, mesh)
+    )(sharded, toks))
+    np.testing.assert_allclose(inter, plain, rtol=1e-5)
+
+
+def test_interleaved_trainer_step_converges():
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=4, pp_schedule="1f1b", pp_virtual_stages=2,
+        pp_microbatches=2,
+    )
+    mc, mesh = _mesh(2, tp=2)
+    specs = llama.param_specs(cfg, pp=2)
+    local = llama.init_params(cfg, jax.random.key(0))
+    sharded = jax.device_put(local, named_shardings(mesh, specs))
+    tc = TrainConfig(global_batch_size=4, micro_batch_size=4,
+                     learning_rate=1e-2, warmup_steps=0, total_steps=20)
+    tr = ElasticTrainer(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh), specs, mesh, mc, tc
+    )
+    state = tr.init_state(sharded)
+    toks = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    a, b = tr.step_batch_shape
+    batch = toks.reshape(a, b, 16)
+    losses = []
+    for _ in range(5):
+        state, loss = tr.step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1, losses
