@@ -67,9 +67,17 @@ _MIGRATIONS = [
 class BrainDataStore:
     def __init__(self, path: str = ":memory:"):
         # one connection guarded by a lock: the service is low-QPS
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, timeout=30.0
+        )
         self._lock = threading.Lock()
         with self._lock:
+            if path != ":memory:":
+                # cluster deployment (one shared brain, PVC-backed file,
+                # docs/tutorial/brain_autoscaling.md): WAL survives crash
+                # mid-commit and lets the admin CLI read concurrently
+                self._conn.execute("PRAGMA journal_mode=WAL")
+                self._conn.execute("PRAGMA synchronous=NORMAL")
             self._conn.executescript(_SCHEMA)
             for mig in _MIGRATIONS:
                 try:

@@ -88,6 +88,16 @@ class LlamaConfig:
     # chunks inside warmup/cooldown (parallel/pp_schedule.py; reference
     # parity: megatron_dist_ckpt.py:262,489 virtual-stage checkpoints)
     pp_virtual_stages: int = 1
+    # layer-stack layout the interleaved executor expects:
+    # - "canonical": train state keeps the natural layer order; the
+    #   executor gathers to rank-major in-step and scatters grads back.
+    #   Checkpoint-layout independent, but the gather moves ~(1-1/v) of
+    #   layer params + grads across the pp axis EVERY step — fine for
+    #   tests/small models, wasteful at scale.
+    # - "rank_major": the state already holds layers in rank-major order
+    #   (see ``interleave_layers``/``deinterleave_layers``); zero
+    #   per-step movement. Canonicalize at checkpoint boundaries.
+    pp_interleave_layout: str = "canonical"
 
     def __post_init__(self):
         if self.remat_policy not in ("all", "mlp"):
@@ -100,6 +110,11 @@ class LlamaConfig:
             )
         if self.pp_virtual_stages < 1:
             raise ValueError("pp_virtual_stages must be >= 1")
+        if self.pp_interleave_layout not in ("canonical", "rank_major"):
+            raise ValueError(
+                f"pp_interleave_layout={self.pp_interleave_layout!r}: "
+                "expected 'canonical' or 'rank_major'"
+            )
         if self.pp_virtual_stages > 1 and self.pp_schedule != "1f1b":
             raise ValueError(
                 "pp_virtual_stages > 1 is the interleaved schedule; it "
@@ -199,6 +214,36 @@ def param_specs(cfg: LlamaConfig, pp: int = 1) -> Params:
         },
         "final_norm": P(None),
         "lm_head": P(FSDP, TP),
+    }
+
+
+def interleave_layers(params: Params, pp: int, v: int) -> Params:
+    """Canonical -> rank-major layer order for
+    ``pp_interleave_layout='rank_major'`` interleaved pipelines: apply
+    once after init / after a checkpoint restore (the per-step gather
+    the 'canonical' layout pays then disappears)."""
+    from dlrover_tpu.parallel.pp_schedule import interleave_layer_perm
+
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    perm = interleave_layer_perm(n_layers, pp, v)
+    return {
+        **params,
+        "layers": jax.tree.map(lambda a: a[perm], params["layers"]),
+    }
+
+
+def deinterleave_layers(params: Params, pp: int, v: int) -> Params:
+    """Rank-major -> canonical: apply before saving a portable
+    checkpoint from a ``rank_major`` interleaved run."""
+    import numpy as np
+
+    from dlrover_tpu.parallel.pp_schedule import interleave_layer_perm
+
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    inv = np.argsort(interleave_layer_perm(n_layers, pp, v))
+    return {
+        **params,
+        "layers": jax.tree.map(lambda a: a[inv], params["layers"]),
     }
 
 
@@ -497,7 +542,55 @@ def _pp_loss(
     extra (auto) axes — always route through a (cached) jit; under the
     trainer's jit this is just an inlined call, and direct eager calls
     (tests, notebooks) keep working."""
+    # comm inventory HERE, not inside the cached jit: a ledger.clear()
+    # (new trainer) followed by a cache-hit trace would otherwise leave
+    # the pp rows unrecorded; this entry runs per call and records are
+    # idempotent
+    _record_pp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
     return _jitted_pp_loss(cfg, mesh)(params, tokens)
+
+
+def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
+    from dlrover_tpu.profiler.comm import record_collective
+
+    pp_size = mesh.shape[PP]
+    sp_size = mesh.shape.get(SP, 1)
+    n_micro = cfg.pp_microbatches or pp_size
+    if b % n_micro:
+        return  # the loss itself will raise with a clear message
+    mb = b // n_micro
+    s_local = s // sp_size
+    act_bytes = mb * s_local * cfg.dim * jnp.dtype(cfg.dtype).itemsize
+    if cfg.pp_schedule == "1f1b":
+        if cfg.pp_virtual_stages > 1:
+            from dlrover_tpu.parallel.pp_schedule import (
+                build_interleaved_tables,
+            )
+
+            n_ticks = build_interleaved_tables(
+                pp_size, cfg.pp_virtual_stages, n_micro
+            ).T
+        else:
+            n_ticks = 2 * (n_micro + pp_size - 1)
+        record_collective("pp.act_hop", "ppermute", PP, act_bytes,
+                          count=n_ticks, per="loss_call")
+        record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
+                          count=n_ticks, per="loss_call")
+        return
+    n_ticks = n_micro + pp_size - 1
+    record_collective("pp.act_hop", "ppermute", PP, act_bytes,
+                      count=n_ticks, per="loss_call")
+    # gpipe's backward is pure autodiff: AD transposes every ppermute
+    # into a reverse hop of the same size, once per tick
+    record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
+                      count=n_ticks, per="loss_call")
+    if sp_size > 1:
+        # gpipe x sp composition: each tick runs a slab of L/pp layers
+        # with ring/ulysses attention inside
+        _record_sp_comm(
+            cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
+            calls_per_loss=n_ticks,
+        )
 
 
 @functools.lru_cache(maxsize=32)
@@ -564,45 +657,11 @@ def _pp_loss_impl(
         _shift_targets(tokens).reshape(n_micro, mb, s),
         NamedSharding(mesh, P(None, BATCH_AXES, SP)),
     )
-    # per-collective attribution (trace-time; profiler/comm.py): each
-    # tick moves one (mb, s_local, dim) activation along the pp ring;
-    # 1f1b-family schedules add the mirrored grad hop
-    from dlrover_tpu.profiler.comm import record_collective
-
-    act_bytes = mb * s_local * cfg.dim * jnp.dtype(cfg.dtype).itemsize
     if cfg.pp_schedule == "1f1b":
-        if cfg.pp_virtual_stages > 1:
-            from dlrover_tpu.parallel.pp_schedule import (
-                build_interleaved_tables,
-            )
-
-            n_ticks = build_interleaved_tables(
-                pp_size, cfg.pp_virtual_stages, n_micro
-            ).T
-        else:
-            n_ticks = 2 * (n_micro + pp_size - 1)
-        record_collective("pp.act_hop", "ppermute", PP, act_bytes,
-                          count=n_ticks, per="loss_call")
-        record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
-                          count=n_ticks, per="loss_call")
         static = _PPStatic(cfg, mesh, pp_size, sp_size, n_micro, mb, s_local)
         return _pp_1f1b_call(
             static, params["layers"], x_micro,
             params["final_norm"], params["lm_head"], tgt_micro,
-        )
-    n_ticks = n_micro + pp_size - 1
-    record_collective("pp.act_hop", "ppermute", PP, act_bytes,
-                      count=n_ticks, per="loss_call")
-    # gpipe's backward is pure autodiff: AD transposes every ppermute
-    # into a reverse hop of the same size, once per tick
-    record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
-                      count=n_ticks, per="loss_call")
-    if sp_size > 1:
-        # gpipe x sp composition: each tick runs a slab of L/pp layers
-        # with ring/ulysses attention inside
-        _record_sp_comm(
-            cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
-            calls_per_loss=n_ticks,
         )
     return _pp_gpipe(
         cfg, mesh, pp_size, sp_size, n_micro, mb, s_local,
@@ -1032,9 +1091,15 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
     }
     S = tables.n_slots
     Lc = cfg.n_layers // (pp_size * v)
-    perm = interleave_layer_perm(cfg.n_layers, pp_size, v)
-    inv_perm = np.argsort(perm)
-    layers_rm = jax.tree.map(lambda a: a[perm], layers)  # rank-major
+    if cfg.pp_interleave_layout == "rank_major":
+        # state already rank-major (interleave_layers): no per-step
+        # cross-rank layer movement
+        layers_rm = layers
+        inv_perm = None
+    else:
+        perm = interleave_layer_perm(cfg.n_layers, pp_size, v)
+        inv_perm = np.argsort(perm)
+        layers_rm = jax.tree.map(lambda a: a[perm], layers)  # rank-major
 
     ring_fwd = [(i, (i + 1) % pp_size) for i in range(pp_size)]
     ring_bwd = [(i, (i - 1) % pp_size) for i in range(pp_size)]
@@ -1223,6 +1288,8 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
     loss, g_layers_rm, g_x, g_fn, g_lm = pipe(
         layers_rm, x_micro, tgt_micro, final_norm, lm_head
     )
+    if inv_perm is None:
+        return loss, (g_layers_rm, g_x, g_fn, g_lm)
     # grads back to the canonical layer order of the train state
     g_layers = jax.tree.map(lambda a: a[inv_perm], g_layers_rm)
     return loss, (g_layers, g_x, g_fn, g_lm)

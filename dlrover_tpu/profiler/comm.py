@@ -199,11 +199,40 @@ def collective_scope(name: str, kind: str, axis: str, nbytes: int,
         yield
 
 
+_server_singleton: Optional[Tuple[object, int]] = None
+_server_lock = threading.Lock()
+
+
 def start_metrics_server(port: int = 0):
     """Serve the ledger's Prometheus rows on ``/metrics`` (worker-side
     sibling of the native interposer's per-program endpoint). Returns
     (server, port); the server runs on a daemon thread. Workers enable
-    it with ``DLROVER_TPU_COMM_METRICS_PORT`` (see train/trainer.py)."""
+    it with ``DLROVER_TPU_COMM_METRICS_PORT`` (see train/trainer.py).
+
+    Process-wide singleton: the ledger being served is process-global,
+    and rebuilding trainers (elastic resizes, bench sweeps) must not
+    leak one listener thread per trainer."""
+    global _server_singleton
+    with _server_lock:
+        if _server_singleton is not None:
+            return _server_singleton
+        _server_singleton = _start_metrics_server(port)
+        return _server_singleton
+
+
+def stop_metrics_server():
+    """Shut the singleton down (tests / graceful worker exit)."""
+    global _server_singleton
+    with _server_lock:
+        if _server_singleton is not None:
+            try:
+                _server_singleton[0].shutdown()
+            except Exception:
+                pass
+            _server_singleton = None
+
+
+def _start_metrics_server(port: int):
     import http.server
 
     class Handler(http.server.BaseHTTPRequestHandler):

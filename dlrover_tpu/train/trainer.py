@@ -187,14 +187,19 @@ class ElasticTrainer:
         param_bytes = sum(
             l.size * l.dtype.itemsize for l in jax.tree.leaves(params)
         )
-        if shape.get("fsdp", 1) > 1:
+        fsdp = shape.get("fsdp", 1)
+        if fsdp > 1:
+            # ledger unit is PER-SHARD payload per issue (what one rank
+            # sends), matching measure_axis_bandwidth's accounting — an
+            # fsdp all-gather/reduce-scatter moves 1/fsdp of the params
+            # per rank per issue
             record_collective(
                 "fsdp.param_all_gather", "all_gather", "fsdp",
-                nbytes=param_bytes, count=2 * self.accum_steps,
+                nbytes=param_bytes // fsdp, count=2 * self.accum_steps,
             )
             record_collective(
                 "fsdp.grad_reduce_scatter", "reduce_scatter", "fsdp",
-                nbytes=param_bytes, count=1,
+                nbytes=param_bytes // fsdp, count=1,
             )
         if shape.get("dp", 1) > 1:
             record_collective(

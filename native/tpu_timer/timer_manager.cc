@@ -1,9 +1,14 @@
 #include "timer_manager.h"
 
+#include <algorithm>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <ctime>
+#include <map>
 #include <sstream>
+#include <utility>
+#include <vector>
 
 namespace dlrover_tpu {
 
@@ -25,6 +30,13 @@ TimerManager::TimerManager() : t0_ns_(MonotonicNs()) {
   hang_timeout_us_ = secs * 1000000LL;
   const char* peak = std::getenv("DLROVER_TPU_TIMER_PEAK_TFLOPS");
   peak_tflops_ = peak ? std::atof(peak) : 0.0;
+  // Cardinality cap (reference bvar_prometheus.cc:1-232 buckets series
+  // by throughput level for the same reason): per-program series are
+  // kept for the top-N programs by total device time; the long tail is
+  // aggregated into flops-magnitude buckets.
+  const char* max_series = std::getenv("DLROVER_TPU_TIMER_MAX_SERIES");
+  max_series_ = max_series ? (size_t)std::atoll(max_series) : 32;
+  if (max_series_ == 0) max_series_ = 32;
   watcher_ = std::thread([this] { WatchLoop(); });
 }
 
@@ -163,20 +175,71 @@ static int64_t Quantile(const ProgramStats& s, double q) {
   return (int64_t)s.max_us;
 }
 
-static void AppendStats(
-    std::ostringstream& out, const char* metric,
-    const std::unordered_map<std::string, ProgramStats>& stats) {
-  for (const auto& kv : stats) {
-    const auto& s = kv.second;
-    out << metric << "_total{program=\"" << kv.first << "\"} " << s.count
-        << "\n";
-    out << metric << "_us_sum{program=\"" << kv.first << "\"} " << s.total_us
-        << "\n";
-    out << metric << "_us_max{program=\"" << kv.first << "\"} " << s.max_us
-        << "\n";
-    if (s.errors)
-      out << metric << "_errors{program=\"" << kv.first << "\"} " << s.errors
-          << "\n";
+static void AppendOneStat(std::ostringstream& out, const char* metric,
+                          const char* label_key, const std::string& label,
+                          const ProgramStats& s) {
+  out << metric << "_total{" << label_key << "=\"" << label << "\"} "
+      << s.count << "\n";
+  out << metric << "_us_sum{" << label_key << "=\"" << label << "\"} "
+      << s.total_us << "\n";
+  out << metric << "_us_max{" << label_key << "=\"" << label << "\"} "
+      << s.max_us << "\n";
+  if (s.errors)
+    out << metric << "_errors{" << label_key << "=\"" << label << "\"} "
+        << s.errors << "\n";
+}
+
+// Throughput-level bucket label for a tail program: the order of
+// magnitude of its per-execution flops ("flops_1e12"), "flops_none"
+// when the cost analysis gave nothing. Matches the reference's
+// throughput-level series bucketing (bvar_prometheus.cc) in spirit:
+// cardinality is bounded by the ~15 possible magnitudes, while
+// similar-sized programs aggregate together meaningfully.
+static std::string FlopsBucket(const ProgramStats& s) {
+  if (s.flops <= 0) return "flops_none";
+  int mag = (int)std::floor(std::log10(s.flops));
+  std::ostringstream b;
+  b << "flops_1e" << mag;
+  return b.str();
+}
+
+static void MergeStats(ProgramStats& dst, const ProgramStats& s) {
+  dst.count += s.count;
+  dst.total_us += s.total_us;
+  if (s.max_us > dst.max_us) dst.max_us = s.max_us;
+  dst.errors += s.errors;
+  for (int i = 0; i < kLatencyBuckets; i++)
+    dst.lat_buckets[i] += s.lat_buckets[i];
+  dst.flops += s.flops;
+  dst.bytes += s.bytes;
+}
+
+// Partition stats into the per-program head (top max_series by total
+// device time) and a flops-magnitude-bucketed tail.
+static void SplitByCardinality(
+    const std::unordered_map<std::string, ProgramStats>& stats,
+    size_t max_series,
+    std::vector<std::pair<std::string, const ProgramStats*>>* head,
+    std::map<std::string, ProgramStats>* tail) {
+  head->clear();
+  tail->clear();
+  if (stats.size() <= max_series) {
+    for (const auto& kv : stats) head->emplace_back(kv.first, &kv.second);
+    return;
+  }
+  std::vector<std::pair<std::string, const ProgramStats*>> order;
+  order.reserve(stats.size());
+  for (const auto& kv : stats) order.emplace_back(kv.first, &kv.second);
+  std::sort(order.begin(), order.end(),
+            [](const auto& a, const auto& b) {
+              return a.second->total_us > b.second->total_us;
+            });
+  for (size_t i = 0; i < order.size(); i++) {
+    if (i < max_series) {
+      head->push_back(order[i]);
+    } else {
+      MergeStats((*tail)[FlopsBucket(*order[i].second)], *order[i].second);
+    }
   }
 }
 
@@ -201,35 +264,64 @@ std::string TimerManager::PrometheusText() {
     out << "dlrover_tpu_timer_mfu "
         << (mfu_den_ > 0 ? mfu_num_ / mfu_den_ : 0.0) << "\n";
   }
-  AppendStats(out, "dlrover_tpu_timer_execute", exec_stats_);
-  AppendStats(out, "dlrover_tpu_timer_compile", compile_stats_);
+  // Cardinality-capped per-program series: head by device time, tail
+  // aggregated into throughput-level buckets (reference
+  // bvar_prometheus.cc series bucketing).
+  std::vector<std::pair<std::string, const ProgramStats*>> exec_head;
+  std::map<std::string, ProgramStats> exec_tail;
+  SplitByCardinality(exec_stats_, max_series_, &exec_head, &exec_tail);
+  std::vector<std::pair<std::string, const ProgramStats*>> comp_head;
+  std::map<std::string, ProgramStats> comp_tail;
+  SplitByCardinality(compile_stats_, max_series_, &comp_head, &comp_tail);
+  for (const auto& kv : exec_head)
+    AppendOneStat(out, "dlrover_tpu_timer_execute", "program", kv.first,
+                  *kv.second);
+  for (const auto& kv : exec_tail)
+    AppendOneStat(out, "dlrover_tpu_timer_execute", "bucket", kv.first,
+                  kv.second);
+  for (const auto& kv : comp_head)
+    AppendOneStat(out, "dlrover_tpu_timer_compile", "program", kv.first,
+                  *kv.second);
+  for (const auto& kv : comp_tail)
+    AppendOneStat(out, "dlrover_tpu_timer_compile", "bucket", kv.first,
+                  kv.second);
+  if (!exec_tail.empty())
+    out << "dlrover_tpu_timer_bucketed_programs "
+        << (exec_stats_.size() > max_series_
+                ? exec_stats_.size() - max_series_
+                : 0)
+        << "\n";
+
   // Prometheus histogram + quantile gauges per program (reference:
-  // per-kernel bvar latency quantiles, common/bvar_prometheus.cc)
-  for (const auto& kv : exec_stats_) {
-    const auto& s = kv.second;
-    if (s.count == 0) continue;
+  // per-kernel bvar latency quantiles, common/bvar_prometheus.cc) —
+  // head per-program, tail per-bucket
+  auto emit_hist = [&](const char* label_key, const std::string& label,
+                       const ProgramStats& s) {
+    if (s.count == 0) return;
     uint64_t cum = 0;
     for (int i = 0; i < kLatencyBuckets; i++) {
       cum += s.lat_buckets[i];
-      out << "dlrover_tpu_timer_execute_latency_us_bucket{program=\""
-          << kv.first << "\",le=\"";
+      out << "dlrover_tpu_timer_execute_latency_us_bucket{" << label_key
+          << "=\"" << label << "\",le=\"";
       if (i == kLatencyBuckets - 1)
         out << "+Inf";
       else
         out << (kLatencyBase << i);
       out << "\"} " << cum << "\n";
     }
-    out << "dlrover_tpu_timer_execute_latency_us_count{program=\""
-        << kv.first << "\"} " << s.count << "\n";
-    out << "dlrover_tpu_timer_execute_latency_us_sum{program=\""
-        << kv.first << "\"} " << s.total_us << "\n";
-    out << "dlrover_tpu_timer_execute_latency_us_p50{program=\""
-        << kv.first << "\"} " << Quantile(s, 0.50) << "\n";
-    out << "dlrover_tpu_timer_execute_latency_us_p99{program=\""
-        << kv.first << "\"} " << Quantile(s, 0.99) << "\n";
-  }
-  for (const auto& kv : exec_stats_) {
-    const auto& s = kv.second;
+    out << "dlrover_tpu_timer_execute_latency_us_count{" << label_key
+        << "=\"" << label << "\"} " << s.count << "\n";
+    out << "dlrover_tpu_timer_execute_latency_us_sum{" << label_key
+        << "=\"" << label << "\"} " << s.total_us << "\n";
+    out << "dlrover_tpu_timer_execute_latency_us_p50{" << label_key
+        << "=\"" << label << "\"} " << Quantile(s, 0.50) << "\n";
+    out << "dlrover_tpu_timer_execute_latency_us_p99{" << label_key
+        << "=\"" << label << "\"} " << Quantile(s, 0.99) << "\n";
+  };
+  for (const auto& kv : exec_head) emit_hist("program", kv.first, *kv.second);
+  for (const auto& kv : exec_tail) emit_hist("bucket", kv.first, kv.second);
+  for (const auto& kv : exec_head) {
+    const auto& s = *kv.second;
     if (s.flops <= 0 && s.bytes <= 0) continue;
     out << "dlrover_tpu_timer_program_flops{program=\"" << kv.first << "\"} "
         << s.flops << "\n";

@@ -89,6 +89,9 @@ class TimerManager {
   // Test hook: shrink the hang timeout (normally from env
   // DLROVER_TPU_TIMER_HANG_SECS, default 300).
   void SetHangTimeoutUs(int64_t us) { hang_timeout_us_ = us; }
+  // Test hook: per-program series cap (normally from env
+  // DLROVER_TPU_TIMER_MAX_SERIES, default 32).
+  void SetMaxSeries(size_t n) { max_series_ = n ? n : 1; }
 
  private:
   TimerManager();
@@ -111,6 +114,7 @@ class TimerManager {
   // live MFU: peak from env DLROVER_TPU_TIMER_PEAK_TFLOPS (0 = unset,
   // per-program utilization then unavailable but flops/bytes still export)
   double peak_tflops_ = 0;
+  size_t max_series_ = 32;  // per-program series cap (tail is bucketed)
   double device_flops_total_ = 0;  // sum of completed executions' flops
   // flops-weighted live MFU across programs: decayed numerator
   // (util*flops) over decayed denominator (flops), so a chatty tiny

@@ -157,7 +157,8 @@ def test_trainer_records_fsdp_and_dp_collectives():
     pbytes = sum(
         l.size * l.dtype.itemsize for l in jax.tree.leaves(params)
     )
-    assert ag.nbytes == pbytes and ag.count == 2 * tr.accum_steps
+    # ledger unit: per-shard payload per issue (1/fsdp of the params)
+    assert ag.nbytes == pbytes // 2 and ag.count == 2 * tr.accum_steps
 
 
 def test_measure_axis_bandwidth_real_collective():
@@ -198,12 +199,17 @@ def test_prometheus_export_end_to_end():
 
 
 def test_metrics_http_server():
+    from dlrover_tpu.profiler.comm import stop_metrics_server
+
     comm_ledger.record("x.hop", "ppermute", "sp", nbytes=512, count=2)
     srv, port = start_metrics_server(0)
     try:
+        # singleton: a second trainer must get the SAME server back
+        srv2, port2 = start_metrics_server(0)
+        assert (srv2, port2) == (srv, port)
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=5
         ).read().decode()
         assert 'collective="x.hop"' in body
     finally:
-        srv.shutdown()
+        stop_metrics_server()

@@ -186,6 +186,42 @@ def test_interleaved_grads_match_single_device():
         )
 
 
+def test_interleaved_rank_major_layout_matches_canonical():
+    """pp_interleave_layout='rank_major' skips the per-step layer
+    gather; with the state pre-permuted by interleave_layers the loss is
+    identical to the canonical layout."""
+    cfg_c = llama.LlamaConfig.tiny(
+        n_layers=4, pp_microbatches=2, pp_schedule="1f1b",
+        pp_virtual_stages=2,
+    )
+    cfg_r = llama.LlamaConfig.tiny(
+        n_layers=4, pp_microbatches=2, pp_schedule="1f1b",
+        pp_virtual_stages=2, pp_interleave_layout="rank_major",
+    )
+    params = llama.init_params(
+        llama.LlamaConfig.tiny(n_layers=4), jax.random.key(0)
+    )
+    toks = jax.random.randint(
+        jax.random.key(1), (4, 16), 0, cfg_c.vocab_size
+    )
+    _, mesh = _mesh(2)
+    sharded = jax.device_put(
+        params, named_shardings(mesh, llama.param_specs(cfg_c, pp=2))
+    )
+    canonical = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg_c, mesh)
+    )(sharded, toks))
+    rm = llama.interleave_layers(sharded, pp=2, v=2)
+    rank_major = float(jax.jit(
+        lambda p, t: llama.loss_fn(p, t, cfg_r, mesh)
+    )(rm, toks))
+    np.testing.assert_allclose(rank_major, canonical, rtol=1e-6)
+    # helpers round-trip
+    back = llama.deinterleave_layers(rm, pp=2, v=2)
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(sharded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_interleaved_matches_plain_1f1b():
     n_micro = 4
     cfg_p = llama.LlamaConfig.tiny(

