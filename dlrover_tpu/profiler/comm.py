@@ -227,6 +227,7 @@ def stop_metrics_server():
         if _server_singleton is not None:
             try:
                 _server_singleton[0].shutdown()
+                _server_singleton[0].server_close()  # release the fd/port
             except Exception:
                 pass
             _server_singleton = None

@@ -33,12 +33,18 @@ def native_build_dir() -> str:
 
 def build_native(force: bool = False) -> str:
     """Build the interposer (idempotent); returns the .so path."""
-    lib = os.path.join(native_build_dir(), "libdlrover_tpu_timer.so")
-    if force or not os.path.exists(lib):
+    build = native_build_dir()
+    targets = [
+        os.path.join(build, "libdlrover_tpu_timer.so"),
+        os.path.join(build, "libmock_pjrt.so"),
+        os.path.join(build, "test_interposer"),
+        os.path.join(build, "test_bucketing"),
+    ]
+    if force or not all(os.path.exists(t) for t in targets):
         subprocess.run(
             ["make", "-C", NATIVE_DIR], check=True, capture_output=True
         )
-    return lib
+    return targets[0]
 
 
 def find_libtpu() -> str:

@@ -202,9 +202,11 @@ class ElasticTrainer:
                 nbytes=param_bytes // fsdp, count=1,
             )
         if shape.get("dp", 1) > 1:
+            # grads entering the dp psum are fsdp-sharded when fsdp>1:
+            # per-shard payload is param_bytes/fsdp
             record_collective(
                 "dp.grad_allreduce", "psum", "dp",
-                nbytes=param_bytes, count=1,
+                nbytes=param_bytes // max(fsdp, 1), count=1,
             )
 
     def _build_step(self):

@@ -159,6 +159,10 @@ def test_trainer_records_fsdp_and_dp_collectives():
     )
     # ledger unit: per-shard payload per issue (1/fsdp of the params)
     assert ag.nbytes == pbytes // 2 and ag.count == 2 * tr.accum_steps
+    # dp allreduce operates on fsdp-sharded grads: per-shard too
+    dpev = next(e for e in comm_ledger.events()
+                if e.name == "dp.grad_allreduce")
+    assert dpev.nbytes == pbytes // 2
 
 
 def test_measure_axis_bandwidth_real_collective():
