@@ -38,6 +38,10 @@ class ElasticLaunchConfig:
     training_port: int = 0  # coordinator port base; 0 = auto
     tpu_timer: bool = False  # interpose the native PJRT profiler
     tpu_timer_port: int = TpuTimerConsts.DEFAULT_PORT
+    # per-collective comm attribution: workers serve the comm ledger on
+    # comm_metrics_port + local_rank; the agent scrapes into diagnosis
+    comm_metrics: bool = False
+    comm_metrics_port: int = 29700
     ckpt_replica: bool = False  # cross-host backup of staged checkpoints
 
     # TPU topology hints (injected by the platform or discovered)

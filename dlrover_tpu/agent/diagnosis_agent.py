@@ -41,6 +41,7 @@ class DiagnosisAgent:
         self._thread: Optional[threading.Thread] = None
         self._log_source = None  # callable -> str (worker log tail)
         self._metrics_source = None  # callable -> dict (tpu_timer scrape)
+        self._comm_metrics_source = None  # callable -> dict (comm ledger)
         self._hang_dumper = None  # profiler.hang_dump.HangDumper
 
     def set_log_source(self, fn):
@@ -48,6 +49,11 @@ class DiagnosisAgent:
 
     def set_metrics_source(self, fn):
         self._metrics_source = fn
+
+    def set_comm_metrics_source(self, fn):
+        """Per-collective comm attribution scrape (profiler/comm.py
+        CommMetricsSource); shipped as CommMetricsRecord."""
+        self._comm_metrics_source = fn
 
     def set_hang_dumper(self, dumper):
         """On a detected hang the agent collects all-rank Python stacks +
@@ -120,6 +126,12 @@ class DiagnosisAgent:
                     self._client.report_diagnosis_data(
                         "HangDumpRecord", json.dumps(bundle)
                     )
+        if self._comm_metrics_source is not None:
+            comm = self._comm_metrics_source()
+            if comm:
+                self._client.report_diagnosis_data(
+                    "CommMetricsRecord", json.dumps(comm)
+                )
 
     def collect_and_ship_dump(
         self, reason: str = "master_request", min_interval: float = 20.0

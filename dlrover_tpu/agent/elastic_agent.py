@@ -78,8 +78,18 @@ class ElasticAgent:
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._current_world: Optional[CommWorld] = None
         self._ckpt_saver = None  # wired by the flash-checkpoint layer
+        try:
+            diag_interval = float(
+                os.environ.get("DLROVER_TPU_DIAG_INTERVAL", "60") or 60
+            )
+        except ValueError:
+            logger.warning(
+                "DLROVER_TPU_DIAG_INTERVAL is not numeric; using 60s"
+            )
+            diag_interval = 60.0
         self._diagnosis = DiagnosisAgent(
-            client=self._client, node_id=config.node_id
+            client=self._client, node_id=config.node_id,
+            interval_secs=max(diag_interval, 1.0),
         )
         self._diagnosis.set_log_source(self._last_worker_log_tail)
         self._tpu_timer_env: Dict[str, str] = {}
@@ -98,6 +108,13 @@ class ElasticAgent:
         self._paral_tuner = None
         if config.tpu_timer:
             self._setup_tpu_timer()
+        if config.comm_metrics:
+            from dlrover_tpu.profiler.comm import CommMetricsSource
+
+            self._diagnosis.set_comm_metrics_source(CommMetricsSource([
+                config.comm_metrics_port + i
+                for i in range(config.nproc_per_node)
+            ]))
 
     def _setup_tpu_timer(self):
         """Route workers' PJRT plugin loading through the native profiler
@@ -370,6 +387,10 @@ class ElasticAgent:
                 self._config.tpu_timer_port + local_rank
             )
         process_id = world.process_id_base + local_rank
+        if self._config.comm_metrics:
+            env["DLROVER_TPU_COMM_METRICS_PORT"] = str(
+                self._config.comm_metrics_port + local_rank
+            )
         env.update(
             {
                 NodeEnv.JOB_NAME: self._config.job_name,

@@ -21,6 +21,7 @@ class DiagnosisDataType:
     ACCEL_METRICS = "accel_metrics"  # external exporter scrape tier
     RESOURCE_USAGE = "resource_usage"
     HANG_DUMP = "hang_dump"  # all-rank stacks + pending device programs
+    COMM_METRICS = "comm_metrics"  # per-collective attribution rollup
 
 
 class DiagnosisData:
@@ -130,6 +131,39 @@ class TpuMetricsRecord(DiagnosisData):
         return rec
 
 
+class CommMetricsRecord(DiagnosisData):
+    """Per-axis communication rollup for one host: the agent's
+    ``CommMetricsSource`` scrape of the workers' per-collective ledgers
+    (profiler/comm.py). ``axes`` maps mesh axis -> {link, bytes_per_step,
+    est_seconds_per_step} — the fleet-level ICI/DCN signal the
+    reference's per-collective bus-bandwidth metrics feed (xpu_timer
+    NCCL classification)."""
+
+    def __init__(self, axes: Optional[Dict] = None, workers: int = 0,
+                 **kw):
+        kw.setdefault("data_type", DiagnosisDataType.COMM_METRICS)
+        super().__init__(**kw)
+        self.axes = axes or {}
+        self.workers = workers
+        if not self.data_content:
+            self.data_content = json.dumps(
+                {"workers": workers, "axes": self.axes}
+            )
+
+    @classmethod
+    def from_json(cls, text: str) -> "CommMetricsRecord":
+        rec = cls()
+        rec.data_content = text
+        try:
+            payload = json.loads(text)
+        except (ValueError, TypeError):
+            return rec
+        if isinstance(payload, dict):
+            rec.axes = payload.get("axes", {}) or {}
+            rec.workers = int(payload.get("workers", 0) or 0)
+        return rec
+
+
 class AcceleratorMetricsRecord(DiagnosisData):
     """Condensed accelerator-exporter gauges for one host (the scraper
     tier, ``common/metric/monitor.py`` — reference GpuMetricMonitor's
@@ -198,6 +232,7 @@ _DATA_CLASSES: Dict[str, Type[DiagnosisData]] = {
     "DiagnosisData": DiagnosisData,
     "TrainingLogRecord": TrainingLogRecord,
     "TpuMetricsRecord": TpuMetricsRecord,
+    "CommMetricsRecord": CommMetricsRecord,
     "AcceleratorMetricsRecord": AcceleratorMetricsRecord,
     "HangDumpRecord": HangDumpRecord,
 }

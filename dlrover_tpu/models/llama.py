@@ -515,6 +515,31 @@ def _record_sp_comm(cfg: LlamaConfig, mesh: Mesh, batch: int, seq: int,
         )
 
 
+def _record_tp_comm(cfg: LlamaConfig, mesh: Mesh, batch: int, seq: int,
+                    n_layers: int = 0, calls_per_loss: int = 1):
+    """Analytic tp inventory: row-parallel outputs (wo, w_down) each
+    allreduce a full-size activation over tp, twice per layer. nbytes is
+    the standard allreduce algorithm volume per rank (~activation size;
+    ring sends 2(n-1)/n of it) — approximate, like NCCL busbw formulas."""
+    tp = mesh.shape.get(TP, 1)
+    if tp <= 1:
+        return
+    from dlrover_tpu.profiler.comm import record_collective
+
+    data = max(
+        mesh.shape.get(DP, 1) * mesh.shape.get(FSDP, 1)
+        * mesh.shape.get(EP, 1), 1,
+    )
+    bl = max(batch // data, 1)
+    s_local = seq // mesh.shape.get(SP, 1)
+    act = bl * s_local * cfg.dim * jnp.dtype(cfg.dtype).itemsize
+    record_collective(
+        "tp.act_allreduce", "psum", TP, act,
+        count=2 * (n_layers or cfg.n_layers) * calls_per_loss,
+        per="loss_call",
+    )
+
+
 def loss_fn(
     params: Params,
     tokens: jnp.ndarray,  # (b, s) int32; next-token targets derived inside
@@ -526,6 +551,7 @@ def loss_fn(
         return _pp_loss(params, tokens, cfg, mesh)
     if mesh is not None:
         _record_sp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
+        _record_tp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
     logits = forward(params, tokens, cfg, mesh)
     nll_sum, n_valid = _ce_sums(logits, tokens)
     return nll_sum / jnp.maximum(n_valid, 1.0)
@@ -576,6 +602,12 @@ def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
                           count=n_ticks, per="loss_call")
         record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
                           count=n_ticks, per="loss_call")
+        # tp inside the stages: ~n_micro forward + n_micro backward slab
+        # passes, each over the rank's L/pp layers
+        _record_tp_comm(
+            cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
+            calls_per_loss=2 * n_micro,
+        )
         return
     n_ticks = n_micro + pp_size - 1
     record_collective("pp.act_hop", "ppermute", PP, act_bytes,
@@ -591,6 +623,11 @@ def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
             cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
             calls_per_loss=n_ticks,
         )
+    # tp inside stages: n_ticks forward slabs + autodiff backward again
+    _record_tp_comm(
+        cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
+        calls_per_loss=2 * n_ticks,
+    )
 
 
 @functools.lru_cache(maxsize=32)

@@ -23,6 +23,7 @@ from dlrover_tpu.profiler.analysis import (  # noqa: F401
 from dlrover_tpu.profiler.comm import (  # noqa: F401
     CollectiveEvent,
     CommLedger,
+    CommMetricsSource,
     axis_links,
     collective_scope,
     comm_ledger,

@@ -277,7 +277,10 @@ def matmul_bench(m: int, k: int, n: int, dtype: str = "bfloat16",
     time.sleep(max(0.05, 2.0 * t_sync))  # compute certainly done by now
     t0 = time.perf_counter()
     jax.device_get(lat_probe)
-    lat = time.perf_counter() - t0  # tunnel roundtrip only
+    # tunnel roundtrip only; clamp to the full sync turnaround — on a
+    # loaded host a scheduler hiccup can inflate this probe past the
+    # real roundtrip, and an over-subtracted lat corrupts the rate
+    lat = min(time.perf_counter() - t0, t_sync)
 
     t0 = time.perf_counter()
     out = None

@@ -261,6 +261,67 @@ def _start_metrics_server(port: int):
     return srv, srv.server_address[1]
 
 
+class CommMetricsSource:
+    """Callable for ``DiagnosisAgent.set_comm_metrics_source``: scrape
+    each local worker's comm ``/metrics`` endpoint (the agent assigns
+    port base + local_rank) and condense per-axis byte/second totals —
+    the agent-side collector tier of the per-collective attribution,
+    mirroring how tpu_timer metrics flow into diagnosis (reference:
+    xpu_timer_metric_collector.py)."""
+
+    _ROW = None  # compiled regex cache
+
+    def __init__(self, ports):
+        self._ports = (
+            list(ports) if isinstance(ports, (list, tuple)) else [ports]
+        )
+
+    def __call__(self) -> Dict:
+        import re
+        import urllib.request
+
+        if CommMetricsSource._ROW is None:
+            CommMetricsSource._ROW = re.compile(
+                r"dlrover_tpu_comm_(bytes|est_seconds)_per_step\{"
+                r'collective="([^"]+)",kind="[^"]+",axis="([^"]+)",'
+                r'link="([^"]+)"\} ([\d.eE+-]+)'
+            )
+        axes: Dict[str, Dict] = {}
+        workers = 0
+        for port in self._ports:
+            try:
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ).read().decode()
+            except OSError:
+                continue
+            rows = list(CommMetricsSource._ROW.finditer(text))
+            if not rows:
+                # responding but ledger still empty (worker booted, no
+                # program traced yet): counting it would dilute the
+                # per-worker average below
+                continue
+            workers += 1
+            for m in rows:
+                unit, _coll, axis, link, val = m.groups()
+                row = axes.setdefault(
+                    axis, {"link": link, "bytes_per_step": 0.0,
+                           "est_seconds_per_step": 0.0},
+                )
+                key = ("bytes_per_step" if unit == "bytes"
+                       else "est_seconds_per_step")
+                row[key] += float(val)
+        if not workers or not axes:
+            return {}
+        # per-worker average: every worker reports the same program set
+        for row in axes.values():
+            row["bytes_per_step"] = int(row["bytes_per_step"] / workers)
+            row["est_seconds_per_step"] = (
+                row["est_seconds_per_step"] / workers
+            )
+        return {"workers": workers, "axes": axes}
+
+
 def axis_links(mesh, n_slices: int = 1) -> Dict[str, str]:
     """Classify each mesh axis as "ici" or "dcn". With the slice-major
     multislice layout (``parallel/mesh.py build_mesh``), only the

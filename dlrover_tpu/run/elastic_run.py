@@ -60,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="interpose the native PJRT profiler into workers")
     p.add_argument("--tpu-timer-port", type=int,
                    default=TpuTimerConsts.DEFAULT_PORT, dest="tpu_timer_port")
+    p.add_argument("--comm-metrics", action="store_true",
+                   dest="comm_metrics",
+                   help="serve + scrape per-collective comm attribution "
+                        "(profiler/comm.py) from every worker")
+    p.add_argument("--comm-metrics-port", type=int, default=29700,
+                   dest="comm_metrics_port")
     p.add_argument("--no-save-at-breakpoint", action="store_false",
                    dest="save_at_breakpoint",
                    help="skip the shm->storage checkpoint persist before "
@@ -129,6 +135,8 @@ def config_from_args(args) -> ElasticLaunchConfig:
         accelerator=args.accelerator,
         tpu_timer=args.tpu_timer,
         tpu_timer_port=args.tpu_timer_port,
+        comm_metrics=args.comm_metrics,
+        comm_metrics_port=args.comm_metrics_port,
         ckpt_replica=args.ckpt_replica,
         save_at_breakpoint=args.save_at_breakpoint,
         monitor_interval=args.monitor_interval,

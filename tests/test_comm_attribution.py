@@ -202,6 +202,55 @@ def test_prometheus_export_end_to_end():
     assert summary["dp"]["bytes_per_step"] == 1 << 20
 
 
+def test_comm_metrics_source_scrapes_and_ships_to_diagnosis():
+    """Agent-side collection: CommMetricsSource condenses the worker
+    endpoints' rows per axis, and the DiagnosisAgent ships them as a
+    CommMetricsRecord (the --comm-metrics CLI path)."""
+    from dlrover_tpu.agent.diagnosis_agent import DiagnosisAgent
+    from dlrover_tpu.profiler.comm import (
+        CommMetricsSource,
+        stop_metrics_server,
+    )
+
+    comm_ledger.set_links({"sp": "ici", "dp": "dcn"})
+    comm_ledger.record("ring_attention.kv_hop", "ppermute", "sp",
+                       nbytes=1 << 20, count=8)
+    comm_ledger.record("dp.grad_allreduce", "psum", "dp",
+                       nbytes=4 << 20, count=1)
+    comm_ledger.set_bandwidth("sp", 10.0)
+    _, port = start_metrics_server(0)
+    try:
+        src = CommMetricsSource([port, port + 1])  # one port dead: fine
+        got = src()
+        assert got["workers"] == 1
+        assert got["axes"]["sp"]["bytes_per_step"] == 8 << 20
+        assert got["axes"]["sp"]["link"] == "ici"
+        assert got["axes"]["dp"]["link"] == "dcn"
+        assert got["axes"]["sp"]["est_seconds_per_step"] == pytest.approx(
+            (8 << 20) / (10.0 * 2**30)
+        )
+
+        shipped = []
+
+        class FakeClient:
+            def report_diagnosis_data(self, dtype, content):
+                shipped.append((dtype, content))
+
+        agent = DiagnosisAgent(client=FakeClient(), node_id=0)
+        agent.set_comm_metrics_source(src)
+        agent.report_once()
+        types = [t for t, _ in shipped]
+        assert "CommMetricsRecord" in types
+        import json as _json
+
+        rec = _json.loads(
+            next(c for t, c in shipped if t == "CommMetricsRecord")
+        )
+        assert rec["axes"]["sp"]["bytes_per_step"] == 8 << 20
+    finally:
+        stop_metrics_server()
+
+
 def test_metrics_http_server():
     from dlrover_tpu.profiler.comm import stop_metrics_server
 
