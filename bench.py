@@ -346,11 +346,23 @@ def main():
                 pauses.append(time.perf_counter() - t0)
                 engine.wait_staging()  # drain off-path stage (not counted)
             blocking = min(pauses)
+            # restore-from-shm: the crash-recovery path ("order of
+            # seconds" reference claim, flash_checkpoint.md:390-393) —
+            # rebuild the state from the staged segment onto the device
+            t0 = time.perf_counter()
+            restored = engine.load(target={"params": state["params"]})
+            restore_s = time.perf_counter() - t0
+            if restored is not None:
+                jax.block_until_ready(restored[1])
+                restore_s = time.perf_counter() - t0
             ckpt = {
                 "blocking_save_s": round(blocking, 4),
                 "stage_mode": engine.last_stage_mode,
                 "vs_baseline": (round(BASELINE_CKPT_S / max(blocking, 1e-9),
                                       3) if nparams >= 1e9 else None),
+                "restore_from_shm_s": (
+                    round(restore_s, 4) if restored is not None else None
+                ),
                 "staged_gb": round(param_bytes / 2**30, 3),
                 "d2h_gbps": round(rate, 3) if on_tpu else None,
                 "trials": trials,

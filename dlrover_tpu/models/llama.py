@@ -505,8 +505,16 @@ def _record_sp_comm(cfg: LlamaConfig, mesh: Mesh, batch: int, seq: int,
         h_l = max(cfg.n_heads // tp, 1)
         q_b = bl * s_local * h_l * hd * itemsize
         kv_b = bl * s_local * hkv_l * hd * itemsize
+        # GQA below sp: ulysses_attention replicates kv heads by
+        # sp/gcd(hkv, sp); the kv all-to-all volume grows accordingly
+        rep = 1
+        if hkv_l % sp:
+            import math
+
+            rep = sp // math.gcd(hkv_l, sp)
         record_collective(
-            "ulysses.head_scatter", "all_to_all", SP, q_b + 2 * kv_b,
+            "ulysses.head_scatter", "all_to_all", SP,
+            q_b + 2 * rep * kv_b,
             count=L * calls_per_loss, per="loss_call",
         )
         record_collective(
@@ -602,8 +610,10 @@ def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
                           count=n_ticks, per="loss_call")
         record_collective("pp.grad_hop", "ppermute", PP, act_bytes,
                           count=n_ticks, per="loss_call")
-        # tp inside the stages: ~n_micro forward + n_micro backward slab
-        # passes, each over the rank's L/pp layers
+        # tp inside the stages: the 1f1b conds SKIP compute on bubble
+        # ticks, so exactly n_micro forward + n_micro backward slab
+        # passes run, each over the rank's L/pp layers. (No sp record:
+        # validate_for_mesh rejects 1f1b x sp.)
         _record_tp_comm(
             cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
             calls_per_loss=2 * n_micro,
@@ -623,7 +633,10 @@ def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
             cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
             calls_per_loss=n_ticks,
         )
-    # tp inside stages: n_ticks forward slabs + autodiff backward again
+    # tp inside stages: n_ticks forward slabs + autodiff backward again.
+    # Deliberately n_TICKS, not n_micro: gpipe's scan body is
+    # unconditional (XLA-friendly), so bubble ticks execute masked slabs
+    # and their collectives really run — unlike 1f1b's cond-gated ticks
     _record_tp_comm(
         cfg, mesh, mb, s, n_layers=cfg.n_layers // pp_size,
         calls_per_loss=2 * n_ticks,

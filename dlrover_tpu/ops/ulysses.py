@@ -27,8 +27,11 @@ re-partitioning heads while un-sharding the sequence computes the same
 math as single-device causal attention per head.
 
 GQA: P must divide the K/V head count too. With fewer KV heads than P,
-ring attention or head replication are the options — asserted here
-rather than silently replicated.
+when KV heads don't divide sp, each kv head is replicated by
+sp/gcd(hkv, sp) (the DeepSpeed-Ulysses GQA treatment) so the scatter
+divides — exact, at the cost of a proportionally larger kv all-to-all;
+shapes where even replication can't produce a valid GQA grouping
+(h % lcm(hkv, sp) != 0) raise with a pointer to ring attention.
 """
 
 from __future__ import annotations
@@ -71,11 +74,32 @@ def ulysses_attention(
         return flash_attention(q, k, v, causal=causal,
                                block_q=block_q, block_k=block_k)
     h, hkv = q.shape[2], k.shape[2]
-    if h % sp or hkv % sp:
+    if h % sp:
         raise ValueError(
-            f"ulysses needs heads divisible by sp: h={h} hkv={hkv} sp={sp}"
-            " (use ring attention for fewer KV heads than sp)"
+            f"ulysses needs query heads divisible by sp: h={h} sp={sp}"
+            " (use ring attention otherwise)"
         )
+    if hkv % sp:
+        # GQA with fewer (or indivisible) KV heads than sp: replicate
+        # each kv head so the head-scatter divides (DeepSpeed-Ulysses
+        # GQA treatment). jnp.repeat keeps the q->kv group mapping of
+        # the flash kernel intact ([k0,k0,k1,k1,...] with the ratio
+        # halved per replica), and backward sums replica grads — exact.
+        # Cost: kv all-to-all volume grows by the replication factor;
+        # kv is the small side, and this unlocks ulysses for e.g.
+        # 8-kv-head models on sp=16.
+        import math
+
+        rep = sp // math.gcd(hkv, sp)
+        if h % (hkv * rep):
+            raise ValueError(
+                f"ulysses GQA replication needs h % lcm(hkv, sp) == 0: "
+                f"h={h} hkv={hkv} sp={sp} (lcm={hkv * rep}); use ring "
+                "attention for this shape"
+            )
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        hkv *= rep
     # NB: comm attribution for the all-to-alls is recorded at the MODEL
     # layer (models/llama.py), which knows the per-step multiplicity
     # (n_layers x microbatches); this body traces once per layer scan.

@@ -234,13 +234,44 @@ def test_ulysses_attention_grads():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_ulysses_rejects_indivisible_heads():
+def test_ulysses_gqa_replicates_kv_heads_below_sp():
+    """GQA with hkv < sp: kv heads replicate so the head scatter
+    divides (DeepSpeed-Ulysses GQA treatment) — output matches the
+    unsharded reference exactly."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dlrover_tpu.ops.attention import mha_reference
+    from dlrover_tpu.ops.ulysses import ulysses_attention
+
+    q, k, v = _qkv(b=1, s=32, h=4, hkv=2, d=8)  # hkv=2 < sp=4
+    ref = mha_reference(q, k, v, causal=True)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    spec = P(None, "sp", None, None)
+    uly = shard_map(
+        lambda q, k, v: ulysses_attention(
+            q, k, v, axis_name="sp", block_q=8, block_k=8
+        ),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    got = uly(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-2, atol=2e-3
+    )
+
+
+def test_ulysses_rejects_unreplicatable_heads():
     from jax import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from dlrover_tpu.ops.ulysses import ulysses_attention
 
-    q, k, v = _qkv(b=1, s=32, h=4, hkv=2, d=8)  # hkv=2 < sp=4
+    # h=4, hkv=3, sp=4: lcm(3,4)=12 does not divide h -> no valid GQA
+    # grouping even with replication
+    q, _, _ = _qkv(b=1, s=32, h=4, hkv=2, d=8)
+    k = jnp.zeros((1, 32, 3, 8), q.dtype)
+    v = jnp.zeros((1, 32, 3, 8), q.dtype)
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
     spec = P(None, "sp", None, None)
     uly = shard_map(
@@ -248,7 +279,7 @@ def test_ulysses_rejects_indivisible_heads():
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
-    with pytest.raises(ValueError, match="divisible"):
+    with pytest.raises(ValueError, match="ring"):
         uly(q, k, v)
 
 
