@@ -347,10 +347,13 @@ def main():
                 engine.wait_staging()  # drain off-path stage (not counted)
             blocking = min(pauses)
             # restore-from-shm: the crash-recovery path ("order of
-            # seconds" reference claim, flash_checkpoint.md:390-393) —
-            # rebuild the state from the staged segment onto the device
+            # seconds" reference claim, flash_checkpoint.md:390-393).
+            # Call the memory path DIRECTLY — engine.load silently falls
+            # back to a disk restore, which must not masquerade as shm
             t0 = time.perf_counter()
-            restored = engine.load(target={"params": state["params"]})
+            restored = engine._load_from_memory(
+                target={"params": state["params"]}
+            )
             restore_s = time.perf_counter() - t0
             if restored is not None:
                 jax.block_until_ready(restored[1])

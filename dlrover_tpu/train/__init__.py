@@ -1,1 +1,6 @@
 from dlrover_tpu.train.bootstrap import WorkerContext, get_context, init  # noqa: F401
+from dlrover_tpu.train.datasets import (  # noqa: F401
+    TokenFileDataset,
+    pack_text_file,
+    pack_tokens,
+)

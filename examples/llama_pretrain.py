@@ -41,6 +41,12 @@ def parse_args():
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--ckpt-dir", default="/tmp/llama_pretrain_ckpt")
     p.add_argument("--save-every", type=int, default=10)
+    p.add_argument("--data", default="",
+                   help="flat binary token file (nanoGPT/Megatron .bin "
+                        "convention; see dlrover_tpu.train.datasets); "
+                        "empty = synthetic tokens")
+    p.add_argument("--data-dtype", default="uint16",
+                   choices=["uint16", "uint32", "int32"])
     return p.parse_args()
 
 
@@ -102,15 +108,70 @@ def main():
         print(f"restored from step {start}", flush=True)
 
     a, b = trainer.step_batch_shape
-    for step in range(start, args.steps):
-        # synthetic tokens; swap in ElasticDataLoader/ShardingClient for
-        # master-driven shard assignment (see docs/tutorial)
-        batch = jax.random.randint(
-            jax.random.fold_in(jax.random.key(1), step), (a, b, seq), 0,
-            cfg.vocab_size,
+    loader_iter = None
+    loader = None
+    loader_state_path = os.path.join(args.ckpt_dir, "loader_state.json")
+    if args.data:
+        import json
+
+        import numpy as np
+
+        from dlrover_tpu.train.data import (
+            ElasticDataLoader,
+            ElasticDistributedSampler,
         )
+        from dlrover_tpu.train.datasets import TokenFileDataset
+
+        dataset = TokenFileDataset(args.data, seq_len=seq,
+                                   dtype=args.data_dtype)
+        if len(dataset) < a * b:
+            raise SystemExit(
+                f"--data has only {len(dataset)} sequences of seq={seq}; "
+                f"need at least one global batch of {a * b}"
+            )
+        # every host draws the IDENTICAL global batch (num_replicas=1):
+        # the trainer's jitted step expects the same (a, b, seq) array on
+        # all processes and slices each device's shard from it. For
+        # corpora too large to read fully from every host, switch to the
+        # master-driven ShardingClient flow (docs/tutorial).
+        sampler = ElasticDistributedSampler(
+            dataset_size=len(dataset), batch_size=a * b,
+            num_replicas=1, rank=0, shuffle=True, seed=1,
+        )
+        loader = ElasticDataLoader(
+            dataset, batch_size=a * b, sampler=sampler,
+            collate=lambda xs: np.stack(xs).reshape(a, b, seq),
+        )
+        if restored is not None and os.path.exists(loader_state_path):
+            with open(loader_state_path) as f:
+                loader.load_state_dict(json.load(f))
+            print("loader position restored", flush=True)
+
+        def batches():
+            while True:  # loop epochs; the step budget bounds the run
+                yield from loader
+
+        loader_iter = batches()
+
+    for step in range(start, args.steps):
+        if loader_iter is not None:
+            batch = next(loader_iter)
+        else:
+            # synthetic tokens; --data switches to the memmapped corpus
+            batch = jax.random.randint(
+                jax.random.fold_in(jax.random.key(1), step), (a, b, seq),
+                0, cfg.vocab_size,
+            )
         state, loss = trainer.step(state, batch)
         ckpt.save(step + 1, state)
+        if loader is not None and jax.process_index() == 0:
+            # data position rides a sidecar so a resume continues the
+            # epoch instead of replaying it (sampler state_dict)
+            import json
+
+            os.makedirs(args.ckpt_dir, exist_ok=True)
+            with open(loader_state_path, "w") as f:
+                json.dump(loader.state_dict(), f)
         if jax.process_index() == 0:
             print(f"step {step + 1} loss {float(loss):.4f}", flush=True)
     ckpt.close()
