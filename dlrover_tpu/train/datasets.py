@@ -114,18 +114,36 @@ def pack_text_file(
     dtype: str = "uint16",
     chunk_bytes: int = 1 << 20,
 ) -> int:
-    """Tokenize a text file into the binary format, streaming. Default
+    """Tokenize a text file into the binary format, streaming in
+    LINE-ALIGNED chunks (a subword tokenizer applied to an arbitrary
+    mid-word split would produce different ids than the contiguous
+    text; newline boundaries are where tokenizers are stable). Default
     tokenizer is raw UTF-8 bytes (vocab 256) — a real run passes e.g. a
-    ``transformers`` tokenizer's encode."""
+    ``transformers`` tokenizer's encode. The destination is TRUNCATED
+    first: re-running a packing job must not silently append a second
+    copy of the corpus (``pack_tokens`` itself appends, for multi-file
+    packing)."""
+    open(bin_path, "wb").close()  # truncate
     total = 0
+    buf: list = []
+    buf_chars = 0
     with open(text_path, "r", encoding="utf-8", errors="replace") as f:
-        while True:
-            chunk = f.read(chunk_bytes)
-            if not chunk:
-                break
+        for line in f:
+            buf.append(line)
+            buf_chars += len(line)
+            if buf_chars >= chunk_bytes:
+                text = "".join(buf)
+                ids = (
+                    list(text.encode("utf-8")) if tokenize is None
+                    else list(tokenize(text))
+                )
+                total += pack_tokens(bin_path, ids, dtype=dtype)
+                buf, buf_chars = [], 0
+        if buf:
+            text = "".join(buf)
             ids = (
-                list(chunk.encode("utf-8")) if tokenize is None
-                else list(tokenize(chunk))
+                list(text.encode("utf-8")) if tokenize is None
+                else list(tokenize(text))
             )
             total += pack_tokens(bin_path, ids, dtype=dtype)
     return total

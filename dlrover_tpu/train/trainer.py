@@ -85,6 +85,7 @@ class ElasticTrainer:
         self.optimizer = make_optimizer(train_config)
         self.worker_ctx = worker_ctx
         self._step_fn = None
+        self._eval_fn = None
         self._host_step = 0
         self._applied_config_version = 0
         self._maybe_serve_comm_metrics()
@@ -309,6 +310,38 @@ class ElasticTrainer:
             state = self.apply_paral_config(state, config)
         return state
 
+    def eval_step(self, state: dict, batch) -> jnp.ndarray:
+        """Loss of one batch WITHOUT touching the train state: jitted
+        forward-only, no donation (state survives), batch shaped
+        (micro*dp, ...) — one microbatch row of ``step_batch_shape``."""
+        if self._eval_fn is None:
+            bspec = batch_spec()
+            self._eval_fn = jax.jit(
+                lambda params, b: self.loss_fn(params, b),
+                in_shardings=(
+                    None, NamedSharding(self.mesh, P(*bspec)),
+                ),
+            )
+        return self._eval_fn(state["params"], batch)
+
+    def evaluate(self, state: dict, batches) -> float:
+        """Mean loss over an iterable of eval batches (each shaped like
+        one ``step_batch_shape`` row). The evaluator-role analogue of the
+        reference's estimator evaluation: the same jitted graph and mesh
+        as training, params untouched, no optimizer state involved."""
+        total = 0.0
+        count = 0
+        for batch in batches:
+            total += float(self.eval_step(state, batch))
+            count += 1
+        if count == 0:
+            # 0.0 would read as a perfect loss to early-stopping logic
+            raise ValueError(
+                "evaluate() got zero batches (eval dataset smaller than "
+                "one batch under drop_last?)"
+            )
+        return total / count
+
     def step(self, state: dict, batch) -> Tuple[dict, jnp.ndarray]:
         """One optimizer step = ``accum_steps`` microbatches.
 
@@ -344,6 +377,7 @@ class ElasticTrainer:
         self.mesh = mesh
         self.mesh_config = mesh_config
         self._step_fn = None
+        self._eval_fn = None  # its NamedSharding binds the old mesh
         logger.info(
             "remesh: world=%d accum %d→%d (global batch fixed at %d)",
             mesh.size, old, self.accum_steps, self.tc.global_batch_size,

@@ -164,9 +164,13 @@ def main():
             )
         state, loss = trainer.step(state, batch)
         ckpt.save(step + 1, state)
-        if loader is not None and jax.process_index() == 0:
-            # data position rides a sidecar so a resume continues the
-            # epoch instead of replaying it (sampler state_dict)
+        if loader is not None and (step + 1) % args.save_every == 0:
+            # data position rides a sidecar, written at the SAME cadence
+            # as the storage persist so a disk restore never pairs an
+            # old model with a newer data position (a shm restore may
+            # replay a few batches — safe direction). EVERY host writes:
+            # with a non-shared ckpt dir each host restores its own copy
+            # and the identical-global-batch invariant holds.
             import json
 
             os.makedirs(args.ckpt_dir, exist_ok=True)

@@ -28,7 +28,10 @@ while true; do
     if probe; then
         echo "[$(date +%FT%T)] probe $n: TPU ALIVE - running bench" >>"$LOG"
         out="$RUNS_DIR/bench_$(date +%s).json"
-        if timeout "${TPU_BENCH_TIMEOUT:-3600}" python bench.py \
+        # the watcher just probed successfully; if the tunnel wedges
+        # again mid-bench, one failed re-probe should fall through fast
+        if DLROVER_BENCH_PROBE_ATTEMPTS=2 \
+                timeout "${TPU_BENCH_TIMEOUT:-3600}" python bench.py \
                 >"$out" 2>>"$LOG"; then
             # check the TOP-LEVEL backend: a CPU fallback embeds the
             # cached TPU blob whose text would fool a plain grep

@@ -104,3 +104,50 @@ def test_composes_with_shard_tasks(corpus):
         task = mgr.get_task(node_id=0)
     # every sample consumed exactly once (first token identifies it)
     assert sorted(seen) == [i * 10 for i in range(100)]
+
+
+def test_trainer_evaluate_leaves_state_untouched():
+    """ElasticTrainer.eval_step/evaluate: forward-only loss on the
+    training mesh; params and optimizer state unchanged, eval loss
+    matches the plain loss_fn."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    mc = MeshConfig(dp=2, fsdp=2, sp=1, tp=2).resolve(8)
+    mesh = build_mesh(mc)
+    cfg = llama.LlamaConfig.tiny()
+    specs = llama.param_specs(cfg)
+    params = jax.device_put(
+        llama.init_params(cfg, jax.random.key(0)),
+        named_shardings(mesh, specs),
+    )
+    tc = TrainConfig(global_batch_size=8, micro_batch_size=2,
+                     warmup_steps=0, total_steps=10)
+    tr = ElasticTrainer(
+        lambda p, t: llama.loss_fn(p, t, cfg, mesh), specs, mesh, mc, tc
+    )
+    state = tr.init_state(params)
+    _, b = tr.step_batch_shape
+    batches = [
+        jax.random.randint(jax.random.key(10 + i), (b, 16), 0,
+                           cfg.vocab_size)
+        for i in range(3)
+    ]
+    ref = float(np.mean([
+        float(llama.loss_fn(params, t, cfg)) for t in batches
+    ]))
+    before = jax.tree.map(np.asarray, state["params"])
+    got = tr.evaluate(state, batches)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    after = jax.tree.map(np.asarray, state["params"])
+    for x, y in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(x, y)
+    # training still works after eval (no donated-buffer damage)
+    tbatch = jax.random.randint(jax.random.key(99), (1, b, 16), 0,
+                                cfg.vocab_size)
+    state, loss = tr.step(state, tbatch)
+    assert float(loss) > 0
