@@ -194,7 +194,13 @@ def main():
     # default window is ~15 min of retrying (r4 verdict: treat a fresh
     # TPU number as a feature with engineering behind it)
     alive = False
-    attempts = int(os.environ.get("DLROVER_BENCH_PROBE_ATTEMPTS", "5"))
+    state = "down"
+    try:
+        attempts = max(
+            1, int(os.environ.get("DLROVER_BENCH_PROBE_ATTEMPTS", "5"))
+        )
+    except ValueError:
+        attempts = 5
     for attempt in range(attempts):
         state = _tpu_probe()
         if state == "tpu":

@@ -115,35 +115,41 @@ def pack_text_file(
     chunk_bytes: int = 1 << 20,
 ) -> int:
     """Tokenize a text file into the binary format, streaming in
-    LINE-ALIGNED chunks (a subword tokenizer applied to an arbitrary
-    mid-word split would produce different ids than the contiguous
-    text; newline boundaries are where tokenizers are stable). Default
-    tokenizer is raw UTF-8 bytes (vocab 256) — a real run passes e.g. a
-    ``transformers`` tokenizer's encode. The destination is TRUNCATED
-    first: re-running a packing job must not silently append a second
-    copy of the corpus (``pack_tokens`` itself appends, for multi-file
-    packing)."""
-    open(bin_path, "wb").close()  # truncate
+    chunks extended to the next newline (a subword tokenizer applied to
+    a mid-word split produces different ids than contiguous text;
+    newline boundaries are far more stable, though tokenizers that
+    merge runs of newlines can still differ by a token per boundary).
+    Memory stays bounded: a "line" longer than ``chunk_bytes`` is split
+    mid-line rather than buffered whole. Default tokenizer is raw UTF-8
+    bytes (vocab 256) — a real run passes e.g. a ``transformers``
+    tokenizer's encode.
+
+    Atomicity: output goes to ``bin_path + '.tmp'`` and replaces
+    ``bin_path`` only on success, so a failed re-pack never destroys an
+    existing corpus and a partial pack is never mistaken for a complete
+    one (``pack_tokens`` itself appends, for multi-file packing)."""
+    tmp_path = bin_path + ".tmp"
+    open(tmp_path, "wb").close()  # truncate the temp
     total = 0
-    buf: list = []
-    buf_chars = 0
+
+    def flush(text: str) -> int:
+        ids = (
+            list(text.encode("utf-8")) if tokenize is None
+            else list(tokenize(text))
+        )
+        return pack_tokens(tmp_path, ids, dtype=dtype)
+
     with open(text_path, "r", encoding="utf-8", errors="replace") as f:
-        for line in f:
-            buf.append(line)
-            buf_chars += len(line)
-            if buf_chars >= chunk_bytes:
-                text = "".join(buf)
-                ids = (
-                    list(text.encode("utf-8")) if tokenize is None
-                    else list(tokenize(text))
-                )
-                total += pack_tokens(bin_path, ids, dtype=dtype)
-                buf, buf_chars = [], 0
-        if buf:
-            text = "".join(buf)
-            ids = (
-                list(text.encode("utf-8")) if tokenize is None
-                else list(tokenize(text))
-            )
-            total += pack_tokens(bin_path, ids, dtype=dtype)
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk:
+                break
+            if not chunk.endswith("\n"):
+                # extend to the next newline for tokenizer stability,
+                # but never past another chunk_bytes (single-huge-line
+                # corpora must not buffer unboundedly)
+                tail = f.readline(chunk_bytes)
+                chunk += tail
+            total += flush(chunk)
+    os.replace(tmp_path, bin_path)
     return total

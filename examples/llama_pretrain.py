@@ -110,7 +110,12 @@ def main():
     a, b = trainer.step_batch_shape
     loader_iter = None
     loader = None
-    loader_state_path = os.path.join(args.ckpt_dir, "loader_state.json")
+    # per-host filename: shared ckpt dirs must not have N hosts racing
+    # one file (every host's content is identical, but torn concurrent
+    # writes are not)
+    loader_state_path = os.path.join(
+        args.ckpt_dir, f"loader_state-{jax.process_index()}.json"
+    )
     if args.data:
         import json
 
@@ -143,9 +148,17 @@ def main():
             collate=lambda xs: np.stack(xs).reshape(a, b, seq),
         )
         if restored is not None and os.path.exists(loader_state_path):
-            with open(loader_state_path) as f:
-                loader.load_state_dict(json.load(f))
-            print("loader position restored", flush=True)
+            try:
+                with open(loader_state_path) as f:
+                    side = json.load(f)
+            except ValueError:
+                side = None  # torn write: fall back to epoch start
+            # discard a sidecar AHEAD of the restored model (the disk
+            # persist is async; a crash inside that window must replay
+            # data, never skip it)
+            if side is not None and side.get("step", 0) <= start:
+                loader.load_state_dict(side["loader"])
+                print("loader position restored", flush=True)
 
         def batches():
             while True:  # loop epochs; the step budget bounds the run
@@ -165,17 +178,20 @@ def main():
         state, loss = trainer.step(state, batch)
         ckpt.save(step + 1, state)
         if loader is not None and (step + 1) % args.save_every == 0:
-            # data position rides a sidecar, written at the SAME cadence
-            # as the storage persist so a disk restore never pairs an
-            # old model with a newer data position (a shm restore may
-            # replay a few batches — safe direction). EVERY host writes:
-            # with a non-shared ckpt dir each host restores its own copy
-            # and the identical-global-batch invariant holds.
+            # data position rides a per-host sidecar stamped with the
+            # step: restore discards it when it is AHEAD of the restored
+            # model (the storage persist is async), so a crash replays
+            # data rather than skipping it. tmp+rename keeps each write
+            # atomic against SIGKILL.
             import json
 
             os.makedirs(args.ckpt_dir, exist_ok=True)
-            with open(loader_state_path, "w") as f:
-                json.dump(loader.state_dict(), f)
+            tmp = loader_state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"step": step + 1, "loader": loader.state_dict()}, f
+                )
+            os.replace(tmp, loader_state_path)
         if jax.process_index() == 0:
             print(f"step {step + 1} loss {float(loss):.4f}", flush=True)
     ckpt.close()

@@ -222,6 +222,33 @@ def test_interleaved_rank_major_layout_matches_canonical():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_interleaved_grads_match_with_fsdp():
+    """Interleaved 1F1B composed with fsdp (ZeRO param sharding inside
+    the stages): gradients still match the single-device model."""
+    cfg = llama.LlamaConfig.tiny(
+        n_layers=4, pp_schedule="1f1b", pp_virtual_stages=2,
+        pp_microbatches=2,
+    )
+    ref_cfg = llama.LlamaConfig.tiny(n_layers=4)
+    params = llama.init_params(ref_cfg, jax.random.key(0))
+    toks = jax.random.randint(
+        jax.random.key(1), (4, 16), 0, cfg.vocab_size
+    )
+    ref_grads = jax.grad(lambda p: llama.loss_fn(p, toks, ref_cfg))(params)
+    mc = MeshConfig(dp=1, pp=2, fsdp=2, sp=1, tp=2).resolve(8)
+    mesh = build_mesh(mc)
+    sharded = jax.device_put(
+        params, named_shardings(mesh, llama.param_specs(cfg, pp=2))
+    )
+    got = jax.jit(
+        jax.grad(lambda p: llama.loss_fn(p, toks, cfg, mesh))
+    )(sharded)
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(got)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+
+
 def test_interleaved_matches_plain_1f1b():
     n_micro = 4
     cfg_p = llama.LlamaConfig.tiny(
