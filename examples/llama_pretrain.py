@@ -147,16 +147,34 @@ def main():
             dataset, batch_size=a * b, sampler=sampler,
             collate=lambda xs: np.stack(xs).reshape(a, b, seq),
         )
-        if restored is not None and os.path.exists(loader_state_path):
-            try:
-                with open(loader_state_path) as f:
-                    side = json.load(f)
-            except ValueError:
-                side = None  # torn write: fall back to epoch start
+        if restored is not None:
+            side = None
+            if os.path.exists(loader_state_path):
+                try:
+                    with open(loader_state_path) as f:
+                        side = json.load(f)
+                except ValueError:
+                    side = None  # torn write: fall back to epoch start
             # discard a sidecar AHEAD of the restored model (the disk
             # persist is async; a crash inside that window must replay
             # data, never skip it)
-            if side is not None and side.get("step", 0) <= start:
+            if side is not None and side.get("step", 0) > start:
+                side = None
+            # cross-host agreement: hosts whose renames straddled the
+            # kill hold different steps; every host must load the SAME
+            # position or none (the jitted step requires the identical
+            # global batch on all processes)
+            my_step = side["step"] if side is not None else -1
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                import numpy as np
+
+                steps = np.asarray(multihost_utils.process_allgather(
+                    np.array([my_step])
+                )).reshape(-1)
+                if not (steps == steps[0]).all() or steps[0] < 0:
+                    side = None
+            if side is not None:
                 loader.load_state_dict(side["loader"])
                 print("loader position restored", flush=True)
 
