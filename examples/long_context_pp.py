@@ -33,6 +33,9 @@ import dlrover_tpu.train as dtrain
 def parse_args():
     p = argparse.ArgumentParser("long_context_pp")
     p.add_argument("--schedule", default="gpipe", choices=["gpipe", "1f1b"])
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help=">1 = interleaved 1f1b (pp*virtual_stages must "
+                        "divide layers)")
     p.add_argument("--pp", type=int, default=2)
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=1)
@@ -61,6 +64,7 @@ def main():
         n_layers=args.layers, n_heads=4, n_kv_heads=2,
         max_seq_len=args.seq,
         pp_schedule=args.schedule, pp_microbatches=args.micro_batches,
+        pp_virtual_stages=args.virtual_stages,
     )
     mc = MeshConfig(
         dp=-1, pp=args.pp, fsdp=args.fsdp, sp=args.sp, tp=args.tp,
