@@ -130,6 +130,13 @@ class ElasticTrainer:
         return self.tc.global_batch_size // denom
 
     @property
+    def batch_sharding(self):
+        """The NamedSharding the jitted step expects for its batch —
+        the single source of truth input pipelines (prefetch) should
+        place against."""
+        return NamedSharding(self.mesh, P(None, *batch_spec()))
+
+    @property
     def step_batch_shape(self) -> Tuple[int, int]:
         """(accum_steps, global_batch_per_accum) — how callers should shape
         the token batch fed to `step`."""
@@ -262,7 +269,7 @@ class ElasticTrainer:
 
         # state keeps the shardings its arrays already carry (params placed
         # by the caller, opt state born sharded in init_state).
-        batch_sh = NamedSharding(self.mesh, P(None, *bspec))
+        batch_sh = self.batch_sharding
         return jax.jit(
             step,
             in_shardings=(None, batch_sh),

@@ -178,15 +178,39 @@ def main():
                 loader.load_state_dict(side["loader"])
                 print("loader position restored", flush=True)
 
+        from collections import deque
+
+        # sampler positions AFTER each produced batch: prefetch pulls
+        # ahead, so the sidecar must record the CONSUMED position, not
+        # the sampler's (which runs up to `size` batches ahead)
+        state_q: deque = deque()
+
         def batches():
             while True:  # loop epochs; the step budget bounds the run
-                yield from loader
+                for b_ in loader:
+                    state_q.append(loader.state_dict())
+                    yield b_
 
-        loader_iter = batches()
+        if jax.process_count() == 1:
+            # keep 2 batches in flight on-device: h2d rides behind
+            # compute, placed straight onto the step's batch sharding.
+            # Multi-host keeps the plain numpy handoff: every host holds
+            # the IDENTICAL global batch (num_replicas=1), which jit's
+            # in_shardings consumes correctly, while prefetch's
+            # multi-host branch would treat it as a per-process shard
+            from dlrover_tpu.train.data import prefetch_to_device
 
+            loader_iter = prefetch_to_device(
+                batches(), sharding=trainer.batch_sharding
+            )
+        else:
+            loader_iter = batches()
+
+    loader_pos = None
     for step in range(start, args.steps):
         if loader_iter is not None:
             batch = next(loader_iter)
+            loader_pos = state_q.popleft()  # position of THIS batch
         else:
             # synthetic tokens; --data switches to the memmapped corpus
             batch = jax.random.randint(
@@ -206,9 +230,7 @@ def main():
             os.makedirs(args.ckpt_dir, exist_ok=True)
             tmp = loader_state_path + ".tmp"
             with open(tmp, "w") as f:
-                json.dump(
-                    {"step": step + 1, "loader": loader.state_dict()}, f
-                )
+                json.dump({"step": step + 1, "loader": loader_pos}, f)
             os.replace(tmp, loader_state_path)
         if jax.process_index() == 0:
             print(f"step {step + 1} loss {float(loss):.4f}", flush=True)
