@@ -59,6 +59,60 @@ def _index_to_ranges(index, shape) -> Tuple[Tuple[int, int], ...]:
     return tuple(out)
 
 
+#: live engines whose in-flight background stage must be drained at
+#: teardown. Module-level (one atexit hook + one SIGTERM chain link per
+#: PROCESS, not per engine) so repeatedly built engines — benches,
+#: elastic rebuilds — neither grow the handler chain nor stay pinned
+#: after close(). Weak refs: an engine abandoned without close() is
+#: GC-collectable, not pinned (and not serially drained) forever.
+_DRAIN_REGISTRY: "weakref.WeakSet" = None
+_drain_hooks_installed = False
+
+
+def _registry() -> "weakref.WeakSet":
+    global _DRAIN_REGISTRY
+    if _DRAIN_REGISTRY is None:
+        import weakref
+
+        _DRAIN_REGISTRY = weakref.WeakSet()
+    return _DRAIN_REGISTRY
+
+
+def _drain_all_engines():
+    for eng in list(_registry()):
+        try:
+            eng._drain_at_exit()
+        except BaseException as e:  # never let one engine's failure (or
+            # a SystemExit smuggled out of a staging thread) skip the
+            # remaining drains or the SIGTERM re-kill chain
+            logger.warning("drain of %r at teardown failed: %s", eng, e)
+
+
+def _install_drain_hooks():
+    global _drain_hooks_installed
+    if _drain_hooks_installed:
+        return
+    _drain_hooks_installed = True
+    import atexit
+    import signal
+
+    atexit.register(_drain_all_engines)
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            _drain_all_engines()
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signum, prev or signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # not the main thread: atexit alone still covers exits
+
+
 class CheckpointEngine:
     def __init__(
         self,
@@ -122,6 +176,7 @@ class CheckpointEngine:
         self._snap_fn = None
         self._staging_thread: Optional[threading.Thread] = None
         self._staging_error: Optional[BaseException] = None
+        self._crash_drain_installed = False
         #: how the last save staged: "device_snapshot" (pause = HBM copy),
         #: "host_gather" (pause = d2h transfer), or "sync"
         self.last_stage_mode = ""
@@ -248,9 +303,41 @@ class CheckpointEngine:
         self._report_save(step, blocking)
         return blocking
 
+    def _install_crash_drain(self):
+        """Join in-flight staging on every teardown the interpreter can
+        see: atexit (covers normal exit AND uncaught exceptions) plus a
+        chained SIGTERM handler (covers agent-driven restarts and k8s
+        preemption grace windows). The device snapshot dies with the
+        process, so draining at teardown is what turns "save() returned"
+        into "that step is recoverable" for every crash short of SIGKILL.
+        A hard kill falls back to the last drained step — or, if the kill
+        lands inside the shm write itself (the header is invalidated
+        before the payload memcpy and republished after, so a torn write
+        can never be READ as valid), to the last disk persist; the
+        master's shard queues replay the lost steps exactly
+        (tests/test_ckpt_e2e.py covers both crash modes). Reference
+        blocks through the shm write instead (engine.py:155-502) — zero
+        window, but the pause scales with the d2h link."""
+        if self._crash_drain_installed:
+            return
+        self._crash_drain_installed = True
+        _registry().add(self)
+        _install_drain_hooks()
+
+    def _drain_at_exit(self):
+        try:
+            timeout = float(os.environ.get("DLROVER_TPU_DRAIN_TIMEOUT", "60"))
+        except ValueError:
+            timeout = 60.0
+        try:
+            self.wait_staging(timeout=timeout)
+        except BaseException as e:  # staging errors are stored broadly
+            logger.warning("checkpoint drain at exit failed: %s", e)
+
     def _start_async_stage(
         self, t0: float, step: int, state: Any, persist: bool
     ) -> float:
+        self._install_crash_drain()
         # Degrade, don't crash training: a failure of the PREVIOUS cycle's
         # staging (incl. its shm-lock timeout) means that step was lost —
         # log it and carry on with this one. The unbounded join means the
@@ -737,6 +824,8 @@ class CheckpointEngine:
         short-lived tools (benches, dryruns) whose staged state must not
         outlive them; training processes keep the segment so the agent's
         saver can ship it after a crash."""
+        _registry().discard(self)
+        self._crash_drain_installed = False
         try:
             self.wait_staging(timeout=300)
         except Exception as e:

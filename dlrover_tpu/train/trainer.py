@@ -219,7 +219,6 @@ class ElasticTrainer:
 
     def _build_step(self):
         accum = self.accum_steps
-        bspec = batch_spec()
 
         def step(state, batch):
             # batch: any pytree whose leaves lead with (accum, micro*dp):

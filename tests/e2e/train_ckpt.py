@@ -67,7 +67,9 @@ for step in range(start_step + 1, TOTAL_STEPS + 1):
     )
     if step == CRASH_STEP and ctx.restart_count == 0:
         print(f"[ckpt-e2e] injected crash at step {step}", flush=True)
-        os._exit(23)
+        if os.environ.get("DLROVER_TPU_TEST_CRASH_MODE", "exc") == "exit":
+            os._exit(23)  # hard kill: no teardown, drain thread dies too
+        raise RuntimeError("injected training crash")  # atexit drain runs
     ctx.report_step(step, force=True)
 
 # multi-host safe: "w" spans all processes when nnodes > 1
