@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -65,21 +66,12 @@ def _index_to_ranges(index, shape) -> Tuple[Tuple[int, int], ...]:
 #: elastic rebuilds — neither grow the handler chain nor stay pinned
 #: after close(). Weak refs: an engine abandoned without close() is
 #: GC-collectable, not pinned (and not serially drained) forever.
-_DRAIN_REGISTRY: "weakref.WeakSet" = None
+_DRAIN_REGISTRY = weakref.WeakSet()
 _drain_hooks_installed = False
 
 
-def _registry() -> "weakref.WeakSet":
-    global _DRAIN_REGISTRY
-    if _DRAIN_REGISTRY is None:
-        import weakref
-
-        _DRAIN_REGISTRY = weakref.WeakSet()
-    return _DRAIN_REGISTRY
-
-
 def _drain_all_engines():
-    for eng in list(_registry()):
+    for eng in list(_DRAIN_REGISTRY):
         try:
             eng._drain_at_exit()
         except BaseException as e:  # never let one engine's failure (or
@@ -105,6 +97,9 @@ def _install_drain_hooks():
             if callable(prev):
                 prev(signum, frame)
             else:
+                # prev is SIG_DFL/SIG_IGN — or None for a handler some C
+                # extension installed, which Python cannot re-invoke; the
+                # best available behavior is default-action re-kill
                 signal.signal(signum, prev or signal.SIG_DFL)
                 os.kill(os.getpid(), signum)
 
@@ -321,7 +316,7 @@ class CheckpointEngine:
         if self._crash_drain_installed:
             return
         self._crash_drain_installed = True
-        _registry().add(self)
+        _DRAIN_REGISTRY.add(self)
         _install_drain_hooks()
 
     def _drain_at_exit(self):
@@ -824,7 +819,7 @@ class CheckpointEngine:
         short-lived tools (benches, dryruns) whose staged state must not
         outlive them; training processes keep the segment so the agent's
         saver can ship it after a crash."""
-        _registry().discard(self)
+        _DRAIN_REGISTRY.discard(self)
         self._crash_drain_installed = False
         try:
             self.wait_staging(timeout=300)

@@ -314,7 +314,8 @@ def _default_collate(samples):
     return np.stack(samples)
 
 
-def prefetch_to_device(iterator, size: int = 2, sharding=None):
+def prefetch_to_device(iterator, size: int = 2, sharding=None,
+                       replicated: bool = False):
     """Overlap host->device transfer with compute by keeping ``size``
     batches in flight on the device.
 
@@ -330,7 +331,11 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
     structure. On a multi-host mesh (sharding not fully addressable) the
     batch is taken as this process's LOCAL shard and the global array is
     assembled via ``jax.make_array_from_process_local_data`` — matching
-    how ``ElasticDataLoader`` shards the sample space per process. With
+    how ``ElasticDataLoader`` shards the sample space per process. Pass
+    ``replicated=True`` when every host instead holds the IDENTICAL
+    global batch (``ElasticDataLoader`` with ``num_replicas=1``): each
+    device then slices its own shard out of the global value, so
+    multi-host runs keep the h2d-behind-compute overlap too. With
     ``size=0`` placement still applies; only the overlap is dropped.
 
     The returned generator is one-shot (it follows the wrapped
@@ -351,6 +356,12 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
             return jax.device_put(leaf)
         if sh.is_fully_addressable:
             return jax.device_put(leaf, sh)
+        if replicated:
+            # every process holds the identical global batch: each device
+            # takes its slice (h2d of the addressable shards only)
+            return jax.make_array_from_callback(
+                leaf.shape, sh, lambda idx: leaf[idx]
+            )
         # multi-host mesh: each process holds its LOCAL batch; device_put
         # would treat it as the global value (inconsistent global array).
         # Assemble the global array from per-process shards instead.

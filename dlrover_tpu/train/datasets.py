@@ -66,6 +66,24 @@ class TokenFileDataset:
     def n_tokens(self) -> int:
         return len(self._tokens)
 
+    def validate_vocab(self, vocab_size: int, sample: int = 1 << 20):
+        """Raise if any of the first ``sample`` tokens is >= vocab_size.
+
+        An out-of-range token id reaches the embedding gather as an
+        out-of-bounds index and trains on garbage (nan loss at best,
+        silent corruption at worst); a truncated scan catches the common
+        corpus/tokenizer-vs-model mismatch for the cost of one page-in."""
+        head = self._tokens[: min(sample, len(self._tokens))]
+        if not len(head):
+            return
+        lo, hi = int(head.min()), int(head.max())
+        if hi >= vocab_size or lo < 0:  # signed dtypes can go negative
+            raise ValueError(
+                f"corpus {self.path} has token ids in [{lo}, {hi}], "
+                f"outside the model vocab [0, {vocab_size}) (checked first "
+                f"{len(head)} tokens): wrong tokenizer or wrong --data-dtype?"
+            )
+
     def __len__(self) -> int:
         return self._n
 

@@ -129,6 +129,7 @@ def main():
 
         dataset = TokenFileDataset(args.data, seq_len=seq,
                                    dtype=args.data_dtype)
+        dataset.validate_vocab(cfg.vocab_size)
         if len(dataset) < a * b:
             raise SystemExit(
                 f"--data has only {len(dataset)} sequences of seq={seq}; "
@@ -191,20 +192,16 @@ def main():
                     state_q.append(loader.state_dict())
                     yield b_
 
-        if jax.process_count() == 1:
-            # keep 2 batches in flight on-device: h2d rides behind
-            # compute, placed straight onto the step's batch sharding.
-            # Multi-host keeps the plain numpy handoff: every host holds
-            # the IDENTICAL global batch (num_replicas=1), which jit's
-            # in_shardings consumes correctly, while prefetch's
-            # multi-host branch would treat it as a per-process shard
-            from dlrover_tpu.train.data import prefetch_to_device
+        # keep 2 batches in flight on-device: h2d rides behind compute,
+        # placed straight onto the step's batch sharding. Every host
+        # holds the IDENTICAL global batch (num_replicas=1), so
+        # multi-host uses prefetch's replicated mode (each device slices
+        # its shard from the global value).
+        from dlrover_tpu.train.data import prefetch_to_device
 
-            loader_iter = prefetch_to_device(
-                batches(), sharding=trainer.batch_sharding
-            )
-        else:
-            loader_iter = batches()
+        loader_iter = prefetch_to_device(
+            batches(), sharding=trainer.batch_sharding, replicated=True
+        )
 
     loader_pos = None
     for step in range(start, args.steps):

@@ -1,0 +1,44 @@
+"""Worker for tests/test_prefetch_replicated.py: two jax.distributed
+processes each hold the IDENTICAL global batch; prefetch_to_device in
+replicated mode must assemble correct non-fully-addressable global
+arrays (each device slicing its dp shard) while keeping batches in
+flight."""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+pid = int(sys.argv[1])
+coord = sys.argv[2]
+jax.distributed.initialize(coord, num_processes=2, process_id=pid)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.train.data import prefetch_to_device
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+sh = NamedSharding(mesh, P("dp"))
+assert not sh.is_fully_addressable
+
+
+def gen():
+    for i in range(5):
+        yield np.full((4, 3), i, np.float32)
+
+
+tot = jax.jit(jnp.sum)
+outs = [float(tot(b)) for b in prefetch_to_device(gen(), 2, sh, replicated=True)]
+expect = [i * 12.0 for i in range(5)]
+assert outs == expect, (outs, expect)
+print("PREFETCH_REPL_OK", flush=True)

@@ -1,0 +1,34 @@
+"""Multi-host device prefetch: ``replicated=True`` keeps the
+h2d-behind-compute overlap when every host holds the identical global
+batch (the ElasticDataLoader ``num_replicas=1`` handoff the llama
+example uses)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "tests", "e2e", "prefetch_replicated.py")
+
+
+def test_replicated_prefetch_two_processes():
+    from dlrover_tpu.utils.net import find_free_port
+
+    coord = f"127.0.0.1:{find_free_port()}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # one cpu device per process: the dp axis spans processes, so the
+    # batch sharding is genuinely non-fully-addressable
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, SCRIPT, str(pid), coord],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+        assert "PREFETCH_REPL_OK" in out, out[-2000:]
