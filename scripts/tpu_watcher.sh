@@ -4,9 +4,10 @@
 #
 # Loops forever: probe the axon tunnel in a throwaway subprocess with a
 # hard timeout; the moment it answers, run the full bench (which persists
-# BENCH_TPU_LAST.json on success) and keep a copy of every successful
-# run under bench_runs/. Probes and benches are all subprocesses — a
-# wedged PJRT client dies with its process, never with the watcher.
+# BENCH_TPU_LAST.json after every completed phase) and keep a copy of
+# every successful run under bench_runs/. Probes and benches are all
+# subprocesses — a wedged PJRT client dies with its process, never with
+# the watcher.
 set -u
 cd "$(dirname "$0")/.."
 LOG=${TPU_WATCH_LOG:-/tmp/tpu_watcher.log}
@@ -21,6 +22,16 @@ assert jax.default_backend() == "tpu"
 EOF
 }
 
+# is $1 a bench result whose TOP-LEVEL backend is tpu? (a CPU fallback
+# embeds the cached TPU blob whose text would fool a plain grep)
+is_tpu_result() {
+    python - "$1" <<'EOF' 2>>"$LOG"
+import json, sys
+d = json.load(open(sys.argv[1]))
+sys.exit(0 if d.get("detail", {}).get("backend") == "tpu" else 1)
+EOF
+}
+
 echo "[$(date +%FT%T)] watcher up (pid $$)" >>"$LOG"
 n=0
 while true; do
@@ -28,18 +39,13 @@ while true; do
     if probe; then
         echo "[$(date +%FT%T)] probe $n: TPU ALIVE - running bench" >>"$LOG"
         out="$RUNS_DIR/bench_$(date +%s).json"
+        start_ts=$(date +%s)
         # the watcher just probed successfully; if the tunnel wedges
         # again mid-bench, one failed re-probe should fall through fast
         if DLROVER_BENCH_PROBE_ATTEMPTS=2 \
-                timeout "${TPU_BENCH_TIMEOUT:-3600}" python bench.py \
+                timeout "${TPU_BENCH_TIMEOUT:-7200}" python bench.py \
                 >"$out" 2>>"$LOG"; then
-            # check the TOP-LEVEL backend: a CPU fallback embeds the
-            # cached TPU blob whose text would fool a plain grep
-            if python -c "
-import json, sys
-d = json.load(open('$out'))
-sys.exit(0 if d.get('detail', {}).get('backend') == 'tpu' else 1)
-" 2>>"$LOG"; then
+            if is_tpu_result "$out"; then
                 echo "[$(date +%FT%T)] bench OK -> $out" >>"$LOG"
                 cp "$out" BENCH_TPU_FRESH.json
                 # success: slow down, but keep refreshing (a fresher
@@ -50,6 +56,15 @@ sys.exit(0 if d.get('detail', {}).get('backend') == 'tpu' else 1)
             echo "[$(date +%FT%T)] bench ran but backend!=tpu" >>"$LOG"
         else
             echo "[$(date +%FT%T)] bench failed/timed out" >>"$LOG"
+            # salvage: bench.py persists BENCH_TPU_LAST.json after every
+            # completed phase, so a run killed mid-phase still leaves a
+            # usable TPU result (phases_done records how far it got)
+            if [ -f BENCH_TPU_LAST.json ] && \
+                    [ "$(stat -c %Y BENCH_TPU_LAST.json)" -ge "$start_ts" ] && \
+                    is_tpu_result BENCH_TPU_LAST.json; then
+                echo "[$(date +%FT%T)] salvaged partial TPU result" >>"$LOG"
+                cp BENCH_TPU_LAST.json BENCH_TPU_FRESH.json
+            fi
         fi
     else
         echo "[$(date +%FT%T)] probe $n: tunnel down" >>"$LOG"

@@ -28,7 +28,13 @@ def test_replicated_prefetch_two_processes():
         )
         for pid in range(2)
     ]
-    outs = [p.communicate(timeout=180)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+    finally:
+        for p in procs:  # a dead peer leaves the other blocked on
+            if p.poll() is None:  # the coordinator: don't orphan it
+                p.kill()
+                p.wait()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
         assert "PREFETCH_REPL_OK" in out, out[-2000:]
