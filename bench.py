@@ -118,22 +118,29 @@ def _bench_candidates(llama, jnp):
         # model TFLOP/s — b8 mlp-remat is the untested gap between them;
         # if its activations OOM it falls through to the known winners
         ("llama_1.2B_seq2k_b8_mlp_q512k1024",
-         b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024), 8),
+         b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024),
+         8, 2048),
         # lighter remat (save ffn gate/up) + long flash tiles
         ("llama_1.2B_seq2k_b4_mlp_q512k1024",
-         b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024), 4),
+         b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024),
+         4, 2048),
+        # same tokens as the b4/s2k winner, but seq 4k doubles the
+        # CREDITED attention flops per token (the causal S^2 term)
+        ("llama_1.2B_seq4k_b2_mlp_q512k1024",
+         b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024,
+             max_seq_len=4096), 2, 4096),
         # no remat at all on the 0.8B: zero recompute if it fits
         ("llama_0.8B_seq2k_b4_noremat",
-         b08(remat=False, attn_block_q=512, attn_block_k=1024), 4),
+         b08(remat=False, attn_block_q=512, attn_block_k=1024), 4, 2048),
         # flagship size, biggest batch, long tiles (r3/r4 best measured)
         ("llama_1.2B_seq2k_b8_q512k1024",
-         b12(attn_block_q=512, attn_block_k=1024), 8),
+         b12(attn_block_q=512, attn_block_k=1024), 8, 2048),
         ("llama_1.2B_seq2k_b8_q256k512",
-         b12(attn_block_q=256, attn_block_k=512), 8),
-        ("llama_1.2B_seq2k_b8", b12(), 8),
-        ("llama_1.2B_seq2k_b4", b12(), 4),
-        ("llama_0.8B_seq2k_b4", b08(), 4),
-        ("llama_0.35B_seq2k_b4", b035, 4),
+         b12(attn_block_q=256, attn_block_k=512), 8, 2048),
+        ("llama_1.2B_seq2k_b8", b12(), 8, 2048),
+        ("llama_1.2B_seq2k_b4", b12(), 4, 2048),
+        ("llama_0.8B_seq2k_b4", b08(), 4, 2048),
+        ("llama_0.35B_seq2k_b4", b035, 4, 2048),
     ]
 
 
@@ -249,15 +256,13 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     dev = jax.devices()[0]
     peak = _peak_flops(dev)
-    seq = 2048
-    micro = 4
     timed_steps = 10
 
     if on_tpu:
         candidates = _bench_candidates(llama, jnp)
     else:
-        candidates = [("tiny_cpu", llama.LlamaConfig.tiny(), 2)]
-        seq, timed_steps = 128, 3
+        candidates = [("tiny_cpu", llama.LlamaConfig.tiny(), 2, 128)]
+        timed_steps = 3
 
     def _free(*trees):
         """Release a candidate's device arrays before the next candidate
@@ -271,15 +276,15 @@ def main():
                 except Exception:
                     pass
 
-    results = []  # (rate, name, cfg, micro, step_s)
+    results = []  # (rate, name, cfg, micro, seq, step_s)
     measured = 0
     # sweep: measure up to 3 fitting candidates and keep the fastest
     # (model FLOPs/s, so differently-sized candidates compare fairly)
     max_measured = 3 if on_tpu else 1
-    for name, cand, cand_micro in candidates:
+    for name, cand, cand_micro, cand_seq in candidates:
         try:
             c_trainer, c_state, c_batch, c_step_s = _run_mfu(
-                jax, jnp, llama, cand, cand_micro, seq, timed_steps
+                jax, jnp, llama, cand, cand_micro, cand_seq, timed_steps
             )
         except NanLossError:
             raise
@@ -297,10 +302,10 @@ def main():
                 raise
             print(f"config {name} failed ({msg[:300]})", file=sys.stderr)
             continue
-        rate = _model_flops_per_step(cand, cand_micro, seq) / c_step_s
+        rate = _model_flops_per_step(cand, cand_micro, cand_seq) / c_step_s
         print(f"candidate {name}: {rate / 1e12:.2f} model TFLOP/s "
               f"({c_step_s:.3f}s/step)", file=sys.stderr)
-        results.append((rate, name, cand, cand_micro, c_step_s))
+        results.append((rate, name, cand, cand_micro, cand_seq, c_step_s))
         measured += 1
         _free(c_state, c_batch)
         del c_trainer, c_state, c_batch
@@ -312,7 +317,9 @@ def main():
     model_name = "none"
     cfg = None
     if results:
-        _, model_name, cfg, micro, step_s = max(results, key=lambda r: r[0])
+        _, model_name, cfg, micro, seq, step_s = max(
+            results, key=lambda r: r[0]
+        )
         # rebuild the winner (its arrays were freed during the sweep) for
         # the flash-checkpoint measurement below; untimed
         trainer, state, batch, _ = _run_mfu(
@@ -351,7 +358,7 @@ def main():
         "sweep": [
             {"name": n, "model_tflops": round(r / 1e12, 2),
              "step_s": round(t, 4)}
-            for r, n, _, _, t in results
+            for r, n, _, _, _, t in results
         ],
         "phases_done": ["mfu"],
     }

@@ -75,8 +75,7 @@ def main():
     if cand is None:
         print(json.dumps({"error": f"unknown candidate {cand_name}"}))
         return 1
-    name, cfg, micro = cand
-    seq = 2048
+    name, cfg, micro, seq = cand
     _tr, _state, _batch, step_s = bench._run_mfu(
         jax, jnp, llama, cfg, micro, seq, steps
     )
