@@ -58,6 +58,10 @@ def main():
 
     from dlrover_tpu.models import llama
 
+    # share bench.py's persistent jit cache: repeat variants deserialize
+    # instead of paying the remote-compile tunnel again
+    bench._enable_jit_cache(jax)
+
     dev = jax.devices()[0]
     peak = bench._peak_flops(dev)
     print(f"# device {getattr(dev, 'device_kind', '?')} "
