@@ -165,8 +165,36 @@ def test_slice_count_resize_2_1_2(tmp_path):
         assert done, logs0[-2000:]
         steps = [int(s) for s in re.findall(r"step=(\d+) slices=\d+",
                                             logs0)]
-        assert steps == sorted(steps), steps
         assert steps[-1] == 14
+        # monotonic within each incarnation; across a resume the counter
+        # legally rewinds by the commit lag (the resized restore reads
+        # the last COMMITTED disk step, and those steps replay with the
+        # same shard data) — but never jumps forward
+        for seg in re.split(r"resumed step \d+ onto", logs0):
+            seg_steps = [int(s)
+                         for s in re.findall(r"step=(\d+) slices=\d+", seg)]
+            assert seg_steps == sorted(seg_steps), seg_steps
+        for m in re.finditer(r"resumed step (\d+) onto \d+-slice", logs0):
+            resumed = int(m.group(1))
+            # never forward past data already trained: the resumed step
+            # must have been reached before this resume (+1 because a
+            # kill can land between save(N)'s commit and the step=N
+            # print, so the commit may lead the printed max by one)
+            prior = [int(s) for s in re.findall(
+                r"step=(\d+) slices=\d+", logs0[: m.start()]
+            )]
+            assert prior and resumed <= max(prior) + 1, (m.group(0), prior)
+            # the incarnation continues at resumed+1 (bound the search to
+            # this incarnation: a kill can land before any step prints)
+            nxt_resume = re.search(r"resumed step \d+ onto",
+                                   logs0[m.end():])
+            segment = logs0[m.end(): m.end() + nxt_resume.start()] \
+                if nxt_resume else logs0[m.end():]
+            nxt = re.search(r"step=(\d+) slices=\d+", segment)
+            if nxt:
+                assert int(nxt.group(1)) == resumed + 1, (
+                    m.group(0), nxt.group(0),
+                )
         cold = re.search(r"step=1 slices=2 loss=([\d.]+)", logs0)
         assert cold, logs0[:2000]
         # the state survived both resizes: the final loss sits clearly
