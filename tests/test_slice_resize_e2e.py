@@ -86,7 +86,7 @@ def _kill_node_processes(agent_proc, job, node_id):
             continue
 
 
-def _wait_for(pattern, job, node_id=0, timeout=240):
+def _wait_for(pattern, job, node_id=0, timeout=420):
     deadline = time.time() + timeout
     while time.time() < deadline:
         logs = _worker_log(job, node_id)
@@ -150,8 +150,8 @@ def test_slice_count_resize_2_1_2(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             start_new_session=True,
         )
-        _wait_for(r"resumed step (\d+) onto 2-slice", job, 0, timeout=300)
-        out0, _ = p0.communicate(timeout=420)
+        _wait_for(r"resumed step (\d+) onto 2-slice", job, 0, timeout=480)
+        out0, _ = p0.communicate(timeout=600)
         out1b, _ = p1b.communicate(timeout=120)
         logs0 = _worker_log(job, 0)
         assert p0.returncode == 0, f"{out0[-3000:]}\n{logs0[-3000:]}"
