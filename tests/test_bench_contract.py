@@ -1,0 +1,46 @@
+"""bench.py output contract, pinned.
+
+The driver records bench.py's single JSON line as the round's headline
+and scripts/tpu_watcher.sh salvages partially-completed TPU runs from
+BENCH_TPU_LAST.json — both depend on the shapes asserted here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_json_line_contract(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DLROVER_BENCH_PROBE_ATTEMPTS"] = "1"
+    env["DLROVER_BENCH_PHASES"] = "mfu,ckpt"
+    # isolate the persistent jit cache per test run
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jitcache")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+
+    # the driver's parse: one JSON object with these exact keys
+    assert d["metric"] == "train_step_mfu"
+    assert d["unit"] == "fraction"
+    assert isinstance(d["value"], (int, float))
+    assert isinstance(d["vs_baseline"], (int, float))
+    detail = d["detail"]
+    # the watcher's backend check reads detail.backend at top level
+    assert detail["backend"] in ("cpu", "tpu")
+    # phase accounting: completed phases, in order ("interposer" only
+    # runs on TPU, and was not requested here anyway)
+    assert detail["phases_done"] == ["mfu", "ckpt"]
+    assert detail["sweep"], "sweep must list measured candidates"
+    assert detail["model"] == detail["sweep"][0]["name"]
+    ckpt = detail["ckpt"]
+    assert ckpt["stage_mode"] == "device_snapshot"
+    assert ckpt["blocking_save_s"] < 1.0  # the design claim, CPU-measured
+    assert ckpt["trials"] >= 1
