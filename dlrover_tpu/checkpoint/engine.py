@@ -102,6 +102,12 @@ def _install_drain_hooks():
                 # best available behavior is default-action re-kill
                 signal.signal(signum, prev or signal.SIG_DFL)
                 os.kill(os.getpid(), signum)
+                # Reached only when the re-raise did not terminate us —
+                # prev was SIG_IGN (the kill was ignored). Reinstall this
+                # handler so LATER SIGTERMs still drain: leaving SIG_IGN
+                # installed would let one survived SIGTERM permanently
+                # disable crash-drain for the rest of the process.
+                signal.signal(signum, _on_term)
 
         signal.signal(signal.SIGTERM, _on_term)
     except (ValueError, OSError):
@@ -320,10 +326,15 @@ class CheckpointEngine:
         _install_drain_hooks()
 
     def _drain_at_exit(self):
+        # Default 20 s: comfortably under Kubernetes' default 30 s
+        # termination grace, leaving the previous SIGTERM handler's
+        # cleanup time to run before the kubelet's SIGKILL. Raise it in
+        # lockstep with terminationGracePeriodSeconds on slow d2h links
+        # (deploy/k8s/README.md documents the pairing).
         try:
-            timeout = float(os.environ.get("DLROVER_TPU_DRAIN_TIMEOUT", "60"))
+            timeout = float(os.environ.get("DLROVER_TPU_DRAIN_TIMEOUT", "20"))
         except ValueError:
-            timeout = 60.0
+            timeout = 20.0
         try:
             self.wait_staging(timeout=timeout)
         except BaseException as e:  # staging errors are stored broadly
@@ -725,10 +736,15 @@ class CheckpointEngine:
             if not (isinstance(t_leaf, jax.Array) or hasattr(t_leaf, "sharding")):
                 return True
             shape = tuple(t_leaf.shape)
-            for shard_index in set(
-                t_leaf.sharding.addressable_devices_indices_map(shape).values()
-            ):
-                needed = _index_to_ranges(shard_index, shape)
+            # dedup via the normalized (start, stop) form: raw shard
+            # indices are tuples of slice objects, which are unhashable
+            # before Python 3.12 — set() over them is a TypeError here
+            for needed in {
+                _index_to_ranges(idx, shape)
+                for idx in t_leaf.sharding.addressable_devices_indices_map(
+                    shape
+                ).values()
+            }:
                 contained = any(
                     all(
                         ps <= ns and ne <= pe

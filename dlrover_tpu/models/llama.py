@@ -15,7 +15,10 @@ torch layout:
 - **sequence parallelism**: when the mesh has sp>1 the attention runs as
   `ring_attention` inside a `shard_map` island (kv chunks rotate over ICI);
   otherwise the Pallas `flash_attention` path.
-- bfloat16 compute / float32 params + optimizer, f32 logits for the loss.
+- bfloat16 compute / float32 params + optimizer; the loss fuses the
+  unembed matmul into a chunked cross-entropy (``ops/chunked_ce.py``) so
+  full [B, T, V] f32 logits are never materialized — f32 accumulation per
+  vocab chunk instead (``DLROVER_TPU_CHUNKED_CE=0`` restores dense logits).
 
 The reference has no model code at all (it orchestrates wrapped trainers,
 SURVEY.md §2.8); configs here mirror the public Llama-3 shapes.
@@ -35,6 +38,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from dlrover_tpu.ops import (
     apply_rope,
+    chunked_ce_enabled,
+    chunked_cross_entropy,
     embed_lookup,
     flash_attention,
     mha_reference,
@@ -73,6 +78,11 @@ class LlamaConfig:
     # per-core sequence is long enough)
     attn_block_q: int = 128
     attn_block_k: int = 128
+    # chunked fused cross-entropy (ops/chunked_ce.py): vocab columns per
+    # scan step of the loss — peak loss activation is b*s*ce_chunk_size
+    # f32 instead of the dense path's b*s*vocab. Gated globally by the
+    # DLROVER_TPU_CHUNKED_CE env kill-switch (=0 restores dense logits).
+    ce_chunk_size: int = 2048
     # pipeline parallelism: microbatches in flight per step (0 → pp size).
     # More microbatches shrink the GPipe bubble (pp-1)/(n_micro+pp-1).
     pp_microbatches: int = 0
@@ -409,13 +419,16 @@ def validate_for_mesh(cfg: LlamaConfig, mesh: Mesh, seq_len: int = 0) -> None:
             )
 
 
-def forward(
+def forward_hidden(
     params: Params,
     tokens: jnp.ndarray,  # (b, s) int32
     cfg: LlamaConfig,
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
-    """Logits (b, s, vocab) in float32."""
+    """Final-norm hidden states (b, s, dim) in compute dtype — everything
+    up to (but not including) the unembed matmul, so the loss can fuse
+    the lm-head into a chunked cross-entropy instead of materializing
+    [b, s, vocab] f32 logits."""
     b, s = tokens.shape
     if mesh is not None:
         validate_for_mesh(cfg, mesh, seq_len=s)
@@ -431,15 +444,29 @@ def forward(
         return layer_fn(lp, x), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    # bf16 operands + f32 MXU accumulation: f32 logits for the loss at bf16
-    # matmul throughput (a pure-f32 matmul runs off the MXU fast path)
-    logits = lax.dot_general(
-        x, params["lm_head"].astype(x.dtype),
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def unembed(x: jnp.ndarray, lm_head: jnp.ndarray) -> jnp.ndarray:
+    """Dense logits (..., vocab) in f32: bf16 operands + f32 MXU
+    accumulation — f32 logits for the loss at bf16 matmul throughput (a
+    pure-f32 matmul runs off the MXU fast path)."""
+    return lax.dot_general(
+        x, lm_head.astype(x.dtype),
         (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    return logits
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,  # (b, s) int32
+    cfg: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """Logits (b, s, vocab) in float32."""
+    return unembed(forward_hidden(params, tokens, cfg, mesh),
+                   params["lm_head"])
 
 
 def _ce_sums(logits: jnp.ndarray, tokens: jnp.ndarray):
@@ -560,8 +587,19 @@ def loss_fn(
     if mesh is not None:
         _record_sp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
         _record_tp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
-    logits = forward(params, tokens, cfg, mesh)
-    nll_sum, n_valid = _ce_sums(logits, tokens)
+    if chunked_ce_enabled():
+        # fused lm-head + CE: never materializes [b, s, vocab] logits.
+        # Shifted-target form (last position's target is the -1 sentinel)
+        # computes the head on the same b*s positions the dense path does,
+        # so the bench's model-FLOPs accounting is unchanged.
+        x = forward_hidden(params, tokens, cfg, mesh)
+        nll_sum, n_valid = chunked_cross_entropy(
+            x, params["lm_head"], _shift_targets(tokens),
+            chunk_size=cfg.ce_chunk_size,
+        )
+    else:
+        logits = forward(params, tokens, cfg, mesh)
+        nll_sum, n_valid = _ce_sums(logits, tokens)
     return nll_sum / jnp.maximum(n_valid, 1.0)
 
 
@@ -581,7 +619,7 @@ def _pp_loss(
     # the pp rows unrecorded; this entry runs per call and records are
     # idempotent
     _record_pp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
-    return _jitted_pp_loss(cfg, mesh)(params, tokens)
+    return _jitted_pp_loss(cfg, mesh, chunked_ce_enabled())(params, tokens)
 
 
 def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
@@ -644,7 +682,12 @@ def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted_pp_loss(cfg: LlamaConfig, mesh: Mesh):
+def _jitted_pp_loss(cfg: LlamaConfig, mesh: Mesh, chunked_ce: bool):
+    # ``chunked_ce`` is part of the cache KEY only: _head_loss_sums
+    # re-reads the env var at trace time (which happens on the first call
+    # for this key, when the env still matches), so toggling
+    # DLROVER_TPU_CHUNKED_CE between calls retraces instead of silently
+    # reusing the other path's cached program.
     return jax.jit(
         functools.partial(_pp_loss_impl, cfg=cfg, mesh=mesh)
     )
@@ -766,14 +809,16 @@ def _stage_layer_fn(cfg: LlamaConfig, mb: int, s_local: int, sp_size: int):
 
 
 def _head_loss_sums(cfg: LlamaConfig, out, final_norm, lm_head, tgt):
-    """(nll_sum, n_valid) of one microbatch's slab output."""
+    """(nll_sum, n_valid) of one microbatch's slab output. The chunked-CE
+    op broadcasts over leading dims without reshapes, so it composes
+    inside the pp shard_map manual regions (and under the jax.vjp /
+    value_and_grad the 1f1b schedule takes through this function)."""
     h = rms_norm(out, final_norm, cfg.norm_eps)
-    logits = lax.dot_general(
-        h, lm_head.astype(h.dtype),
-        (((h.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    return _ce_sums_shifted(logits, tgt)
+    if chunked_ce_enabled():
+        return chunked_cross_entropy(
+            h, lm_head, tgt, chunk_size=cfg.ce_chunk_size
+        )
+    return _ce_sums_shifted(unembed(h, lm_head), tgt)
 
 
 def _pp_gpipe(

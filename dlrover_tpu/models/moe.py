@@ -31,7 +31,14 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.models import llama
-from dlrover_tpu.ops import apply_rope, embed_lookup, rms_norm, rope_frequencies
+from dlrover_tpu.ops import (
+    apply_rope,
+    chunked_ce_enabled,
+    chunked_cross_entropy,
+    embed_lookup,
+    rms_norm,
+    rope_frequencies,
+)
 from dlrover_tpu.parallel.mesh import BATCH_AXES, EP, FSDP, SP, TP
 
 Params = Dict[str, Any]
@@ -58,6 +65,9 @@ class MoeConfig:
     attn_impl: str = "auto"
     attn_block_q: int = 128
     attn_block_k: int = 128
+    # chunked fused cross-entropy (ops/chunked_ce.py): vocab columns per
+    # loss scan step; DLROVER_TPU_CHUNKED_CE=0 restores dense logits
+    ce_chunk_size: int = 2048
 
     @property
     def head_dim(self) -> int:
@@ -81,6 +91,7 @@ class MoeConfig:
             attn_impl=self.attn_impl,
             attn_block_q=self.attn_block_q,
             attn_block_k=self.attn_block_k,
+            ce_chunk_size=self.ce_chunk_size,
         )
 
     # ---- presets -------------------------------------------------------
@@ -275,13 +286,15 @@ def validate_for_mesh(cfg: MoeConfig, mesh: Mesh, seq_len: int = 0) -> None:
         )
 
 
-def forward(
+def forward_hidden(
     params: Params,
     tokens: jnp.ndarray,
     cfg: MoeConfig,
     mesh: Optional[Mesh] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(logits (b, s, vocab) float32, aux_loss scalar)."""
+    """(final-norm hidden states (b, s, dim), aux_loss scalar) — the
+    pre-unembed factorization the chunked-CE loss fuses the lm-head into
+    (same split as models/llama.py forward_hidden)."""
     b, s = tokens.shape
     if mesh is not None:
         validate_for_mesh(cfg, mesh, seq_len=s)
@@ -304,8 +317,19 @@ def forward(
         scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"]
     )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x, aux_sum / cfg.n_layers
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: MoeConfig,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(logits (b, s, vocab) float32, aux_loss scalar)."""
+    x, aux = forward_hidden(params, tokens, cfg, mesh)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
-    return logits, aux_sum / cfg.n_layers
+    return logits, aux
 
 
 def loss_fn(
@@ -315,6 +339,19 @@ def loss_fn(
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
     """Next-token CE + router aux loss (pad tokens < 0 ignored)."""
+    if chunked_ce_enabled():
+        x, aux = forward_hidden(params, tokens, cfg, mesh)
+        # f32 operands, matching this model's dense unembed contract
+        # (x.astype(f32) @ lm_head.astype(f32)) — the op casts w to x's
+        # dtype, so promoting x keeps chunked-vs-dense numerics identical
+        # rather than silently moving MoE to bf16-operand logits
+        nll_sum, n_valid = chunked_cross_entropy(
+            x.astype(jnp.float32), params["lm_head"],
+            llama._shift_targets(tokens),
+            chunk_size=cfg.ce_chunk_size,
+        )
+        ce = nll_sum / jnp.maximum(n_valid, 1.0)
+        return ce + cfg.router_aux_coef * aux
     logits, aux = forward(params, tokens, cfg, mesh)
     logits = logits[:, :-1]
     targets = tokens[:, 1:]

@@ -24,6 +24,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.ops.attention import flash_attention, mha_reference
+from dlrover_tpu.ops.chunked_ce import chunked_ce_enabled, chunked_cross_entropy
 from dlrover_tpu.ops.norms import rms_norm
 from dlrover_tpu.parallel.mesh import BATCH_AXES, FSDP, TP
 
@@ -185,9 +186,11 @@ def _encoder_layer(cfg: ViTConfig, lp, x):
     return x
 
 
-def forward(params: Params, images: jnp.ndarray, cfg: ViTConfig,
-            mesh=None) -> jnp.ndarray:
-    """(b, H, W, C) float images -> (b, n_classes) logits."""
+def forward_pooled(params: Params, images: jnp.ndarray, cfg: ViTConfig,
+                   mesh=None) -> jnp.ndarray:
+    """(b, H, W, C) float images -> (b, dim) mean-pooled features (the
+    pre-head factorization shared with the LM families' forward_hidden,
+    so the loss can fuse the classifier matmul into the CE)."""
     dt = cfg.dtype
     x = patchify(cfg, images.astype(dt)) @ params["patch_embed"].astype(dt)
     x = x + params["pos_embed"].astype(dt)[None]
@@ -209,8 +212,14 @@ def forward(params: Params, images: jnp.ndarray, cfg: ViTConfig,
             x, NamedSharding(mesh, P(BATCH_AXES, None, None))
         )
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    pooled = x.mean(axis=1)
-    return (pooled @ params["head"].astype(dt)).astype(jnp.float32)
+    return x.mean(axis=1)
+
+
+def forward(params: Params, images: jnp.ndarray, cfg: ViTConfig,
+            mesh=None) -> jnp.ndarray:
+    """(b, H, W, C) float images -> (b, n_classes) logits."""
+    pooled = forward_pooled(params, images, cfg, mesh)
+    return (pooled @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
 
 
 def loss_fn(params: Params, batch, cfg: ViTConfig, mesh=None) -> jnp.ndarray:
@@ -218,6 +227,16 @@ def loss_fn(params: Params, batch, cfg: ViTConfig, mesh=None) -> jnp.ndarray:
     < 0 are the pad sentinel (``pad_batch_to`` after an elastic resize)
     and contribute nothing."""
     images, labels = batch
+    if chunked_ce_enabled():
+        # same fused head-matmul + masked-CE path as the LM families —
+        # n_classes is small so one chunk covers it (the op clips), but
+        # sharing the op keeps the CE semantics (pad < 0, f32 MXU
+        # accumulation) defined in exactly one place
+        pooled = forward_pooled(params, images, cfg, mesh)
+        nll_sum, n_valid = chunked_cross_entropy(
+            pooled, params["head"], labels
+        )
+        return nll_sum / jnp.maximum(n_valid, 1.0)
     logits = forward(params, images, cfg, mesh)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(

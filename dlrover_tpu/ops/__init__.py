@@ -6,6 +6,10 @@ Green-field relative to the reference, which owns no kernels (SURVEY.md
 """
 
 from dlrover_tpu.ops.attention import flash_attention, mha_reference  # noqa: F401
+from dlrover_tpu.ops.chunked_ce import (  # noqa: F401
+    chunked_ce_enabled,
+    chunked_cross_entropy,
+)
 from dlrover_tpu.ops.embedding import embed_lookup  # noqa: F401
 from dlrover_tpu.ops.norms import rms_norm  # noqa: F401
 from dlrover_tpu.ops.ring_attention import ring_attention  # noqa: F401

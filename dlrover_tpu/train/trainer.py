@@ -231,6 +231,12 @@ class ElasticTrainer:
                     state["params"], jax.tree.map(lambda x: x[0], batch)
                 )
             else:
+                # NB: the model losses may route through the chunked-CE
+                # custom_vjp (ops/chunked_ce.py), which itself scans over
+                # vocab chunks — custom_vjp rules are opaque to this outer
+                # scan's AD, so the grad-accum scan composes with it the
+                # same as with any primitive (and the f32 accumulator
+                # below absorbs its param-dtype dw chunks via promotion)
                 def micro_grads(carry, micro):
                     loss_sum, grads = carry
                     loss, g = jax.value_and_grad(self.loss_fn)(

@@ -23,12 +23,15 @@ EOF
 }
 
 # is $1 a bench result whose TOP-LEVEL backend is tpu? (a CPU fallback
-# embeds the cached TPU blob whose text would fool a plain grep)
+# embeds the cached TPU blob whose text would fool a plain grep). Hand-
+# reconstructed cache entries carry "reconstructed": true and must never
+# be salvaged as if bench.py had measured them this run.
 is_tpu_result() {
     python - "$1" <<'EOF' 2>>"$LOG"
 import json, sys
 d = json.load(open(sys.argv[1]))
-sys.exit(0 if d.get("detail", {}).get("backend") == "tpu" else 1)
+ok = d.get("detail", {}).get("backend") == "tpu" and not d.get("reconstructed")
+sys.exit(0 if ok else 1)
 EOF
 }
 

@@ -18,6 +18,11 @@ def test_bench_json_line_contract(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["DLROVER_BENCH_PROBE_ATTEMPTS"] = "1"
     env["DLROVER_BENCH_PHASES"] = "mfu,ckpt"
+    # this test pins the CPU contract (tiny config, fast sweep, sub-second
+    # shm save); on a TPU-attached host the probe would otherwise find the
+    # chip and run the full candidate sweep, where the 600 s timeout and
+    # the link-limited blocking_save_s < 1.0 can both legitimately fail
+    env["JAX_PLATFORMS"] = "cpu"
     # isolate the persistent jit cache per test run
     env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jitcache")
     r = subprocess.run(

@@ -1,0 +1,379 @@
+"""Chunked fused cross-entropy (ops/chunked_ce.py): value and grad parity
+with the dense [B, T, V] logits path (incl. masked tokens and chunk sizes
+that do not divide V), jaxpr proof that no [B, T, V] intermediate survives
+the fwd+bwd of the chunked path, peak-activation scaling with chunk_size,
+the DLROVER_TPU_CHUNKED_CE=0 kill-switch, and composition with the
+trainer's grad-accumulation scan."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import llama, moe, vit
+from dlrover_tpu.ops.chunked_ce import (
+    chunked_ce_enabled,
+    chunked_cross_entropy,
+)
+
+jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# dense reference + jaxpr helpers
+# ---------------------------------------------------------------------------
+
+
+def dense_ce_sums(x, w, targets):
+    """The dense path's math, verbatim: full logits, logsumexp, gather."""
+    logits = x @ w
+    valid = (targets >= 0).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, jnp.maximum(targets, 0)[..., None], axis=-1
+    )[..., 0]
+    return jnp.sum((logz - gold) * valid), jnp.sum(valid)
+
+
+def iter_avals(jaxpr):
+    """Every equation output aval, recursing into sub-jaxprs (scan/cond/
+    custom_vjp bodies) — the full set of intermediates AD + the op create."""
+    for eqn in jaxpr.eqns:
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                yield aval
+        for val in eqn.params.values():
+            yield from _avals_in(val)
+
+
+def _avals_in(val):
+    if isinstance(val, jax.core.ClosedJaxpr):
+        yield from iter_avals(val.jaxpr)
+    elif isinstance(val, jax.core.Jaxpr):
+        yield from iter_avals(val)
+    elif isinstance(val, (tuple, list)):
+        for v in val:
+            yield from _avals_in(v)
+
+
+def logits_sized_avals(jaxpr, n_tokens_options, vocab):
+    """Avals shaped (..., vocab) whose leading product is a full token
+    count — the [B*T, V] materialization the chunked path must not have."""
+    found = []
+    for aval in iter_avals(jaxpr):
+        if (
+            len(aval.shape) >= 2
+            and aval.shape[-1] == vocab
+            and int(np.prod(aval.shape[:-1])) in n_tokens_options
+        ):
+            found.append(aval)
+    return found
+
+
+def rel_err(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    return np.max(np.abs(a - b)) / max(np.max(np.abs(b)), 1e-30)
+
+
+# ---------------------------------------------------------------------------
+# op-level parity
+# ---------------------------------------------------------------------------
+
+B, T, D, V = 3, 8, 16, 300
+
+
+@pytest.fixture(scope="module")
+def xwt():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, T, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, V)) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.integers(0, V, size=(B, T)), jnp.int32)
+    t = t.at[:, -2:].set(-1)  # masked/ignored tail
+    t = t.at[0, 0].set(-1)
+    return x, w, t
+
+
+# 128 does not divide 300 (padded final chunk); 300 and 512 cover the
+# exact-fit and single-chunk (clipped) degenerate cases; 7 many tiny chunks
+@pytest.mark.parametrize("chunk", [7, 128, 300, 512])
+def test_value_matches_dense(xwt, chunk):
+    x, w, t = xwt
+    ns, nv = chunked_cross_entropy(x, w, t, chunk_size=chunk)
+    ds, dv = dense_ce_sums(x, w, t)
+    assert float(nv) == float(dv) == B * T - 7  # 2 cols * 3 rows + 1
+    assert rel_err(ns, ds) <= 1e-5
+
+
+@pytest.mark.parametrize("chunk", [128, 512])
+def test_grads_match_dense(xwt, chunk):
+    x, w, t = xwt
+
+    def mean_loss(ce):
+        def f(x, w):
+            ns, nv = ce(x, w, t)
+            return ns / jnp.maximum(nv, 1.0)
+
+        return f
+
+    gc = jax.grad(
+        mean_loss(lambda x, w, t: chunked_cross_entropy(
+            x, w, t, chunk_size=chunk)),
+        argnums=(0, 1),
+    )(x, w)
+    gd = jax.grad(mean_loss(dense_ce_sums), argnums=(0, 1))(x, w)
+    assert rel_err(gc[0], gd[0]) <= 1e-5  # dx
+    assert rel_err(gc[1], gd[1]) <= 1e-5  # dw
+
+
+def test_all_tokens_masked(xwt):
+    x, w, _ = xwt
+    t = jnp.full((B, T), -1, jnp.int32)
+
+    def loss(x, w):
+        ns, nv = chunked_cross_entropy(x, w, t, chunk_size=128)
+        return ns / jnp.maximum(nv, 1.0)
+
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+    assert float(val) == 0.0
+    assert float(jnp.max(jnp.abs(grads[0]))) == 0.0
+    assert float(jnp.max(jnp.abs(grads[1]))) == 0.0
+
+
+def test_shape_validation(xwt):
+    x, w, t = xwt
+    with pytest.raises(ValueError, match="targets shape"):
+        chunked_cross_entropy(x, w, t[:, :-1])
+    with pytest.raises(ValueError, match="feature dim"):
+        chunked_cross_entropy(x[..., :-1], w, t)
+
+
+def test_composes_under_jit_and_scan(xwt):
+    """The trainer's grad-accum wraps value_and_grad in a lax.scan; the
+    custom_vjp must be opaque to that outer AD + scan."""
+    x, w, t = xwt
+    micro_x = jnp.stack([x, x * 0.5])
+
+    def loss(w, xb):
+        ns, nv = chunked_cross_entropy(xb, w, t, chunk_size=128)
+        return ns / jnp.maximum(nv, 1.0)
+
+    @jax.jit
+    def accum(w, micro_x):
+        def body(carry, xb):
+            s, g = carry
+            l, gw = jax.value_and_grad(loss)(w, xb)
+            return (s + l, jax.tree.map(jnp.add, g, gw)), None
+
+        (s, g), _ = jax.lax.scan(
+            body, (jnp.zeros(()), jnp.zeros_like(w)), micro_x
+        )
+        return s / 2, g
+
+    s, g = accum(w, micro_x)
+    expect = (loss(w, x) + loss(w, x * 0.5)) / 2
+    assert rel_err(s, expect) <= 1e-6
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------------------------------------------------------------------
+# memory shape: no [B, T, V] intermediate; peak scales with chunk, not V
+# ---------------------------------------------------------------------------
+
+
+def test_no_full_logits_in_fwd_bwd_jaxpr(xwt):
+    x, w, t = xwt
+    n_tok = {B * T, B * (T - 1)}
+
+    def mk(ce):
+        def f(x, w):
+            ns, nv = ce(x, w, t)
+            return ns / jnp.maximum(nv, 1.0)
+
+        return jax.make_jaxpr(jax.grad(f, argnums=(0, 1)))(x, w)
+
+    chunked = mk(lambda x, w, t: chunked_cross_entropy(x, w, t, chunk_size=64))
+    assert not logits_sized_avals(chunked.jaxpr, n_tok, V), (
+        "chunked fwd+bwd materializes a full-logits-sized intermediate"
+    )
+    # sanity: the detector does fire on the dense path
+    dense = mk(dense_ce_sums)
+    assert logits_sized_avals(dense.jaxpr, n_tok, V)
+
+
+def test_peak_intermediate_scales_with_chunk_not_vocab():
+    n, d, v = 48, 16, 1000
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(d, v)) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, size=(n,)), jnp.int32)
+
+    def max_token_major(chunk):
+        def f(x, w):
+            ns, nv = chunked_cross_entropy(x, w, t, chunk_size=chunk)
+            return ns / jnp.maximum(nv, 1.0)
+
+        jaxpr = jax.make_jaxpr(jax.grad(f, argnums=(0, 1)))(x, w)
+        # widest intermediate carrying the token axis — the loss's live
+        # activation (weight-shaped [d, v] grads are excluded by shape)
+        return max(
+            int(np.prod(a.shape))
+            for a in iter_avals(jaxpr.jaxpr)
+            if len(a.shape) >= 2 and a.shape[0] == n
+        )
+
+    # exactly tokens*chunk (the per-chunk logits/softmax buffers), far
+    # below tokens*v — and it tracks chunk_size linearly
+    assert max_token_major(50) == n * 50
+    assert max_token_major(250) == n * 250
+    assert max_token_major(50) * v // 50 == n * v  # dense would be n*v
+
+    # opportunistic second witness: XLA's own memory analysis, where the
+    # backend reports temps (CPU reports zeros; TPU/GPU report real sizes)
+    def lowered(chunk):
+        def f(x, w):
+            ns, nv = chunked_cross_entropy(x, w, t, chunk_size=chunk)
+            return ns / jnp.maximum(nv, 1.0)
+
+        return jax.jit(jax.grad(f, argnums=(0, 1))).lower(x, w).compile()
+
+    try:
+        small = lowered(50).memory_analysis()
+        big = lowered(500).memory_analysis()
+    except Exception:
+        return
+    if small and big and getattr(big, "temp_size_in_bytes", 0) > 0:
+        assert small.temp_size_in_bytes <= big.temp_size_in_bytes
+
+
+# ---------------------------------------------------------------------------
+# model wiring: llama / moe / vit / pp head + kill-switch
+# ---------------------------------------------------------------------------
+
+LCFG = llama.LlamaConfig.tiny(ce_chunk_size=64)
+
+
+@pytest.fixture(scope="module")
+def lparams():
+    return llama.init_params(LCFG, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def ltoks():
+    toks = jax.random.randint(jax.random.key(1), (2, 10), 0,
+                              LCFG.vocab_size)
+    return toks.at[:, -3:].set(-1)
+
+
+def test_llama_loss_matches_dense(monkeypatch, lparams, ltoks):
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "1")
+    assert chunked_ce_enabled()
+    chunked = llama.loss_fn(lparams, ltoks, LCFG)
+    gc = jax.grad(llama.loss_fn)(lparams, ltoks, LCFG)
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "0")
+    assert not chunked_ce_enabled()
+    dense = llama.loss_fn(lparams, ltoks, LCFG)
+    gd = jax.grad(llama.loss_fn)(lparams, ltoks, LCFG)
+    assert rel_err(chunked, dense) <= 1e-5
+    for kc, kd in zip(jax.tree.leaves(gc), jax.tree.leaves(gd)):
+        assert rel_err(kc, kd) <= 1e-5
+
+
+def test_llama_kill_switch_restores_dense_logits(monkeypatch, lparams,
+                                                 ltoks):
+    b, s = ltoks.shape
+    n_tok = {b * s, b * (s - 1)}
+
+    def jaxpr_of_loss():
+        return jax.make_jaxpr(
+            lambda p: llama.loss_fn(p, ltoks, LCFG)
+        )(lparams)
+
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "0")
+    assert logits_sized_avals(
+        jaxpr_of_loss().jaxpr, n_tok, LCFG.vocab_size
+    ), "kill-switch must restore the dense [B, T, V] logits path"
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "1")
+    assert not logits_sized_avals(
+        jaxpr_of_loss().jaxpr, n_tok, LCFG.vocab_size
+    )
+
+
+def test_pp_head_loss_sums_matches_dense(monkeypatch, lparams):
+    """The pipeline schedules' shared head+loss helper (the path 1f1b
+    differentiates with jax.vjp inside the tick) takes the chunked route
+    too."""
+    rng = np.random.default_rng(2)
+    out = jnp.asarray(rng.normal(size=(2, 10, LCFG.dim)), jnp.float32)
+    tgt = jnp.asarray(
+        rng.integers(0, LCFG.vocab_size, size=(2, 10)), jnp.int32
+    ).at[:, -1].set(-1)
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "1")
+    ns_c, nv_c = llama._head_loss_sums(
+        LCFG, out, lparams["final_norm"], lparams["lm_head"], tgt
+    )
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "0")
+    ns_d, nv_d = llama._head_loss_sums(
+        LCFG, out, lparams["final_norm"], lparams["lm_head"], tgt
+    )
+    assert float(nv_c) == float(nv_d)
+    assert rel_err(ns_c, ns_d) <= 1e-5
+
+
+def test_moe_loss_matches_dense(monkeypatch):
+    cfg = moe.MoeConfig.tiny(ce_chunk_size=48)
+    params = moe.init_params(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (2, 12), 0,
+                              cfg.vocab_size).at[:, -2:].set(-1)
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "1")
+    chunked = moe.loss_fn(params, toks, cfg)
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "0")
+    dense = moe.loss_fn(params, toks, cfg)
+    assert rel_err(chunked, dense) <= 1e-5
+
+
+def test_vit_loss_matches_dense(monkeypatch):
+    cfg = vit.ViTConfig.tiny()
+    params = vit.init_params(cfg, jax.random.key(0))
+    images = jax.random.normal(
+        jax.random.key(1), (2, cfg.image_size, cfg.image_size, 3)
+    )
+    labels = jnp.asarray([3, -1], jnp.int32)  # one pad-sentinel label
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "1")
+    chunked = vit.loss_fn(params, (images, labels), cfg)
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "0")
+    dense = vit.loss_fn(params, (images, labels), cfg)
+    assert rel_err(chunked, dense) <= 1e-5
+
+
+def test_trainer_grad_accum_composes(monkeypatch, lparams, ltoks):
+    """End to end through ElasticTrainer: accum=2 wraps the chunked-CE
+    custom_vjp in the grad-accumulation lax.scan inside the donating
+    jitted step; first-step loss must match the dense path's."""
+    from dlrover_tpu.parallel import MeshConfig, build_mesh
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    mc = MeshConfig(dp=1, fsdp=1, sp=1, tp=1).resolve(1)
+    mesh = build_mesh(mc, devices=jax.devices()[:1])
+    tc = TrainConfig(global_batch_size=4, micro_batch_size=2,
+                     warmup_steps=0, total_steps=100)
+    batch = jax.random.randint(jax.random.key(3), (2, 2, 10), 0,
+                               LCFG.vocab_size)
+
+    def first_step_loss():
+        trainer = ElasticTrainer(
+            lambda p, t: llama.loss_fn(p, t, LCFG, None),
+            llama.param_specs(LCFG), mesh, mc, tc,
+        )
+        assert trainer.accum_steps == 2
+        state = trainer.init_state(jax.tree.map(jnp.copy, lparams))
+        state, loss = trainer.step(state, batch)
+        state, loss2 = trainer.step(state, batch)
+        assert np.isfinite(float(loss2))
+        return float(loss)
+
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "1")
+    chunked = first_step_loss()
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "0")
+    dense = first_step_loss()
+    assert abs(chunked - dense) / max(abs(dense), 1e-30) <= 1e-5
