@@ -41,6 +41,18 @@ class NanLossError(RuntimeError):
     """Loss went NaN — a correctness signal, never a capacity fallback."""
 
 
+def _release(jax, *trees):
+    """Delete a pytree's device arrays NOW: a retained 1.2B state
+    (params + Adam moments) would OOM the next candidate/leg and
+    silently shrink the measurement."""
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            try:
+                leaf.delete()
+            except Exception:
+                pass
+
+
 def _tpu_probe(timeout: float = 120.0) -> str:
     """Probe TPU backend liveness in a subprocess: a wedged remote-tunnel
     plugin can hang jax.devices() forever, which must not hang the bench.
@@ -224,7 +236,7 @@ LAST_TPU_RESULT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
 )
 
-KNOWN_PHASES = ("mfu", "ckpt", "interposer")
+KNOWN_PHASES = ("mfu", "ckpt", "interposer", "resize")
 
 
 def _requested_phases() -> set:
@@ -278,6 +290,155 @@ def _persist_last(result: dict):
         os.replace(tmp, LAST_TPU_RESULT)
     except OSError:
         pass
+
+
+def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
+    """remesh→first-step downtime, cold vs warm (train/warm_compile.py).
+
+    Cold: kill-switch off AND the compilation cache disabled — the
+    plain jit rebuild every resize paid before this subsystem existed.
+    Warm: the real production path — AOT build, speculative neighbor
+    compile in the background, resize lands on the cached executable.
+    With ≥2 devices the resize is a genuine world change (world →
+    world/2, the speculative thread's own target); on one device it
+    degrades to a same-world remesh (still exercising the rebuild
+    path, flagged in ``mode``)."""
+    import numpy as np
+
+    from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+    from dlrover_tpu.parallel.mesh import remesh as remesh_config
+    from dlrover_tpu.train import warm_compile as wc
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    devs = jax.devices()
+    world = len(devs)
+    target = world // 2 if world >= 2 else world
+    mode = "half_world" if world >= 2 else "same_world"
+    if on_tpu:
+        # small-but-real: compile long enough that the cold number
+        # means something, phase still bounded in minutes
+        cfg = llama.LlamaConfig(
+            dim=1024, n_layers=8, ffn_dim=4096, vocab_size=32768,
+            n_heads=8, n_kv_heads=8, max_seq_len=512,
+            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, remat=True,
+        )
+        micro, seq = 2, 512
+    else:
+        cfg = llama.LlamaConfig.tiny()
+        micro, seq = 2, 64
+    specs = llama.param_specs(cfg)
+    mc_full = MeshConfig(dp=-1).resolve(world)
+    gb = micro * mc_full.data_parallel_size
+    tc = TrainConfig(global_batch_size=gb, micro_batch_size=micro,
+                     warmup_steps=0, total_steps=10_000)
+
+    def factory(mesh):
+        return lambda p, t: llama.loss_fn(p, t, cfg, mesh)
+
+    def drop(*trees):
+        # release between legs: the cold leg's state must not crowd
+        # the warm leg's trainers out of a 16 GB chip
+        _release(jax, *trees)
+
+    def place_for(tr):
+        """A resized world's state/batch (the restore itself is the ckpt
+        phase's number; downtime here isolates remesh→first-step)."""
+        mesh = tr.mesh
+        params = jax.jit(
+            lambda k: llama.init_params(cfg, k),
+            out_shardings=named_shardings(mesh, specs),
+        )(jax.random.key(0))
+        state = tr.init_state(params)
+        a, b = tr.step_batch_shape
+        batch = jax.random.randint(
+            jax.random.key(1), (a, b, seq), 0, cfg.vocab_size,
+            dtype=jnp.int32,
+        )
+        return state, batch
+
+    def make_trainer(world_n):
+        mc = remesh_config(mc_full, world_n).resolve(world_n)
+        mesh = build_mesh(mc, devices=devs[:world_n])
+        tr = ElasticTrainer(None, specs, mesh, mc, tc,
+                            loss_factory=factory)
+        state, batch = place_for(tr)
+        return tr, state, batch
+
+    def resize_downtime(tr):
+        """remesh to the target world (a no-op world change in
+        same_world mode) and time remesh→first-step."""
+        mc_t = remesh_config(mc_full, target).resolve(target)
+        mesh_t = build_mesh(mc_t, devices=devs[:target])
+        tr.remesh(mesh_t, mc_t)
+        state_t, batch_t = place_for(tr)
+        t0 = time.perf_counter()
+        new_state, loss = tr.step(state_t, batch_t)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        lval = float(loss)
+        drop(new_state, batch_t)  # state_t was donated into the step
+        return dt, lval
+
+    saved_kill = os.environ.get(wc.ENV_KILL_SWITCH)
+    out = {"mode": mode, "world": world, "target_world": target,
+           "model_params": llama.param_count(cfg)}
+    try:
+        # ---- cold: today's behavior, no caches anywhere ----
+        os.environ[wc.ENV_KILL_SWITCH] = "0"
+        jax.config.update("jax_enable_compilation_cache", False)
+        tr, state, batch = make_trainer(world)
+        st1, l0 = tr.step(state, batch)  # world-A compile, not measured
+        jax.block_until_ready(l0)
+        cold_s, cold_loss = resize_downtime(tr)
+        drop(st1, batch)  # cold leg done: free its HBM for the warm leg
+        del tr, state, batch, st1
+
+        # ---- warm: AOT + speculative neighbor compile ----
+        os.environ[wc.ENV_KILL_SWITCH] = "1"
+        jax.config.update("jax_enable_compilation_cache", True)
+        tr2, state2, batch2 = make_trainer(world)
+        st2, l1 = tr2.step(state2, batch2)  # kicks the speculative thread
+        jax.block_until_ready(l1)
+        if mode == "half_world":
+            # resize lands after speculation finished (the steady-state
+            # case: memberships change minutes apart, compiles take
+            # seconds); the cache-hit rebuild is what we measure
+            tr2.warm.wait_idle(timeout=600)
+        # "completed" means the ledger actually holds a speculative
+        # compile for the target world — wait_idle alone returns True
+        # when the thread never started (no cache dir) or every target
+        # failed, which must not read as "the warm path works"
+        speculated = any(
+            e["world"] == target
+            and any(c["source"] == "speculative" for c in e["compiles"])
+            for e in wc.compile_ledger.entries().values()
+        )
+        warm_s, warm_loss = resize_downtime(tr2)
+        if abs(cold_loss - warm_loss) > 1e-3:
+            out["loss_mismatch"] = [cold_loss, warm_loss]
+        out.update({
+            "cold_downtime_s": round(cold_s, 4),
+            "warm_downtime_s": round(warm_s, 4),
+            "warm_cold_ratio": round(warm_s / max(cold_s, 1e-9), 4),
+            "speculation_completed": speculated,
+            "compile_ledger": {
+                k: [
+                    {"source": c["source"], "seconds": c["seconds"]}
+                    for c in v["compiles"]
+                ]
+                for k, v in wc.compile_ledger.entries().items()
+            },
+        })
+    finally:
+        if saved_kill is None:
+            os.environ.pop(wc.ENV_KILL_SWITCH, None)
+        else:
+            os.environ[wc.ENV_KILL_SWITCH] = saved_kill
+        try:
+            jax.config.update("jax_enable_compilation_cache", True)
+        except Exception:
+            pass
+    return out
 
 
 def main():
@@ -337,19 +498,11 @@ def main():
         timed_steps = 3
 
     def _free(*trees):
-        """Release a candidate's device arrays before the next candidate
-        builds — retaining a 1.2B state (params + Adam moments) would OOM
-        every same-size rival and silently shrink the sweep to one
-        config."""
-        for tree in trees:
-            for leaf in jax.tree.leaves(tree):
-                try:
-                    leaf.delete()
-                except Exception:
-                    pass
+        _release(jax, *trees)
 
     results = []  # (rate, name, cfg, micro, seq, step_s)
     measured = 0
+    phases = _requested_phases()
     # sweep: measure up to 3 fitting candidates and keep the fastest
     # (model FLOPs/s, so differently-sized candidates compare fairly).
     # When the chunked-CE-unlocked candidates lead the list they are
@@ -359,6 +512,11 @@ def main():
     max_measured = 3 if on_tpu else 1
     if any("_cce" in c[0] for c in candidates):
         max_measured += 1
+    if "mfu" not in phases:
+        # phase excluded: one candidate still builds (the later phases
+        # and the JSON contract need a winner), but the multi-candidate
+        # sweep is skipped and phases_done won't claim "mfu"
+        max_measured = 1
     for name, cand, cand_micro, cand_seq in candidates:
         try:
             c_trainer, c_state, c_batch, c_step_s = _run_mfu(
@@ -438,7 +596,7 @@ def main():
              "step_s": round(t, 4)}
             for r, n, _, _, _, t in results
         ],
-        "phases_done": ["mfu"],
+        "phases_done": ["mfu"] if "mfu" in phases else [],
     }
     result = {
         "metric": "train_step_mfu",
@@ -449,7 +607,6 @@ def main():
     }
     if on_tpu:
         _persist_last(result)
-    phases = _requested_phases()
 
     # ---- flash-checkpoint pause on the live (fresh) train state --------
     # Save params from the state the trainer just produced; run a real
@@ -585,6 +742,22 @@ def main():
         detail["interposer"] = interposed
         if "error" not in interposed:
             detail["phases_done"].append("interposer")
+
+    # ---- resize leg: remesh→first-step downtime, cold vs warm ----------
+    # (train/warm_compile.py). Runs last: it frees the winner's state —
+    # a 1.2B params+adam tree would crowd the resize trainers out of a
+    # 16 GB chip — and nothing after this needs it.
+    if "resize" in phases:
+        _free(state, batch)
+        del trainer, state, batch
+        try:
+            rz = _bench_resize(jax, jnp, llama, on_tpu)
+        except Exception as e:  # keep the already-persisted headline
+            rz = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        detail["resize"] = rz
+        if "error" not in rz:
+            detail["phases_done"].append("resize")
+
     if on_tpu:
         # remember the last real-TPU measurement so a CPU fallback run
         # (wedged tunnel) can still surface it — clearly marked as cached

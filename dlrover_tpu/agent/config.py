@@ -43,6 +43,10 @@ class ElasticLaunchConfig:
     comm_metrics: bool = False
     comm_metrics_port: int = 29700
     ckpt_replica: bool = False  # cross-host backup of staged checkpoints
+    # persistent XLA compile cache dir injected into workers
+    # (DLROVER_TPU_COMPILE_CACHE_DIR); "" = workers default it under
+    # their checkpoint dir (train/warm_compile.py)
+    compile_cache_dir: str = ""
 
     # TPU topology hints (injected by the platform or discovered)
     slice_name: str = ""

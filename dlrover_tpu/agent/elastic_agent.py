@@ -374,6 +374,13 @@ class ElasticAgent:
         env.update(self._config.env)
         if self._config.ckpt_replica:
             env["DLROVER_TPU_CKPT_REPLICA"] = "1"
+        if self._config.compile_cache_dir:
+            # workers point JAX's persistent compile cache here
+            # (train/warm_compile.py via bootstrap.init) so a restarted
+            # worker's step rebuild is a cache hit, not a cold compile
+            env["DLROVER_TPU_COMPILE_CACHE_DIR"] = (
+                self._config.compile_cache_dir
+            )
         if self._paral_tuner is not None:
             from dlrover_tpu.agent.paral_config_tuner import (
                 PARAL_CONFIG_PATH_ENV,

@@ -129,6 +129,18 @@ class CheckpointEngine:
         from dlrover_tpu.common.constants import NodeEnv
 
         self.ckpt_dir = ckpt_dir
+        # warm-path elasticity: the checkpoint dir is the one path the
+        # deployment already persists across pod restarts, so when no
+        # explicit compile-cache dir was configured, default JAX's
+        # persistent compilation cache under it — a restarted worker
+        # then rebuilds its train step from cache (never overrides a
+        # dir jax already has; no-op under DLROVER_TPU_WARM_COMPILE=0)
+        try:
+            from dlrover_tpu.train.warm_compile import default_cache_under
+
+            default_cache_under(ckpt_dir)
+        except Exception:
+            pass  # cache is an optimization, never a ckpt failure
         self.job_name = job_name or os.environ.get(NodeEnv.JOB_NAME, "local")
         self.node_id = (
             node_id

@@ -280,7 +280,7 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
         impl = "ring" if sp_size > 1 else "flash"
     if impl in ("ring", "ulysses") and sp_size > 1:
         assert mesh is not None
-        from jax import shard_map
+        from dlrover_tpu.ops.shard_map_compat import shard_map
 
         if impl == "ulysses":
             from dlrover_tpu.ops.ulysses import ulysses_attention as sp_attn
@@ -825,7 +825,7 @@ def _pp_gpipe(
     cfg, mesh, pp_size, sp_size, n_micro, mb, s_local, params,
     x_micro, tgt_micro,
 ) -> jnp.ndarray:
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
 
     n_ticks = n_micro + pp_size - 1
     fwd_perm = [(i, i + 1) for i in range(pp_size - 1)]
@@ -953,7 +953,7 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
     cfg, mesh = static.cfg, static.mesh
     pp_size, sp_size = static.pp, static.sp
     n_micro, mb, s_local = static.n_micro, static.mb, static.s_local
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
 
     if cfg.pp_virtual_stages > 1:
         return _pp_interleaved_run(
@@ -1178,7 +1178,7 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
     v = cfg.pp_virtual_stages
     if sp_size > 1:
         raise ValueError("interleaved 1f1b does not compose with sp yet")
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
 
     tables = build_interleaved_tables(pp_size, v, n_micro)
     dev_tables = {

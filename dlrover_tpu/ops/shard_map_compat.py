@@ -1,0 +1,63 @@
+"""``shard_map`` across JAX versions.
+
+The ops/models code targets current JAX, where ``shard_map`` lives at
+the top level and the replication-check kwarg is ``check_vma``. Older
+jaxlibs (0.4.x, this image) ship it under ``jax.experimental`` with the
+kwarg named ``check_rep``. One import point so every call site stays
+written in the modern idiom.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: top-level, check_vma
+    from jax import shard_map as _shard_map
+
+    _LEGACY_KWARG = False
+except ImportError:  # jax 0.4.x: experimental, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY_KWARG = True
+
+__all__ = ["shard_map", "axis_size", "supports_partial_manual"]
+
+
+def supports_partial_manual() -> bool:
+    """Whether ``axis_names`` (map a subset of mesh axes, leave the
+    rest to the partitioner) works natively. The legacy ``auto=``
+    translation is best-effort: some programs it cannot partition
+    (XLA CHECK-aborts on PartitionId) — callers whose body only uses
+    the mapped axes should drop ``axis_names`` entirely on legacy jax
+    and take the full-manual map instead."""
+    return not _LEGACY_KWARG
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped axis, inside shard_map. Modern JAX has
+    ``lax.axis_size``; on 0.4.x ``jax.core.axis_frame(name)`` returns
+    the bound size as a plain int."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.core.axis_frame(axis_name)
+
+
+@functools.wraps(_shard_map)
+def shard_map(f=None, **kwargs):
+    if _LEGACY_KWARG:
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if "axis_names" in kwargs:
+            # modern axis_names = the axes to MAP; legacy auto = the
+            # complement (mesh axes left to the partitioner)
+            axis_names = kwargs.pop("axis_names")
+            mesh = kwargs.get("mesh")
+            if axis_names is not None and mesh is not None:
+                kwargs["auto"] = (
+                    frozenset(mesh.axis_names) - frozenset(axis_names)
+                )
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    return _shard_map(f, **kwargs)

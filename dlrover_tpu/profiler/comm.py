@@ -239,8 +239,19 @@ def _start_metrics_server(port: int):
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
             if self.path.rstrip("/") in ("", "/metrics".rstrip("/")):
-                body = ("\n".join(comm_ledger.prometheus_lines()) +
-                        "\n").encode()
+                rows = comm_ledger.prometheus_lines()
+                try:
+                    # compile-seconds gauges ride the same endpoint: the
+                    # fleet-level signal for whether elastic resizes are
+                    # landing warm (train/warm_compile.py)
+                    from dlrover_tpu.train.warm_compile import (
+                        prometheus_lines as compile_lines,
+                    )
+
+                    rows = rows + compile_lines()
+                except Exception:
+                    pass
+                body = ("\n".join(rows) + "\n").encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
@@ -337,8 +348,13 @@ def _bench_collective(mesh, axis: str, kind: str, nbytes: int):
     """Build the jitted microbenchmark collective for one axis."""
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.ops.shard_map_compat import (
+        shard_map,
+        supports_partial_manual,
+    )
 
     n = mesh.shape[axis]
     # per-shard length divisible by n too (all_to_all re-splits the
@@ -361,12 +377,16 @@ def _bench_collective(mesh, axis: str, kind: str, nbytes: int):
             return lax.all_gather(x, axis)
         raise ValueError(f"unknown collective kind {kind!r}")
 
+    # the body only touches the measured axis, so on legacy jax (no
+    # native partial-manual mode) the full-manual map is equivalent —
+    # and the auto= translation CHECK-aborts XLA on this program
+    extra = {"axis_names": {axis}} if supports_partial_manual() else {}
     fn = shard_map(
         body, mesh=mesh, in_specs=P(axis), out_specs=(
             P() if kind == "all_gather" else P(axis)
         ),
-        axis_names={axis},
         check_vma=False,
+        **extra,
     )
     return jax.jit(fn), x
 

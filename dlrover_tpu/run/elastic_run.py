@@ -66,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(profiler/comm.py) from every worker")
     p.add_argument("--comm-metrics-port", type=int, default=29700,
                    dest="comm_metrics_port")
+    p.add_argument("--compile-cache-dir", dest="compile_cache_dir",
+                   default=os.environ.get("DLROVER_TPU_COMPILE_CACHE_DIR",
+                                          ""),
+                   help="persistent XLA compile-cache dir injected into "
+                        "workers (put it on the checkpoint volume so "
+                        "restarts rebuild the train step from cache "
+                        "instead of recompiling); empty = workers "
+                        "default it under their checkpoint dir")
     p.add_argument("--no-save-at-breakpoint", action="store_false",
                    dest="save_at_breakpoint",
                    help="skip the shm->storage checkpoint persist before "
@@ -138,6 +146,7 @@ def config_from_args(args) -> ElasticLaunchConfig:
         comm_metrics=args.comm_metrics,
         comm_metrics_port=args.comm_metrics_port,
         ckpt_replica=args.ckpt_replica,
+        compile_cache_dir=args.compile_cache_dir,
         save_at_breakpoint=args.save_at_breakpoint,
         monitor_interval=args.monitor_interval,
         rdzv_join_timeout=args.rdzv_join_timeout,

@@ -192,6 +192,9 @@ def init(
 
     import jax
 
+    will_init_distributed = bool(
+        init_distributed and env.num_processes > 1 and env.coordinator_addr
+    )
     if env.accelerator == "cpu":
         # Test mode: virtual CPU devices + gloo cross-process collectives.
         # (The axon image overrides JAX_PLATFORMS; config update wins.)
@@ -207,9 +210,23 @@ def init(
                 f"{local_device_count}"
             ).strip()
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if will_init_distributed:
+            # gloo needs the distributed client: configuring it in a
+            # single-process run makes CPU backend init itself fail
+            # (make_gloo_tcp_collectives(distributed_client=None))
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
 
-    if init_distributed and env.num_processes > 1 and env.coordinator_addr:
+    # warm-path elasticity: point JAX's persistent compilation cache at
+    # the agent-injected dir (train/warm_compile.py) so a restarted
+    # worker deserializes the step executable instead of recompiling —
+    # the resize-downtime twin of the flash-checkpoint restore
+    from dlrover_tpu.train.warm_compile import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    if will_init_distributed:
         logger.info(
             "process %s/%s: jax.distributed.initialize(coordinator=%s)",
             env.process_id,

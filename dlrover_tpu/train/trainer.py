@@ -22,18 +22,45 @@ boundaries. TPU-native version:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Tuple
+import time
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.parallel.mesh import MeshConfig
 from dlrover_tpu.parallel.sharding import batch_spec
+from dlrover_tpu.train import warm_compile
 
 PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class _Avatar:
+    """Mesh-independent stand-in for one state/batch leaf: enough to
+    rebuild a ``jax.ShapeDtypeStruct`` (with sharding) against any
+    target mesh. A plain object on purpose — pytree LEAF, so avatar
+    trees keep the state's treedef."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+    spec: Any  # PartitionSpec (state leaves) | None (batch leaves)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+
+def _avatar_of(leaf) -> _Avatar:
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        spec = P()  # single-device / uncommitted: replicated on retarget
+    return _Avatar(tuple(leaf.shape), np.dtype(leaf.dtype), spec)
 
 
 @dataclasses.dataclass
@@ -70,13 +97,26 @@ class ElasticTrainer:
 
     def __init__(
         self,
-        loss_fn: Callable[[PyTree, jnp.ndarray], jnp.ndarray],
+        loss_fn: Optional[Callable[[PyTree, jnp.ndarray], jnp.ndarray]],
         p_specs: PyTree,
         mesh: Mesh,
         mesh_config: MeshConfig,
         train_config: TrainConfig,
         worker_ctx=None,
+        loss_factory: Optional[Callable[[Optional[Mesh]], Callable]] = None,
     ):
+        """``loss_fn`` may close over the live mesh (sharding
+        constraints); that pins the step to one mesh forever. Passing
+        ``loss_factory`` (mesh → loss_fn) instead lets the trainer
+        re-derive the loss for any mesh — which is what makes
+        cross-world AOT compilation (``lower_step`` for a world that is
+        not live) and true in-process ``remesh()`` possible. With only
+        ``loss_fn``, speculative neighbor compilation stays off."""
+        self.loss_factory = loss_factory
+        if loss_fn is None:
+            if loss_factory is None:
+                raise ValueError("need loss_fn or loss_factory")
+            loss_fn = loss_factory(mesh)
         self.loss_fn = loss_fn
         self.p_specs = p_specs
         self.mesh = mesh
@@ -88,6 +128,14 @@ class ElasticTrainer:
         self._eval_fn = None
         self._host_step = 0
         self._applied_config_version = 0
+        # warm-compile layer (train/warm_compile.py): AOT executable
+        # cache + the speculative neighbor-compile thread. Avatars are
+        # captured from the first state/batch seen so the step can be
+        # lowered for meshes that are not live.
+        self.warm = warm_compile.WarmCompiler()
+        self._state_avatar: Optional[PyTree] = None
+        self._batch_avatar: Optional[PyTree] = None
+        self._params_avatar: Optional[PyTree] = None
         self._maybe_serve_comm_metrics()
 
     def _maybe_serve_comm_metrics(self):
@@ -120,7 +168,12 @@ class ElasticTrainer:
     # ---- elastic global-batch math (reference trainer.py:307-327) ------
     @property
     def accum_steps(self) -> int:
-        dp = self.mesh_config.resolve(self.mesh.size).data_parallel_size
+        return self._accum_for(self.mesh, self.mesh_config)
+
+    def _accum_for(self, mesh: Mesh, mesh_config: MeshConfig) -> int:
+        """Accumulation count keeping the global batch fixed on any
+        (mesh, config) — the live pair or a warm-compile target."""
+        dp = mesh_config.resolve(mesh.size).data_parallel_size
         denom = self.tc.micro_batch_size * dp
         if self.tc.global_batch_size % denom:
             raise ValueError(
@@ -151,6 +204,7 @@ class ElasticTrainer:
         # to XLA, which has been seen to choose SingleDeviceSharding for
         # some leaves — poisoning every later restore that places leaves
         # by this target's sharding (resized-world restore path).
+        self._params_avatar = jax.tree.map(_avatar_of, params)
         self._record_data_parallel_comm(params)
         opt_state = self.optimizer.init(params)
         # scalars born mesh-replicated, not on the default device: a
@@ -183,7 +237,8 @@ class ElasticTrainer:
         scatters gradients; dp all-reduces gradients — so the byte
         counts come from the parameter tree, the same way the
         reference derives NCCL bus bandwidth from algorithm formulas
-        rather than observed packets (xpu_timer parse_params.cc)."""
+        rather than observed packets (xpu_timer parse_params.cc).
+        ``params`` may be live arrays or their avatars (remesh path)."""
         from dlrover_tpu.profiler.comm import comm_ledger, record_collective
 
         # a new trainer means a new program inventory: drop rows from any
@@ -193,7 +248,8 @@ class ElasticTrainer:
         comm_ledger.set_accum_steps(self.accum_steps)
         shape = dict(self.mesh.shape)
         param_bytes = sum(
-            l.size * l.dtype.itemsize for l in jax.tree.leaves(params)
+            l.size * np.dtype(l.dtype).itemsize
+            for l in jax.tree.leaves(params)
         )
         fsdp = shape.get("fsdp", 1)
         if fsdp > 1:
@@ -217,8 +273,35 @@ class ElasticTrainer:
                 nbytes=param_bytes // max(fsdp, 1), count=1,
             )
 
-    def _build_step(self):
-        accum = self.accum_steps
+    def _build_step(
+        self,
+        mesh: Optional[Mesh] = None,
+        mesh_config: Optional[MeshConfig] = None,
+        out_shardings: Any = None,
+    ):
+        """The jitted step for ``(mesh, mesh_config)`` — defaults to the
+        live pair. Parametrized so the warm-compile path can build the
+        step for a mesh that is not (yet) the trainer's.
+
+        ``out_shardings`` (AOT path): pin the output state to the input
+        state's shardings. Left to XLA, some outputs come back sharded
+        differently than they went in (observed: replicated norm-param
+        adam moments returned tp-sharded) — which makes step N+1's
+        input signature differ from step N's, silently recompiling
+        under jit and hard-rejecting under an AOT executable."""
+        mesh = mesh if mesh is not None else self.mesh
+        mesh_config = (
+            mesh_config if mesh_config is not None else self.mesh_config
+        )
+        accum = self._accum_for(mesh, mesh_config)
+        # the loss must target the step's mesh: a loss closing over a
+        # different mesh would bake foreign sharding constraints into
+        # this program (cross-world AOT needs the factory form)
+        loss_fn = (
+            self.loss_factory(mesh)
+            if self.loss_factory is not None
+            else self.loss_fn
+        )
 
         def step(state, batch):
             # batch: any pytree whose leaves lead with (accum, micro*dp):
@@ -227,7 +310,7 @@ class ElasticTrainer:
                 # single microbatch: no accumulator scan — grads stay in
                 # param dtype and the f32 accumulation buffer (a full extra
                 # param-sized pytree) is never allocated
-                loss_sum, grads = jax.value_and_grad(self.loss_fn)(
+                loss_sum, grads = jax.value_and_grad(loss_fn)(
                     state["params"], jax.tree.map(lambda x: x[0], batch)
                 )
             else:
@@ -239,7 +322,7 @@ class ElasticTrainer:
                 # below absorbs its param-dtype dw chunks via promotion)
                 def micro_grads(carry, micro):
                     loss_sum, grads = carry
-                    loss, g = jax.value_and_grad(self.loss_fn)(
+                    loss, g = jax.value_and_grad(loss_fn)(
                         state["params"], micro
                     )
                     grads = jax.tree.map(jnp.add, grads, g)
@@ -274,12 +357,221 @@ class ElasticTrainer:
 
         # state keeps the shardings its arrays already carry (params placed
         # by the caller, opt state born sharded in init_state).
-        batch_sh = self.batch_sharding
+        batch_sh = NamedSharding(mesh, P(None, *batch_spec()))
+        kwargs = {}
+        if out_shardings is not None:
+            kwargs["out_shardings"] = out_shardings
         return jax.jit(
             step,
             in_shardings=(None, batch_sh),
             donate_argnums=(0,),
+            **kwargs,
         )
+
+    # ---- warm compile (train/warm_compile.py) --------------------------
+    def record_avatars(self, state: dict, batch: PyTree):
+        """Capture mesh-independent shape/dtype/spec stand-ins for the
+        train state and batch. Called automatically on the first
+        ``step()``; call it explicitly to AOT-compile before any live
+        step has run."""
+        self._state_avatar = jax.tree.map(_avatar_of, state)
+        self._params_avatar = jax.tree.map(_avatar_of, state["params"])
+        self._batch_avatar = jax.tree.map(_avatar_of, batch)
+
+    def _config_hash(self) -> str:
+        """Model/config identity for the compile ledger: state-avatar
+        shapes+dtypes (the program's real input signature — a model
+        change or dtype change re-keys it) plus the trainer knobs that
+        shape the step. World-independent by construction."""
+        parts = [
+            f"gb={self.tc.global_batch_size}",
+            f"mb={self.tc.micro_batch_size}",
+            f"lr={self.tc.learning_rate}",
+            f"wd={self.tc.weight_decay}",
+            f"clip={self.tc.grad_clip}",
+        ]
+        for av in jax.tree.leaves(self._state_avatar):
+            parts.append(f"{av.shape}/{av.dtype}")
+        return warm_compile.signature_hash(parts)
+
+    def _step_signature(
+        self, mesh: Mesh, mesh_config: MeshConfig, accum: int
+    ) -> Tuple[str, str]:
+        """(in-process cache key, ledger config-hash). The cache key
+        pins the exact device assignment: an AOT executable only runs
+        on the devices it was compiled for, so a mesh over different
+        devices must miss here (and fall through to the persistent
+        cache, which keys on topology, not identity)."""
+        config_hash = self._config_hash()
+        parts = [
+            config_hash,
+            str(sorted(mesh.shape.items())),
+            # the resolved logical config too: two MeshConfigs resolving
+            # over the same physical mesh shape must never share an
+            # executable if any future knob differentiates their programs
+            str(sorted(mesh_config.resolve(mesh.size).shape().items())),
+            str(tuple(d.id for d in mesh.devices.flat)),
+            f"accum={accum}",
+        ]
+        for av in jax.tree.leaves(self._state_avatar):
+            parts.append(f"{av.spec}")
+        for av in jax.tree.leaves(self._batch_avatar):
+            parts.append(f"{av.shape[2:]}/{av.dtype}")
+        return warm_compile.signature_hash(parts), config_hash
+
+    def _avatar_args(self, mesh: Mesh, mesh_config: MeshConfig, accum: int):
+        """ShapeDtypeStruct (state, batch) pair for ``jit.lower`` on a
+        target mesh: state keeps its global shapes with specs re-bound
+        to the target mesh; batch leading dims re-derive from the
+        target's accumulation split."""
+        dp = mesh_config.resolve(mesh.size).data_parallel_size
+        state_av = jax.tree.map(
+            lambda av: jax.ShapeDtypeStruct(
+                av.shape, av.dtype, sharding=NamedSharding(mesh, av.spec)
+            ),
+            self._state_avatar,
+        )
+        bspec = NamedSharding(mesh, P(None, *batch_spec()))
+        batch_av = jax.tree.map(
+            lambda av: jax.ShapeDtypeStruct(
+                (accum, self.tc.micro_batch_size * dp) + av.shape[2:],
+                av.dtype,
+                sharding=bspec,
+            ),
+            self._batch_avatar,
+        )
+        # output state pinned to the INPUT shardings (same keys the step
+        # emits), loss replicated: keeps step N+1's input signature
+        # identical to step N's — see _build_step
+        out_state_sh = {
+            k: jax.tree.map(
+                lambda av: NamedSharding(mesh, av.spec),
+                self._state_avatar[k],
+            )
+            for k in ("params", "opt", "step", "lr_scale")
+            if k in self._state_avatar
+        }
+        out_sh = (out_state_sh, NamedSharding(mesh, P()))
+        return state_av, batch_av, out_sh
+
+    def lower_step(
+        self,
+        mesh: Mesh,
+        mesh_config: MeshConfig,
+        source: str = "cold",
+    ) -> Tuple[Any, dict]:
+        """AOT-build the step for ``(mesh, mesh_config)`` — which need
+        not be live — via ``jit.lower(avatars).compile()``. Returns
+        ``(compiled, info)``; ``info`` records cache disposition and
+        compile seconds, which also land in the compile ledger. The
+        compiled executable is cached in-process so a later remesh to
+        this signature (or a repeat call) is a warm hit; with the
+        persistent compilation cache enabled the XLA compile itself is
+        also a disk hit across process restarts.
+
+        Requires avatars (one live ``step()`` or ``record_avatars``)."""
+        if self._state_avatar is None or self._batch_avatar is None:
+            raise RuntimeError(
+                "lower_step needs state/batch avatars: run one step() or "
+                "call record_avatars(state, batch) first"
+            )
+        accum = self._accum_for(mesh, mesh_config)
+        sig, config_hash = self._step_signature(mesh, mesh_config, accum)
+        cached = self.warm.get(sig)
+        if cached is not None:
+            warm_compile.compile_ledger.record(
+                mesh.size, config_hash, 0.0, "warm"
+            )
+            return cached, {
+                "cache": "warm", "compile_s": 0.0,
+                "world": mesh.size, "config_hash": config_hash,
+            }
+        state_av, batch_av, out_sh = self._avatar_args(
+            mesh, mesh_config, accum
+        )
+        t0 = time.perf_counter()
+        compiled = (
+            self._build_step(mesh, mesh_config, out_shardings=out_sh)
+            .lower(state_av, batch_av)
+            .compile()
+        )
+        dt = time.perf_counter() - t0
+        self.warm.put(sig, compiled)
+        warm_compile.compile_ledger.record(mesh.size, config_hash, dt, source)
+        return compiled, {
+            "cache": "miss", "compile_s": dt,
+            "world": mesh.size, "config_hash": config_hash,
+        }
+
+    def _acquire_step_fn(self):
+        """The step for the live mesh: plain jit when the kill-switch
+        is off; otherwise the AOT path — in-process warm hit when this
+        signature compiled before (speculative neighbor compile, a
+        remesh back to a previous world), cold AOT compile otherwise —
+        followed by a speculative kick for the neighbor worlds."""
+        if not warm_compile.warm_compile_enabled():
+            return self._build_step()
+        try:
+            fn, info = self.lower_step(self.mesh, self.mesh_config)
+        except Exception:
+            logger.exception(
+                "AOT step build failed; falling back to plain jit"
+            )
+            return self._build_step()
+        if info["cache"] == "warm":
+            logger.info(
+                "step build: WARM (AOT cache hit, world=%d)", self.mesh.size
+            )
+        else:
+            logger.info(
+                "step build: cold compile %.2fs (world=%d config=%s)",
+                info["compile_s"], self.mesh.size, info["config_hash"],
+            )
+        self._maybe_speculate()
+        return fn
+
+    def _maybe_speculate(self):
+        """After a successful live build, compile the step for neighbor
+        world sizes in the background (bounded daemon thread; skips
+        when the kill-switch is off or no persistent cache dir is
+        configured — see WarmCompiler.speculate). Needs the factory
+        form of the loss: a plain ``loss_fn`` may close over the live
+        mesh and cannot be retargeted to a neighbor world."""
+        if self.loss_factory is None:
+            return
+        try:
+            targets = warm_compile.neighbor_worlds(
+                self.mesh.size,
+                self.mesh_config,
+                n_devices_available=jax.device_count(),
+                devices_per_node=jax.local_device_count(),
+                global_batch_size=self.tc.global_batch_size,
+                micro_batch_size=self.tc.micro_batch_size,
+            )
+        except Exception:
+            return
+        if not targets:
+            return
+
+        def compile_for_world(w: int):
+            from dlrover_tpu.parallel.mesh import build_mesh
+            from dlrover_tpu.parallel.mesh import remesh as remesh_config
+
+            cfg = remesh_config(self.mesh_config, w).resolve(w)
+            mesh = build_mesh(cfg, devices=jax.devices()[:w])
+            _, info = self.lower_step(mesh, cfg, source="speculative")
+            # no log once shutdown began: the interpreter may have
+            # closed the log streams under this daemon thread
+            if info["cache"] == "miss" and not self.warm._stop.is_set():
+                logger.info(
+                    "speculative compile: world=%d ready in %.2fs",
+                    w, info["compile_s"],
+                )
+
+        if self.warm.speculate(targets, compile_for_world):
+            logger.info(
+                "speculating step compiles for neighbor worlds %s", targets
+            )
 
     def apply_paral_config(self, state: dict, config: dict) -> dict:
         """Apply a master-pushed runtime config to the train state: a new
@@ -340,11 +632,17 @@ class ElasticTrainer:
         """Mean loss over an iterable of eval batches (each shaped like
         one ``step_batch_shape`` row). The evaluator-role analogue of the
         reference's estimator evaluation: the same jitted graph and mesh
-        as training, params untouched, no optimizer state involved."""
-        total = 0.0
+        as training, params untouched, no optimizer state involved.
+
+        Losses accumulate ON DEVICE and convert to a host float once at
+        the end: a per-batch ``float()`` would block on every batch's
+        just-dispatched forward, serializing host and device (async
+        dispatch is the whole point of the jitted eval)."""
+        total = None
         count = 0
         for batch in batches:
-            total += float(self.eval_step(state, batch))
+            loss = self.eval_step(state, batch)
+            total = loss if total is None else total + loss
             count += 1
         if count == 0:
             # 0.0 would read as a perfect loss to early-stopping logic
@@ -352,7 +650,7 @@ class ElasticTrainer:
                 "evaluate() got zero batches (eval dataset smaller than "
                 "one batch under drop_last?)"
             )
-        return total / count
+        return float(total) / count
 
     def step(self, state: dict, batch) -> Tuple[dict, jnp.ndarray]:
         """One optimizer step = ``accum_steps`` microbatches.
@@ -361,16 +659,62 @@ class ElasticTrainer:
         micro*dp, ...) — int32 token arrays for the LM families,
         (images, labels) tuples for CV."""
         if self._step_fn is None:
-            self._step_fn = self._build_step()
+            self.record_avatars(state, batch)
+            self._step_fn = self._acquire_step_fn()
         if self.worker_ctx is not None:
             state = self.poll_runtime_config(state)
-        new_state, loss = self._step_fn(state, batch)
+        try:
+            new_state, loss = self._step_fn(state, batch)
+        except (ValueError, TypeError) as e:
+            # an AOT executable (warm path) is stricter than jit: a
+            # committed input with a different sharding raises
+            # ValueError("...does not match..."), and a batch with a
+            # different shape/dtype raises TypeError("Argument types
+            # differ from the types for which this computation was
+            # compiled") where jit would silently recompile. Rebuild
+            # via plain jit once rather than fail training over it.
+            msg = str(e)
+            if not warm_compile.warm_compile_enabled() or not (
+                "does not match" in msg
+                or "differ from the types" in msg
+            ):
+                raise
+            logger.warning(
+                "AOT step rejected input shardings (%s); rebuilding with "
+                "plain jit", str(e)[:200],
+            )
+            # evict the poisoned executable: a later remesh back to this
+            # signature must not warm-hit it and fail again
+            try:
+                sig, _ = self._step_signature(
+                    self.mesh, self.mesh_config, self.accum_steps
+                )
+                self.warm.evict(sig)
+            except Exception:
+                pass
+            self._step_fn = self._build_step()
+            new_state, loss = self._step_fn(state, batch)
         # host-side step counter: reading new_state["step"] would block on
         # the just-dispatched computation and kill async dispatch
         self._host_step += 1
         if self.worker_ctx is not None:
             self.worker_ctx.report_step(self._host_step)
         return new_state, loss
+
+    def sync_host_step(self, state: dict):
+        """Seed the host-side step counter from a restored train state.
+
+        Call this from the restore path (after ``ckpt.load``): without
+        it ``_host_step`` restarts at 0 and ``report_step`` feeds the
+        master's SpeedMonitor a regressing global step after every
+        restart, corrupting goodput accounting. The one host sync here
+        is fine — restore already synchronized."""
+        step = state.get("step") if isinstance(state, dict) else None
+        if step is None:
+            return
+        self._host_step = int(jax.device_get(step))
+        logger.info("host step counter seeded from restore: %d",
+                    self._host_step)
 
     # ---- elasticity ----------------------------------------------------
     def remesh(self, mesh: Mesh, mesh_config: MeshConfig):
@@ -390,7 +734,33 @@ class ElasticTrainer:
         self.mesh_config = mesh_config
         self._step_fn = None
         self._eval_fn = None  # its NamedSharding binds the old mesh
+        if self.loss_factory is not None:
+            # re-derive the loss for the new mesh (a loss closing over
+            # the old mesh would pin its sharding constraints to dead
+            # devices and poison the rebuild)
+            self.loss_fn = self.loss_factory(mesh)
+        # refresh the comm inventory NOW: on the elastic resize path the
+        # state is restored (init_state never runs again), and without
+        # this /metrics keeps advertising the dead mesh's collectives
+        # and accumulation count
+        if self._params_avatar is not None:
+            self._record_data_parallel_comm(self._params_avatar)
+        warm = False
+        if (
+            warm_compile.warm_compile_enabled()
+            and self._state_avatar is not None
+            and self._batch_avatar is not None
+        ):
+            try:
+                sig, _ = self._step_signature(
+                    mesh, mesh_config, self.accum_steps
+                )
+                warm = self.warm.get(sig) is not None
+            except Exception:
+                warm = False
         logger.info(
-            "remesh: world=%d accum %d→%d (global batch fixed at %d)",
+            "remesh: world=%d accum %d→%d (global batch fixed at %d); "
+            "step rebuild will be %s",
             mesh.size, old, self.accum_steps, self.tc.global_batch_size,
+            "WARM (AOT executable cached)" if warm else "cold",
         )

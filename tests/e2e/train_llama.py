@@ -46,6 +46,9 @@ restored = ckpt.load(target=state)
 start = 0
 if restored is not None:
     start, state = restored
+    # seed the host step counter so report_step never regresses the
+    # master's SpeedMonitor after a restart
+    trainer.sync_host_step(state)
     print(f"restored from step {start}", flush=True)
 
 a, b = trainer.step_batch_shape

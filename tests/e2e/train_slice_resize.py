@@ -70,6 +70,9 @@ restored = ckpt.load(target=state)
 start_step = 0
 if restored is not None:
     start_step, state = restored
+    # seed the host step counter so report_step never regresses the
+    # master's SpeedMonitor after the resize restart
+    trainer.sync_host_step(state)
     print(
         f"[slice] resumed step {start_step} onto {n_slices}-slice world",
         flush=True,

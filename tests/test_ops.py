@@ -140,7 +140,7 @@ def test_flash_attention_grad_matches_reference():
 
 def test_ring_attention_matches_reference():
     """Ring over a 4-device sp axis == full causal attention."""
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     q, k, v = _qkv(b=2, s=64, h=4, hkv=2, d=16)
@@ -159,7 +159,7 @@ def test_ring_attention_matches_reference():
 
 
 def test_ring_attention_grads():
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     q, k, v = _qkv(b=1, s=32, h=2, hkv=1, d=8)
@@ -192,7 +192,7 @@ def test_ulysses_attention_matches_reference():
     """All-to-all sequence parallelism over 4 devices == full causal
     attention (Ulysses pattern: scatter heads / gather seq around a
     single-device kernel)."""
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from dlrover_tpu.ops.ulysses import ulysses_attention
@@ -213,7 +213,7 @@ def test_ulysses_attention_matches_reference():
 
 
 def test_ulysses_attention_grads():
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from dlrover_tpu.ops.ulysses import ulysses_attention
@@ -238,7 +238,7 @@ def test_ulysses_gqa_replicates_kv_heads_below_sp():
     """GQA with hkv < sp: kv heads replicate so the head scatter
     divides (DeepSpeed-Ulysses GQA treatment) — output matches the
     unsharded reference exactly."""
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from dlrover_tpu.ops.attention import mha_reference
@@ -262,7 +262,7 @@ def test_ulysses_gqa_replicates_kv_heads_below_sp():
 
 
 def test_ulysses_rejects_unreplicatable_heads():
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from dlrover_tpu.ops.ulysses import ulysses_attention
@@ -287,7 +287,7 @@ def test_ring_and_ulysses_agree_at_longer_seq():
     """The two SP strategies are interchangeable: at seq 512 over sp=4
     both match full attention (and therefore each other) with GQA-free
     heads — the swap a user makes via attn_impl must be numerics-neutral."""
-    from jax import shard_map
+    from dlrover_tpu.ops.shard_map_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from dlrover_tpu.ops.ulysses import ulysses_attention

@@ -1,0 +1,382 @@
+"""Warm-path elasticity (train/warm_compile.py + trainer AOT wiring).
+
+The contract under test: an AOT-compiled step is indistinguishable from
+the jitted one numerically; repeating a signature is a warm cache hit
+(~0 ledger seconds); a remesh refreshes the comm inventory; evaluate()
+syncs the host exactly once; and DLROVER_TPU_WARM_COMPILE=0 restores
+the plain-jit world exactly.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+from dlrover_tpu.parallel.mesh import remesh as remesh_config
+from dlrover_tpu.train import warm_compile as wc
+from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+CFG = llama.LlamaConfig.tiny()
+SEQ = 16
+
+
+def _factory(cfg):
+    return lambda mesh: (lambda p, t: llama.loss_fn(p, t, cfg, mesh))
+
+
+def _make_trainer(world=8, fsdp=2, tp=2, gb=8, use_factory=True):
+    mc = MeshConfig(dp=-1, fsdp=fsdp, tp=tp).resolve(world)
+    mesh = build_mesh(mc, devices=jax.devices()[:world])
+    specs = llama.param_specs(CFG)
+    tc = TrainConfig(global_batch_size=gb, micro_batch_size=2,
+                     warmup_steps=0, total_steps=100)
+    if use_factory:
+        tr = ElasticTrainer(None, specs, mesh, mc, tc,
+                            loss_factory=_factory(CFG))
+    else:
+        tr = ElasticTrainer(
+            lambda p, t: llama.loss_fn(p, t, CFG, mesh),
+            specs, mesh, mc, tc,
+        )
+    params = jax.device_put(
+        llama.init_params(CFG, jax.random.key(0)),
+        named_shardings(mesh, specs),
+    )
+    state = tr.init_state(params)
+    a, b = tr.step_batch_shape
+    batch = jax.random.randint(jax.random.key(1), (a, b, SEQ), 0,
+                               CFG.vocab_size)
+    return tr, state, batch
+
+
+def _drain_speculation():
+    """Join any in-flight speculative threads (this module's trainers,
+    or earlier suites whose CheckpointEngine configured a cache dir and
+    thereby armed speculation): a straggler finishing mid-test would
+    write into the freshly cleared ledger."""
+    for c in list(wc._live_compilers):
+        c._stop.set()
+        c.wait_idle(timeout=120)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger(monkeypatch):
+    """Each test reads its own compile ledger; speculation stays manual
+    (no configured cache dir in tests unless a test opts in)."""
+    _drain_speculation()
+    wc.compile_ledger.clear()
+    monkeypatch.delenv(wc.ENV_KILL_SWITCH, raising=False)
+    monkeypatch.delenv(wc.ENV_CACHE_DIR, raising=False)
+    yield
+    _drain_speculation()
+    wc.compile_ledger.clear()
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: AOT == jit, warm hits, speculation, kill-switch
+# ---------------------------------------------------------------------------
+
+
+def test_aot_step_matches_jit_step():
+    """The AOT-compiled executable and the plain jitted step produce
+    identical results from identical state."""
+    tr, state, batch = _make_trainer()
+    # jit reference: kill-switch off
+    os.environ[wc.ENV_KILL_SWITCH] = "0"
+    try:
+        s_jit, l_jit = tr.step(state, batch)
+        l_jit = float(l_jit)
+        leaves_jit = [np.asarray(x) for x in jax.tree.leaves(
+            s_jit["params"])]
+    finally:
+        os.environ.pop(wc.ENV_KILL_SWITCH, None)
+
+    # AOT path from the SAME initial state (donation consumed the first
+    # trainer's buffers — rebuild)
+    tr2, state2, batch2 = _make_trainer()
+    assert wc.warm_compile_enabled()
+    s_aot, l_aot = tr2.step(state2, batch2)
+    assert float(l_aot) == pytest.approx(l_jit, rel=1e-6)
+    for a, b in zip(
+        [np.asarray(x) for x in jax.tree.leaves(s_aot["params"])],
+        leaves_jit,
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_aot_step_survives_batch_shape_change():
+    """jit silently recompiles when the batch shape changes; the AOT
+    executable raises TypeError instead — step() must absorb it and
+    rebuild rather than crash training (drop_last=False tails,
+    curriculum seq-length changes)."""
+    tr, state, batch = _make_trainer()
+    state, _ = tr.step(state, batch)
+    a, b = tr.step_batch_shape
+    longer = jax.random.randint(jax.random.key(2), (a, b, SEQ * 2), 0,
+                                CFG.vocab_size)
+    state, loss = tr.step(state, longer)
+    assert np.isfinite(float(loss))
+
+
+def test_lower_step_same_signature_is_cache_hit():
+    """Second lower_step for the same (mesh, config) returns the cached
+    executable and the ledger records ~0 compile seconds."""
+    tr, state, batch = _make_trainer()
+    tr.record_avatars(state, batch)
+    _, info1 = tr.lower_step(tr.mesh, tr.mesh_config)
+    assert info1["cache"] == "miss"
+    assert info1["compile_s"] > 0
+    _, info2 = tr.lower_step(tr.mesh, tr.mesh_config)
+    assert info2["cache"] == "warm"
+    assert info2["compile_s"] == 0.0
+    entry = wc.compile_ledger.get(tr.mesh.size, info1["config_hash"])
+    assert [c["source"] for c in entry["compiles"]] == ["cold", "warm"]
+    assert entry["compiles"][1]["seconds"] == 0.0
+
+
+def test_lower_step_for_non_live_world_then_warm_remesh():
+    """Compile for a world that is not live; remeshing onto it later
+    picks the executable up without a recompile, and the resized step
+    still trains (loss finite, accum re-derived)."""
+    tr, state, batch = _make_trainer(world=8, fsdp=2, tp=2, gb=8)
+    tr.record_avatars(state, batch)
+    mc4 = remesh_config(tr.mesh_config, 4).resolve(4)
+    mesh4 = build_mesh(mc4, devices=jax.devices()[:4])
+    _, info = tr.lower_step(mesh4, mc4, source="speculative")
+    assert info["cache"] == "miss" and info["world"] == 4
+
+    tr.remesh(mesh4, mc4)
+    params4 = jax.device_put(
+        llama.init_params(CFG, jax.random.key(0)),
+        named_shardings(mesh4, llama.param_specs(CFG)),
+    )
+    state4 = tr.init_state(params4)
+    a, b = tr.step_batch_shape
+    assert a == 2  # world halved with fixed global batch: accum doubles
+    batch4 = jax.random.randint(jax.random.key(1), (a, b, SEQ), 0,
+                                CFG.vocab_size)
+    _, loss = tr.step(state4, batch4)
+    assert np.isfinite(float(loss))
+    # the live build for world 4 must have been the cached executable
+    entry = wc.compile_ledger.get(4, info["config_hash"])
+    assert "warm" in [c["source"] for c in entry["compiles"]]
+
+
+def test_speculative_thread_populates_cache(tmp_path, monkeypatch):
+    """With a cache dir configured, the first build kicks a background
+    compile for the admissible neighbor world (8 → 4 here) and the
+    cache ends up holding both executables."""
+    monkeypatch.setenv(wc.ENV_CACHE_DIR, str(tmp_path / "cc"))
+    tr, state, batch = _make_trainer()
+    tr.step(state, batch)
+    assert tr.warm.wait_idle(timeout=300)
+    assert len(tr.warm) == 2  # live world 8 + speculated world 4
+    worlds = {e["world"] for e in wc.compile_ledger.entries().values()}
+    assert worlds == {8, 4}
+    sources = [
+        c["source"]
+        for e in wc.compile_ledger.entries().values()
+        for c in e["compiles"]
+    ]
+    assert "speculative" in sources
+
+
+def test_kill_switch_disables_aot_and_speculation(tmp_path, monkeypatch):
+    """DLROVER_TPU_WARM_COMPILE=0 restores today's behavior exactly:
+    plain jit build, no AOT cache entries, no speculative thread, no
+    ledger rows."""
+    monkeypatch.setenv(wc.ENV_CACHE_DIR, str(tmp_path / "cc"))
+    monkeypatch.setenv(wc.ENV_KILL_SWITCH, "0")
+    tr, state, batch = _make_trainer()
+    _, loss = tr.step(state, batch)
+    assert np.isfinite(float(loss))
+    assert len(tr.warm) == 0
+    assert not tr.warm.speculating
+    assert wc.compile_ledger.entries() == {}
+    assert not wc.warm_compile_enabled()
+
+
+def test_speculation_skipped_without_cache_dir():
+    """No persistent cache dir configured → the speculative thread
+    never starts (a speculative compile that cannot outlive the
+    process only helps the same-process resize and costs host RAM)."""
+    started = wc.WarmCompiler().speculate(
+        [4], lambda w: None, require_cache_dir=True
+    )
+    if wc.configured_cache_dir() is None:
+        assert not started
+    compiled = []
+    assert wc.WarmCompiler().speculate(
+        [4], lambda w: compiled.append(w), require_cache_dir=False
+    )
+
+
+def test_neighbor_worlds_heuristic():
+    mc = MeshConfig(dp=-1, fsdp=1, tp=2).resolve(8)
+    # 8 devices live: 8-1=7 (model axes tp=2 don't divide), 4 (ok)
+    assert wc.neighbor_worlds(
+        8, mc, n_devices_available=8, devices_per_node=1,
+        global_batch_size=8, micro_batch_size=2,
+    ) == [4]
+    # node-sized steps: 8-4=4 first, then 8//2=4 dedupes
+    assert wc.neighbor_worlds(
+        8, mc, n_devices_available=8, devices_per_node=4,
+        global_batch_size=8, micro_batch_size=2,
+    ) == [4]
+    # growth target admitted only when devices exist for it
+    assert wc.neighbor_worlds(
+        4, mc, n_devices_available=8, devices_per_node=4,
+        global_batch_size=8, micro_batch_size=2,
+    ) == [2, 8]
+    assert wc.neighbor_worlds(
+        4, mc, n_devices_available=4, devices_per_node=4,
+        global_batch_size=8, micro_batch_size=2,
+    ) == [2]
+    # global-batch invariant filters: gb=2, micro=2 → dp' must be 1,
+    # which no neighbor of 8 satisfies under tp=2
+    assert wc.neighbor_worlds(
+        8, mc, n_devices_available=8, devices_per_node=1,
+        global_batch_size=2, micro_batch_size=2,
+    ) == []
+    # ...but world 4's shrink target does: 2 devices, tp=2, dp'=1
+    assert wc.neighbor_worlds(
+        4, mc, n_devices_available=8, devices_per_node=1,
+        global_batch_size=2, micro_batch_size=2,
+    ) == [2]
+
+
+def test_enable_persistent_cache_respects_existing(tmp_path, monkeypatch):
+    """The first configured cache dir wins — never repoint a cache jax
+    already has (bench's per-user cache, a user's env)."""
+    existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if existing:
+        monkeypatch.setenv(wc.ENV_CACHE_DIR, str(tmp_path / "other"))
+        assert wc.enable_persistent_cache() == existing
+    else:
+        d = str(tmp_path / "cc")
+        monkeypatch.setenv(wc.ENV_CACHE_DIR, d)
+        assert wc.enable_persistent_cache() == d
+        assert wc.configured_cache_dir() == d
+
+
+def test_compile_ledger_persists_json(tmp_path, monkeypatch):
+    """When a cache dir is live, the ledger mirrors to JSON inside it."""
+    import json
+
+    d = tmp_path / "cc"
+    d.mkdir()
+    monkeypatch.setattr(wc, "_enabled_dir", str(d))
+    wc.compile_ledger.record(8, "abc123", 1.25, "cold")
+    wc.compile_ledger.record(8, "abc123", 0.0, "warm")
+    data = json.loads((d / wc.LEDGER_FILENAME).read_text())
+    entry = data["world8:abc123"]
+    assert entry["world"] == 8
+    assert [c["source"] for c in entry["compiles"]] == ["cold", "warm"]
+
+
+# ---------------------------------------------------------------------------
+# Satellites
+# ---------------------------------------------------------------------------
+
+
+def test_remesh_refreshes_comm_ledger():
+    """After an elastic resize (state restored, init_state NOT called
+    again) /metrics must advertise the new mesh's collectives and
+    accumulation count, not the dead mesh's."""
+    from dlrover_tpu.profiler.comm import comm_ledger
+
+    tr, state, batch = _make_trainer(world=8, fsdp=2, tp=2, gb=8)
+    tr.step(state, batch)
+    assert comm_ledger._accum_steps == tr.accum_steps == 1
+    rows_before = {e.name for e in comm_ledger.events()} if hasattr(
+        comm_ledger, "events") else None
+
+    mc4 = remesh_config(tr.mesh_config, 4).resolve(4)
+    mesh4 = build_mesh(mc4, devices=jax.devices()[:4])
+    tr.remesh(mesh4, mc4)  # no init_state: the elastic restore path
+    assert comm_ledger._accum_steps == tr.accum_steps == 2
+    del rows_before
+
+
+def test_remesh_without_init_state_keeps_param_bytes():
+    """The comm rows after remesh carry real byte counts (from the
+    params avatar), not zeros."""
+    from dlrover_tpu.profiler.comm import comm_ledger
+
+    tr, state, batch = _make_trainer(world=8, fsdp=2, tp=2, gb=8)
+    tr.step(state, batch)
+    mc4 = remesh_config(tr.mesh_config, 4).resolve(4)
+    mesh4 = build_mesh(mc4, devices=jax.devices()[:4])
+    tr.remesh(mesh4, mc4)
+    summary = comm_ledger.summary() if hasattr(comm_ledger, "summary") \
+        else None
+    lines = comm_ledger.prometheus_lines()
+    byte_rows = [ln for ln in lines if "comm_bytes_per_step{" in ln]
+    assert byte_rows, "remesh must re-record the collective inventory"
+    assert any(int(ln.rsplit(" ", 1)[1]) > 0 for ln in byte_rows)
+    del summary
+
+
+class _CountingLoss:
+    """Quacks like a device scalar; counts host syncs (__float__)."""
+
+    syncs = 0
+
+    def __init__(self, value):
+        self.value = value
+
+    def __add__(self, other):
+        val = other.value if isinstance(other, _CountingLoss) else other
+        return _CountingLoss(self.value + val)
+
+    def __float__(self):
+        _CountingLoss.syncs += 1
+        return float(self.value)
+
+
+def test_evaluate_syncs_host_once(monkeypatch):
+    """evaluate() must accumulate losses on device and convert to a
+    host float exactly once — a per-batch float() serializes host and
+    device."""
+    tr, state, batch = _make_trainer()
+    _CountingLoss.syncs = 0
+    monkeypatch.setattr(
+        ElasticTrainer, "eval_step",
+        lambda self, s, b: _CountingLoss(2.0),
+    )
+    out = tr.evaluate(state, [object()] * 5)
+    assert out == pytest.approx(2.0)
+    assert _CountingLoss.syncs == 1
+
+
+def test_evaluate_real_mean():
+    tr, state, batch = _make_trainer()
+    rows = [batch[i] for i in range(batch.shape[0])]
+    mean = tr.evaluate(state, rows)
+    singles = [float(tr.eval_step(state, r)) for r in rows]
+    assert mean == pytest.approx(sum(singles) / len(singles), rel=1e-6)
+
+
+def test_evaluate_zero_batches_raises():
+    tr, state, batch = _make_trainer()
+    with pytest.raises(ValueError):
+        tr.evaluate(state, [])
+
+
+def test_sync_host_step_seeds_from_restored_state():
+    """After a restore, report_step must continue from the restored
+    global step, never regress to 0."""
+    tr, state, batch = _make_trainer()
+    state, _ = tr.step(state, batch)
+    assert tr._host_step == 1
+    restored = {**state, "step": jnp.asarray(41, jnp.int32)}
+    tr2, _, _ = _make_trainer()
+    tr2.sync_host_step(restored)
+    assert tr2._host_step == 41
+    # stateless dicts are a no-op, not a crash
+    tr2.sync_host_step({})
+    assert tr2._host_step == 41
