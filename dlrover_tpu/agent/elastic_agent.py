@@ -563,6 +563,10 @@ class ElasticAgent:
             return
 
         def handle(signum, frame):
+            # intentional save-on-signal: the preemption grace window is
+            # the ONLY time to persist the staged checkpoint, so this
+            # handler owns the blocking-I/O risk (the reference agent
+            # makes the same trade)  # graftlint: disable=JG005
             logger.warning("agent got signal %s; saving + stopping", signum)
             self._save_checkpoint_at_breakpoint()
             self._stop_evt.set()

@@ -40,6 +40,7 @@ from dlrover_tpu.checkpoint.shm_handler import (
     shm_name,
     unflatten_state,
 )
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.ipc import (
     SharedDict,
     SharedLock,
@@ -179,13 +180,9 @@ class CheckpointEngine:
         # behavior); torch engines block for the whole shm stage
         # (reference blocks ~0.5 s, flash_checkpoint.md:362-415).
         if async_staging is None:
-            async_staging = (
-                os.environ.get("DLROVER_TPU_ASYNC_STAGING", "1") != "0"
-            )
+            async_staging = flags.ASYNC_STAGING.get()
         self._async_staging = bool(async_staging)
-        self._device_snapshot_enabled = (
-            os.environ.get("DLROVER_TPU_DEVICE_SNAPSHOT", "1") != "0"
-        )
+        self._device_snapshot_enabled = flags.DEVICE_SNAPSHOT.get()
         self._snap_fn = None
         self._staging_thread: Optional[threading.Thread] = None
         self._staging_error: Optional[BaseException] = None
@@ -343,10 +340,7 @@ class CheckpointEngine:
         # cleanup time to run before the kubelet's SIGKILL. Raise it in
         # lockstep with terminationGracePeriodSeconds on slow d2h links
         # (deploy/k8s/README.md documents the pairing).
-        try:
-            timeout = float(os.environ.get("DLROVER_TPU_DRAIN_TIMEOUT", "20"))
-        except ValueError:
-            timeout = 20.0
+        timeout = float(flags.DRAIN_TIMEOUT.get())
         try:
             self.wait_staging(timeout=timeout)
         except BaseException as e:  # staging errors are stored broadly
@@ -510,6 +504,9 @@ class CheckpointEngine:
             self._report_save(step, pause)
         except BaseException as e:  # surfaced on the next wait_staging
             logger.exception("background staging of step %s failed", step)
+            # single pointer write; the only reader (wait_staging) joins
+            # this thread first, so the join IS the happens-before edge
+            # a lock would add  # graftlint: disable=JG006
             self._staging_error = e
         finally:
             payload = None  # free the snapshot's HBM buffers promptly
@@ -551,7 +548,7 @@ class CheckpointEngine:
         self.latest_saved_step = step
         # replica mode (agent-set env): tell the saver to stream this staged
         # state to the backup peer, off the training critical path
-        if os.environ.get("DLROVER_TPU_CKPT_REPLICA") == "1":
+        if flags.CKPT_REPLICA.get() == "1":
             q = self._queue()
             if q is not None:
                 q.put(CheckpointEvent("backup", step=step).to_wire())

@@ -18,7 +18,6 @@ deterministic, so a restored host knows exactly whom to ask.
 from __future__ import annotations
 
 import json
-import os
 import socket
 import struct
 import threading
@@ -28,6 +27,7 @@ from dlrover_tpu.checkpoint.shm_handler import (
     HEADER_SPACE,
     SharedMemoryHandler,
 )
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
 
 _CHUNK = 1 << 20
@@ -68,9 +68,7 @@ def _recv_msg(sock: socket.socket) -> Tuple[Dict, bytes]:
 
 
 #: refuse absurd payloads before buffering them (memory-DoS bound)
-MAX_PAYLOAD_BYTES = int(
-    os.environ.get("DLROVER_TPU_REPLICA_MAX_BYTES", str(64 << 30))
-)
+MAX_PAYLOAD_BYTES = int(flags.REPLICA_MAX_BYTES.get())
 
 
 class ReplicaServer:

@@ -1,0 +1,164 @@
+"""Typed registry for ``DLROVER_TPU_*`` environment flags.
+
+The repo grew ~50 scattered ``os.environ`` call sites; each invented
+its own default and parse-failure behavior, none were discoverable,
+and a typo'd name failed silent. This module is the one place a
+runtime knob is *defined* — name, type, default, help — and the one
+place it is *read*. graftlint rule JG003 enforces it: raw env reads
+outside {this module, common/constants.py, agent/config.py,
+train/bootstrap.py} fail the lint gate.
+
+Semantics, kept bit-identical to the call sites this replaced:
+
+- flags re-read the environment on every ``get()`` — tests and benches
+  flip kill-switches at runtime, and the jitted-trace caveat ("set it
+  before the first trace") is the call site's contract, not this
+  module's;
+- empty string == unset == default (every migrated site used
+  ``os.environ.get(X, d) or d`` or treated "" as absent);
+- bool flags are ``raw != "0"`` (``DLROVER_TPU_WARM_COMPILE=0`` is the
+  only spelling that disables — matching the kill-switch convention);
+- a value that fails to parse logs one warning and returns the
+  default: a mistyped knob must never crash a training process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import logger
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvFlag:
+    """One typed environment flag. ``kind``: bool | int | float | str."""
+
+    name: str
+    default: Any
+    kind: str
+    help: str = ""
+
+    def raw(self) -> Optional[str]:
+        return os.environ.get(self.name)
+
+    def present(self) -> bool:
+        """Set to a non-empty value (empty string counts as unset)."""
+        raw = self.raw()
+        return raw is not None and raw != ""
+
+    def get(self) -> Any:
+        """Current typed value; re-reads the environment every call."""
+        raw = self.raw()
+        if raw is None or raw == "":
+            return self.default
+        if self.kind == "bool":
+            return raw != "0"
+        if self.kind == "str":
+            return raw
+        try:
+            return int(raw) if self.kind == "int" else float(raw)
+        except ValueError:
+            logger.warning(
+                "%s=%r is not a valid %s; using default %r",
+                self.name, raw, self.kind, self.default,
+            )
+            return self.default
+
+    def propagate(self, value: Any) -> None:
+        """Write the flag back into ``os.environ`` so CHILD processes
+        (speculative compile helpers, restarted workers forked from
+        this env) inherit it. The registry is the only sanctioned env
+        *writer* for its own flags, same as it is the only reader."""
+        os.environ[self.name] = str(value)
+
+
+_REGISTRY: Dict[str, EnvFlag] = {}
+
+
+def _define(name: str, default: Any, kind: str, help: str = "") -> EnvFlag:
+    flag = EnvFlag(name, default, kind, help)
+    _REGISTRY[name] = flag
+    return flag
+
+
+def all_flags() -> List[EnvFlag]:
+    """The full catalog, for docs and ``describe()``."""
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def describe() -> str:
+    lines = []
+    for f in all_flags():
+        lines.append(f"{f.name} ({f.kind}, default {f.default!r}): {f.help}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the catalog
+# ---------------------------------------------------------------------------
+
+WARM_COMPILE = _define(
+    "DLROVER_TPU_WARM_COMPILE", True, "bool",
+    "Warm-path elasticity kill-switch: 0 restores the plain jax.jit "
+    "rebuild path (train/warm_compile.py).",
+)
+COMPILE_CACHE_DIR = _define(
+    "DLROVER_TPU_COMPILE_CACHE_DIR", "", "str",
+    "Persistent XLA compile cache dir (agent-injected; checkpoint "
+    "engine defaults it under the checkpoint dir).",
+)
+COMPILE_CACHE_MIN_S = _define(
+    "DLROVER_TPU_COMPILE_CACHE_MIN_S", 1.0, "float",
+    "Minimum compile seconds for an executable to enter the "
+    "persistent cache.",
+)
+WARM_COMPILE_MAX_TARGETS = _define(
+    "DLROVER_TPU_WARM_COMPILE_MAX_TARGETS", 2, "int",
+    "Upper bound on speculative neighbor-world compiles per build.",
+)
+WARM_COMPILE_EXIT_JOIN_S = _define(
+    "DLROVER_TPU_WARM_COMPILE_EXIT_JOIN_S", 60.0, "float",
+    "Interpreter-exit join bound for the speculative compile thread.",
+)
+CHUNKED_CE = _define(
+    "DLROVER_TPU_CHUNKED_CE", True, "bool",
+    "Chunked fused cross-entropy kill-switch: 0 restores the dense "
+    "[B,T,V] logits path (ops/chunked_ce.py). Read at trace time.",
+)
+COMM_METRICS_PORT = _define(
+    "DLROVER_TPU_COMM_METRICS_PORT", None, "int",
+    "Worker /metrics port for the per-collective comm ledger "
+    "(0 = ephemeral port; unset = disabled).",
+)
+ASYNC_STAGING = _define(
+    "DLROVER_TPU_ASYNC_STAGING", True, "bool",
+    "Checkpoint staging kill-switch: 0 stages shm copies synchronously "
+    "on the training thread (checkpoint/engine.py).",
+)
+DEVICE_SNAPSHOT = _define(
+    "DLROVER_TPU_DEVICE_SNAPSHOT", True, "bool",
+    "0 disables the on-device state snapshot before async staging "
+    "(falls back to blocking for the d2h transfer).",
+)
+DRAIN_TIMEOUT = _define(
+    "DLROVER_TPU_DRAIN_TIMEOUT", 20.0, "float",
+    "Seconds to wait for in-flight checkpoint staging at teardown; "
+    "pair with terminationGracePeriodSeconds (deploy/k8s/README.md).",
+)
+CKPT_REPLICA = _define(
+    "DLROVER_TPU_CKPT_REPLICA", "", "str",
+    "Agent-set replica mode: exactly '1' streams staged checkpoints "
+    "to the backup peer (checkpoint/replica.py).",
+)
+REPLICA_MAX_BYTES = _define(
+    "DLROVER_TPU_REPLICA_MAX_BYTES", 64 << 30, "int",
+    "Replica server per-payload size bound (memory-DoS refusal).",
+)
+RETRACE_GUARD = _define(
+    "DLROVER_TPU_RETRACE_GUARD", 0, "int",
+    "Silent-recompile guard (lint/retrace_guard.py): 0 off, 1 on with "
+    "defaults, N>=2 on with max N distinct compile signatures per "
+    "jitted function.",
+)

@@ -16,6 +16,9 @@ def _build_logger() -> logging.Logger:
     logger = logging.getLogger("dlrover_tpu")
     if logger.handlers:
         return logger
+    # bootstrap ordering: the typed flag registry (common/flags.py)
+    # imports this logger to warn about bad values, so the log level
+    # itself must be read raw  # graftlint: disable=JG003
     level = os.environ.get("DLROVER_TPU_LOG_LEVEL", "INFO").upper()
     logger.setLevel(level)
     handler = logging.StreamHandler(sys.stderr)

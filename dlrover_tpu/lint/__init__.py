@@ -1,0 +1,37 @@
+"""graftlint: the repo's elasticity invariants, checked by machine.
+
+PR 1 and PR 2 each lost days to the same bug species — a ``loss_fn``
+closing over a mesh made remesh impossible, a per-batch ``float()``
+host-synced ``evaluate()``, a ``set()`` of slices crashed the shm
+restore path, a SIGTERM handler had to be re-armed after SIG_IGN.
+These are invariant classes, not one-off bugs, and with 40+ threaded
+modules and ~50 raw ``os.environ`` call sites convention does not
+scale. graftlint encodes each class as an AST rule (stdlib ``ast``
+only, no new deps) and runs as a tier-1 test and a CI gate, the way
+Orbax bakes checkpoint-layout invariants into its API instead of its
+docs.
+
+Usage::
+
+    python -m dlrover_tpu.lint dlrover_tpu/            # check
+    python -m dlrover_tpu.lint --fix-baseline dlrover_tpu/
+    # graftlint: disable=JG002  -- per-line suppression (with a reason)
+
+The rule catalog lives in :mod:`dlrover_tpu.lint.rules`; each rule's
+docstring names the shipped bug it encodes. The runtime companion
+:mod:`dlrover_tpu.lint.retrace_guard` catches the one invariant static
+analysis cannot see — silent XLA recompiles of an already-compiled
+step signature.
+"""
+
+from dlrover_tpu.lint.engine import (  # noqa: F401
+    LintResult,
+    Severity,
+    SourceFile,
+    Violation,
+    lint_paths,
+    load_baseline,
+    run,
+    write_baseline,
+)
+from dlrover_tpu.lint.rules import ALL_RULES, rule_catalog  # noqa: F401

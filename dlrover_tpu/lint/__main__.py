@@ -1,0 +1,107 @@
+"""graftlint CLI.
+
+    python -m dlrover_tpu.lint [options] paths...
+
+Exit codes: 0 clean (against the baseline), 1 new violations or
+unparsable files, 2 usage error. ``--fix-baseline`` rewrites the
+baseline to exactly the current violation set (use after deliberate
+grandfathering, never to silence a new violation you should fix).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dlrover_tpu.lint import engine
+from dlrover_tpu.lint.rules import ALL_RULES, rule_catalog
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dlrover_tpu.lint",
+        description="graftlint: machine-checked elasticity invariants",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument(
+        "--baseline",
+        default=engine.DEFAULT_BASELINE,
+        help="baseline file of grandfathered violations "
+        "(default: the checked-in dlrover_tpu/lint/baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every violation, ignoring the baseline",
+    )
+    p.add_argument(
+        "--fix-baseline",
+        action="store_true",
+        help="rewrite the baseline to the current violation set",
+    )
+    p.add_argument(
+        "--rule",
+        action="append",
+        default=None,
+        metavar="JGnnn",
+        help="run only these rule ids (repeatable)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rid, name, doc in rule_catalog():
+            print(f"{rid}  {name:28s} {doc}")
+        return 0
+    if not args.paths:
+        p.print_usage(sys.stderr)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+
+    rules = ALL_RULES
+    if args.rule:
+        if args.fix_baseline:
+            # a scoped --fix-baseline would rewrite the baseline with
+            # ONLY the selected rules' violations, silently erasing
+            # every other rule's grandfathered entries
+            print(
+                "error: --rule cannot be combined with --fix-baseline "
+                "(the baseline must cover the full rule catalog)",
+                file=sys.stderr,
+            )
+            return 2
+        wanted = set(args.rule)
+        unknown = wanted - {r.id for r in ALL_RULES}
+        if unknown:
+            print(f"error: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in ALL_RULES if r.id in wanted]
+
+    if args.fix_baseline:
+        result = engine.run(
+            args.paths, baseline_path=args.baseline, fix_baseline=True,
+            rules=rules,
+        )
+        print(
+            f"graftlint: baseline {args.baseline} rewritten with "
+            f"{len(result.violations)} violation(s)"
+        )
+        for e in result.errors:
+            print(f"ERROR {e}", file=sys.stderr)
+        return 1 if result.errors else 0
+
+    if args.no_baseline:
+        violations, errors = engine.lint_paths(args.paths, rules=rules)
+        result = engine.LintResult(violations, violations, [], errors)
+    else:
+        result = engine.run(args.paths, baseline_path=args.baseline,
+                            rules=rules)
+    engine.report(result)
+    return 1 if result.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

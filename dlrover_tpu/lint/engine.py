@@ -1,0 +1,340 @@
+"""graftlint rule engine: file walking, suppressions, baseline, reporting.
+
+Design (mirrors how large linters age well, scaled down to stdlib-only):
+
+- a **Rule** is an object with an ``id`` (``JGnnn``) and a
+  ``check(source) -> Iterable[Violation]``; rules never do I/O;
+- **suppression** is per-line (``# graftlint: disable=JG003`` on the
+  violating line or the line above) or per-file
+  (``# graftlint: disable-file=JG003`` anywhere in the file), always
+  naming the rule — blanket ``disable=all`` exists but is for fixture
+  files, not production code;
+- the **baseline** grandfathers pre-existing violations so the linter
+  can gate CI from day one without a big-bang cleanup: violations are
+  fingerprinted by ``(rule, relative path, stripped source line)`` —
+  NOT the line number, so unrelated edits above a grandfathered site
+  don't un-baseline it — with a count per fingerprint (two identical
+  offending lines in one file need two baseline slots). New violations
+  are everything beyond the baselined count.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: baseline shipped with the package: grandfathered violations of the
+#: pre-graftlint codebase (``--fix-baseline`` rewrites it)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+SUPPRESS_TOKEN = "graftlint: disable="
+SUPPRESS_FILE_TOKEN = "graftlint: disable-file="
+
+
+class Severity:
+    ERROR = "error"  # fails the gate
+    WARNING = "warning"  # reported, never fails (heuristic rules start here)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # posix-normalized, relative to the lint invocation root
+    line: int  # 1-indexed
+    col: int
+    message: str
+    snippet: str  # stripped source line (fingerprint component)
+    severity: str = Severity.ERROR
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: rule + file + offending line
+        TEXT. Line numbers drift with every edit above the site; the
+        text of the offending line only changes when someone touches
+        the site itself — exactly when re-review is wanted."""
+        key = f"{self.rule}|{self.path}|{self.snippet}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"{self.message}\n    {self.snippet}"
+        )
+
+
+class SourceFile:
+    """One parsed python file plus the per-file context rules share."""
+
+    def __init__(self, path: str, text: str, rel_path: Optional[str] = None):
+        self.path = path
+        self.rel_path = (rel_path or path).replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        # parent links: rules walk up (e.g. "is this assignment inside a
+        # `with lock:` block"); ast itself only links downward
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._graftlint_parent = node  # type: ignore[attr-defined]
+        self._file_suppressions: Optional[set] = None
+
+    # -- suppression -------------------------------------------------------
+
+    def _line_suppressions(self, lineno: int) -> set:
+        """Rule ids disabled on source line ``lineno`` (1-indexed)."""
+        if not 1 <= lineno <= len(self.lines):
+            return set()
+        line = self.lines[lineno - 1]
+        idx = line.find(SUPPRESS_TOKEN)
+        if idx < 0:
+            return set()
+        # an empty spec ("disable=" with the rule id forgotten) is a
+        # no-op suppression, not a crash
+        parts = line[idx + len(SUPPRESS_TOKEN):].split()
+        if not parts:
+            return set()
+        return {r.strip() for r in parts[0].split(",") if r.strip()}
+
+    def file_suppressions(self) -> set:
+        if self._file_suppressions is None:
+            out = set()
+            for line in self.lines:
+                idx = line.find(SUPPRESS_FILE_TOKEN)
+                if idx < 0:
+                    continue
+                parts = line[idx + len(SUPPRESS_FILE_TOKEN):].split()
+                if not parts:
+                    continue
+                out.update(
+                    r.strip() for r in parts[0].split(",") if r.strip()
+                )
+            self._file_suppressions = out
+        return self._file_suppressions
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """Suppressed on the line itself, the line above (comment-above
+        style), or file-wide."""
+        for rules in (
+            self._line_suppressions(lineno),
+            self._line_suppressions(lineno - 1),
+            self.file_suppressions(),
+        ):
+            if rule in rules or "all" in rules:
+                return True
+        return False
+
+    # -- helpers rules lean on ---------------------------------------------
+
+    def snippet_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def violation(
+        self,
+        rule: str,
+        node: ast.AST,
+        message: str,
+        severity: str = Severity.ERROR,
+    ) -> Violation:
+        line = getattr(node, "lineno", 1)
+        return Violation(
+            rule=rule,
+            path=self.rel_path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=self.snippet_at(line),
+            severity=severity,
+        )
+
+
+# ---------------------------------------------------------------------------
+# file walking + linting
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[Tuple[str, str]]:
+    """(abs path, display path) for every .py under ``paths``; hidden
+    dirs and __pycache__ skipped. Display paths stay relative when the
+    input was, so fingerprints are machine-independent."""
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p, os.path.normpath(p)
+            continue
+        for root, dirnames, files in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    full = os.path.join(root, f)
+                    yield full, os.path.normpath(full)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence] = None,
+) -> Tuple[List[Violation], List[str]]:
+    """Run ``rules`` (default: the full catalog) over every python file
+    under ``paths``. Returns (violations, unparsable-file messages) —
+    a syntax error in one file must not hide violations in the rest."""
+    if rules is None:
+        from dlrover_tpu.lint.rules import ALL_RULES
+
+        rules = ALL_RULES
+    violations: List[Violation] = []
+    errors: List[str] = []
+    for full, display in iter_py_files(paths):
+        try:
+            with open(full, encoding="utf-8") as f:
+                text = f.read()
+            src = SourceFile(full, text, rel_path=display)
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{display}: unparsable: {e}")
+            continue
+        for rule in rules:
+            try:
+                found = list(rule.check(src))
+            except Exception as e:  # a broken rule must not kill the run
+                errors.append(f"{display}: rule {rule.id} crashed: {e}")
+                continue
+            for v in found:
+                if not src.suppressed(v.rule, v.line):
+                    violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations, errors
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> {rule, path, snippet, count}. Missing file = empty
+    baseline (a fresh checkout of a clean repo needs no file)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}
+    if not isinstance(data, dict) or "violations" not in data:
+        raise ValueError(f"baseline {path}: not a graftlint baseline file")
+    return dict(data["violations"])
+
+
+def write_baseline(path: str, violations: Sequence[Violation]) -> dict:
+    entries: Dict[str, dict] = {}
+    for v in violations:
+        fp = v.fingerprint()
+        e = entries.setdefault(
+            fp,
+            {
+                "rule": v.rule,
+                "path": v.path,
+                "snippet": v.snippet,
+                "count": 0,
+            },
+        )
+        e["count"] += 1
+    data = {
+        "comment": (
+            "graftlint baseline: grandfathered violations. Entries key on "
+            "(rule, path, line TEXT) so line drift never un-baselines a "
+            "site. Regenerate with: python -m dlrover_tpu.lint "
+            "--fix-baseline dlrover_tpu/"
+        ),
+        "version": 1,
+        "violations": {k: entries[k] for k in sorted(entries)},
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+def apply_baseline(
+    violations: Sequence[Violation], baseline: Dict[str, dict]
+) -> Tuple[List[Violation], List[str]]:
+    """(new violations, stale baseline fingerprints). The first
+    ``count`` occurrences of each baselined fingerprint are forgiven;
+    anything beyond is new. Stale fingerprints (baselined but no longer
+    occurring) are reported so ``--fix-baseline`` runs shrink the file
+    as debt is paid down."""
+    remaining = {fp: int(e.get("count", 1)) for fp, e in baseline.items()}
+    fresh: List[Violation] = []
+    for v in violations:
+        fp = v.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            fresh.append(v)
+    stale = [fp for fp, n in remaining.items() if n > 0]
+    return fresh, stale
+
+
+# ---------------------------------------------------------------------------
+# one-call entry (CLI and the tier-1 test share it)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LintResult:
+    violations: List[Violation]  # everything found (post-suppression)
+    fresh: List[Violation]  # not covered by the baseline
+    stale_fingerprints: List[str]
+    errors: List[str]
+
+    @property
+    def failed(self) -> bool:
+        return bool(
+            [v for v in self.fresh if v.severity == Severity.ERROR]
+            or self.errors
+        )
+
+
+def run(
+    paths: Sequence[str],
+    baseline_path: Optional[str] = None,
+    fix_baseline: bool = False,
+    rules: Optional[Sequence] = None,
+) -> LintResult:
+    baseline_path = baseline_path or DEFAULT_BASELINE
+    violations, errors = lint_paths(paths, rules=rules)
+    if fix_baseline:
+        write_baseline(baseline_path, violations)
+        return LintResult(violations, [], [], errors)
+    baseline = load_baseline(baseline_path)
+    fresh, stale = apply_baseline(violations, baseline)
+    return LintResult(violations, fresh, stale, errors)
+
+
+def report(result: LintResult, out=None) -> None:
+    out = out or sys.stdout
+    for v in result.fresh:
+        print(v.format(), file=out)
+    for e in result.errors:
+        print(f"ERROR {e}", file=out)
+    if result.stale_fingerprints:
+        print(
+            f"note: {len(result.stale_fingerprints)} baseline entr"
+            f"{'y is' if len(result.stale_fingerprints) == 1 else 'ies are'}"
+            " stale (violation fixed — run --fix-baseline to shrink the "
+            "baseline)",
+            file=out,
+        )
+    n_base = len(result.violations) - len(result.fresh)
+    print(
+        f"graftlint: {len(result.fresh)} new, {n_base} baselined, "
+        f"{len(result.errors)} errors",
+        file=out,
+    )

@@ -1,0 +1,273 @@
+"""Runtime companion to graftlint: catch silent XLA recompiles.
+
+The one elasticity invariant static analysis cannot see: the PR 2 adam
+bug — XLA returned some optimizer moments re-sharded, so step N+1's
+input signature differed from step N's and ``jax.jit`` silently
+recompiled the full fwd+bwd+adamw program. Nothing crashed; the job
+just burned tens of compile-seconds of chip time, repeatedly, with no
+signal beyond a slow wall clock. The same species: a data pipeline
+whose batch shape drifts (re-tracing every step), an eval fn re-wrapped
+in ``jax.jit`` inside a loop (recompiling an identical program).
+
+:class:`RetraceGuard` listens to ``jax_log_compiles`` — every compile
+logs ``Compiling <fn> with global shapes and types [...]. Argument
+mapping: (...)`` through ``jax._src.interpreters.pxla``, and that
+message IS the (mesh signature, avatar signature) pair: global shapes/
+dtypes plus the per-argument sharding mapping. The guard counts
+compiles per signature and per function name and raises
+:class:`RetraceError`
+
+- when one exact signature compiles more than ``max_recompiles_per_
+  signature`` extra times (an identical program rebuilt — cache-
+  defeating churn), or
+- when one function accumulates more than ``max_signatures_per_fn``
+  distinct signatures (signature drift — the input keeps changing
+  shape/sharding under the same step).
+
+A *warm* remesh (``ElasticTrainer.lower_step`` AOT cache hit) emits no
+compile log at all, so the guard stays silent across it — which is
+exactly the property the warm-compile tests pin down.
+
+Wired into :class:`ElasticTrainer` behind ``DLROVER_TPU_RETRACE_GUARD``
+(see :func:`maybe_install`); usable standalone::
+
+    with RetraceGuard(max_signatures_per_fn=2):
+        step(state, batch)   # raises on the 3rd distinct signature
+
+The raise happens *in place* — inside the jit call that triggered the
+over-budget compile — so the stack trace points at the drifting call
+site, not at some later check. (Python logging propagates exceptions
+raised by a handler's ``emit`` up through the logging call.) Compiles
+from background threads (speculative neighbor compiles) are counted
+but never raise there; they surface at the next ``check()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common import flags
+from dlrover_tpu.common.log import logger
+
+#: jax logs "Compiling <fn> with global shapes and types ..." here
+_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+_COMPILE_PREFIX = "Compiling "
+
+__all__ = ["RetraceError", "RetraceGuard", "maybe_install", "installed"]
+
+
+class RetraceError(RuntimeError):
+    """A jitted function recompiled beyond the guard's budget."""
+
+
+#: jax's EAGER op dispatch jit-compiles tiny per-primitive programs
+#: (convert_element_type, broadcast_in_dim, rng internals, ...) whose
+#: shapes naturally drift during setup — param init alone compiles one
+#: broadcast per distinct param shape. Counting those would false-trip
+#: the drift budget before the first train step, so they are exempt by
+#: default; the step/eval/loss functions the guard exists for are
+#: ordinary user ``def``s and never collide with these names.
+DEFAULT_IGNORE_FNS = frozenset({
+    "convert_element_type", "broadcast_in_dim", "reshape", "transpose",
+    "squeeze", "expand_dims", "concatenate", "iota", "copy", "slice",
+    "add", "subtract", "multiply", "divide", "true_divide", "power",
+    "maximum", "minimum", "_where", "fn", "threefry_2x32",
+    "_threefry_seed", "_threefry_split", "_uniform", "_normal",
+    "_randint", "_gamma", "ones", "zeros", "full",
+})
+
+
+class _CompileLogHandler(logging.Handler):
+    def __init__(self, guard: "RetraceGuard"):
+        super().__init__(level=logging.DEBUG)
+        self._guard = guard
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if msg.startswith(_COMPILE_PREFIX):
+            self._guard._on_compile(msg)
+
+
+class RetraceGuard:
+    """Counts XLA compiles per (function, signature); raises on churn.
+
+    ``max_recompiles_per_signature``: how many *repeat* compiles of one
+    exact signature are tolerated (default 2 — a remesh away and back
+    legitimately rebuilds the eval fn; a third identical compile is
+    churn). ``max_signatures_per_fn``: distinct signatures one function
+    may compile (default 8 — a live world plus a handful of speculated
+    neighbors; shape-drifting inputs blow past it immediately).
+    """
+
+    def __init__(
+        self,
+        max_recompiles_per_signature: int = 2,
+        max_signatures_per_fn: int = 8,
+        raise_in_place: bool = True,
+        ignore_fns: frozenset = DEFAULT_IGNORE_FNS,
+    ):
+        self.max_recompiles_per_signature = max_recompiles_per_signature
+        self.max_signatures_per_fn = max_signatures_per_fn
+        self.raise_in_place = raise_in_place
+        self.ignore_fns = ignore_fns
+        self._lock = threading.Lock()
+        self._sig_counts: Dict[str, int] = {}
+        self._fn_sigs: Dict[str, set] = {}
+        self._pending: List[str] = []
+        self._handler: Optional[_CompileLogHandler] = None
+        self._prev_log_compiles: Optional[bool] = None
+        self._active = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "RetraceGuard":
+        if self._active:
+            return self
+        import jax
+
+        self._prev_log_compiles = bool(
+            getattr(jax.config, "jax_log_compiles", False)
+        )
+        jax.config.update("jax_log_compiles", True)
+        self._handler = _CompileLogHandler(self)
+        logging.getLogger(_COMPILE_LOGGER).addHandler(self._handler)
+        self._active = True
+        return self
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        self._active = False
+        if self._handler is not None:
+            logging.getLogger(_COMPILE_LOGGER).removeHandler(self._handler)
+            self._handler = None
+        try:
+            import jax
+
+            jax.config.update(
+                "jax_log_compiles", bool(self._prev_log_compiles)
+            )
+        except Exception:
+            pass
+
+    def __enter__(self) -> "RetraceGuard":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+        if exc_type is None:
+            self.check()
+
+    # -- accounting --------------------------------------------------------
+
+    @staticmethod
+    def _fn_of(sig: str) -> str:
+        rest = sig[len(_COMPILE_PREFIX):]
+        return rest.split(" ", 1)[0] or "<unknown>"
+
+    def _on_compile(self, sig: str) -> None:
+        fn = self._fn_of(sig)
+        if fn in self.ignore_fns:
+            return
+        with self._lock:
+            n = self._sig_counts.get(sig, 0) + 1
+            self._sig_counts[sig] = n
+            sigs = self._fn_sigs.setdefault(fn, set())
+            sigs.add(sig)
+            problem = None
+            if n > 1 + self.max_recompiles_per_signature:
+                problem = (
+                    f"jitted '{fn}' recompiled an ALREADY-SEEN signature "
+                    f"(compile #{n} of the same program): cache-defeating "
+                    "churn — look for jit re-wrapping in a loop, or "
+                    "outputs resharded relative to inputs (pin "
+                    "out_shardings). Signature: " + sig[:400]
+                )
+            elif len(sigs) > self.max_signatures_per_fn:
+                problem = (
+                    f"jitted '{fn}' compiled {len(sigs)} distinct "
+                    f"signatures (> {self.max_signatures_per_fn}): input "
+                    "shape/sharding is drifting call-to-call — every "
+                    "step pays a full XLA compile. Latest signature: "
+                    + sig[:400]
+                )
+            raising = (
+                problem is not None
+                and self.raise_in_place
+                and threading.current_thread() is threading.main_thread()
+            )
+            if problem and not raising:
+                # background (speculative-compile) threads swallow
+                # exceptions by design, and raise_in_place=False defers
+                # by contract: queue for the next check(). A violation
+                # raised in place is NOT also queued — the caller saw
+                # it; a later clean check() must not re-raise it.
+                self._pending.append(problem)
+        if problem:
+            logger.error("retrace guard: %s", problem)
+            if raising:
+                raise RetraceError(problem)
+
+    # -- inspection --------------------------------------------------------
+
+    def check(self) -> None:
+        """Raise any violation recorded since the last check (covers
+        ``raise_in_place=False`` and background-thread compiles)."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if pending:
+            raise RetraceError("; ".join(pending))
+
+    @property
+    def compile_count(self) -> int:
+        with self._lock:
+            return sum(self._sig_counts.values())
+
+    def signatures_of(self, fn: str) -> int:
+        with self._lock:
+            return len(self._fn_sigs.get(fn, ()))
+
+
+# ---------------------------------------------------------------------------
+# trainer wiring (DLROVER_TPU_RETRACE_GUARD)
+# ---------------------------------------------------------------------------
+
+_installed: Optional[RetraceGuard] = None
+_install_lock = threading.Lock()
+
+
+def maybe_install() -> Optional[RetraceGuard]:
+    """Process-wide singleton guard when ``DLROVER_TPU_RETRACE_GUARD``
+    is on: 1 = defaults, N>=2 = at most N distinct signatures per
+    function. Idempotent — every ElasticTrainer calls this; the first
+    one wins. Returns the active guard or None when disabled."""
+    n = int(flags.RETRACE_GUARD.get() or 0)
+    if n <= 0:
+        return None
+    global _installed
+    with _install_lock:
+        if _installed is None:
+            kwargs = {} if n <= 1 else {"max_signatures_per_fn": n}
+            _installed = RetraceGuard(**kwargs).start()
+            logger.info(
+                "retrace guard active (max %d signatures/fn, %d repeat "
+                "compiles/signature)",
+                _installed.max_signatures_per_fn,
+                _installed.max_recompiles_per_signature,
+            )
+        return _installed
+
+
+def installed() -> Optional[RetraceGuard]:
+    return _installed
+
+
+def uninstall() -> None:
+    """Tear down the singleton (tests)."""
+    global _installed
+    with _install_lock:
+        if _installed is not None:
+            _installed.stop()
+            _installed = None

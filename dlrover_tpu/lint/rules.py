@@ -1,0 +1,718 @@
+"""The graftlint rule catalog: JG001–JG006.
+
+Every rule encodes a bug this repo actually shipped (PR number in each
+docstring). Rules are heuristic by design — they trade exhaustiveness
+for zero dependencies and zero false-positive *classes*; individual
+false positives are handled by the suppression comment, which doubles
+as in-place documentation of why the flagged pattern is safe there.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import symtable
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dlrover_tpu.lint.engine import SourceFile, Violation
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.jit' for Attribute chains, 'jit' for Names, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_graftlint_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    p = parent(node)
+    while p is not None:
+        yield p
+        p = parent(p)
+
+
+def enclosing_function(node: ast.AST):
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return a
+    return None
+
+
+def module_functions(src: SourceFile) -> Dict[str, ast.FunctionDef]:
+    """Every def in the file by name — same-module call resolution;
+    methods and nested defs included, keyed bare. For duplicate names a
+    top-level def or method shadows a def nested inside a function (the
+    nested one is usually a traced/jitted inner body, not a call
+    target — e.g. the trainer's inner ``step`` inside ``_build_step``)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            prev = out.get(node.name)
+            nested = enclosing_function(node) is not None
+            if prev is None or (
+                enclosing_function(prev) is not None and not nested
+            ):
+                out[node.name] = node
+    return out
+
+
+class _FreeVars:
+    """Free variables per (scope name, lineno), from stdlib symtable —
+    the interpreter's own closure analysis, so `nonlocal`, comprehension
+    scopes and default-arg subtleties are all handled for free."""
+
+    def __init__(self, src: SourceFile):
+        self._by_pos: Dict[Tuple[str, int], Set[str]] = {}
+        try:
+            top = symtable.symtable(src.text, src.path, "exec")
+        except (SyntaxError, ValueError):
+            return
+        stack = [top]
+        while stack:
+            st = stack.pop()
+            if st.get_type() == "function":
+                frees = set(st.get_frees())
+                key = (st.get_name(), st.get_lineno())
+                self._by_pos[key] = self._by_pos.get(key, set()) | frees
+            stack.extend(st.get_children())
+
+    def frees_of(self, node: ast.AST) -> Set[str]:
+        if isinstance(node, ast.Lambda):
+            return self._by_pos.get(("lambda", node.lineno), set())
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return self._by_pos.get((node.name, node.lineno), set())
+        return set()
+
+
+# ---------------------------------------------------------------------------
+# JG001 — mesh capture in jit-compiled closures
+# ---------------------------------------------------------------------------
+
+
+class MeshCaptureRule:
+    """JG001: a function handed to ``jax.jit`` closes over a
+    ``Mesh``/``NamedSharding`` free variable.
+
+    The PR 2 ``loss_factory`` bug: a ``loss_fn`` closing over the live
+    mesh bakes that mesh's sharding constraints into every program built
+    from it — the trainer can never retarget the step to a resized
+    world, so in-process remesh and cross-world AOT compilation are
+    silently impossible. The fix shape is a factory (``mesh -> loss``)
+    or an explicit parameter; the rule exists so the next loss/step
+    helper doesn't regress to the closure form.
+
+    Detection: closure free-variable analysis (stdlib ``symtable``)
+    against mesh-typed names — names assigned from ``Mesh(...)`` /
+    ``build_mesh(...)`` / ``NamedSharding(...)`` / ``named_shardings``,
+    annotated ``: Mesh``, or matching ``mesh``-ish naming. Heuristic:
+    a mesh smuggled through an innocently-named variable escapes it
+    (code review's job), but every shipped instance of this bug used
+    the obvious name.
+    """
+
+    id = "JG001"
+    name = "mesh-capture"
+
+    JIT_CALLEES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+    MESH_MAKERS = (
+        "Mesh",
+        "build_mesh",
+        "make_mesh",
+        "create_device_mesh",
+        "NamedSharding",
+        "named_shardings",
+    )
+    MESH_NAME_RE = re.compile(
+        r"(^|_)(mesh(es)?|named_sharding[s]?|sharding[s]?)($|_)"
+    )
+
+    def _mesh_typed_names(self, src: SourceFile) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                callee = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                if callee in self.MESH_MAKERS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                ann = dotted_name(node.annotation).rsplit(".", 1)[-1]
+                if ann in ("Mesh", "NamedSharding"):
+                    names.add(node.target.id)
+            elif isinstance(node, ast.arg):
+                ann = (
+                    dotted_name(node.annotation).rsplit(".", 1)[-1]
+                    if node.annotation is not None
+                    else ""
+                )
+                if ann in ("Mesh", "NamedSharding") or self.MESH_NAME_RE.search(
+                    node.arg
+                ):
+                    names.add(node.arg)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                if self.MESH_NAME_RE.search(node.id):
+                    names.add(node.id)
+        return names
+
+    def check(self, src: SourceFile) -> Iterable[Violation]:
+        mesh_names = self._mesh_typed_names(src)
+        if not mesh_names:
+            return
+        frees = _FreeVars(src)
+        defs = module_functions(src)
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if dotted_name(node.func) not in self.JIT_CALLEES:
+                continue
+            fn = node.args[0]
+            if isinstance(fn, ast.Name):
+                fn = defs.get(fn.id, fn)
+            if not isinstance(
+                fn, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            captured = sorted(frees.frees_of(fn) & mesh_names)
+            if captured:
+                yield src.violation(
+                    self.id,
+                    node,
+                    f"function passed to {dotted_name(node.func)} closes "
+                    f"over mesh-typed name(s) {captured}: the compiled "
+                    "program is pinned to that mesh forever and can never "
+                    "be retargeted by remesh/lower_step. Pass the mesh as "
+                    "an argument or use a factory (mesh -> fn).",
+                )
+
+
+# ---------------------------------------------------------------------------
+# JG002 — host sync in the hot path
+# ---------------------------------------------------------------------------
+
+
+class HostSyncRule:
+    """JG002: a host-device synchronization inside the training hot path.
+
+    The PR 2 ``evaluate()`` bug: a per-batch ``float(loss)`` blocked on
+    every just-dispatched forward, serializing host and device — the
+    whole point of jitted dispatch is that the host runs ahead. Same
+    species: ``.item()``, ``np.asarray`` on device arrays,
+    ``jax.device_get``, ``block_until_ready`` between steps.
+
+    Detection: hot roots are functions named ``step`` / ``train_step``
+    / ``eval_step`` (flagged anywhere in the body — they run once per
+    optimizer step) and ``evaluate`` (flagged only inside its loops —
+    the accumulate-on-device-then-sync-ONCE ending is the blessed
+    pattern). Functions they call (same module, two call-graph hops)
+    are hot by contagion and flagged anywhere. An intentional throttled
+    sync takes a ``# graftlint: disable=JG002`` with its justification.
+    """
+
+    id = "JG002"
+    name = "host-sync-in-hot-path"
+
+    ROOT_ANYWHERE = {"step", "train_step", "eval_step"}
+    ROOT_LOOP_ONLY = {"evaluate"}
+    SYNC_CALLEES = {
+        "jax.device_get",
+        "device_get",
+        "jax.block_until_ready",
+        "np.asarray",
+        "np.array",
+        "numpy.asarray",
+        "numpy.array",
+        "onp.asarray",
+        "float",
+    }
+    SYNC_METHODS = {"item", "block_until_ready"}
+
+    def _called_names(self, fn: ast.FunctionDef) -> Set[str]:
+        """Bare names this function calls: ``f(...)`` and ``self.f(...)``
+        — the same-module resolution set."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d and "." not in d:
+                    out.add(d)
+                elif d.startswith("self."):
+                    out.add(d.split(".", 1)[1])
+        return out
+
+    def _sync_calls(self, fn: ast.FunctionDef, loops_only: bool):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            hit = None
+            if d in self.SYNC_CALLEES:
+                hit = d
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.SYNC_METHODS
+                and not node.args
+            ):
+                hit = f".{node.func.attr}()"
+            if hit is None:
+                continue
+            if loops_only and not any(
+                isinstance(a, (ast.For, ast.While))
+                for a in ancestors(node)
+                if enclosing_function(a) is fn or a is fn
+            ):
+                continue
+            yield node, hit
+
+    def check(self, src: SourceFile) -> Iterable[Violation]:
+        defs = module_functions(src)
+        hot: Dict[str, Tuple[ast.FunctionDef, bool, str]] = {}
+        for name, fn in defs.items():
+            if name in self.ROOT_ANYWHERE:
+                hot[name] = (fn, False, name)
+            elif name in self.ROOT_LOOP_ONLY:
+                hot[name] = (fn, True, name)
+        # two hops of same-module contagion from the roots
+        for _ in range(2):
+            for name, (fn, _loops, root) in list(hot.items()):
+                for callee in self._called_names(fn):
+                    if callee in defs and callee not in hot:
+                        hot[callee] = (defs[callee], False, root)
+        for name, (fn, loops_only, root) in sorted(hot.items()):
+            for node, what in self._sync_calls(fn, loops_only):
+                where = (
+                    f"in hot function {name}()"
+                    if name == root
+                    else f"in {name}(), reachable from {root}()"
+                )
+                yield src.violation(
+                    self.id,
+                    node,
+                    f"host sync {what} {where}: blocks the host on the "
+                    "just-dispatched device computation and kills async "
+                    "dispatch. Accumulate on device and sync once, or "
+                    "suppress with the justification if the sync is "
+                    "intentional and throttled.",
+                )
+
+
+# ---------------------------------------------------------------------------
+# JG003 — raw environment reads
+# ---------------------------------------------------------------------------
+
+
+class RawEnvRule:
+    """JG003: ``os.environ`` / ``os.getenv`` outside the blessed modules.
+
+    The repo grew ~50 scattered env call sites; each invents its own
+    default and parse-failure behavior, none are discoverable, and a
+    typo'd flag name fails silent. All ``DLROVER_TPU_*`` knobs go
+    through the typed registry (``common/flags.py``); platform wiring
+    stays in ``common/constants.py`` (NodeEnv), ``agent/config.py``
+    and ``train/bootstrap.py``, which translate the process environment
+    into typed objects exactly once.
+    """
+
+    id = "JG003"
+    name = "raw-env-read"
+
+    ALLOWED_SUFFIXES = (
+        "common/constants.py",
+        "common/flags.py",
+        "agent/config.py",
+        "train/bootstrap.py",
+    )
+
+    def check(self, src: SourceFile) -> Iterable[Violation]:
+        if src.rel_path.endswith(self.ALLOWED_SUFFIXES):
+            return
+        env_aliases: Set[str] = set()  # `from os import environ [as e]`
+        getenv_aliases: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "os":
+                for a in node.names:
+                    if a.name == "environ":
+                        env_aliases.add(a.asname or a.name)
+                    if a.name == "getenv":
+                        getenv_aliases.add(a.asname or a.name)
+        for node in ast.walk(src.tree):
+            hit = None
+            if isinstance(node, ast.Attribute):
+                d = dotted_name(node)
+                if d == "os.environ":
+                    hit = "os.environ"
+                elif d == "os.getenv":
+                    hit = "os.getenv"
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ):
+                if node.id in getenv_aliases and isinstance(
+                    parent(node), ast.Call
+                ):
+                    hit = node.id
+                elif node.id in env_aliases:
+                    # `from os import environ`: flag any read use —
+                    # environ.get(...), environ[...], `x in environ` —
+                    # at the bare Name (the Attribute arm above only
+                    # sees chains rooted at the `os` module)
+                    hit = node.id
+            if hit is None:
+                continue
+            # os.environ.get / os.environ[...]: report the outermost
+            # expression once, at the attribute node (one per read)
+            p = parent(node)
+            if isinstance(p, ast.Attribute) and dotted_name(p) in (
+                "os.environ",
+                "os.getenv",
+            ):
+                continue  # inner `os` Name of the chain
+            yield src.violation(
+                self.id,
+                node,
+                f"raw {hit} access: DLROVER_TPU_* flags go through the "
+                "typed registry (dlrover_tpu.common.flags); other env "
+                "translation belongs in constants/config/bootstrap.",
+            )
+
+
+# ---------------------------------------------------------------------------
+# JG004 — unhashable elements in sets / dict keys
+# ---------------------------------------------------------------------------
+
+
+class UnhashableInSetRule:
+    """JG004: a slice / list / dict / set placed into a ``set()`` or
+    used as a dict key.
+
+    The PR 1 ``covers_target`` crash: a ``set()`` of ``slice`` objects
+    worked on the py3.12 dev box (slices became hashable in 3.12) and
+    crashed the shm restore path with ``TypeError: unhashable type``
+    on the py3.10 fleet. The rule flags the statically-visible cases:
+    unhashable literals (and ``slice(...)`` calls) in set displays,
+    dict-literal keys, ``set([...])`` constructor args, ``.add(...)``
+    arguments, and ``set``/dict comprehension keys.
+    """
+
+    id = "JG004"
+    name = "unhashable-in-set"
+
+    def _unhashable(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.List):
+            return "list"
+        if isinstance(node, ast.Dict):
+            return "dict"
+        if isinstance(node, ast.Set):
+            return "set"
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp)):
+            return "comprehension result"
+        if isinstance(node, ast.Call) and dotted_name(node.func) in (
+            "slice",
+            "list",
+            "dict",
+            "set",
+            "bytearray",
+        ):
+            return dotted_name(node.func)
+        if isinstance(node, ast.Tuple):
+            for elt in node.elts:
+                inner = self._unhashable(elt)
+                if inner:
+                    return f"tuple containing {inner}"
+        return None
+
+    def check(self, src: SourceFile) -> Iterable[Violation]:
+        for node in ast.walk(src.tree):
+            spots: List[Tuple[ast.AST, str]] = []
+            if isinstance(node, ast.Set):
+                spots = [(e, "set display element") for e in node.elts]
+            elif isinstance(node, ast.Dict):
+                spots = [
+                    (k, "dict key") for k in node.keys if k is not None
+                ]
+            elif isinstance(node, ast.DictComp):
+                spots = [(node.key, "dict comprehension key")]
+            elif isinstance(node, ast.SetComp):
+                spots = [(node.elt, "set comprehension element")]
+            elif isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d in ("set", "frozenset") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, (ast.List, ast.Tuple, ast.Set)):
+                        spots = [(e, f"{d}() element") for e in arg.elts]
+                    elif isinstance(
+                        arg, (ast.ListComp, ast.GeneratorExp)
+                    ):
+                        spots = [(arg.elt, f"{d}() comprehension element")]
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add"
+                    and len(node.args) == 1
+                ):
+                    spots = [(node.args[0], ".add() argument")]
+            for expr, where in spots:
+                kind = self._unhashable(expr)
+                if kind:
+                    yield src.violation(
+                        self.id,
+                        expr,
+                        f"unhashable {kind} as {where}: TypeError at "
+                        "runtime (slice objects: only hashable on "
+                        "py>=3.12 — the covers_target shm-restore crash). "
+                        "Convert to a tuple of hashables first.",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# JG005 — unsafe work inside signal handlers
+# ---------------------------------------------------------------------------
+
+
+class UnsafeSignalHandlerRule:
+    """JG005: blocking I/O, locks, or logging inside a ``signal.signal``
+    handler.
+
+    Python signal handlers run between bytecodes of the MAIN thread: if
+    the signal lands while that thread holds the logging module's (or
+    any other) lock, a handler that logs/acquires deadlocks the
+    process — during SIGTERM drain, inside the preemption grace window,
+    which is the worst possible moment (PR 1's SIG_IGN re-arm bug was
+    adjacent: handler correctness under signals is never 'obvious').
+    Handlers that intentionally do blocking save-on-signal work (the
+    flash-checkpoint drain) own that risk explicitly via suppression.
+    """
+
+    id = "JG005"
+    name = "unsafe-signal-handler"
+
+    BLOCKING_CALLEES = {
+        "print",
+        "open",
+        "input",
+        "time.sleep",
+        "os.system",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "subprocess.Popen",
+    }
+    BLOCKING_PREFIXES = ("logging.", "logger.", "log.")
+    BLOCKING_METHODS = {"acquire", "join", "wait", "flush", "write"}
+
+    def _handlers(self, src: SourceFile):
+        defs = module_functions(src)
+        seen = set()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "signal.signal":
+                continue
+            if len(node.args) < 2:
+                continue
+            h = node.args[1]
+            if isinstance(h, ast.Name):
+                h = defs.get(h.id)
+            if (
+                isinstance(
+                    h, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                )
+                and id(h) not in seen
+            ):
+                seen.add(id(h))
+                yield h
+
+    def check(self, src: SourceFile) -> Iterable[Violation]:
+        for handler in self._handlers(src):
+            body = handler.body
+            nodes = (
+                ast.walk(handler)
+                if isinstance(handler, ast.Lambda)
+                else (n for stmt in body for n in ast.walk(stmt))
+            )
+            for node in nodes:
+                hit = None
+                if isinstance(node, ast.Call):
+                    d = dotted_name(node.func)
+                    if d in self.BLOCKING_CALLEES:
+                        hit = d
+                    elif d.startswith(self.BLOCKING_PREFIXES):
+                        hit = d
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self.BLOCKING_METHODS
+                    ):
+                        hit = f".{node.func.attr}()"
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        d = dotted_name(item.context_expr)
+                        if "lock" in d.lower():
+                            hit = f"with {d}"
+                if hit:
+                    name = getattr(handler, "name", "<lambda>")
+                    yield src.violation(
+                        self.id,
+                        node,
+                        f"{hit} inside signal handler {name}(): handlers "
+                        "run between main-thread bytecodes — if the "
+                        "signal lands while that thread holds the "
+                        "logging/lock being acquired, the process "
+                        "deadlocks. Set a flag/Event and do the work "
+                        "outside, or suppress with the justification "
+                        "for an intentional save-on-signal path.",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# JG006 — unguarded shared mutation from thread targets
+# ---------------------------------------------------------------------------
+
+
+class UnguardedSharedMutationRule:
+    """JG006: ``self.attr`` / module-global written from a
+    ``threading.Thread`` target (or timer callback) outside a
+    ``with ...lock:`` block.
+
+    40+ modules in this repo run background threads (rendezvous
+    managers, checkpoint staging, warm-compile speculation, monitors).
+    The lock discipline that keeps them correct is pure convention —
+    exactly what regressed twice during PR 2's speculative-compile
+    thread work. Heuristic lock-discipline check: inside a function
+    that is some ``Thread(target=...)`` / ``threading.Timer`` callback
+    (or a ``run`` method of a Thread subclass), attribute writes on
+    ``self``/objects and global writes must have a ``with <...lock...>``
+    ancestor. Names that only the thread itself reads (thread-local by
+    convention: leading ``_local``) and ``threading.Event`` flags
+    (written via ``.set()``, a method call, not an assignment) don't
+    trip it.
+    """
+
+    id = "JG006"
+    name = "unguarded-shared-mutation"
+
+    def _thread_targets(self, src: SourceFile):
+        defs = module_functions(src)
+        # methods by class, for resolving self._run style targets
+        seen: Set[int] = set()
+        for node in ast.walk(src.tree):
+            fn = None
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d.rsplit(".", 1)[-1] in ("Thread", "Timer"):
+                    cand = None
+                    for kw in node.keywords:
+                        if kw.arg in ("target", "function"):
+                            cand = kw.value
+                    if (
+                        cand is None
+                        and d.rsplit(".", 1)[-1] == "Timer"
+                        and len(node.args) >= 2
+                    ):
+                        cand = node.args[1]
+                    if isinstance(cand, ast.Name):
+                        fn = defs.get(cand.id)
+                    elif isinstance(cand, ast.Attribute) and isinstance(
+                        cand.value, ast.Name
+                    ) and cand.value.id == "self":
+                        fn = defs.get(cand.attr)
+                    elif isinstance(cand, (ast.Lambda,)):
+                        fn = cand
+            elif isinstance(node, ast.ClassDef):
+                bases = {dotted_name(b).rsplit(".", 1)[-1] for b in node.bases}
+                if "Thread" in bases:
+                    for item in node.body:
+                        if (
+                            isinstance(item, ast.FunctionDef)
+                            and item.name == "run"
+                        ):
+                            fn = item
+            if fn is not None and id(fn) not in seen:
+                seen.add(id(fn))
+                yield fn
+
+    def _lock_guarded(self, node: ast.AST, fn: ast.AST) -> bool:
+        for a in ancestors(node):
+            if a is fn:
+                return False
+            if isinstance(a, ast.With):
+                for item in a.items:
+                    if "lock" in dotted_name(item.context_expr).lower():
+                        return True
+        return False
+
+    def check(self, src: SourceFile) -> Iterable[Violation]:
+        for fn in self._thread_targets(src):
+            declared_global: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    declared_global.update(node.names)
+            fn_name = getattr(fn, "name", "<lambda>")
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        what = None
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            what = f"self.{t.attr}"
+                        elif (
+                            isinstance(t, ast.Name)
+                            and t.id in declared_global
+                        ):
+                            # without a `global` declaration a bare Name
+                            # store is a new local, not a shared write
+                            what = f"global {t.id}"
+                        if what and not self._lock_guarded(node, fn):
+                            yield src.violation(
+                                self.id,
+                                node,
+                                f"{what} written in thread target "
+                                f"{fn_name}() without a `with ...lock:` "
+                                "guard: racing the main thread. Guard "
+                                "the write, use a threading.Event, or "
+                                "suppress with why the race is benign.",
+                            )
+
+
+ALL_RULES = [
+    MeshCaptureRule(),
+    HostSyncRule(),
+    RawEnvRule(),
+    UnhashableInSetRule(),
+    UnsafeSignalHandlerRule(),
+    UnguardedSharedMutationRule(),
+]
+
+
+def rule_catalog() -> List[Tuple[str, str, str]]:
+    """(id, name, first docstring line) for --list-rules and the docs."""
+    out = []
+    for r in ALL_RULES:
+        doc = (r.__class__.__doc__ or "").strip().splitlines()[0]
+        out.append((r.id, r.name, doc))
+    return out

@@ -488,9 +488,22 @@ def _ce_sums_shifted(logits: jnp.ndarray, targets: jnp.ndarray):
 
 
 def _shift_targets(tokens: jnp.ndarray) -> jnp.ndarray:
-    """targets[i] = tokens[i+1], last position padded invalid (-1)."""
-    pad = jnp.full(tokens.shape[:-1] + (1,), -1, tokens.dtype)
-    return jnp.concatenate([tokens[..., 1:], pad], axis=-1)
+    """targets[i] = tokens[i+1], last position padded invalid (-1).
+
+    Implemented as slice + ``lax.pad`` — NOT ``jnp.concatenate`` — on
+    purpose: when this runs inside jit on a mesh with BOTH a data axis
+    and sp > 1, this jaxlib's (0.4.36) GSPMD partitioner miscompiles a
+    concatenate along the sp-sharded axis into an unreduced replica
+    sum, returning every target id multiplied by the data-axis size
+    (123 -> 246, the pad -1 -> -2). Wrong gold columns made the ring
+    configs of test_sharded_loss read ~0.25% off — not a tolerance
+    problem, a wrong-targets problem. ``lax.pad`` partitions cleanly.
+    """
+    return lax.pad(
+        tokens[..., 1:],
+        jnp.asarray(-1, tokens.dtype),
+        [(0, 0, 0)] * (tokens.ndim - 1) + [(0, 1, 0)],
+    )
 
 
 def _record_sp_comm(cfg: LlamaConfig, mesh: Mesh, batch: int, seq: int,

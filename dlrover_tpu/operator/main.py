@@ -39,6 +39,9 @@ def main(argv=None) -> int:
     stop = threading.Event()
 
     def _sig(_signum, _frame):
+        # intentional: the operator's main thread only sleeps on
+        # stop.wait(), so it cannot hold the logging lock when the
+        # signal lands  # graftlint: disable=JG005
         logger.info("operator stopping")
         controller.stop()
         stop.set()

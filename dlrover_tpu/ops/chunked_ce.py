@@ -32,13 +32,14 @@ and the op composes inside shard_map manual regions (the pp head path).
 from __future__ import annotations
 
 import functools
-import os
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from dlrover_tpu.common import flags
 
 #: Default vocab-chunk width: 16 MXU lanes of 128 — wide enough that the
 #: per-chunk [tokens, chunk] matmul stays MXU-bound, narrow enough that
@@ -52,7 +53,7 @@ def chunked_ce_enabled() -> bool:
     through this op. Read at trace time — set it before the first loss
     call / trainer step of the process (the jitted step caches the trace).
     """
-    return os.environ.get("DLROVER_TPU_CHUNKED_CE", "1") != "0"
+    return flags.CHUNKED_CE.get()
 
 
 def chunked_cross_entropy(

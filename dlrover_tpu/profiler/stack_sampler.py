@@ -87,7 +87,9 @@ class StackSampler:
                 if not self._include_idle and is_idle_stack(frames):
                     continue
                 self.trie.insert(frames)
-            self.samples += 1
+            # single-writer counter (this thread only); readers tolerate
+            # a stale value — telemetry, not control flow
+            self.samples += 1  # graftlint: disable=JG006
 
     # -- results ---------------------------------------------------------
     def render(self, min_share: float = 0.02) -> str:

@@ -31,7 +31,9 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.lint import retrace_guard
 from dlrover_tpu.parallel.mesh import MeshConfig
 from dlrover_tpu.parallel.sharding import batch_spec
 from dlrover_tpu.train import warm_compile
@@ -136,25 +138,20 @@ class ElasticTrainer:
         self._state_avatar: Optional[PyTree] = None
         self._batch_avatar: Optional[PyTree] = None
         self._params_avatar: Optional[PyTree] = None
+        # silent-recompile guard (lint/retrace_guard.py), opt-in via
+        # DLROVER_TPU_RETRACE_GUARD: raises in place when the step (or
+        # any jitted fn) recompiles an already-seen signature or drifts
+        # through too many distinct ones
+        self._retrace_guard = retrace_guard.maybe_install()
         self._maybe_serve_comm_metrics()
 
     def _maybe_serve_comm_metrics(self):
         """Worker-side /metrics for the per-collective ledger
         (profiler/comm.py), opted in with
         ``DLROVER_TPU_COMM_METRICS_PORT`` (0 = ephemeral port)."""
-        import os
-
-        port = os.getenv("DLROVER_TPU_COMM_METRICS_PORT")
-        if port is None:
-            return
-        try:
-            port_num = int(port)
-        except ValueError:
-            logger.warning(
-                "DLROVER_TPU_COMM_METRICS_PORT=%r is not a port; comm "
-                "metrics disabled", port,
-            )
-            return
+        port_num = flags.COMM_METRICS_PORT.get()
+        if port_num is None:
+            return  # unset (or non-numeric: flags warned) = disabled
         from dlrover_tpu.profiler.comm import start_metrics_server
 
         try:
@@ -578,9 +575,12 @@ class ElasticTrainer:
         ``optimizer_learning_rate`` becomes an update multiplier relative
         to the configured base lr (the schedule shape is preserved). The
         dataloader fields are consumed by ``ElasticDataLoader``."""
+        # host dict read, not a device sync  # graftlint: disable=JG002
         new_lr = float(config.get("optimizer_learning_rate", 0.0) or 0.0)
         if new_lr > 0 and self.tc.learning_rate > 0 and "lr_scale" in state:
             scale = new_lr / self.tc.learning_rate
+            # intentional sync: throttled to every poll interval (~100
+            # steps) by poll_runtime_config  # graftlint: disable=JG002
             if abs(scale - float(state["lr_scale"])) > 1e-9:
                 state = {
                     **state,
@@ -699,6 +699,10 @@ class ElasticTrainer:
         self._host_step += 1
         if self.worker_ctx is not None:
             self.worker_ctx.report_step(self._host_step)
+        if self._retrace_guard is not None:
+            # violations from background (speculative-compile) threads
+            # can't raise in place; surface them at the step boundary
+            self._retrace_guard.check()
         return new_state, loss
 
     def sync_host_step(self, state: dict):

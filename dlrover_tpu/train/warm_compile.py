@@ -51,14 +51,17 @@ import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
 
 PyTree = Any
 
-ENV_KILL_SWITCH = "DLROVER_TPU_WARM_COMPILE"
-ENV_CACHE_DIR = "DLROVER_TPU_COMPILE_CACHE_DIR"
-ENV_MIN_COMPILE_S = "DLROVER_TPU_COMPILE_CACHE_MIN_S"
-ENV_MAX_TARGETS = "DLROVER_TPU_WARM_COMPILE_MAX_TARGETS"
+# flag names kept importable for tests/docs; reads go through the
+# typed registry (common/flags.py, graftlint JG003)
+ENV_KILL_SWITCH = flags.WARM_COMPILE.name
+ENV_CACHE_DIR = flags.COMPILE_CACHE_DIR.name
+ENV_MIN_COMPILE_S = flags.COMPILE_CACHE_MIN_S.name
+ENV_MAX_TARGETS = flags.WARM_COMPILE_MAX_TARGETS.name
 
 LEDGER_FILENAME = "compile_ledger.json"
 
@@ -77,7 +80,7 @@ __all__ = [
 
 def warm_compile_enabled() -> bool:
     """Kill-switch, read at call time so tests/benches can flip it."""
-    return os.environ.get(ENV_KILL_SWITCH, "1") != "0"
+    return flags.WARM_COMPILE.get()
 
 
 _enable_lock = threading.Lock()
@@ -113,7 +116,7 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
         existing = configured_cache_dir()
         if existing:
             return existing
-        path = path or os.environ.get(ENV_CACHE_DIR, "")
+        path = path or flags.COMPILE_CACHE_DIR.get()
         if not path:
             return None
         try:
@@ -121,19 +124,16 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
             import jax
 
             jax.config.update("jax_compilation_cache_dir", path)
-            try:
-                min_s = float(os.environ.get(ENV_MIN_COMPILE_S, "1.0") or 1.0)
-            except ValueError:
-                min_s = 1.0
             jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", min_s
+                "jax_persistent_cache_min_compile_time_secs",
+                float(flags.COMPILE_CACHE_MIN_S.get()),
             )
         except Exception as e:
             logger.warning("persistent compile cache unavailable: %s", e)
             return None
         # children (speculative compile helpers, interposed probes,
         # restarted workers forked from this env) inherit the same dir
-        os.environ[ENV_CACHE_DIR] = path
+        flags.COMPILE_CACHE_DIR.propagate(path)
         _enabled_dir = path
         logger.info("persistent compile cache at %s", path)
         return path
@@ -147,7 +147,7 @@ def default_cache_under(base_dir: str) -> Optional[str]:
     do. An explicit ``DLROVER_TPU_COMPILE_CACHE_DIR`` wins."""
     if not warm_compile_enabled():
         return None
-    if os.environ.get(ENV_CACHE_DIR, ""):
+    if flags.COMPILE_CACHE_DIR.present():
         return enable_persistent_cache()
     if not base_dir:
         return None
@@ -350,10 +350,7 @@ def neighbor_worlds(
     from dlrover_tpu.parallel.mesh import remesh as remesh_config
 
     if max_targets is None:
-        try:
-            max_targets = int(os.environ.get(ENV_MAX_TARGETS, "2") or 2)
-        except ValueError:
-            max_targets = 2
+        max_targets = int(flags.WARM_COMPILE_MAX_TARGETS.get())
     node = max(1, devices_per_node)
     raw = [world - node, world // 2, world + node]
     out: List[int] = []
@@ -508,13 +505,7 @@ def _shutdown_speculation():
     # outlive the pod's termination grace (SIGKILL mid-teardown); past
     # the bound we accept the daemon-thread teardown risk instead. The
     # stop flag bounds the common case to "finish the current target".
-    try:
-        timeout = float(
-            os.environ.get("DLROVER_TPU_WARM_COMPILE_EXIT_JOIN_S", "60")
-            or 60
-        )
-    except ValueError:
-        timeout = 60.0
+    timeout = float(flags.WARM_COMPILE_EXIT_JOIN_S.get())
     for wcm in list(_live_compilers):
         wcm._stop.set()
     deadline = time.monotonic() + timeout

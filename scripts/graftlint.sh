@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# graftlint, from anywhere in the repo: lint the package against the
+# checked-in baseline (dlrover_tpu/lint/baseline.json). Exit 1 on any
+# non-baselined violation — same gate as tier-1 and CI.
+#
+#   scripts/graftlint.sh                 # check
+#   scripts/graftlint.sh --fix-baseline  # deliberate grandfathering only
+set -euo pipefail
+cd "$(dirname "$0")/.."   # fingerprints embed repo-relative paths
+exec python -m dlrover_tpu.lint "$@" dlrover_tpu/

@@ -1,0 +1,542 @@
+"""graftlint (dlrover_tpu/lint/): every rule fires on its minimal bad
+snippet and stays quiet on the corresponding good one; suppressions and
+the baseline round-trip work; and — the tier-1 gate — the repo itself
+lints clean against the checked-in baseline."""
+
+import os
+import textwrap
+
+import pytest
+
+from dlrover_tpu.lint import engine
+from dlrover_tpu.lint.__main__ import main as lint_main
+from dlrover_tpu.lint.rules import ALL_RULES, rule_catalog
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_snippet(tmp_path, code, rel="snippet.py", rules=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    violations, errors = engine.lint_paths([str(path)], rules=rules)
+    assert not errors, errors
+    return violations
+
+
+def _rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the repo lints clean against its baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean_against_baseline(monkeypatch):
+    """`python -m dlrover_tpu.lint dlrover_tpu/` exits 0: no violation
+    outside the checked-in baseline. A red here means either fix the
+    new violation, suppress it with a justification, or (for deliberate
+    grandfathering only) run --fix-baseline."""
+    monkeypatch.chdir(REPO_ROOT)  # baseline fingerprints are repo-relative
+    result = engine.run(["dlrover_tpu"])
+    msgs = [v.format() for v in result.fresh] + result.errors
+    assert not result.failed, "\n".join(msgs)
+
+
+def test_baseline_has_no_new_subsystem_entries():
+    """The baseline grandfathers LEGACY debt only: the lint package,
+    flags registry, and warm-compile path were born clean and must
+    never acquire baseline entries."""
+    baseline = engine.load_baseline(engine.DEFAULT_BASELINE)
+    clean_prefixes = ("dlrover_tpu/lint/", "dlrover_tpu/common/flags.py",
+                      "dlrover_tpu/train/warm_compile.py",
+                      "dlrover_tpu/ops/chunked_ce.py")
+    dirty = [e["path"] for e in baseline.values()
+             if e["path"].startswith(clean_prefixes)]
+    assert not dirty, dirty
+
+
+# ---------------------------------------------------------------------------
+# JG001 mesh-capture
+# ---------------------------------------------------------------------------
+
+
+JG001_BAD = """
+    import jax
+    from dlrover_tpu.parallel import build_mesh
+
+    def make_step(cfg):
+        mesh = build_mesh(cfg)
+
+        def loss(params, batch):
+            return compute(params, batch, mesh)
+
+        return jax.jit(loss)
+"""
+
+JG001_BAD_LAMBDA = """
+    import jax
+
+    def make_step(mesh):
+        return jax.jit(lambda p, t: compute(p, t, mesh))
+"""
+
+JG001_GOOD_FACTORY = """
+    import jax
+    from dlrover_tpu.parallel import build_mesh
+
+    def make_step(cfg):
+        mesh = build_mesh(cfg)
+
+        def loss(params, batch, mesh):  # mesh is an argument, not a capture
+            return compute(params, batch, mesh)
+
+        return jax.jit(loss)
+"""
+
+
+def test_jg001_fires_on_mesh_closure(tmp_path):
+    assert _rules_of(_lint_snippet(tmp_path, JG001_BAD)) == ["JG001"]
+
+
+def test_jg001_fires_on_lambda_over_mesh_param(tmp_path):
+    assert _rules_of(_lint_snippet(tmp_path, JG001_BAD_LAMBDA)) == ["JG001"]
+
+
+def test_jg001_quiet_on_parameterized_mesh(tmp_path):
+    assert _lint_snippet(tmp_path, JG001_GOOD_FACTORY) == []
+
+
+# ---------------------------------------------------------------------------
+# JG002 host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+
+JG002_BAD = """
+    def evaluate(state, batches):
+        total = 0.0
+        for b in batches:
+            total += float(eval_step(state, b))   # per-batch host sync
+        return total / 10
+
+
+    def step(state, batch):
+        import jax
+        jax.device_get(state)
+        return state
+"""
+
+JG002_BAD_REACHABLE = """
+    def step(state, batch):
+        state = poll_config(state)
+        return state
+
+
+    def poll_config(state):
+        return state["x"].item()
+"""
+
+JG002_GOOD = """
+    def evaluate(state, batches):
+        total = None
+        for b in batches:
+            loss = eval_step(state, b)
+            total = loss if total is None else total + loss
+        return float(total) / 10     # ONE sync, outside the loop
+
+
+    def helper_not_hot(x):
+        return float(x)              # not reachable from a hot root
+"""
+
+
+def test_jg002_fires_in_loop_and_step_body(tmp_path):
+    violations = _lint_snippet(tmp_path, JG002_BAD)
+    assert _rules_of(violations) == ["JG002"]
+    assert len(violations) == 2  # the loop float() and the device_get
+
+
+def test_jg002_fires_via_reachability(tmp_path):
+    violations = _lint_snippet(tmp_path, JG002_BAD_REACHABLE)
+    assert _rules_of(violations) == ["JG002"]
+    assert "reachable from step()" in violations[0].message
+
+
+def test_jg002_quiet_on_single_final_sync(tmp_path):
+    assert _lint_snippet(tmp_path, JG002_GOOD) == []
+
+
+# ---------------------------------------------------------------------------
+# JG003 raw-env-read
+# ---------------------------------------------------------------------------
+
+
+JG003_BAD = """
+    import os
+
+    TIMEOUT = float(os.environ.get("DLROVER_TPU_TIMEOUT", "60"))
+    NAME = os.getenv("DLROVER_TPU_NAME", "")
+"""
+
+JG003_BAD_FROM_IMPORT = """
+    from os import getenv
+
+    NAME = getenv("DLROVER_TPU_NAME", "")
+"""
+
+
+def test_jg003_fires_on_raw_env(tmp_path):
+    violations = _lint_snippet(tmp_path, JG003_BAD)
+    assert _rules_of(violations) == ["JG003"]
+    assert len(violations) == 2
+
+
+def test_jg003_fires_on_from_import_alias(tmp_path):
+    assert _rules_of(_lint_snippet(tmp_path, JG003_BAD_FROM_IMPORT)) == [
+        "JG003"
+    ]
+
+
+def test_jg003_quiet_in_allowed_modules(tmp_path):
+    for rel in ("common/flags.py", "train/bootstrap.py", "agent/config.py",
+                "common/constants.py"):
+        assert _lint_snippet(tmp_path, JG003_BAD, rel=rel) == []
+
+
+def test_jg003_registry_is_actually_typed():
+    """The registry JG003 points people at must hold up its end:
+    typed defaults, env re-read per get(), parse-failure -> default."""
+    from dlrover_tpu.common import flags
+
+    assert flags.WARM_COMPILE.get() in (True, False)
+    os.environ["DLROVER_TPU_WARM_COMPILE"] = "0"
+    try:
+        assert flags.WARM_COMPILE.get() is False
+    finally:
+        del os.environ["DLROVER_TPU_WARM_COMPILE"]
+    assert flags.WARM_COMPILE.get() is True
+    os.environ["DLROVER_TPU_DRAIN_TIMEOUT"] = "not-a-number"
+    try:
+        assert flags.DRAIN_TIMEOUT.get() == 20.0
+    finally:
+        del os.environ["DLROVER_TPU_DRAIN_TIMEOUT"]
+    # every flag in the catalog parses its own default
+    for f in flags.all_flags():
+        f.get()
+
+
+# ---------------------------------------------------------------------------
+# JG004 unhashable-in-set
+# ---------------------------------------------------------------------------
+
+
+JG004_BAD = """
+    def shard_keys(ranges, x):
+        seen = {slice(0, 4), slice(4, 8)}
+        seen.add([x])
+        table = {[1, 2]: "a"}
+        covered = set([slice(r, r + 1) for r in ranges])
+        return seen, table, covered
+"""
+
+JG004_GOOD = """
+    def shard_keys(ranges, x):
+        seen = {(0, 4), (4, 8)}         # slices normalized to tuples
+        seen.add((x,))
+        table = {(1, 2): "a"}
+        covered = set([(r, r + 1) for r in ranges])
+        return seen, table, covered
+"""
+
+
+def test_jg004_fires_on_unhashables(tmp_path):
+    violations = _lint_snippet(tmp_path, JG004_BAD)
+    assert _rules_of(violations) == ["JG004"]
+    assert len(violations) == 5  # 2 slices + .add list + dict key + comp
+
+
+def test_jg004_quiet_on_tuples(tmp_path):
+    assert _lint_snippet(tmp_path, JG004_GOOD) == []
+
+
+# ---------------------------------------------------------------------------
+# JG005 unsafe-signal-handler
+# ---------------------------------------------------------------------------
+
+
+JG005_BAD = """
+    import signal
+    from dlrover_tpu.common.log import logger
+
+    def install(lock):
+        def on_term(signum, frame):
+            logger.warning("dying")
+            with lock:
+                cleanup()
+
+        signal.signal(signal.SIGTERM, on_term)
+"""
+
+JG005_GOOD = """
+    import signal
+    import threading
+
+    STOP = threading.Event()
+
+    def install():
+        def on_term(signum, frame):
+            STOP.set()          # async-signal-safe: just flag it
+
+        signal.signal(signal.SIGTERM, on_term)
+"""
+
+
+def test_jg005_fires_on_logging_and_lock(tmp_path):
+    violations = _lint_snippet(tmp_path, JG005_BAD)
+    assert _rules_of(violations) == ["JG005"]
+    assert len(violations) == 2  # logger call + with lock
+
+
+def test_jg005_quiet_on_flag_only_handler(tmp_path):
+    assert _lint_snippet(tmp_path, JG005_GOOD) == []
+
+
+# ---------------------------------------------------------------------------
+# JG006 unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+
+
+JG006_BAD = """
+    import threading
+
+    class Stager:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            self.result = compute()     # racing every reader
+"""
+
+JG006_GOOD = """
+    import threading
+
+    class Stager:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            local = compute()           # locals never flagged
+            with self._lock:
+                self.result = local
+"""
+
+
+def test_jg006_fires_on_unguarded_write(tmp_path):
+    violations = _lint_snippet(tmp_path, JG006_BAD)
+    assert _rules_of(violations) == ["JG006"]
+    assert "self.result" in violations[0].message
+
+
+def test_jg006_quiet_under_lock(tmp_path):
+    assert _lint_snippet(tmp_path, JG006_GOOD) == []
+
+
+def test_jg006_thread_subclass_run(tmp_path):
+    code = """
+    import threading
+
+    class Worker(threading.Thread):
+        def run(self):
+            self.done = True
+    """
+    assert _rules_of(_lint_snippet(tmp_path, code)) == ["JG006"]
+
+
+# ---------------------------------------------------------------------------
+# suppression
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line(tmp_path):
+    code = """
+    import os
+
+    X = os.getenv("DLROVER_TPU_X", "")  # graftlint: disable=JG003
+    """
+    assert _lint_snippet(tmp_path, code) == []
+
+
+def test_suppression_line_above(tmp_path):
+    code = """
+    import os
+
+    # the agent copies the whole env  # graftlint: disable=JG003
+    X = os.getenv("DLROVER_TPU_X", "")
+    """
+    assert _lint_snippet(tmp_path, code) == []
+
+
+def test_suppression_file_level(tmp_path):
+    code = """
+    # graftlint: disable-file=JG003
+    import os
+
+    X = os.getenv("A", "")
+    Y = os.getenv("B", "")
+    """
+    assert _lint_snippet(tmp_path, code) == []
+
+
+def test_suppression_empty_spec_is_noop_not_crash(tmp_path):
+    """The typo '# graftlint: disable=' (rule id forgotten) must not
+    kill the lint run — the violation stays reported."""
+    code = """
+    import os
+
+    X = os.getenv("DLROVER_TPU_X", "")  # graftlint: disable=
+    """
+    assert _rules_of(_lint_snippet(tmp_path, code)) == ["JG003"]
+
+
+def test_jg003_fires_on_from_import_environ(tmp_path):
+    code = """
+    from os import environ
+
+    X = environ.get("DLROVER_TPU_X", "")
+    Y = environ["DLROVER_TPU_Y"]
+    """
+    violations = _lint_snippet(tmp_path, code)
+    assert _rules_of(violations) == ["JG003"]
+    assert len(violations) == 2
+
+
+def test_jg003_quiet_on_unrelated_environ_name(tmp_path):
+    code = """
+    environ = {"a": 1}          # a local dict, not os.environ
+
+    X = environ.get("a")
+    """
+    assert _lint_snippet(tmp_path, code) == []
+
+
+def test_cli_rejects_scoped_fix_baseline(tmp_path):
+    """--rule + --fix-baseline would erase the other rules'
+    grandfathered entries; must be a usage error, not a data loss."""
+    f = tmp_path / "legacy.py"
+    f.write_text('import os\nX = os.getenv("A", "")\nS = {[1]: "a"}\n')
+    baseline = tmp_path / "baseline.json"
+    assert lint_main(
+        ["--fix-baseline", "--baseline", str(baseline), str(f)]
+    ) == 0
+    assert lint_main(
+        ["--rule", "JG003", "--fix-baseline", "--baseline", str(baseline),
+         str(f)]
+    ) == 2
+    # the full baseline is intact: clean run still passes
+    assert lint_main(["--baseline", str(baseline), str(f)]) == 0
+
+
+def test_suppression_is_per_rule(tmp_path):
+    code = """
+    import os
+
+    X = os.getenv("DLROVER_TPU_X", "")  # graftlint: disable=JG004
+    """
+    assert _rules_of(_lint_snippet(tmp_path, code)) == ["JG003"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    """--fix-baseline then a clean run; a NEW violation still fails;
+    fixing a baselined one reports it stale."""
+    f = tmp_path / "legacy.py"
+    f.write_text('import os\nX = os.getenv("A", "")\n')
+    baseline = tmp_path / "baseline.json"
+
+    assert lint_main(
+        ["--fix-baseline", "--baseline", str(baseline), str(f)]
+    ) == 0
+    assert lint_main(["--baseline", str(baseline), str(f)]) == 0
+
+    # a new violation on top of the baselined one fails, names only itself
+    f.write_text(
+        'import os\nX = os.getenv("A", "")\nY = os.getenv("B", "")\n'
+    )
+    result = engine.run([str(f)], baseline_path=str(baseline))
+    assert result.failed
+    assert len(result.fresh) == 1
+    assert 'os.getenv("B"' in result.fresh[0].snippet
+
+    # fixing the baselined site leaves a stale entry (clean, reported)
+    f.write_text("X = 1\n")
+    result = engine.run([str(f)], baseline_path=str(baseline))
+    assert not result.failed
+    assert len(result.stale_fingerprints) == 1
+
+
+def test_baseline_keys_on_text_not_line_numbers(tmp_path):
+    """Edits ABOVE a grandfathered site must not un-baseline it."""
+    f = tmp_path / "legacy.py"
+    f.write_text('import os\nX = os.getenv("A", "")\n')
+    baseline = tmp_path / "baseline.json"
+    engine.run([str(f)], baseline_path=str(baseline), fix_baseline=True)
+
+    f.write_text(
+        'import os\n\n\ndef pushed_down():\n    pass\n\n\n'
+        'X = os.getenv("A", "")\n'
+    )
+    result = engine.run([str(f)], baseline_path=str(baseline))
+    assert not result.failed
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('import os\nX = os.getenv("A", "")\n')
+    good = tmp_path / "good.py"
+    good.write_text("X = 1\n")
+    empty_baseline = tmp_path / "nonexistent.json"
+    assert lint_main(
+        ["--baseline", str(empty_baseline), str(bad)]
+    ) == 1
+    assert lint_main(
+        ["--baseline", str(empty_baseline), str(good)]
+    ) == 0
+    assert lint_main([]) == 2
+    assert lint_main(["--rule", "JG999", str(good)]) == 2
+    assert lint_main(["--list-rules"]) == 0
+
+
+def test_cli_rule_filter(tmp_path):
+    f = tmp_path / "mixed.py"
+    f.write_text(
+        'import os\nX = os.getenv("A", "")\nS = {[1]: "a"}\n'
+    )
+    violations, _ = engine.lint_paths(
+        [str(f)], rules=[r for r in ALL_RULES if r.id == "JG004"]
+    )
+    assert _rules_of(violations) == ["JG004"]
+
+
+def test_unparsable_file_reports_error_not_crash(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def broken(:\n")
+    ok = tmp_path / "ok.py"
+    ok.write_text('import os\nX = os.getenv("A", "")\n')
+    violations, errors = engine.lint_paths([str(tmp_path)])
+    assert len(errors) == 1 and "broken.py" in errors[0]
+    assert _rules_of(violations) == ["JG003"]  # ok.py still linted
+
+
+def test_rule_catalog_complete():
+    ids = [rid for rid, _, _ in rule_catalog()]
+    assert ids == ["JG001", "JG002", "JG003", "JG004", "JG005", "JG006"]

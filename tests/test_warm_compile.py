@@ -367,6 +367,120 @@ def test_evaluate_zero_batches_raises():
         tr.evaluate(state, [])
 
 
+# ---------------------------------------------------------------------------
+# Retrace guard (lint/retrace_guard.py): the runtime companion to
+# graftlint — silent recompiles are the invariant static analysis
+# cannot see.
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_guard_raises_on_signature_drift():
+    """A jitted step fed signature-drifting inputs (shape changes every
+    call) must raise instead of silently paying a full XLA compile per
+    step."""
+    from dlrover_tpu.lint.retrace_guard import RetraceError, RetraceGuard
+
+    f = jax.jit(lambda x: (x * 2.0).sum())
+    with pytest.raises(RetraceError, match="distinct signatures"):
+        with RetraceGuard(max_signatures_per_fn=2):
+            f(jnp.ones((3, 5)))
+            f(jnp.ones((3, 6)))
+            f(jnp.ones((3, 7)))  # third distinct signature: over budget
+
+
+def test_retrace_guard_raises_on_identical_recompile():
+    """Re-wrapping jit around a fresh closure each call recompiles an
+    IDENTICAL program every time (the classic churn bug — and the shape
+    of the PR 2 adam-resharding loop). The guard catches the repeat."""
+    from dlrover_tpu.lint.retrace_guard import RetraceError, RetraceGuard
+
+    with pytest.raises(RetraceError, match="ALREADY-SEEN"):
+        with RetraceGuard(max_recompiles_per_signature=1):
+            for _ in range(3):
+                jax.jit(lambda x: (x + 1.0).sum())(jnp.ones((4, 9)))
+
+
+def test_retrace_guard_no_stale_reraise_after_in_place_raise():
+    """A violation raised in place was SEEN by the caller; a later
+    clean check() must not re-raise it as a stale duplicate (a caller
+    that caught the error and kept stepping would fail its next
+    otherwise-clean step)."""
+    from dlrover_tpu.lint.retrace_guard import RetraceError, RetraceGuard
+
+    guard = RetraceGuard(max_signatures_per_fn=1)
+    f = jax.jit(lambda x: (x * 3.0).sum())
+    with guard:
+        f(jnp.ones((2, 11)))
+        with pytest.raises(RetraceError):
+            f(jnp.ones((2, 12)))  # raises in place
+        guard.check()  # already surfaced: must NOT raise again
+
+
+def test_retrace_guard_restores_log_compiles():
+    from dlrover_tpu.lint.retrace_guard import RetraceGuard
+
+    before = bool(jax.config.jax_log_compiles)
+    with RetraceGuard():
+        assert bool(jax.config.jax_log_compiles) is True
+    assert bool(jax.config.jax_log_compiles) is before
+
+
+def test_retrace_guard_silent_across_warm_remesh():
+    """The acceptance property: a warm remesh via lower_step emits ZERO
+    compile events (the AOT executable is a cache hit), so a live guard
+    stays quiet — while the same guard counted the cold builds."""
+    from dlrover_tpu.lint.retrace_guard import RetraceGuard
+
+    tr, state, batch = _make_trainer(world=8, fsdp=2, tp=2, gb=8)
+    guard = RetraceGuard(max_signatures_per_fn=4)
+    with guard:
+        state, _ = tr.step(state, batch)          # cold compile: world 8
+        compiles_cold = guard.compile_count
+        assert compiles_cold >= 1
+        tr.record_avatars(state, batch)
+        mc4 = remesh_config(tr.mesh_config, 4).resolve(4)
+        mesh4 = build_mesh(mc4, devices=jax.devices()[:4])
+        tr.lower_step(mesh4, mc4, source="speculative")  # cold: world 4
+        compiles_spec = guard.compile_count
+        assert compiles_spec > compiles_cold
+
+        tr.remesh(mesh4, mc4)                     # warm: cached executable
+        params4 = jax.device_put(
+            llama.init_params(CFG, jax.random.key(0)),
+            named_shardings(mesh4, llama.param_specs(CFG)),
+        )
+        state4 = tr.init_state(params4)
+        a, b = tr.step_batch_shape
+        batch4 = jax.random.randint(jax.random.key(1), (a, b, SEQ), 0,
+                                    CFG.vocab_size)
+        _, loss = tr.step(state4, batch4)
+        assert np.isfinite(float(loss))
+        # the remeshed step itself added no "step" compile event
+        assert guard.signatures_of("step") == 2  # world 8 + world 4, once each
+    # __exit__ ran check(): no pending violations — the guard was silent
+
+
+def test_retrace_guard_trainer_wiring(monkeypatch):
+    """DLROVER_TPU_RETRACE_GUARD=1 installs the process-wide guard at
+    trainer construction; unset leaves it off."""
+    from dlrover_tpu.lint import retrace_guard
+
+    monkeypatch.delenv("DLROVER_TPU_RETRACE_GUARD", raising=False)
+    tr, _, _ = _make_trainer(world=4, fsdp=2, tp=2, gb=8)
+    assert tr._retrace_guard is None
+    monkeypatch.setenv("DLROVER_TPU_RETRACE_GUARD", "12")
+    try:
+        tr2, state2, batch2 = _make_trainer(world=4, fsdp=2, tp=2, gb=8)
+        assert tr2._retrace_guard is not None
+        assert tr2._retrace_guard.max_signatures_per_fn == 12
+        assert retrace_guard.installed() is tr2._retrace_guard
+        _, loss = tr2.step(state2, batch2)  # guarded step runs clean
+        assert np.isfinite(float(loss))
+    finally:
+        retrace_guard.uninstall()
+    assert retrace_guard.installed() is None
+
+
 def test_sync_host_step_seeds_from_restored_state():
     """After a restore, report_step must continue from the restored
     global step, never regress to 0."""
