@@ -292,6 +292,87 @@ def _persist_last(result: dict):
         pass
 
 
+def _bench_state_transfer(
+    jax, make_trainer, world: int, target: int, mc_full, devs, seq, cfg
+) -> dict:
+    """State half of the resize: live reshard (remesh(state=…)) vs the
+    shm round-trip (stage + target-placed restore) of the SAME state.
+    Returns the detail dict (state_transfer_s / compile_s /
+    shm_restore_s / shm_roundtrip_s)."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp  # noqa: F401  (kept local like the caller)
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.parallel import build_mesh
+    from dlrover_tpu.parallel.mesh import remesh as remesh_config
+    from dlrover_tpu.train import live_reshard as lrs
+
+    lrs.resize_ledger.clear()
+    tr, state, batch = make_trainer(world)
+    st, l0 = tr.step(state, batch)
+    jax.block_until_ready(st)
+    avatars = tr._state_avatar
+    state_bytes = sum(av.size * av.dtype.itemsize
+                      for av in jax.tree.leaves(avatars))
+    mc_t = remesh_config(mc_full, target).resolve(target)
+    mesh_t = build_mesh(mc_t, devices=devs[:target])
+
+    # shm round-trip reference: what the restart path pays for state
+    tmpd = tempfile.mkdtemp(prefix="dlrover_bench_reshard_")
+    eng = CheckpointEngine(tmpd, job_name="bench-reshard")
+    try:
+        # warmup: the restart path's saves run during training with the
+        # snapshot jit + shm segment warm — don't bill its first-use
+        # compile/alloc to the round-trip
+        eng.save_to_memory(0, st)
+        eng.wait_staging()
+        t0 = time.perf_counter()
+        eng.save_to_memory(1, st)
+        eng.wait_staging()
+        shm_save_s = time.perf_counter() - t0
+        target_tree = lrs.state_targets(avatars, mesh_t)
+        t0 = time.perf_counter()
+        restored = eng.load(target=target_tree)
+        assert restored is not None
+        jax.block_until_ready(restored[1])
+        shm_restore_s = time.perf_counter() - t0
+        _release(jax, restored[1])
+    finally:
+        eng.close(unlink_shm=True)
+        shutil.rmtree(tmpd, ignore_errors=True)
+
+    # live path: the in-process remesh moves the same bytes D2D
+    new_state = tr.remesh(mesh_t, mc_t, state=st)
+    out = {"state_bytes": state_bytes}
+    if new_state is None:
+        out["live_reshard"] = "unavailable"
+        _release(jax, st, batch)
+        return out
+    a, b = tr.step_batch_shape
+    batch_t = jax.random.randint(
+        jax.random.key(5), (a, b, seq), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    next_state, loss = tr.step(new_state, batch_t)  # finalizes the event
+    jax.block_until_ready(loss)
+    ev = lrs.resize_ledger.last() or {}
+    out.update({
+        "state_transfer_s": ev.get("state_transfer_s", 0.0),
+        "compile_s": ev.get("compile_s", 0.0),
+        "transfer_path": ev.get("path", ""),
+        "shm_restore_s": round(shm_restore_s, 4),
+        "shm_roundtrip_s": round(shm_save_s + shm_restore_s, 4),
+        "live_vs_shm_ratio": round(
+            ev.get("state_transfer_s", 0.0)
+            / max(shm_save_s + shm_restore_s, 1e-9),
+            4,
+        ),
+    })
+    _release(jax, next_state, batch_t, batch, st)
+    return out
+
+
 def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
     """remesh→first-step downtime, cold vs warm (train/warm_compile.py).
 
@@ -429,6 +510,18 @@ def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
                 for k, v in wc.compile_ledger.entries().items()
             },
         })
+        drop(st2, batch2)
+        del tr2, state2, batch2, st2
+
+        # ---- state leg: live reshard vs the shm round-trip ----
+        # (train/live_reshard.py) — the STATE half of resize downtime.
+        # Same bytes, two paths: remesh(state=…) moving the train state
+        # device-to-device, vs staging it to shm and restoring it placed
+        # for the target mesh (what every resize paid before).
+        if mode == "half_world":
+            out["state"] = _bench_state_transfer(
+                jax, make_trainer, world, target, mc_full, devs, seq, cfg
+            )
     finally:
         if saved_kill is None:
             os.environ.pop(wc.ENV_KILL_SWITCH, None)

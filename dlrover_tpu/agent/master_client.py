@@ -294,6 +294,21 @@ class MasterClient:
             )
         )
 
+    def report_resize_breakdown(
+        self,
+        rendezvous_s: float = 0.0,
+        compile_s: float = 0.0,
+        state_transfer_s: float = 0.0,
+    ):
+        return self._client.report(
+            msg.ResizeBreakdownReport(
+                node_id=self.node_id,
+                rendezvous_s=rendezvous_s,
+                compile_s=compile_s,
+                state_transfer_s=state_transfer_s,
+            )
+        )
+
     def close(self):
         self._client.close()
 

@@ -61,6 +61,55 @@ def _index_to_ranges(index, shape) -> Tuple[Tuple[int, int], ...]:
     return tuple(out)
 
 
+def _slice_pieces(
+    plist, idx, shape: Tuple[int, ...], dtype, stats: Dict[str, int]
+) -> np.ndarray:
+    """Materialize exactly the requested region of a leaf from its
+    staged pieces — the shard-wise restore callback. Never assembles
+    the full array: either one piece CONTAINS the region (a contiguous
+    slice of it comes back — the common case, since restore targets
+    re-slice the same or a coarser grid than the save staged), or the
+    region is assembled from the overlapping pieces at the region's
+    extent (world-resize storage restores, where old-world shards tile
+    differently). Uncovered gaps zero-fill, matching the historical
+    full-array assembly (``np.zeros`` + piece copies)."""
+    ranges = _index_to_ranges(idx, shape)
+    extent = tuple(e - s for s, e in ranges)
+    for p_index, arr, _ in plist:
+        if all(
+            ps <= ns and ne <= pe
+            for (ns, ne), (ps, pe) in zip(ranges, p_index)
+        ):
+            rel = tuple(
+                slice(ns - ps, ne - ps)
+                for (ns, ne), (ps, pe) in zip(ranges, p_index)
+            )
+            stats["sliced"] = stats.get("sliced", 0) + 1
+            # copy=True even when the slice is already contiguous: the
+            # piece may be a VIEW into the shm segment, and the CPU
+            # backend zero-copy-aliases host buffers into jax arrays —
+            # an aliased restore would be silently overwritten by the
+            # next staged save (and pins the segment against close())
+            return np.array(arr[rel], dtype=dtype, copy=True)
+    out = np.zeros(extent, dtype=dtype)
+    for p_index, arr, _ in plist:
+        inter = [
+            (max(ns, ps), min(ne, pe))
+            for (ns, ne), (ps, pe) in zip(ranges, p_index)
+        ]
+        if any(s >= e for s, e in inter):
+            continue
+        dst = tuple(
+            slice(s - ns, e - ns) for (s, e), (ns, _) in zip(inter, ranges)
+        )
+        src = tuple(
+            slice(s - ps, e - ps) for (s, e), (ps, _) in zip(inter, p_index)
+        )
+        out[dst] = arr[src]
+    stats["region_assembled"] = stats.get("region_assembled", 0) + 1
+    return out
+
+
 #: live engines whose in-flight background stage must be drained at
 #: teardown. Module-level (one atexit hook + one SIGTERM chain link per
 #: PROCESS, not per engine) so repeatedly built engines — benches,
@@ -190,6 +239,11 @@ class CheckpointEngine:
         #: how the last save staged: "device_snapshot" (pause = HBM copy),
         #: "host_gather" (pause = d2h transfer), or "sync"
         self.last_stage_mode = ""
+        #: how the last targeted restore placed its leaves: counts of
+        #: "sliced" (single containing piece — zero assembly),
+        #: "region_assembled" (requested extent built from overlapping
+        #: pieces) and "full_assembled" (host-target fallback)
+        self.last_restore_stats: Dict[str, int] = {}
 
     # -- IPC (lazy: standalone use without an agent works too) --------------
 
@@ -661,7 +715,11 @@ class CheckpointEngine:
             step = int(steps[0])
         if step < 0 or meta is None:
             return None
-        pieces = self._read_pieces_from_shm(meta)
+        # With a target the placement callback copies just the slices it
+        # is asked for, so the leaves can stay VIEWS into the shm buffer
+        # (no up-front whole-leaf memcpy). Without a target the restored
+        # pytree itself would alias shm — copy as before.
+        pieces = self._read_pieces_from_shm(meta, copy=target is None)
         return self._assemble(meta.step, pieces, target, full_data=False)
 
     def _load_from_storage(self, target: Any = None):
@@ -700,10 +758,10 @@ class CheckpointEngine:
             logger.info("restored step %s from storage %s", step, sdir)
         return result
 
-    def _read_pieces_from_shm(self, meta: CheckpointMeta):
+    def _read_pieces_from_shm(self, meta: CheckpointMeta, copy: bool = True):
         pieces: Dict[str, List[Tuple[Tuple, np.ndarray, Tuple[int, ...]]]] = {}
         for leaf_meta in meta.leaves:
-            arr = self._shm.read_leaf(leaf_meta, copy=True)
+            arr = self._shm.read_leaf(leaf_meta, copy=copy)
             base = leaf_meta.path.rsplit("#", 1)[0]
             pieces.setdefault(base, []).append(
                 (leaf_meta.index, arr, leaf_meta.global_shape)
@@ -766,18 +824,22 @@ class CheckpointEngine:
             return True
 
         if target is not None:
+            stats: Dict[str, int] = {}
             flat_t, treedef = jax.tree_util.tree_flatten_with_path(target)
             out_leaves = []
             for path, t_leaf in flat_t:
                 key = jax.tree_util.keystr(path)
-                full = build_full(key)
-                if full is None:
+                plist = pieces.get(key)
+                if not plist:
                     logger.warning("checkpoint missing leaf %s; keeping target", key)
                     out_leaves.append(t_leaf)
                     continue
+                # global shape recorded at stage time; shape-gate without
+                # assembling anything
+                gshape = tuple(plist[0][2])
                 if (
                     hasattr(t_leaf, "shape")
-                    and tuple(full.shape) != tuple(t_leaf.shape)
+                    and gshape != tuple(t_leaf.shape)
                 ):
                     # same leaf path but a different tensor shape: this is
                     # NOT our checkpoint (e.g. a stale shm segment from an
@@ -786,7 +848,7 @@ class CheckpointEngine:
                     logger.warning(
                         "checkpoint leaf %s shape %s != target %s; "
                         "rejecting this source",
-                        key, tuple(full.shape), tuple(t_leaf.shape),
+                        key, gshape, tuple(t_leaf.shape),
                     )
                     return None
                 if not covers_target(t_leaf, key):
@@ -796,7 +858,31 @@ class CheckpointEngine:
                         key,
                     )
                     return None
-                out_leaves.append(_place_like(t_leaf, full))
+                if isinstance(t_leaf, jax.Array) or hasattr(
+                    t_leaf, "sharding"
+                ):
+                    # SHARD-WISE placement: the callback materializes
+                    # exactly the index each device asks for, straight
+                    # from the staged pieces — the full host array is
+                    # never assembled (peak restore memory = largest
+                    # local shard, not largest tensor)
+                    out_leaves.append(
+                        _place_sharded(t_leaf, plist, stats)
+                    )
+                else:
+                    full = build_full(key)
+                    if not full_data:
+                        # shm pieces are views; build_full's single-piece
+                        # shortcut returns the view itself, and a host
+                        # target leaf would keep it — aliasing the
+                        # restored value to the segment the next save
+                        # overwrites
+                        full = np.array(full, copy=True)
+                    stats["full_assembled"] = (
+                        stats.get("full_assembled", 0) + 1
+                    )
+                    out_leaves.append(_place_like(t_leaf, full))
+            self.last_restore_stats = stats
             return step, jax.tree_util.tree_unflatten(treedef, out_leaves)
 
         # no target: numpy pytree via stored treedef
@@ -855,6 +941,31 @@ class CheckpointEngine:
         if self._shm_lock is not None:
             self._shm_lock.close()
         self._shm.close(unlink=unlink_shm)
+
+
+def _place_sharded(t_leaf, plist, stats: Dict[str, int]):
+    """Place a leaf per the target's sharding, shard-wise: each device's
+    buffer is fed exactly its requested region sliced from the staged
+    pieces (no per-host full-array assembly — Orbax-style distributed
+    restore, arXiv:2605.23066). 0-d leaves short-circuit to a plain
+    ``device_put`` (no index to slice)."""
+    import jax
+
+    sharding = t_leaf.sharding
+    dtype = t_leaf.dtype
+    shape = tuple(t_leaf.shape)
+    if len(shape) == 0:
+        stats["sliced"] = stats.get("sliced", 0) + 1
+        # copy: the piece may be a view into shm (see _slice_pieces)
+        return jax.device_put(
+            np.array(plist[0][1], dtype=dtype, copy=True).reshape(()),
+            sharding,
+        )
+    return jax.make_array_from_callback(
+        shape,
+        sharding,
+        lambda idx: _slice_pieces(plist, idx, shape, dtype, stats),
+    )
 
 
 def _place_like(t_leaf, full: np.ndarray):

@@ -122,6 +122,12 @@ WARM_COMPILE_EXIT_JOIN_S = _define(
     "DLROVER_TPU_WARM_COMPILE_EXIT_JOIN_S", 60.0, "float",
     "Interpreter-exit join bound for the speculative compile thread.",
 )
+LIVE_RESHARD = _define(
+    "DLROVER_TPU_LIVE_RESHARD", True, "bool",
+    "Live state resharding kill-switch: 0 makes remesh(state=...) "
+    "ignore the passed state so callers restore through the "
+    "checkpoint round-trip exactly as before (train/live_reshard.py).",
+)
 CHUNKED_CE = _define(
     "DLROVER_TPU_CHUNKED_CE", True, "bool",
     "Chunked fused cross-entropy kill-switch: 0 restores the dense "

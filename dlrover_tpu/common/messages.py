@@ -432,6 +432,18 @@ class CheckpointStepReport:
     persist_s: float = 0.0
 
 
+@message
+class ResizeBreakdownReport:
+    """Per-resize downtime breakdown (train/live_reshard.py): seconds a
+    membership change spent in rendezvous vs the step rebuild vs moving
+    the train state — feeds the SpeedMonitor's goodput attribution."""
+
+    node_id: int = -1
+    rendezvous_s: float = 0.0
+    compile_s: float = 0.0
+    state_transfer_s: float = 0.0
+
+
 # ---------------------------------------------------------------------------
 # Diagnosis
 # ---------------------------------------------------------------------------

@@ -37,6 +37,14 @@ class SpeedMonitor:
         self._downtime_start: float = 0.0
         self._total_downtime: float = 0.0
         self._downtime_events: int = 0
+        # per-phase attribution of the downtime brackets: what resizes
+        # actually spend their seconds on (worker-reported via
+        # ResizeBreakdownReport — train/live_reshard.py)
+        self._breakdown_totals: Dict[str, float] = {
+            "rendezvous": 0.0, "compile": 0.0, "state_transfer": 0.0,
+        }
+        self._breakdown_last: Dict[str, float] = {}
+        self._breakdown_events: int = 0
 
     # -- step samples -------------------------------------------------------
 
@@ -115,6 +123,37 @@ class SpeedMonitor:
                 self._downtime_start = 0.0
                 self._downtime_events += 1
 
+    def record_downtime_breakdown(
+        self,
+        rendezvous_s: float = 0.0,
+        compile_s: float = 0.0,
+        state_transfer_s: float = 0.0,
+    ):
+        """Attribute one resize's downtime to its phases. Complements
+        the bracket timers: ``total_downtime`` says how long training
+        stood still, this says on WHAT (and so which half — executable
+        or state — still needs warming)."""
+        with self._lock:
+            last = {
+                "rendezvous": max(0.0, float(rendezvous_s)),
+                "compile": max(0.0, float(compile_s)),
+                "state_transfer": max(0.0, float(state_transfer_s)),
+            }
+            for phase, secs in last.items():
+                self._breakdown_totals[phase] += secs
+            self._breakdown_last = last
+            self._breakdown_events += 1
+
+    def downtime_breakdown(self) -> Dict:
+        """{"totals": per-phase seconds, "last": the latest resize's
+        phases, "events": how many resizes reported}."""
+        with self._lock:
+            return {
+                "totals": dict(self._breakdown_totals),
+                "last": dict(self._breakdown_last),
+                "events": self._breakdown_events,
+            }
+
     def avg_downtime(self) -> float:
         """Mean seconds per completed downtime bracket — what one
         restart/membership change actually costs this job (feeds the
@@ -162,6 +201,8 @@ class SpeedMonitor:
                 "total_downtime": self._total_downtime,
                 "downtime_events": self._downtime_events,
                 "downtime_start": self._downtime_start,
+                "breakdown_totals": dict(self._breakdown_totals),
+                "breakdown_events": self._breakdown_events,
                 # when the old master dies with no open bracket, the
                 # restore path backdates the relaunch gap to this stamp
                 "snapshot_time": time.time(),
@@ -180,3 +221,9 @@ class SpeedMonitor:
             # a downtime bracket that was open when the old master died
             # stays open — the relaunch gap itself is downtime
             self._downtime_start = float(state.get("downtime_start", 0.0))
+            totals = state.get("breakdown_totals") or {}
+            for phase in self._breakdown_totals:
+                self._breakdown_totals[phase] = float(
+                    totals.get(phase, 0.0)
+                )
+            self._breakdown_events = int(state.get("breakdown_events", 0))

@@ -90,6 +90,7 @@ class MasterServicer:
             msg.SyncFinish: self._sync_finish,
             msg.DiagnosisReportData: self._report_diagnosis_data,
             msg.CheckpointStepReport: self._report_ckpt_step,
+            msg.ResizeBreakdownReport: self._report_resize_breakdown,
         }
 
     # -- dispatch -----------------------------------------------------------
@@ -379,4 +380,13 @@ class MasterServicer:
         return msg.SimpleResponse()
 
     def _report_ckpt_step(self, request: msg.CheckpointStepReport):
+        return msg.SimpleResponse()
+
+    def _report_resize_breakdown(self, request: msg.ResizeBreakdownReport):
+        if self._speed_monitor is not None:
+            self._speed_monitor.record_downtime_breakdown(
+                rendezvous_s=request.rendezvous_s,
+                compile_s=request.compile_s,
+                state_transfer_s=request.state_transfer_s,
+            )
         return msg.SimpleResponse()

@@ -251,6 +251,17 @@ def _start_metrics_server(port: int):
                     rows = rows + compile_lines()
                 except Exception:
                     pass
+                try:
+                    # per-resize downtime breakdown (rendezvous /
+                    # compile / state transfer) — the state half of the
+                    # same signal (train/live_reshard.py)
+                    from dlrover_tpu.train.live_reshard import (
+                        prometheus_lines as resize_lines,
+                    )
+
+                    rows = rows + resize_lines()
+                except Exception:
+                    pass
                 body = ("\n".join(rows) + "\n").encode()
                 self.send_response(200)
                 self.send_header("Content-Type",

@@ -118,6 +118,28 @@ class WorkerContext:
         except Exception as e:
             logger.warning("model info report failed: %s", e)
 
+    def report_resize_breakdown(
+        self,
+        rendezvous_s: float = 0.0,
+        compile_s: float = 0.0,
+        state_transfer_s: float = 0.0,
+    ):
+        """Per-resize downtime breakdown for the master's goodput
+        ledger: what this membership change spent on rendezvous vs the
+        step rebuild vs moving the train state (live reshard or
+        checkpoint restore). Chief-only, like model info — every
+        worker sees the same resize."""
+        if self.client is None or not self.is_chief:
+            return
+        try:
+            self.client.report_resize_breakdown(
+                rendezvous_s=rendezvous_s,
+                compile_s=compile_s,
+                state_transfer_s=state_transfer_s,
+            )
+        except Exception as e:
+            logger.warning("resize breakdown report failed: %s", e)
+
     def report_step(self, step: int, force: bool = False):
         """Throttled global-step report feeding the master's SpeedMonitor."""
         if self.client is None:

@@ -36,7 +36,7 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.lint import retrace_guard
 from dlrover_tpu.parallel.mesh import MeshConfig
 from dlrover_tpu.parallel.sharding import batch_spec
-from dlrover_tpu.train import warm_compile
+from dlrover_tpu.train import live_reshard, warm_compile
 
 PyTree = Any
 
@@ -138,6 +138,10 @@ class ElasticTrainer:
         self._state_avatar: Optional[PyTree] = None
         self._batch_avatar: Optional[PyTree] = None
         self._params_avatar: Optional[PyTree] = None
+        # open resize event (remesh() stamps the transfer half; the
+        # first post-resize step build stamps the compile half and
+        # records it to live_reshard.resize_ledger)
+        self._pending_resize: Optional[dict] = None
         # silent-recompile guard (lint/retrace_guard.py), opt-in via
         # DLROVER_TPU_RETRACE_GUARD: raises in place when the step (or
         # any jitted fn) recompiles an already-seen signature or drifts
@@ -506,6 +510,7 @@ class ElasticTrainer:
         signature compiled before (speculative neighbor compile, a
         remesh back to a previous world), cold AOT compile otherwise —
         followed by a speculative kick for the neighbor worlds."""
+        self._last_build_info = {"cache": "jit", "compile_s": None}
         if not warm_compile.warm_compile_enabled():
             return self._build_step()
         try:
@@ -515,6 +520,7 @@ class ElasticTrainer:
                 "AOT step build failed; falling back to plain jit"
             )
             return self._build_step()
+        self._last_build_info = info
         if info["cache"] == "warm":
             logger.info(
                 "step build: WARM (AOT cache hit, world=%d)", self.mesh.size
@@ -658,7 +664,9 @@ class ElasticTrainer:
         ``batch``: any pytree whose leaves lead with (accum_steps,
         micro*dp, ...) — int32 token arrays for the LM families,
         (images, labels) tuples for CV."""
-        if self._step_fn is None:
+        first_build = self._step_fn is None
+        build_t0 = time.perf_counter()
+        if first_build:
             self.record_avatars(state, batch)
             self._step_fn = self._acquire_step_fn()
         if self.worker_ctx is not None:
@@ -692,8 +700,13 @@ class ElasticTrainer:
                 self.warm.evict(sig)
             except Exception:
                 pass
+            # the AOT info (possibly a 0.0s warm hit) no longer describes
+            # this build: route _finalize_resize to the measured branch
+            self._last_build_info = {"cache": "jit", "compile_s": None}
             self._step_fn = self._build_step()
             new_state, loss = self._step_fn(state, batch)
+        if first_build and self._pending_resize is not None:
+            self._finalize_resize(loss, build_t0)
         # host-side step counter: reading new_state["step"] would block on
         # the just-dispatched computation and kill async dispatch
         self._host_step += 1
@@ -720,11 +733,63 @@ class ElasticTrainer:
         logger.info("host step counter seeded from restore: %d",
                     self._host_step)
 
+    def _finalize_resize(self, loss, build_t0: float):
+        """Close the resize event the last ``remesh()`` opened: stamp the
+        compile half of the downtime breakdown and publish the event to
+        the resize ledger (+ the master, when connected).
+
+        The AOT path reports its exact compile seconds. The plain-jit
+        path compiles lazily inside the first call — so, once per
+        resize, block for the just-dispatched step and attribute the
+        wall time to compile (the execute tail is noise next to a real
+        model's compile; a resize boundary already synchronized for the
+        state transfer, so this one sync costs nothing extra)."""
+        pending, self._pending_resize = self._pending_resize, None
+        info = getattr(self, "_last_build_info", None) or {}
+        compile_s = info.get("compile_s")
+        if compile_s is None:
+            # jit (kill-switch / AOT-fallback) path
+            jax.block_until_ready(loss)  # graftlint: disable=JG002
+            compile_s = time.perf_counter() - build_t0
+        event = live_reshard.resize_ledger.record(
+            pending["from"], pending["to"],
+            rendezvous_s=pending.get("rendezvous_s", 0.0),
+            compile_s=compile_s,
+            state_transfer_s=pending.get("state_transfer_s", 0.0),
+            path=pending.get("path", "checkpoint"),
+        )
+        logger.info(
+            "resize %d->%d downtime breakdown: compile=%.3fs "
+            "state_transfer=%.3fs (path=%s)",
+            event["world_from"], event["world_to"], event["compile_s"],
+            event["state_transfer_s"], event["path"],
+        )
+        if self.worker_ctx is not None:
+            self.worker_ctx.report_resize_breakdown(
+                rendezvous_s=event["rendezvous_s"],
+                compile_s=event["compile_s"],
+                state_transfer_s=event["state_transfer_s"],
+            )
+
     # ---- elasticity ----------------------------------------------------
-    def remesh(self, mesh: Mesh, mesh_config: MeshConfig):
+    def remesh(
+        self,
+        mesh: Mesh,
+        mesh_config: MeshConfig,
+        state: Optional[dict] = None,
+    ) -> Optional[dict]:
         """After a membership change: adopt the new mesh; the jitted step is
         rebuilt (recompiled) lazily; accumulation re-derives so the global
-        batch is unchanged (the reference's core elasticity invariant)."""
+        batch is unchanged (the reference's core elasticity invariant).
+
+        ``state`` (live-reshard path): when the old state is still on
+        device — the process survived the resize — pass it here and the
+        trainer moves it old-mesh→new-mesh device-to-device (batched
+        ``jax.device_put`` against the avatar-derived target shardings,
+        with a leaf-wise + host-bridge fallback ladder), skipping the
+        checkpoint round-trip entirely. Returns the transferred state,
+        or None when live reshard is off / unavailable — the caller
+        then restores via the checkpoint engine exactly as before."""
         old = self.accum_steps
         dp = mesh_config.resolve(mesh.size).data_parallel_size
         denom = self.tc.micro_batch_size * dp
@@ -734,10 +799,43 @@ class ElasticTrainer:
                 f"{self.tc.global_batch_size} not divisible by "
                 f"micro_batch*dp={denom}; trainer left on the old mesh"
             )
+        old_world = self.mesh.size
+        new_state: Optional[dict] = None
+        transfer_info: Optional[dict] = None
+        if state is not None and live_reshard.live_reshard_enabled():
+            # transfer BEFORE adopting the new mesh fails nothing if the
+            # ladder falls through: state stays placed for the old mesh
+            # and the caller's checkpoint restore path is untouched
+            try:
+                avatars = (
+                    self._state_avatar
+                    if self._state_avatar is not None
+                    else jax.tree.map(_avatar_of, state)
+                )
+                shardings = live_reshard.state_shardings(avatars, mesh)
+                new_state, transfer_info = live_reshard.transfer_state(
+                    state, shardings
+                )
+            except Exception as e:
+                logger.warning(
+                    "live reshard %d->%d failed (%s); caller should "
+                    "restore from checkpoint", old_world, mesh.size, e,
+                )
+                new_state = None
         self.mesh = mesh
         self.mesh_config = mesh_config
         self._step_fn = None
         self._eval_fn = None  # its NamedSharding binds the old mesh
+        self._pending_resize = {
+            "from": old_world,
+            "to": mesh.size,
+            "state_transfer_s": (
+                transfer_info["transfer_s"] if transfer_info else 0.0
+            ),
+            "path": (
+                transfer_info["path"] if transfer_info else "checkpoint"
+            ),
+        }
         if self.loss_factory is not None:
             # re-derive the loss for the new mesh (a loss closing over
             # the old mesh would pin its sharding constraints to dead
@@ -764,7 +862,19 @@ class ElasticTrainer:
                 warm = False
         logger.info(
             "remesh: world=%d accum %d→%d (global batch fixed at %d); "
-            "step rebuild will be %s",
+            "step rebuild will be %s; state %s",
             mesh.size, old, self.accum_steps, self.tc.global_batch_size,
             "WARM (AOT executable cached)" if warm else "cold",
+            (
+                f"live-resharded in {transfer_info['transfer_s']:.3f}s "
+                f"({transfer_info['path']})"
+                if transfer_info
+                else "NOT transferred (checkpoint restore path)"
+            ),
         )
+        if new_state is not None:
+            # the transfer already synchronized; re-seeding the host
+            # step counter here keeps report_step monotonic across the
+            # resize without a checkpoint restore to do it
+            self.sync_host_step(new_state)
+        return new_state

@@ -66,7 +66,11 @@ trainer = ElasticTrainer(
 state = trainer.init_state(params)
 
 ckpt = Checkpointer(CKPT_DIR)
+import time as _time
+
+_t_restore = _time.perf_counter()
 restored = ckpt.load(target=state)
+restore_s = _time.perf_counter() - _t_restore
 start_step = 0
 if restored is not None:
     start_step, state = restored
@@ -74,11 +78,18 @@ if restored is not None:
     # master's SpeedMonitor after the resize restart
     trainer.sync_host_step(state)
     print(
-        f"[slice] resumed step {start_step} onto {n_slices}-slice world",
+        f"[slice] resumed step {start_step} onto {n_slices}-slice world "
+        f"(restore {restore_s:.2f}s)",
         flush=True,
     )
+    # restart-based resize: the state moved through the (shard-wise)
+    # checkpoint restore, not a live transfer — report the breakdown so
+    # the master's goodput ledger attributes this downtime. compile_s
+    # is stamped after the first step below.
+    _report_breakdown_after_first_step = True
 else:
     print("[slice] cold start", flush=True)
+    _report_breakdown_after_first_step = False
 
 a, b = trainer.step_batch_shape
 first_loss = None
@@ -93,8 +104,17 @@ for step in range(start_step + 1, TOTAL_STEPS + 1):
         import time
 
         time.sleep(STEP_SLEEP)
+    _t_step = _time.perf_counter()
     state, loss = trainer.step(state, batch)
     loss = float(loss)
+    if _report_breakdown_after_first_step:
+        # first post-restore step: its wall time is compile-dominated
+        # (loss above forced the sync) — the restart-path breakdown
+        _report_breakdown_after_first_step = False
+        ctx.report_resize_breakdown(
+            compile_s=_time.perf_counter() - _t_step,
+            state_transfer_s=restore_s,
+        )
     if first_loss is None:
         first_loss = loss
     # persist EVERY step: a slice can die at any moment and the resized

@@ -1,0 +1,23 @@
+"""Shared capability-gated pytest markers.
+
+One definition site: tests/test_pipeline.py, tests/test_pp_interleaved.py
+and tests/test_comm_attribution.py all gate the same legacy-jax red.
+"""
+
+import pytest
+
+from dlrover_tpu.ops.shard_map_compat import supports_partial_manual
+
+# KNOWN red on legacy jax (0.4.x): the pp schedules map {pp} (and sp)
+# manually and leave dp/fsdp/tp to the partitioner — partial-manual
+# mode. Legacy shard_map's best-effort ``auto=`` translation cannot
+# partition these programs (XLA CHECK-aborts: "PartitionId instruction
+# is not supported for SPMD partitioning"). pp × sp-only combos stay
+# green (full-manual is equivalent there). Capability-gated xfail so a
+# tier-1 red in this family means a REGRESSION again.
+legacy_pp_xfail = pytest.mark.xfail(
+    condition=not supports_partial_manual(),
+    reason="legacy shard_map auto= cannot express partial-manual pp "
+    "(XLA PartitionId CHECK; ops/shard_map_compat.supports_partial_manual)",
+    strict=False,
+)

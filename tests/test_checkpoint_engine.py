@@ -215,6 +215,92 @@ def test_resharded_restore(job_env):
     engine2.close()
 
 
+def test_shm_restore_is_shard_wise(job_env):
+    """A same-world shm restore never assembles a full host array: every
+    leaf is placed by slicing the staged piece for exactly the requested
+    index (engine.last_restore_stats pins the fast path)."""
+    job, ckpt_dir = job_env
+    mesh = _mesh((8,), ("dp",))
+    state = _make_state(mesh)
+    engine = CheckpointEngine(ckpt_dir)
+    engine.save_to_memory(3, state)
+    engine.wait_staging()
+    step, restored = engine.load(target=state)
+    assert step == 3
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.asarray(state["w"])
+    )
+    stats = engine.last_restore_stats
+    assert stats.get("sliced", 0) > 0
+    assert stats.get("region_assembled", 0) == 0
+    assert stats.get("full_assembled", 0) == 0
+    # the restored arrays own their bytes: a later staged save must not
+    # mutate them (the CPU backend zero-copy-aliases host buffers, and
+    # the pieces are read as views into shm)
+    before = np.asarray(restored["w"]).copy()
+    state2 = {
+        "w": jax.device_put(
+            jnp.full((8, 4), 7.0), NamedSharding(mesh, P("dp", None))
+        ),
+        "b": jax.device_put(jnp.zeros(4), NamedSharding(mesh, P())),
+        "step": jnp.array(9),
+    }
+    engine.save_to_memory(4, state2)
+    engine.wait_staging()
+    np.testing.assert_array_equal(np.asarray(restored["w"]), before)
+    engine.close()
+
+
+def test_host_scalar_leaf_restore_owns_its_bytes(job_env):
+    """A target leaf with no shape/dtype (plain python scalar) takes the
+    host-assembly branch — the restored value must be a COPY, not a view
+    into shm that the next staged save overwrites."""
+    job, ckpt_dir = job_env
+    mesh = _mesh((8,), ("dp",))
+    state = {**_make_state(mesh), "epoch": 7}
+    engine = CheckpointEngine(ckpt_dir)
+    engine.save_to_memory(1, state)
+    engine.wait_staging()
+    _, restored = engine.load(target={**state, "epoch": 0})
+    assert int(np.asarray(restored["epoch"])) == 7
+    engine.save_to_memory(2, {**state, "epoch": 99})
+    engine.wait_staging()
+    assert int(np.asarray(restored["epoch"])) == 7  # not 99
+    engine.close()
+
+
+def test_storage_restore_region_assembles_on_world_change(job_env):
+    """A resized-world storage restore whose requested index spans
+    multiple old-world shards assembles just that region (never the
+    full array)."""
+    job, ckpt_dir = job_env
+    mesh1 = _mesh((8,), ("dp",))  # w is split into 8 row-shards
+    state = _make_state(mesh1)
+    engine = CheckpointEngine(ckpt_dir)
+    engine.save_to_storage(6, state)
+    engine.wait_staging()
+    engine._shm.close(unlink=True)
+
+    mesh2 = _mesh((2, 4), ("dp", "tp"))  # 2 row-shards: each spans 4 old
+    target = {
+        "w": jax.device_put(
+            jnp.zeros((8, 4)), NamedSharding(mesh2, P("dp", None))
+        ),
+        "b": jax.device_put(jnp.zeros(4), NamedSharding(mesh2, P())),
+        "step": jnp.array(0),
+    }
+    engine2 = CheckpointEngine(ckpt_dir)
+    step, restored = engine2.load(target=target)
+    assert step == 6
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.arange(32.0).reshape(8, 4)
+    )
+    stats = engine2.last_restore_stats
+    assert stats.get("region_assembled", 0) > 0
+    assert stats.get("full_assembled", 0) == 0
+    engine2.close()
+
+
 def test_checkpointer_facade_and_deletion(job_env):
     job, ckpt_dir = job_env
     mesh = _mesh((8,), ("dp",))

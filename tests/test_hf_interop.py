@@ -53,11 +53,13 @@ def test_hf_model_trains_elastically_on_mesh(gpt2):
     adapter = HFCausalLMAdapter(gpt2)
 
     specs = adapter.param_specs(mesh)
-    flat = jax.tree.leaves_with_path(specs)
+    # tree_util spelling: jax.tree.leaves_with_path only exists on
+    # jax >= 0.4.34's jax.tree namespace in part — 0.4.37 still lacks it
+    flat = jax.tree_util.tree_leaves_with_path(specs)
     sharded = [p for _, p in flat if p != P()]
     assert sharded, "no HF leaf got sharded"
     # every big leaf is sharded over fsdp
-    for path, leaf in jax.tree.leaves_with_path(gpt2.params):
+    for path, leaf in jax.tree_util.tree_leaves_with_path(gpt2.params):
         spec = {str(p): s for p, s in flat}.get(str(path))
         if leaf.size >= MIN_SHARD_SIZE and any(
             d % 2 == 0 for d in leaf.shape

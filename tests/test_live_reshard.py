@@ -1,0 +1,359 @@
+"""Live state resharding (train/live_reshard.py + remesh(state=…)).
+
+The contract under test: an in-process remesh moves the train state
+old-mesh→new-mesh device-to-device and lands BITWISE equal to what the
+checkpoint round-trip (stage to shm, restore placed for the new mesh)
+would have produced — across the reshard parity matrix (grow dp,
+shrink dp, dp↔fsdp trade); the host-gather fallback bridge produces the
+same bytes when direct transfers are refused; and
+DLROVER_TPU_LIVE_RESHARD=0 reproduces today's behavior exactly.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.checkpoint.engine import CheckpointEngine
+from dlrover_tpu.checkpoint.shm_handler import SharedMemoryHandler, shm_name
+from dlrover_tpu.common import flags
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+from dlrover_tpu.parallel.mesh import remesh as remesh_config
+from dlrover_tpu.train import live_reshard as lr
+from dlrover_tpu.train import warm_compile as wc
+from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+CFG = llama.LlamaConfig.tiny()
+SEQ = 16
+GB = 16  # divisible by micro*dp for every world in the matrix
+
+
+def _drain_speculation():
+    """Join in-flight speculative compile threads (this module's
+    trainers, or earlier suites that armed a persistent cache dir): a
+    straggler finishing mid-test would write into cleared ledgers."""
+    for c in list(wc._live_compilers):
+        c._stop.set()
+        c.wait_idle(timeout=120)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch, tmp_path):
+    """Fresh ledgers, no kill-switches from outer env, isolated shm."""
+    job = f"reshard-{int(time.time() * 1000) % 100000}"
+    monkeypatch.setenv(NodeEnv.JOB_NAME, job)
+    monkeypatch.setenv(NodeEnv.NODE_ID, "0")
+    monkeypatch.setenv(NodeEnv.PROCESS_ID, "0")
+    monkeypatch.delenv(flags.LIVE_RESHARD.name, raising=False)
+    monkeypatch.delenv(wc.ENV_KILL_SWITCH, raising=False)
+    monkeypatch.delenv(wc.ENV_CACHE_DIR, raising=False)
+    _drain_speculation()
+    lr.resize_ledger.clear()
+    wc.compile_ledger.clear()
+    yield job
+    _drain_speculation()
+    lr.resize_ledger.clear()
+    wc.compile_ledger.clear()
+    h = SharedMemoryHandler(shm_name(job, 0, 0))
+    if h.attach():
+        h.close(unlink=True)
+
+
+def _factory(cfg):
+    return lambda mesh: (lambda p, t: llama.loss_fn(p, t, cfg, mesh))
+
+
+def _mk(world, *, dp=-1, fsdp=1, tp=1):
+    mc = MeshConfig(dp=dp, fsdp=fsdp, tp=tp).resolve(world)
+    mesh = build_mesh(mc, devices=jax.devices()[:world])
+    return mesh, mc
+
+
+def _make_trainer(mesh, mc):
+    specs = llama.param_specs(CFG)
+    tc = TrainConfig(global_batch_size=GB, micro_batch_size=2,
+                     warmup_steps=0, total_steps=100)
+    tr = ElasticTrainer(None, specs, mesh, mc, tc, loss_factory=_factory(CFG))
+    params = jax.device_put(
+        llama.init_params(CFG, jax.random.key(0)),
+        named_shardings(mesh, specs),
+    )
+    state = tr.init_state(params)
+    a, b = tr.step_batch_shape
+    batch = jax.random.randint(jax.random.key(1), (a, b, SEQ), 0,
+                               CFG.vocab_size)
+    return tr, state, batch
+
+
+def _ckpt_reference(state, avatars, mesh_b, ckpt_dir):
+    """What the checkpoint round-trip would restore for mesh_b: stage
+    ``state`` to shm, load it back placed per the new-world shardings."""
+    target = lr.state_targets(avatars, mesh_b)
+    eng = CheckpointEngine(ckpt_dir)
+    try:
+        eng.save_to_memory(1, state)
+        eng.wait_staging()
+        restored = eng.load(target=target)
+        assert restored is not None, "shm restore unexpectedly fell through"
+        return restored[1]
+    finally:
+        eng.close()
+
+
+def _assert_states_equal(got, ref, mesh_b):
+    got_flat, got_def = jax.tree_util.tree_flatten(got)
+    ref_flat, ref_def = jax.tree_util.tree_flatten(ref)
+    assert got_def == ref_def
+    for g, r in zip(got_flat, ref_flat):
+        assert g.dtype == r.dtype
+        assert g.sharding.mesh.devices.tolist() == \
+            mesh_b.devices.tolist()
+        assert g.sharding == r.sharding
+        # bitwise: compare raw bytes, no float tolerance (reshape(-1)
+        # first: 0-d arrays cannot re-view to a smaller itemsize)
+        gb_ = np.ascontiguousarray(np.asarray(g)).reshape(-1)
+        rb_ = np.ascontiguousarray(np.asarray(r)).reshape(-1)
+        np.testing.assert_array_equal(
+            gb_.view(np.uint8), rb_.view(np.uint8)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Tentpole: reshard parity matrix vs the checkpoint-restore path
+# ---------------------------------------------------------------------------
+
+
+MATRIX = [
+    # (name, world_a kwargs, world_b kwargs)
+    ("shrink_dp", (8, {"fsdp": 1, "tp": 1}), (4, {"fsdp": 1, "tp": 1})),
+    ("grow_dp", (4, {"fsdp": 1, "tp": 1}), (8, {"fsdp": 1, "tp": 1})),
+    ("dp_fsdp_trade", (8, {"fsdp": 2, "tp": 1}), (8, {"fsdp": 4, "tp": 1})),
+]
+
+
+@pytest.mark.parametrize("name,a,b", MATRIX, ids=[m[0] for m in MATRIX])
+def test_live_reshard_matches_checkpoint_restore(name, a, b, tmp_path):
+    (wa, kwa), (wb, kwb) = a, b
+    mesh_a, mc_a = _mk(wa, **kwa)
+    tr, state, batch = _make_trainer(mesh_a, mc_a)
+    # one real optimizer step so adam moments are non-trivial bytes
+    state, _ = tr.step(state, batch)
+    jax.block_until_ready(state)
+
+    mesh_b, mc_b = _mk(wb, **kwb)
+    ref = _ckpt_reference(state, tr._state_avatar, mesh_b,
+                          str(tmp_path / "ckpt"))
+
+    new_state = tr.remesh(mesh_b, mc_b, state=state)
+    assert new_state is not None
+    _assert_states_equal(new_state, ref, mesh_b)
+
+    # the transferred state steps on the new mesh (the post-resize
+    # first step accepts it without resharding on entry)
+    a2, b2 = tr.step_batch_shape
+    batch_b = jax.random.randint(jax.random.key(2), (a2, b2, SEQ), 0,
+                                 CFG.vocab_size)
+    next_state, loss = tr.step(new_state, batch_b)
+    assert np.isfinite(float(loss))
+
+    # the resize event carries the breakdown
+    ev = lr.resize_ledger.last()
+    assert ev is not None
+    assert ev["world_from"] == wa and ev["world_to"] == wb
+    assert ev["state_transfer_s"] > 0
+    assert ev["path"] in ("direct", "leafwise", "bridge")
+
+
+def test_live_reshard_training_continuity(tmp_path):
+    """Stepping from the live-resharded state equals stepping from the
+    checkpoint-restored state: identical loss on the new world."""
+    mesh_a, mc_a = _mk(8)
+    tr, state, batch = _make_trainer(mesh_a, mc_a)
+    state, _ = tr.step(state, batch)
+    jax.block_until_ready(state)
+
+    mesh_b, mc_b = _mk(4)
+    ref = _ckpt_reference(state, tr._state_avatar, mesh_b,
+                          str(tmp_path / "ckpt"))
+    new_state = tr.remesh(mesh_b, mc_b, state=state)
+    a2, b2 = tr.step_batch_shape
+    batch_b = jax.random.randint(jax.random.key(3), (a2, b2, SEQ), 0,
+                                 CFG.vocab_size)
+    _, loss_live = tr.step(new_state, batch_b)
+    loss_live = float(loss_live)
+
+    tr2 = ElasticTrainer(None, llama.param_specs(CFG), mesh_b, mc_b,
+                         tr.tc, loss_factory=_factory(CFG))
+    _, loss_ckpt = tr2.step(ref, batch_b)
+    assert loss_live == pytest.approx(float(loss_ckpt), rel=0, abs=0)
+
+
+# ---------------------------------------------------------------------------
+# Kill-switch and fallback ladder
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_restores_old_behavior(monkeypatch):
+    """DLROVER_TPU_LIVE_RESHARD=0: remesh(state=…) returns None (caller
+    restores via checkpoint, exactly today's path) and no live event is
+    recorded."""
+    monkeypatch.setenv(flags.LIVE_RESHARD.name, "0")
+    mesh_a, mc_a = _mk(8)
+    tr, state, batch = _make_trainer(mesh_a, mc_a)
+    state, _ = tr.step(state, batch)
+    mesh_b, mc_b = _mk(4)
+    assert tr.remesh(mesh_b, mc_b, state=state) is None
+    assert tr.mesh is mesh_b  # the remesh itself still happened
+    ev = lr.resize_ledger.last()
+    # pending event closes at the next step build with the checkpoint path
+    assert ev is None or ev["path"] == "checkpoint"
+
+
+def test_remesh_without_state_unchanged():
+    """The historical remesh(mesh, cfg) signature is untouched: returns
+    None, trainer adopts the mesh, step rebuilds."""
+    mesh_a, mc_a = _mk(8)
+    tr, state, batch = _make_trainer(mesh_a, mc_a)
+    mesh_b, mc_b = _mk(4)
+    assert tr.remesh(mesh_b, mc_b) is None
+    assert tr.mesh is mesh_b and tr._step_fn is None
+
+
+def test_fallback_bridge_bitwise_parity(monkeypatch, tmp_path):
+    """When the runtime refuses both the batched and the per-leaf direct
+    transfer, the host-gather bridge still lands bitwise-identical
+    state (and reports the path it took)."""
+    mesh_a, mc_a = _mk(8)
+    tr, state, batch = _make_trainer(mesh_a, mc_a)
+    state, _ = tr.step(state, batch)
+    jax.block_until_ready(state)
+    mesh_b, mc_b = _mk(4)
+    ref = _ckpt_reference(state, tr._state_avatar, mesh_b,
+                          str(tmp_path / "ckpt"))
+
+    real_device_put = jax.device_put
+
+    def refusing_device_put(x, device=None, **kw):
+        if len(jax.tree_util.tree_leaves(x)) > 1:
+            raise RuntimeError("forced: batched transfer unsupported")
+        if getattr(x, "ndim", 0) >= 2:
+            raise RuntimeError("forced: direct leaf transfer unsupported")
+        return real_device_put(x, device, **kw)
+
+    monkeypatch.setattr(jax, "device_put", refusing_device_put)
+    shardings = lr.state_shardings(tr._state_avatar, mesh_b)
+    new_state, info = lr.transfer_state(state, shardings)
+    monkeypatch.undo()
+
+    assert info["path"] == "bridge"
+    assert info["leaves_bridged"] > 0
+    _assert_states_equal(new_state, ref, mesh_b)
+
+
+def test_unaddressable_leaf_raises_live_reshard_error(monkeypatch):
+    """A leaf the bridge cannot gather (not fully addressable) fails the
+    transfer loudly so callers fall back to the checkpoint path."""
+    mesh_a, mc_a = _mk(8)
+    mesh_b, mc_b = _mk(4)
+    x = jax.device_put(
+        jnp.arange(16.0).reshape(8, 2),
+        jax.sharding.NamedSharding(mesh_a, jax.sharding.PartitionSpec("dp")),
+    )
+
+    class FakeLeaf:
+        shape = x.shape
+        dtype = x.dtype
+        ndim = x.ndim
+        is_fully_addressable = False
+
+    sh = jax.sharding.NamedSharding(
+        mesh_b, jax.sharding.PartitionSpec("dp")
+    )
+    real_device_put = jax.device_put
+
+    def refusing_device_put(y, device=None, **kw):
+        raise RuntimeError("forced")
+
+    monkeypatch.setattr(jax, "device_put", refusing_device_put)
+    with pytest.raises(lr.LiveReshardError):
+        lr.transfer_state({"w": FakeLeaf()}, {"w": sh})
+    monkeypatch.setattr(jax, "device_put", real_device_put)
+
+
+def test_remesh_falls_back_to_none_when_ladder_exhausted(monkeypatch):
+    """remesh(state=…) swallows a full ladder failure and returns None —
+    training falls back to the checkpoint restore instead of dying."""
+    mesh_a, mc_a = _mk(8)
+    tr, state, batch = _make_trainer(mesh_a, mc_a)
+    state, _ = tr.step(state, batch)
+    mesh_b, mc_b = _mk(4)
+
+    def exploding_transfer(*a, **kw):
+        raise lr.LiveReshardError("forced")
+
+    monkeypatch.setattr(lr, "transfer_state", exploding_transfer)
+    assert tr.remesh(mesh_b, mc_b, state=state) is None
+    assert tr.mesh is mesh_b  # mesh adoption is not rolled back
+
+
+# ---------------------------------------------------------------------------
+# Ledger + metrics surface
+# ---------------------------------------------------------------------------
+
+
+def test_resize_ledger_prometheus_lines():
+    led = lr.ResizeLedger()
+    # empty ledger exports only TYPE headers, no value rows
+    assert all(l.startswith("# TYPE") for l in led.prometheus_lines())
+    led.record(8, 4, rendezvous_s=0.5, compile_s=1.25,
+               state_transfer_s=0.03, path="direct")
+    led.record(4, 8, compile_s=0.0, state_transfer_s=0.05, path="direct")
+    text = "\n".join(led.prometheus_lines())
+    assert 'dlrover_tpu_resize_seconds{phase="state_transfer"' in text
+    assert 'world_from="4"' in text and 'world_to="8"' in text
+    assert 'dlrover_tpu_resize_seconds_total{phase="compile"} 1.25' in text
+    assert "dlrover_tpu_resize_events 2" in text
+
+
+def test_metrics_endpoint_serves_resize_gauges():
+    """The worker /metrics endpoint carries the resize breakdown rows
+    next to the comm and compile gauges."""
+    import urllib.request
+
+    from dlrover_tpu.profiler import comm
+
+    lr.resize_ledger.record(8, 4, compile_s=0.1, state_transfer_s=0.02,
+                            path="direct")
+    srv, port = comm.start_metrics_server(0)
+    try:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+    finally:
+        comm.stop_metrics_server()
+    assert 'dlrover_tpu_resize_seconds{phase="state_transfer"' in text
+
+
+def test_step_finalizes_resize_event_with_compile_seconds():
+    """The pending resize opened by remesh() closes at the first step
+    build, stamping compile seconds next to the transfer seconds."""
+    mesh_a, mc_a = _mk(8)
+    tr, state, batch = _make_trainer(mesh_a, mc_a)
+    state, _ = tr.step(state, batch)
+    mesh_b, mc_b = _mk(4)
+    new_state = tr.remesh(mesh_b, mc_b, state=state)
+    assert lr.resize_ledger.last() is None  # not recorded until the build
+    a2, b2 = tr.step_batch_shape
+    batch_b = jax.random.randint(jax.random.key(2), (a2, b2, SEQ), 0,
+                                 CFG.vocab_size)
+    tr.step(new_state, batch_b)
+    ev = lr.resize_ledger.last()
+    assert ev is not None
+    assert ev["compile_s"] >= 0.0
+    assert ev["state_transfer_s"] > 0.0
+    assert ev["path"] == "direct"
