@@ -232,6 +232,25 @@ def _run_mfu(jax, jnp, llama, cfg, micro_batch: int, seq: int, steps: int):
     return trainer, state, batch, dt
 
 
+def _comm_census(trainer) -> dict:
+    """SC001 collective census of the live step program
+    (lint/shardcheck): op counts + total bytes per mesh axis, recorded
+    into the phase detail so the perf trajectory carries a comms
+    fingerprint alongside wall time — a BENCH round whose MFU moved can
+    be read against whether (and where) the program's communication
+    moved with it. Cheap by construction: ``lower_step`` is a warm
+    cache hit for a trainer that already stepped. Never fails a bench
+    phase over a fingerprint."""
+    try:
+        from dlrover_tpu.lint import shardcheck
+
+        compiled, _ = trainer.lower_step(trainer.mesh, trainer.mesh_config)
+        coords = shardcheck.MeshCoords(dict(trainer.mesh.shape))
+        return shardcheck.collective_census(compiled.as_text(), coords)
+    except Exception as e:  # telemetry only
+        return {"error": str(e)[:200]}
+
+
 LAST_TPU_RESULT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
 )
@@ -497,6 +516,9 @@ def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
         warm_s, warm_loss = resize_downtime(tr2)
         if abs(cold_loss - warm_loss) > 1e-3:
             out["loss_mismatch"] = [cold_loss, warm_loss]
+        # comms fingerprint of the POST-RESIZE program (tr2 now lives on
+        # the target mesh): the half the mfu-phase census cannot see
+        out["collective_census"] = _comm_census(tr2)
         out.update({
             "cold_downtime_s": round(cold_s, 4),
             "warm_downtime_s": round(warm_s, 4),
@@ -690,6 +712,9 @@ def main():
             for r, n, _, _, _, t in results
         ],
         "phases_done": ["mfu"] if "mfu" in phases else [],
+        # ckpt/interposer re-measure THIS program, so one census covers
+        # the three same-program phases; resize records its own below
+        "collective_census": _comm_census(trainer),
     }
     result = {
         "metric": "train_step_mfu",

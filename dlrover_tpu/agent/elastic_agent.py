@@ -31,6 +31,7 @@ from dlrover_tpu.agent.rendezvous import (
     MasterRendezvousHandler,
     RendezvousTimeoutError,
 )
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.constants import (
     DefaultValues,
     NodeEnv,
@@ -78,15 +79,9 @@ class ElasticAgent:
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._current_world: Optional[CommWorld] = None
         self._ckpt_saver = None  # wired by the flash-checkpoint layer
-        try:
-            diag_interval = float(
-                os.environ.get("DLROVER_TPU_DIAG_INTERVAL", "60") or 60
-            )
-        except ValueError:
-            logger.warning(
-                "DLROVER_TPU_DIAG_INTERVAL is not numeric; using 60s"
-            )
-            diag_interval = 60.0
+        # non-numeric values warn once and fall back to the default
+        # inside the typed registry (common/flags.py)
+        diag_interval = float(flags.DIAG_INTERVAL.get())
         self._diagnosis = DiagnosisAgent(
             client=self._client, node_id=config.node_id,
             interval_secs=max(diag_interval, 1.0),
@@ -97,7 +92,7 @@ class ElasticAgent:
         # external accelerator exporters (GKE TPU metrics agent etc.):
         # comma-separated host:port/path endpoints
         self._metric_monitor = None
-        endpoints = os.environ.get("DLROVER_TPU_METRIC_ENDPOINTS", "")
+        endpoints = flags.METRIC_ENDPOINTS.get()
         if endpoints:
             from dlrover_tpu.common.metric import TpuMetricMonitor
 

@@ -6,11 +6,11 @@ agent or a worker issues goes through here.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.constants import NodeEnv, NodeType, RendezvousName
 from dlrover_tpu.common.log import logger
@@ -33,8 +33,8 @@ class MasterClient:
     def singleton_instance(cls) -> "MasterClient":
         with cls._instance_lock:
             if cls._instance is None:
-                addr = os.environ.get(NodeEnv.MASTER_ADDR, "")
-                node_id = int(os.environ.get(NodeEnv.NODE_ID, "0"))
+                addr = flags.MASTER_ADDR.get()
+                node_id = int(flags.NODE_ID.get())
                 if not addr:
                     raise RuntimeError(
                         f"{NodeEnv.MASTER_ADDR} not set; no master to talk to"
@@ -316,8 +316,8 @@ class MasterClient:
 def build_master_client(
     master_addr: str = "", node_id: Optional[int] = None
 ) -> MasterClient:
-    addr = master_addr or os.environ.get(NodeEnv.MASTER_ADDR, "")
-    nid = node_id if node_id is not None else int(os.environ.get(NodeEnv.NODE_ID, "0"))
+    addr = master_addr or flags.MASTER_ADDR.get()
+    nid = node_id if node_id is not None else int(flags.NODE_ID.get())
     client = MasterClient(addr, nid)
     MasterClient.reset_singleton(client)
     return client

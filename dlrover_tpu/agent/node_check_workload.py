@@ -13,19 +13,20 @@ set ``DLROVER_TPU_MOCK_ERR_NODE`` to this node's id to force a failure, or
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 
+from dlrover_tpu.common import flags
+
 
 def main() -> int:
-    node_id = int(os.environ.get("DLROVER_TPU_NODE_ID", "0"))
-    out_file = os.environ.get("DLROVER_TPU_CHECK_OUT", "")
-    matmul_size = int(os.environ.get("DLROVER_TPU_CHECK_MATMUL_SIZE", "1024"))
-    matmul_iters = int(os.environ.get("DLROVER_TPU_CHECK_MATMUL_ITERS", "50"))
-    psum_bytes = int(os.environ.get("DLROVER_TPU_CHECK_PSUM_BYTES", str(1 << 22)))
+    node_id = int(flags.NODE_ID.get())
+    out_file = flags.CHECK_OUT.get()
+    matmul_size = int(flags.CHECK_MATMUL_SIZE.get())
+    matmul_iters = int(flags.CHECK_MATMUL_ITERS.get())
+    psum_bytes = int(flags.CHECK_PSUM_BYTES.get())
 
-    if os.environ.get("DLROVER_TPU_MOCK_ERR_NODE", "") == str(node_id):
+    if flags.MOCK_ERR_NODE.get() == str(node_id):
         print(f"node {node_id}: injected check failure", flush=True)
         return 1
 
@@ -74,9 +75,9 @@ def main() -> int:
 
     elapsed = time.time() - start
 
-    slow_node = os.environ.get("DLROVER_TPU_MOCK_SLOW_NODE", "")
+    slow_node = flags.MOCK_SLOW_NODE.get()
     if slow_node == str(node_id):
-        time.sleep(float(os.environ.get("DLROVER_TPU_MOCK_SLOW_SECS", "5")))
+        time.sleep(float(flags.MOCK_SLOW_SECS.get()))
         elapsed = time.time() - start
 
     if out_file:

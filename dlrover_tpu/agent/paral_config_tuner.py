@@ -15,10 +15,14 @@ import os
 import threading
 from typing import Optional
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
 
 #: workers find the config file through this env var (set by the agent)
-PARAL_CONFIG_PATH_ENV = "DLROVER_TPU_PARAL_CONFIG_PATH"
+# derives from the typed registry (the env contract's single owner):
+# elastic_agent WRITES this name into worker envs, read_paral_config
+# reads it back through flags.PARAL_CONFIG_PATH — same flag object
+PARAL_CONFIG_PATH_ENV = flags.PARAL_CONFIG_PATH.name
 
 
 def default_config_path(job_name: str, node_id: int) -> str:
@@ -84,7 +88,7 @@ class ParalConfigTuner:
 
 def read_paral_config(path: str = "") -> dict:
     """Worker-side: read the tuner file (empty dict when absent/unset)."""
-    path = path or os.environ.get(PARAL_CONFIG_PATH_ENV, "")
+    path = path or flags.PARAL_CONFIG_PATH.get()
     if not path or not os.path.exists(path):
         return {}
     try:

@@ -191,16 +191,16 @@ class CheckpointEngine:
             default_cache_under(ckpt_dir)
         except Exception:
             pass  # cache is an optimization, never a ckpt failure
-        self.job_name = job_name or os.environ.get(NodeEnv.JOB_NAME, "local")
+        self.job_name = job_name or flags.JOB_NAME.get()
         self.node_id = (
             node_id
             if node_id is not None
-            else int(os.environ.get(NodeEnv.NODE_ID, "0"))
+            else int(flags.NODE_ID.get())
         )
         self.process_id = (
             process_id
             if process_id is not None
-            else int(os.environ.get(NodeEnv.PROCESS_ID, "0"))
+            else int(flags.PROCESS_ID.get())
         )
         self._storage = storage or PosixDiskStorage()
         self._shm = SharedMemoryHandler(

@@ -28,6 +28,7 @@ import dataclasses
 import os
 from typing import Any, Dict, List, Optional
 
+from dlrover_tpu.common import constants
 from dlrover_tpu.common.log import logger
 
 
@@ -162,9 +163,115 @@ REPLICA_MAX_BYTES = _define(
     "DLROVER_TPU_REPLICA_MAX_BYTES", 64 << 30, "int",
     "Replica server per-payload size bound (memory-DoS refusal).",
 )
+SHARDCHECK = _define(
+    "DLROVER_TPU_SHARDCHECK", 0, "int",
+    "IR-level step-program analysis at lower time (lint/shardcheck.py):"
+    " 0 off, 1 warn on violations, 2 strict (reject the build). Runs "
+    "on every lowering, including speculative neighbor worlds.",
+)
+SHARDCHECK_CONTRACTS = _define(
+    "DLROVER_TPU_SHARDCHECK_CONTRACTS", "", "str",
+    "Directory of SC001 collective-census contracts for the lower-time "
+    "hook (default: the checked-in dlrover_tpu/lint/contracts).",
+)
 RETRACE_GUARD = _define(
     "DLROVER_TPU_RETRACE_GUARD", 0, "int",
     "Silent-recompile guard (lint/retrace_guard.py): 0 off, 1 on with "
     "defaults, N>=2 on with max N distinct compile signatures per "
     "jitted function.",
+)
+
+# -- agent/master wiring (NodeEnv names; injected by the agent/launcher)
+
+NODE_ID = _define(
+    constants.NodeEnv.NODE_ID, 0, "int",
+    "This worker's node id (agent-injected; node-check workloads and "
+    "the master client identify themselves with it).",
+)
+PROCESS_ID = _define(
+    constants.NodeEnv.PROCESS_ID, 0, "int",
+    "This worker's process index within its node (agent-injected).",
+)
+MASTER_ADDR = _define(
+    constants.NodeEnv.MASTER_ADDR, "", "str",
+    "host:port of the job master's gRPC endpoint (agent-injected).",
+)
+JOB_NAME = _define(
+    constants.NodeEnv.JOB_NAME, "local", "str",
+    "Job name — keys the shm segments and the master's state backend.",
+)
+NODE_IP = _define(
+    "DLROVER_TPU_NODE_IP", "", "str",
+    "Override for this node's advertised IP (utils/net.py discovery).",
+)
+BRAIN_ADDR = _define(
+    "DLROVER_TPU_BRAIN_ADDR", "", "str",
+    "host:port of the brain optimizer service; empty = local heuristics.",
+)
+DIAG_INTERVAL = _define(
+    "DLROVER_TPU_DIAG_INTERVAL", 60.0, "float",
+    "Seconds between agent diagnosis collections.",
+)
+METRIC_ENDPOINTS = _define(
+    "DLROVER_TPU_METRIC_ENDPOINTS", "", "str",
+    "Comma-separated worker /metrics endpoints the agent scrapes.",
+)
+PARAL_CONFIG_PATH = _define(
+    "DLROVER_TPU_PARAL_CONFIG_PATH", "", "str",
+    "Path of the master-pushed runtime parallel-config JSON file.",
+)
+STATE_BACKEND = _define(
+    "DLROVER_TPU_STATE_BACKEND", "", "str",
+    "Master state backend kind (memory | file | configmap); empty "
+    "picks the platform default.",
+)
+STATE_DIR = _define(
+    "DLROVER_TPU_STATE_DIR", "", "str",
+    "Root directory of the file state backend (master relaunch state).",
+)
+K8S_INSECURE_TLS = _define(
+    "DLROVER_TPU_K8S_INSECURE_TLS", "", "str",
+    "Exactly '1' disables TLS verification toward the k8s apiserver "
+    "(dev clusters with self-signed certs only).",
+)
+PEAK_TFLOPS = _define(
+    "DLROVER_TPU_PEAK_TFLOPS", 0.0, "float",
+    "Override for the accelerator's peak TFLOPs in MFU accounting "
+    "(0 = use the built-in per-chip table).",
+)
+ACCELERATOR = _define(
+    "DLROVER_TPU_ACCELERATOR", "", "str",
+    "Override for the accelerator kind the profiler assumes "
+    "(tpu | gpu | cpu; empty = autodetect).",
+)
+
+# -- node-check workload knobs (agent/node_check_workload.py)
+
+CHECK_OUT = _define(
+    "DLROVER_TPU_CHECK_OUT", "", "str",
+    "File the node-check workload writes its result JSON to.",
+)
+CHECK_MATMUL_SIZE = _define(
+    "DLROVER_TPU_CHECK_MATMUL_SIZE", 1024, "int",
+    "Square matmul dimension of the node-check compute probe.",
+)
+CHECK_MATMUL_ITERS = _define(
+    "DLROVER_TPU_CHECK_MATMUL_ITERS", 50, "int",
+    "Iterations of the node-check compute probe.",
+)
+CHECK_PSUM_BYTES = _define(
+    "DLROVER_TPU_CHECK_PSUM_BYTES", 1 << 22, "int",
+    "Payload bytes of the node-check collective probe.",
+)
+MOCK_ERR_NODE = _define(
+    "DLROVER_TPU_MOCK_ERR_NODE", "", "str",
+    "Chaos hook: node id whose check should fail (tests).",
+)
+MOCK_SLOW_NODE = _define(
+    "DLROVER_TPU_MOCK_SLOW_NODE", "", "str",
+    "Chaos hook: node id whose check should straggle (tests).",
+)
+MOCK_SLOW_SECS = _define(
+    "DLROVER_TPU_MOCK_SLOW_SECS", 5.0, "float",
+    "Chaos hook: seconds the mock-slow node sleeps.",
 )

@@ -1,11 +1,19 @@
-"""graftlint CLI.
+"""graftlint + shardcheck CLI.
 
-    python -m dlrover_tpu.lint [options] paths...
+    python -m dlrover_tpu.lint [options] paths...       # AST rules
+    python -m dlrover_tpu.lint --hlo dp4 [--hlo ...]    # IR rules
 
-Exit codes: 0 clean (against the baseline), 1 new violations or
-unparsable files, 2 usage error. ``--fix-baseline`` rewrites the
-baseline to exactly the current violation set (use after deliberate
-grandfathering, never to silence a new violation you should fix).
+Exit codes: 0 clean (against the baseline / contracts), 1 new
+violations, unparsable files, or missing contracts, 2 usage error.
+``--fix-baseline`` rewrites the AST baseline; ``--fix-contracts``
+regenerates the SC001 collective-census contracts for the given mesh
+specs (both: use after deliberate grandfathering, never to silence a
+new violation you should fix).
+
+The ``--hlo`` path lowers the pinned contract model (see
+lint/contract_model.py) on virtual CPU devices — no TPU, no live
+training process — and runs the SC rules over the lowered StableHLO +
+optimized HLO text.
 """
 
 from __future__ import annotations
@@ -13,7 +21,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from dlrover_tpu.lint import engine
+from dlrover_tpu.lint import engine, shardcheck
 from dlrover_tpu.lint.rules import ALL_RULES, rule_catalog
 
 
@@ -49,12 +57,55 @@ def main(argv=None) -> int:
     p.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    p.add_argument(
+        "--hlo",
+        action="append",
+        default=None,
+        metavar="MESHSPEC",
+        help="IR mode: lower the contract model for this mesh spec "
+        "(e.g. dp4, dp2xfsdp2, sp2xdp2; repeatable) and run the SC "
+        "rules over the lowered program",
+    )
+    p.add_argument(
+        "--contracts",
+        default=shardcheck.DEFAULT_CONTRACTS_DIR,
+        help="SC001 contract directory (default: the checked-in "
+        "dlrover_tpu/lint/contracts)",
+    )
+    p.add_argument(
+        "--fix-contracts",
+        action="store_true",
+        help="regenerate the contracts for the given --hlo mesh specs",
+    )
+    p.add_argument(
+        "--byte-tolerance",
+        type=float,
+        default=shardcheck.DEFAULT_BYTE_TOLERANCE,
+        help="SC001: allowed fractional byte growth per collective cell "
+        f"(default {shardcheck.DEFAULT_BYTE_TOLERANCE})",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
         for rid, name, doc in rule_catalog():
             print(f"{rid}  {name:28s} {doc}")
+        for rid, name, doc in shardcheck.SC_RULES:
+            print(f"{rid}  {name:28s} {doc}")
         return 0
+    if args.hlo:
+        if args.paths or args.fix_baseline or args.no_baseline or args.rule:
+            print(
+                "error: --hlo (IR mode) cannot be combined with paths, "
+                "--fix-baseline, --no-baseline or --rule (AST mode) — "
+                "run them as separate invocations",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_hlo(args)
+    if args.fix_contracts:
+        print("error: --fix-contracts needs --hlo MESHSPEC",
+              file=sys.stderr)
+        return 2
     if not args.paths:
         p.print_usage(sys.stderr)
         print("error: no paths given", file=sys.stderr)
@@ -101,6 +152,97 @@ def main(argv=None) -> int:
                             rules=rules)
     engine.report(result)
     return 1 if result.failed else 0
+
+
+def _run_hlo(args) -> int:
+    """IR mode: one contract-model lowering per mesh spec."""
+    from dlrover_tpu.lint import contract_model
+
+    specs = []
+    for raw in args.hlo:
+        try:
+            axis_sizes = shardcheck.parse_mesh_spec(raw)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        specs.append(shardcheck.mesh_spec_of(axis_sizes))
+
+    # every spec shares one jax process: size the virtual CPU device
+    # pool to the largest world before anything touches jax
+    worlds = []
+    for spec in specs:
+        w = 1
+        for s in shardcheck.parse_mesh_spec(spec).values():
+            w *= s
+        worlds.append(w)
+    contract_model.ensure_cpu_devices(max(worlds))
+
+    failed = False
+    for spec in specs:
+        try:
+            program, _ = contract_model.build_program(spec)
+        except Exception as e:
+            print(f"{spec}: lowering failed: {e}", file=sys.stderr)
+            failed = True
+            continue
+        if args.fix_contracts:
+            import jax
+
+            data = shardcheck.write_contract(
+                args.contracts, spec, program,
+                extra={
+                    "jax_version": jax.__version__,
+                    "seq_len": contract_model.SEQ_LEN,
+                    "vocab": contract_model.VOCAB,
+                },
+            )
+            print(
+                f"shardcheck: contract {spec} rewritten "
+                f"({len(data['census'])} collective cell(s), "
+                f"world={program.world})"
+            )
+            continue
+        try:
+            contract = shardcheck.load_contract(args.contracts, spec)
+        except ValueError as e:
+            print(f"{spec}: {e}", file=sys.stderr)
+            failed = True
+            continue
+        if contract is None:
+            print(
+                f"{spec}: no contract at "
+                f"{shardcheck.contract_path(args.contracts, spec)} — "
+                "generate one with --fix-contracts",
+                file=sys.stderr,
+            )
+            failed = True
+            continue
+        census = shardcheck.collective_census(
+            program.hlo, program.coords()
+        )
+        violations = shardcheck.check_program(
+            program, contract, byte_tolerance=args.byte_tolerance,
+            census=census,
+        )
+        for v in violations:
+            print(v.format())
+        better = shardcheck.census_improvements(census, contract)
+        if better:
+            print(
+                f"note: {spec} communicates less than its contract "
+                f"({len(better)} cell(s) improved — run --fix-contracts "
+                "to bank it):"
+            )
+            for line in better:
+                print(f"  {line}")
+        status = "FAIL" if violations else "ok"
+        print(
+            f"shardcheck: {spec} {status} ({len(violations)} violation(s),"
+            f" {sum(c['count'] for c in census.values())} collectives over"
+            f" {len(census)} cell(s))"
+        )
+        failed = failed or bool(violations)
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,128 @@
+"""The shardcheck contract model: one fixed tiny program per mesh.
+
+SC001 diffs the step program's collective census against a checked-in
+contract, which only means something if every generation of the
+contract lowers the *same* program. This module pins that program: a
+tiny llama (vocab 256, dim 64, 2 layers) with an explicitly small CE
+chunk (64 < vocab — the default 2048 clips to the full tiny vocab,
+which would make the chunked path materialize seq×vocab tensors and
+trip its own SC003 gate), a fixed sequence length and global batch,
+lowered through the exact ``ElasticTrainer`` machinery production uses
+(``step_ir`` → ``lower_step`` avatars). Everything runs on CPU host
+devices — contract generation and CI checking never touch a TPU.
+
+Imports jax lazily: :mod:`dlrover_tpu.lint` must stay importable in
+the dep-free graftlint environment, and the ``--hlo`` CLI needs to
+force the CPU platform *before* jax initializes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+from dlrover_tpu.lint import shardcheck
+
+#: the pinned contract-program knobs — changing any of these re-keys
+#: every contract (config_hash mismatch), which is exactly the signal
+#: to regenerate with --fix-contracts
+SEQ_LEN = 16
+GLOBAL_BATCH = 8
+MICRO_BATCH = 2
+CE_CHUNK = 64
+VOCAB = 256
+
+
+def ensure_cpu_devices(n: int) -> None:
+    """Force the CPU platform with ≥ ``n`` virtual host devices. Must
+    run before jax initializes its backend (mirrors tests/conftest.py,
+    including the jax.config override that beats any sitecustomize
+    meddling with JAX_PLATFORMS)."""
+    # jax platform wiring, not DLROVER_TPU_* knobs: these two env vars
+    # must be written before jax initializes, same as tests/conftest.py
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # graftlint: disable=JG003
+    xla_flags = os.environ.get("XLA_FLAGS", "")  # graftlint: disable=JG003
+    if "--xla_force_host_platform_device_count" not in xla_flags:
+        # graftlint: disable=JG003
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + f" --xla_force_host_platform_device_count={max(n, 8)}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    have = len(jax.devices())
+    if have < n:
+        raise RuntimeError(
+            f"mesh needs {n} devices but jax initialized with {have} "
+            "(jax imported before the device-count flag could be set? "
+            "run the CLI in a fresh process)"
+        )
+
+
+def build_contract_trainer(axis_sizes: Dict[str, int]):
+    """(trainer, state, batch) for the pinned contract model on the
+    mesh ``axis_sizes`` describes, placed on CPU host devices."""
+    import jax
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel import build_mesh, named_shardings
+    from dlrover_tpu.parallel.mesh import MeshConfig
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    world = 1
+    for s in axis_sizes.values():
+        world *= s
+    cfg = llama.LlamaConfig.tiny(
+        vocab_size=VOCAB, ce_chunk_size=CE_CHUNK
+    )
+    mc = MeshConfig(
+        dp=axis_sizes.get("dp", 1),
+        pp=axis_sizes.get("pp", 1),
+        fsdp=axis_sizes.get("fsdp", 1),
+        ep=axis_sizes.get("ep", 1),
+        sp=axis_sizes.get("sp", 1),
+        tp=axis_sizes.get("tp", 1),
+    ).resolve(world)
+    mesh = build_mesh(mc, devices=jax.devices()[:world])
+    specs = llama.param_specs(cfg)
+    tc = TrainConfig(
+        global_batch_size=GLOBAL_BATCH,
+        micro_batch_size=MICRO_BATCH,
+        warmup_steps=0,
+        total_steps=100,
+    )
+    trainer = ElasticTrainer(
+        None, specs, mesh, mc, tc,
+        loss_factory=lambda m: (
+            lambda p, t: llama.loss_fn(p, t, cfg, m)
+        ),
+    )
+    trainer.shardcheck_hints = {
+        "seq_len": SEQ_LEN, "vocab": cfg.vocab_size,
+    }
+    params = jax.device_put(
+        llama.init_params(cfg, jax.random.key(0)),
+        named_shardings(mesh, specs),
+    )
+    state = trainer.init_state(params)
+    accum, per = trainer.step_batch_shape
+    batch = np.zeros((accum, per, SEQ_LEN), np.int32)
+    trainer.record_avatars(state, batch)
+    return trainer, state, batch
+
+
+def build_program(
+    spec: str, pinned: bool = True
+) -> Tuple["shardcheck.StepProgram", object]:
+    """Lower the contract model for ``spec`` (e.g. ``"dp2xfsdp2"``)
+    and return ``(StepProgram, trainer)``."""
+    axis_sizes = shardcheck.parse_mesh_spec(spec)
+    world = 1
+    for s in axis_sizes.values():
+        world *= s
+    ensure_cpu_devices(world)
+    trainer, _, _ = build_contract_trainer(axis_sizes)
+    program = trainer.step_ir(pinned=pinned)
+    program.label = f"hlo:{shardcheck.mesh_spec_of(axis_sizes)}"
+    return program, trainer

@@ -699,6 +699,179 @@ class UnguardedSharedMutationRule:
                             )
 
 
+# ---------------------------------------------------------------------------
+# JG007 — zero-copy aliasing of live host buffers into jax arrays
+# ---------------------------------------------------------------------------
+
+
+class ZeroCopyAliasRule:
+    """JG007: ``jax.device_put`` / ``jax.make_array_from_callback`` fed
+    from a live host buffer view without an explicit copy.
+
+    The PR 4 CPU-backend aliased-restore bug: the CPU backend zero-copy
+    aliases host numpy buffers into jax arrays, so a restore placed
+    from shm VIEWS (``np.frombuffer`` over the segment) was silently
+    overwritten by the next staged save — the fix is the explicit
+    ``np.array(..., copy=True)`` in ``_slice_pieces``. Same species:
+    ``device_put`` of a ``memoryview``/``.buf``-backed array, and
+    placement callbacks returning uncopied slices of a ``device_get``
+    result (``device_get`` may itself return a view of the source
+    array's buffer on CPU).
+
+    Detection: same-function def-use chains. A name is *view-evidenced*
+    when assigned from ``np.frombuffer(...)``, ``memoryview(...)``, a
+    ``.buf`` attribute, or ``jax.device_get(...)`` — or from a
+    pass-through of one (``np.asarray`` / ``np.ascontiguousarray`` /
+    ``.reshape()`` / subscripts, none of which guarantee a copy;
+    ``np.ascontiguousarray`` returns the SAME buffer when the input is
+    already contiguous, which is exactly the trap). Copy wrappers that
+    launder the taint: ``np.array`` (without ``copy=False``),
+    ``np.copy``, ``.copy()``, ``.astype()``. Flagged sites: the first
+    argument of ``device_put``, and a callback handed to
+    ``make_array_from_callback`` whose body yields view-evidenced data
+    uncopied. An intentional alias (a dying buffer handed off to
+    exactly one consumer) takes a suppression with its justification.
+    """
+
+    id = "JG007"
+    name = "zero-copy-aliasing"
+
+    VIEW_SOURCES = {"frombuffer", "memoryview", "device_get"}
+    PASS_THROUGH = {"asarray", "ascontiguousarray", "reshape", "ravel",
+                    "squeeze", "transpose", "view"}
+    COPY_CALLS = {"array", "copy", "astype", "zeros", "ones", "full",
+                  "empty", "zeros_like", "ones_like", "full_like"}
+
+    @staticmethod
+    def _has_copy_false(node: ast.Call) -> bool:
+        return any(
+            kw.arg == "copy"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+            for kw in node.keywords
+        )
+
+    def _view_expr(self, node: ast.AST, views: Set[str]) -> bool:
+        """Does this expression evaluate to (possibly) a live view?"""
+        if isinstance(node, ast.Name):
+            return node.id in views
+        if isinstance(node, ast.Subscript):
+            return self._view_expr(node.value, views)
+        if isinstance(node, ast.Attribute):
+            if node.attr == "buf":
+                return True
+            return False
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee in self.COPY_CALLS:
+                if not self._has_copy_false(node):
+                    return False  # a real copy launders the taint
+                # np.array(x, copy=False) / x.astype(d, copy=False):
+                # explicitly NOT a copy — taint passes through the
+                # data operand (the receiver for method-style astype,
+                # else the first argument)
+                if callee == "astype" and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    return self._view_expr(node.func.value, views)
+                if node.args:
+                    return self._view_expr(node.args[0], views)
+                if isinstance(node.func, ast.Attribute):
+                    return self._view_expr(node.func.value, views)
+                return False
+            if callee in self.VIEW_SOURCES:
+                return True
+            if callee in self.PASS_THROUGH and node.args:
+                return self._view_expr(node.args[0], views)
+            # x.reshape(...) / x.view(...) method style
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self.PASS_THROUGH
+            ):
+                return self._view_expr(node.func.value, views)
+            return False
+        return False
+
+    def _view_names(self, fn: ast.AST) -> Set[str]:
+        """Names in ``fn`` bound (transitively) to view expressions —
+        two passes cover forward chains without full dataflow."""
+        views: Set[str] = set()
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name) and self._view_expr(
+                        node.value, views
+                    ):
+                        views.add(t.id)
+        return views
+
+    def _callback_yields_view(self, cb: ast.AST, views: Set[str]) -> bool:
+        """A placement callback leaks a view if any return path (the
+        body, for a lambda) is view-evidenced and not a copy call."""
+        if isinstance(cb, ast.Lambda):
+            return self._view_expr(cb.body, views)
+        if isinstance(cb, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = views | self._view_names(cb)
+            for node in ast.walk(cb):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    if self._view_expr(node.value, inner):
+                        return True
+        return False
+
+    def check(self, src: SourceFile) -> Iterable[Violation]:
+        defs = module_functions(src)
+        # view analysis walks the whole enclosing scope — only pay for
+        # it at the (rare) placement calls, and once per scope
+        view_cache: Dict[int, Set[str]] = {}
+
+        def views_of(scope) -> Set[str]:
+            key = id(scope)
+            if key not in view_cache:
+                view_cache[key] = self._view_names(scope)
+            return view_cache[key]
+
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee not in ("device_put", "make_array_from_callback"):
+                continue
+            scope = enclosing_function(node)
+            views = views_of(scope if scope is not None else src.tree)
+            if callee == "device_put" and node.args:
+                if self._view_expr(node.args[0], views):
+                    yield src.violation(
+                        self.id,
+                        node,
+                        "device_put of a live host-buffer view: the CPU "
+                        "backend zero-copy aliases host arrays, so the "
+                        "jax array changes when the buffer is rewritten "
+                        "(the shm aliased-restore bug). Copy first "
+                        "(np.array(x, copy=True)), or suppress with why "
+                        "the alias is safe.",
+                    )
+            elif callee == "make_array_from_callback" and len(node.args) >= 3:
+                cb = node.args[2]
+                if isinstance(cb, ast.Name):
+                    cb = defs.get(cb.id, cb)
+                if isinstance(
+                    cb, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and self._callback_yields_view(cb, views):
+                    yield src.violation(
+                        self.id,
+                        node,
+                        "make_array_from_callback whose callback returns "
+                        "an uncopied view of a live host buffer "
+                        "(frombuffer/memoryview/.buf/device_get): the "
+                        "CPU backend zero-copy aliases it, so the placed "
+                        "array is silently overwritten when the buffer "
+                        "is reused. Return a fresh copy "
+                        "(np.array(x, copy=True)), or suppress with why "
+                        "the alias is safe.",
+                    )
+
+
 ALL_RULES = [
     MeshCaptureRule(),
     HostSyncRule(),
@@ -706,6 +879,7 @@ ALL_RULES = [
     UnhashableInSetRule(),
     UnsafeSignalHandlerRule(),
     UnguardedSharedMutationRule(),
+    ZeroCopyAliasRule(),
 ]
 
 

@@ -1,0 +1,1081 @@
+"""shardcheck: static analysis of the *lowered* step program (SC rules).
+
+graftlint (rules.py) machine-checks elasticity invariants at the Python
+AST level; every truly expensive bug this repo shipped lived **below**
+the AST — in the program XLA actually runs:
+
+- the GSPMD ``jnp.concatenate`` miscompile that doubled every target id
+  (an unreduced replica sum the source code could never show);
+- adam moments coming back from the step re-sharded, silently changing
+  step N+1's input signature (recompile under jit, hard reject under
+  AOT);
+- the dense ``[B, T, V]`` f32 logits materialization chunked-CE exists
+  to kill.
+
+So this module reads the IR itself. Two texts, both obtained for free
+from the warm-compile machinery (``ElasticTrainer.lower_step`` lowers
+the step for *any* admissible world from shape avatars — live or not —
+so the whole analysis runs on CPU, in CI, with no TPU attached):
+
+- **StableHLO** (``lowered.as_text()``): global shapes, the entry
+  signature's per-arg/per-result ``mhlo.sharding`` strings and the
+  ``tf.aliasing_output`` donation links, explicit ``@Sharding``
+  constraint sites. Feeds SC002/SC003/SC004.
+- **optimized HLO** (``compiled.as_text()``): the post-GSPMD per-device
+  program where the collectives are real ops with replica groups and
+  shapes. Feeds SC001/SC005.
+
+Rules (each encodes a shipped bug — see docs/design/shardcheck.md):
+
+SC001  collective census: count + size every all-gather / all-reduce /
+       reduce-scatter / collective-permute / all-to-all per mesh axis
+       and diff against a checked-in per-(mesh, config-hash) contract.
+SC002  replicated-large-tensor: an explicitly sharding-constrained
+       intermediate above a byte threshold left fully replicated while
+       the mesh has data axes to shard it over.
+SC003  dense-vocab materialization: a float dot_general result carrying
+       BOTH the sequence and the full vocab dim (the chunked-CE
+       regression gate).
+SC004  output-sharding drift: a donated state input whose paired output
+       sharding is missing (left to XLA — free to drift) or different.
+SC005  host transfer inside the jitted step: host callbacks, infeed /
+       outfeed, host send/recv.
+
+Everything here is text analysis over the two IR strings plus a small
+``StepProgram`` context object — no jax import, no device use — so the
+rules themselves are unit-testable from canned IR and the module stays
+importable in the dep-free lint environment. Lowering the program to
+GET the text (CLI ``--hlo``, trainer hook) is the caller's job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from dlrover_tpu.lint.engine import Severity, Violation
+
+#: contracts shipped with the package (``--fix-contracts`` rewrites)
+DEFAULT_CONTRACTS_DIR = os.path.join(os.path.dirname(__file__), "contracts")
+
+#: canonical mesh-axis order (mirrors parallel.mesh.AXIS_ORDER without
+#: importing jax — this module must stay importable dep-free)
+CANONICAL_AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
+
+
+class ShardcheckError(RuntimeError):
+    """Raised by the strict lower-time hook when the compiled step
+    program violates an SC rule."""
+
+    def __init__(self, violations: Sequence[Violation]):
+        self.violations = list(violations)
+        super().__init__(
+            f"{len(self.violations)} shardcheck violation(s):\n"
+            + "\n".join(v.format() for v in self.violations)
+        )
+
+
+def mesh_spec_of(axis_sizes: Dict[str, int]) -> str:
+    """Canonical spec string for a mesh shape: non-trivial axes in
+    canonical order — ``{"dp": 2, "sp": 2}`` → ``"dp2xsp2"`` (so
+    ``--hlo sp2xdp2`` and ``--hlo dp2xsp2`` share one contract file).
+    Unknown axes sort after the canonical ones."""
+    parts = [
+        f"{a}{axis_sizes[a]}" for a in CANONICAL_AXES
+        if axis_sizes.get(a, 1) > 1
+    ]
+    parts += [
+        f"{a}{s}" for a, s in sorted(axis_sizes.items())
+        if a not in CANONICAL_AXES and s > 1
+    ]
+    return "x".join(parts) if parts else "dp1"
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """``"dp2xfsdp2"`` → ``{"dp": 2, "fsdp": 2}``. Raises on syntax the
+    mesh cannot mean (unknown axis, non-integer size)."""
+    out: Dict[str, int] = {}
+    for token in spec.split("x"):
+        m = re.match(r"^([a-z]+)([0-9]+)$", token.strip())
+        if not m or m.group(1) not in CANONICAL_AXES:
+            raise ValueError(
+                f"bad mesh spec token {token!r} in {spec!r} (want e.g. "
+                "dp4, dp2xfsdp2, sp2xdp2)"
+            )
+        out[m.group(1)] = int(m.group(2))
+    if not out:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return out
+
+#: collective HLO opcodes the census tracks (``-start`` variants fold
+#: into their base op: async pairs describe one transfer)
+COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+#: dtype byte widths for HLO/StableHLO shape strings
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "i8": 1,
+    "s16": 2, "u16": 2, "i16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "i32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "i64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: SC001 default: byte growth beyond this fraction of the contract
+#: fails even when no new collective appeared
+DEFAULT_BYTE_TOLERANCE = 0.10
+
+#: SC002 default: "large" means a global tensor above this many bytes
+#: (CPU-mesh tests pass explicit tiny thresholds)
+DEFAULT_REPLICATED_BYTES = 256 << 20
+
+#: StableHLO custom_call targets that are partitioner plumbing, not
+#: host transfers
+_BENIGN_CUSTOM_CALLS = {
+    "Sharding",
+    "SPMDFullToShardShape",
+    "SPMDShardToFullShape",
+    "MoveToHost",  # explicit host offload is its own, opted-in feature
+    "MoveToDevice",
+    "AllocateBuffer",
+    "LayoutConstraint",
+}
+
+_HOST_CALLBACK_HINTS = ("cpu_callback", "host_callback", "py_callback")
+
+
+# ---------------------------------------------------------------------------
+# shape / sharding string parsing
+# ---------------------------------------------------------------------------
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Bytes of an HLO shape string like ``f32[2,16,64]`` (layout
+    ``{...}`` already stripped by the caller's regex). Tuples and
+    opaque/token shapes return 0 — they never matter for a census."""
+    m = re.match(r"([a-z]+[0-9]*)\[([0-9,]*)\]$", shape_str.strip())
+    if not m:
+        return 0
+    width = _DTYPE_BYTES.get(m.group(1))
+    if width is None:
+        return 0
+    n = 1
+    dims = m.group(2)
+    if dims:
+        for d in dims.split(","):
+            n *= int(d)
+    return n * width
+
+
+def tensor_type_dims(type_str: str) -> Tuple[Tuple[int, ...], str]:
+    """``'8x16x256xf32'`` → ((8, 16, 256), 'f32'); scalars → ((), dtype).
+    Unparsable (dynamic dims, complex element syntax) → ((), '')."""
+    parts = type_str.strip().split("x")
+    if not parts:
+        return (), ""
+    dtype = parts[-1]
+    dims: List[int] = []
+    for p in parts[:-1]:
+        if not p.isdigit():
+            return (), ""
+        dims.append(int(p))
+    if not re.match(r"^[a-z]+[0-9]*$", dtype):
+        return (), ""
+    return tuple(dims), dtype
+
+
+def tensor_type_bytes(type_str: str) -> int:
+    dims, dtype = tensor_type_dims(type_str)
+    width = _DTYPE_BYTES.get(dtype)
+    if width is None:
+        return 0
+    n = 1
+    for d in dims:
+        n *= d
+    return n * width
+
+
+@dataclasses.dataclass(frozen=True)
+class ParsedSharding:
+    """One ``mhlo.sharding`` / HLO sharding string, reduced to what the
+    rules need: how many ways the tensor is tiled (model shards) and
+    how many ways each tile is replicated."""
+
+    raw: str
+    kind: str  # "replicated" | "maximal" | "tiled" | "unknown"
+    tile_dims: Tuple[int, ...] = ()
+    num_devices: int = 0
+    replicate_ways: int = 1
+
+    @property
+    def tile_count(self) -> int:
+        n = 1
+        for d in self.tile_dims:
+            n *= d
+        return n
+
+
+def parse_sharding(raw: str) -> ParsedSharding:
+    """Parse the V1 sharding syntax jax prints into ``mhlo.sharding``:
+    ``{replicated}``, ``{maximal device=0}``,
+    ``{devices=[2,2,2]<=[8] last_tile_dim_replicate}`` (the trailing
+    tile dim is the replication factor), iota/transpose device lists."""
+    s = raw.strip().strip("{}").strip()
+    if s == "replicated" or s == "":
+        return ParsedSharding(raw, "replicated")
+    if s.startswith("maximal"):
+        return ParsedSharding(raw, "maximal")
+    m = re.match(r"devices=\[([0-9,]+)\]", s)
+    if not m:
+        return ParsedSharding(raw, "unknown")
+    dims = tuple(int(d) for d in m.group(1).split(","))
+    n = 1
+    for d in dims:
+        n *= d
+    if "last_tile_dim_replicate" in s:
+        return ParsedSharding(
+            raw, "tiled", tile_dims=dims[:-1], num_devices=n,
+            replicate_ways=dims[-1],
+        )
+    return ParsedSharding(raw, "tiled", tile_dims=dims, num_devices=n)
+
+
+# ---------------------------------------------------------------------------
+# replica-group parsing + mesh-axis attribution
+# ---------------------------------------------------------------------------
+
+
+def parse_replica_groups(attr: str) -> List[Tuple[int, ...]]:
+    """Both HLO forms: explicit ``{{0,2},{1,3}}`` and iota
+    ``[4,2]<=[8]`` / ``[4,2]<=[2,2,2]T(2,1,0)`` (arange over the
+    reshape dims, transposed by the permutation, regrouped row-major)."""
+    attr = attr.strip()
+    if attr.startswith("{"):
+        groups = []
+        for grp in re.findall(r"\{([0-9,\s]*)\}", attr):
+            ids = tuple(int(x) for x in grp.replace(" ", "").split(",") if x)
+            if ids:
+                groups.append(ids)
+        return groups
+    m = re.match(
+        r"\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?", attr
+    )
+    if not m:
+        return []
+    out_dims = [int(x) for x in m.group(1).split(",")]
+    src_dims = [int(x) for x in m.group(2).split(",")]
+    total = 1
+    for d in src_dims:
+        total *= d
+    ids = list(range(total))
+    if m.group(3):
+        perm = [int(x) for x in m.group(3).split(",")]
+        # arange reshaped to src_dims, transposed by perm, flattened —
+        # index arithmetic without numpy (this module stays dep-free)
+        strides = [1] * len(src_dims)
+        for i in range(len(src_dims) - 2, -1, -1):
+            strides[i] = strides[i + 1] * src_dims[i + 1]
+        t_dims = [src_dims[p] for p in perm]
+        t_strides = [strides[p] for p in perm]
+        flat: List[int] = []
+
+        def _emit(prefix_idx: List[int], depth: int):
+            if depth == len(t_dims):
+                flat.append(
+                    sum(i * s for i, s in zip(prefix_idx, t_strides))
+                )
+                return
+            for i in range(t_dims[depth]):
+                _emit(prefix_idx + [i], depth + 1)
+
+        _emit([], 0)
+        ids = flat
+    if len(out_dims) == 1:
+        return [tuple(ids)]
+    group_size = out_dims[-1]
+    n_groups = 1
+    for d in out_dims[:-1]:
+        n_groups *= d
+    return [
+        tuple(ids[g * group_size:(g + 1) * group_size])
+        for g in range(n_groups)
+    ]
+
+
+def parse_source_target_pairs(attr: str) -> List[Tuple[int, int]]:
+    return [
+        (int(a), int(b))
+        for a, b in re.findall(r"\{(\d+),(\d+)\}", attr)
+    ]
+
+
+class MeshCoords:
+    """Maps a replica-group member to its coordinate along each mesh
+    axis, so a group of participants can be attributed to the axes its
+    members vary over.
+
+    ``axis_sizes`` follows the mesh's axis order. Group members in
+    post-GSPMD HLO are **logical device-assignment positions** (the
+    partition index), NOT hardware device ids — and jax builds the
+    assignment in ``mesh.devices.flat`` order, so a member decodes
+    directly as a flat index into the mesh shape. (Mapping through
+    hardware ids would invert the attribution on any mesh whose device
+    order is permuted — every real TPU torus mesh.)"""
+
+    def __init__(self, axis_sizes: Dict[str, int]):
+        self.axis_sizes = dict(axis_sizes)
+        self.axes = list(axis_sizes)
+        n = 1
+        for s in axis_sizes.values():
+            n *= s
+        self.num_devices = n
+
+    def coords(self, position: int) -> Optional[Tuple[int, ...]]:
+        if not 0 <= position < self.num_devices:
+            return None
+        out = []
+        for axis in reversed(self.axes):
+            size = self.axis_sizes[axis]
+            out.append(position % size)
+            position //= size
+        return tuple(reversed(out))
+
+    def _varying_axes(self, members: Sequence[int]) -> Optional[List[str]]:
+        coord_list = [self.coords(m) for m in members]
+        if any(c is None for c in coord_list):
+            return None
+        varying = []
+        for i, axis in enumerate(self.axes):
+            if len({c[i] for c in coord_list}) > 1:
+                varying.append(axis)
+        return varying
+
+    def attribute_groups(self, groups: Sequence[Sequence[int]]) -> str:
+        """Axis label for a replica-group list: the axes whose
+        coordinates vary inside the groups — ``"dp"``, ``"fsdp"``,
+        ``"dp+fsdp"`` for a fused data reduce, ``"unattributed"`` when
+        ids fall outside the mesh. Always named by the actual axes
+        (never collapsed to a "world" label): the same logical
+        collective must key the same census cell on every mesh shape,
+        or contracts stop being comparable across meshes."""
+        if not groups:
+            # num_replicas-style empty groups = every device participates
+            varying = {a for a, s in self.axis_sizes.items() if s > 1}
+        else:
+            varying = set()
+            for g in groups:
+                v = self._varying_axes(g)
+                if v is None:
+                    return "unattributed"
+                varying.update(v)
+        if not varying:
+            return "self"
+        return "+".join(a for a in self.axes if a in varying)
+
+    def attribute_pairs(self, pairs: Sequence[Tuple[int, int]]) -> str:
+        """collective-permute: attribute by the axes source and target
+        coordinates differ over (self-pairs ignored)."""
+        varying: set = set()
+        for s, t in pairs:
+            if s == t:
+                continue
+            v = self._varying_axes([s, t])
+            if v is None:
+                return "unattributed"
+            varying.update(v)
+        if not varying:
+            return "self"
+        return "+".join(a for a in self.axes if a in varying)
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO collective census (SC001 substrate)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    kind: str
+    shape: str  # result shape, e.g. "f32[2,16,64]"
+    bytes: int  # per-device result payload
+    axes: str  # mesh-axis label ("fsdp", "dp+fsdp", "tp", ...)
+    line: int  # 1-indexed line in the HLO text
+
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?:\(?)(?:[a-z0-9]+\[[0-9,]*\])"
+    r"[^=]*?\b(" + "|".join(COLLECTIVE_OPS) + r")(-start)?\("
+)
+_SHAPE_RE = re.compile(r"[a-z]+[0-9]*\[[0-9,]*\]")
+
+
+def _result_shape(line: str, op_start: int, is_async: bool) -> str:
+    """The RESULT payload shape of a collective op line. Sync ops:
+    the (possibly tuple of) shapes before the op name are all results
+    — variadic collectives sum below. Async ``-start`` ops: the tuple
+    is (operand…, result…); the LAST element is the result, so the
+    census records the same bytes whether XLA lowered the transfer
+    sync or async."""
+    eq = line.find("= ")
+    seg = line[eq + 2:op_start] if eq >= 0 else line[:op_start]
+    shapes = _SHAPE_RE.findall(seg)
+    if not shapes:
+        return ""
+    if is_async or len(shapes) == 1:
+        return shapes[-1]
+    return "+".join(shapes)  # sync variadic: every element is a result
+
+
+def parse_collectives(
+    hlo_text: str, coords: MeshCoords
+) -> List[CollectiveOp]:
+    """Every collective op in an optimized HLO module, with its result
+    payload and mesh-axis attribution. ``-done`` halves of async pairs
+    are skipped (the ``-start`` carries the transfer)."""
+    out: List[CollectiveOp] = []
+    for lineno, line in enumerate(hlo_text.splitlines(), start=1):
+        if "-done" in line:
+            continue
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        shape = _result_shape(line, m.start(1), m.group(2) is not None)
+        if kind == "collective-permute":
+            pairs = parse_source_target_pairs(
+                _attr(line, "source_target_pairs")
+            )
+            axes = coords.attribute_pairs(pairs)
+        else:
+            groups = parse_replica_groups(_attr(line, "replica_groups"))
+            axes = coords.attribute_groups(groups)
+        out.append(
+            CollectiveOp(
+                kind=kind,
+                shape=shape,
+                bytes=sum(shape_bytes(s) for s in shape.split("+")),
+                axes=axes,
+                line=lineno,
+            )
+        )
+    return out
+
+
+def _attr(line: str, name: str) -> str:
+    """Value of ``name=...`` in an HLO op line, balanced over {}/[]/()
+    — handles the iota forms ``[4,2]<=[8]`` and
+    ``[4,2]<=[2,2,2]T(2,1,0)``, which continue past their first ``]``."""
+    idx = line.find(name + "=")
+    if idx < 0:
+        return ""
+    i = idx + len(name) + 1
+    depth = 0
+    start = i
+    while i < len(line):
+        c = line[i]
+        if c in "{[(":
+            depth += 1
+        elif c in "}])":
+            depth -= 1
+            if depth == 0 and line[i + 1:i + 2] not in ("<", "T"):
+                return line[start:i + 1]
+        elif c == "," and depth == 0:
+            return line[start:i]
+        i += 1
+    return line[start:]
+
+
+def collective_census(
+    hlo_text: str, coords: MeshCoords
+) -> Dict[str, Dict[str, int]]:
+    """``{"all-gather|fsdp": {"count": N, "bytes": B}, ...}`` — the
+    SC001 fingerprint. Bytes are per-device result payloads summed over
+    static ops (a scan body counts once: the fingerprint tracks the
+    *program*, not the per-step issue count — accum lives in the comm
+    ledger, not here)."""
+    census: Dict[str, Dict[str, int]] = {}
+    for op in parse_collectives(hlo_text, coords):
+        key = f"{op.kind}|{op.axes}"
+        cell = census.setdefault(key, {"count": 0, "bytes": 0})
+        cell["count"] += 1
+        cell["bytes"] += op.bytes
+    return census
+
+
+# ---------------------------------------------------------------------------
+# StableHLO entry-signature parsing (SC002/SC003/SC004 substrate)
+# ---------------------------------------------------------------------------
+
+
+_ATTR_BLOCK = r"\{((?:[^{}\"]|\"[^\"]*\")*)\}"
+_ARG_RE = re.compile(r"%arg(\d+): tensor<([^>]+)>\s*" + _ATTR_BLOCK)
+_RESULT_RE = re.compile(r"tensor<([^>]+)>\s*(?:" + _ATTR_BLOCK + r")?")
+_SHARDING_CONSTRAINT_RE = re.compile(
+    r"stablehlo\.custom_call @Sharding\(.*?mhlo\.sharding = "
+    r"\"([^\"]*)\".*?->\s*tensor<([^>]+)>"
+)
+_DOT_GENERAL_RE = re.compile(
+    r"stablehlo\.dot_general\b.*?->\s*tensor<([^>]+)>"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryArg:
+    index: int
+    type_str: str
+    sharding: Optional[str]
+    aliases_output: Optional[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryResult:
+    index: int
+    type_str: str
+    sharding: Optional[str]
+    result_info: str  # jax.result_info pytree path, e.g. "[0]['params']…"
+
+
+def parse_entry_signature(
+    stablehlo: str,
+) -> Tuple[List[EntryArg], List[EntryResult]]:
+    """Args and results of the public @main func, with shardings and
+    donation links. jax prints the signature on one (very long) line;
+    we slice text between ``@main(`` and the body-opening ``{``."""
+    start = stablehlo.find("@main(")
+    if start < 0:
+        return [], []
+    arrow = stablehlo.find(") -> (", start)
+    if arrow < 0:
+        return [], []
+    arg_text = stablehlo[start:arrow]
+    # results end at the paren closing the tuple opened by ") -> (" —
+    # scanned with quote awareness: sharding strings contain parens
+    # (iota transposes like T(1,0)) and braces
+    i = arrow + len(") -> (")
+    depth = 1
+    in_quote = False
+    end = len(stablehlo)
+    while i < len(stablehlo):
+        c = stablehlo[i]
+        if c == '"':
+            in_quote = not in_quote
+        elif not in_quote:
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        i += 1
+    result_text = stablehlo[arrow + len(") -> ("):end]
+
+    args: List[EntryArg] = []
+    for m in _ARG_RE.finditer(arg_text):
+        attrs = m.group(3)
+        sh = re.search(r'mhlo\.sharding = "([^"]*)"', attrs)
+        al = re.search(r"tf\.aliasing_output = (\d+)", attrs)
+        args.append(
+            EntryArg(
+                index=int(m.group(1)),
+                type_str=m.group(2),
+                sharding=sh.group(1) if sh else None,
+                aliases_output=int(al.group(1)) if al else None,
+            )
+        )
+    # bare-typed args (no attr block) won't match _ARG_RE; they carry
+    # neither sharding nor aliasing, which is exactly "nothing to check"
+
+    results: List[EntryResult] = []
+    for i, m in enumerate(_RESULT_RE.finditer(result_text)):
+        attrs = m.group(2) or ""
+        sh = re.search(r'mhlo\.sharding = "([^"]*)"', attrs)
+        info = re.search(r'jax\.result_info = "([^"]*)"', attrs)
+        results.append(
+            EntryResult(
+                index=i,
+                type_str=m.group(1),
+                sharding=sh.group(1) if sh else None,
+                result_info=info.group(1) if info else "",
+            )
+        )
+    return args, results
+
+
+# ---------------------------------------------------------------------------
+# the analysis context
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StepProgram:
+    """Everything shardcheck knows about one lowered step program.
+
+    ``label`` names the program in findings (a pseudo-path, so the
+    engine's Violation/report machinery can render them). Semantic
+    hints (``seq_len``/``vocab``) gate SC003 — without them the rule
+    stays silent rather than guessing."""
+
+    label: str
+    stablehlo: str = ""
+    hlo: str = ""
+    axis_sizes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    seq_len: Optional[int] = None
+    vocab: Optional[int] = None
+    world: int = 0
+    config_hash: str = ""
+
+    def coords(self) -> MeshCoords:
+        return MeshCoords(self.axis_sizes)
+
+    @property
+    def data_axis_product(self) -> int:
+        """Combined size of the batch-sharding axes (dp·fsdp·ep) — the
+        ways a data-parallel tensor *could* be sharded."""
+        n = 1
+        for axis in ("dp", "fsdp", "ep"):
+            n *= self.axis_sizes.get(axis, 1)
+        return n
+
+    def violation(
+        self,
+        rule: str,
+        message: str,
+        line: int = 1,
+        snippet: str = "",
+        severity: str = Severity.ERROR,
+    ) -> Violation:
+        return Violation(
+            rule=rule,
+            path=self.label,
+            line=line,
+            col=0,
+            message=message,
+            snippet=snippet[:160],
+            severity=severity,
+        )
+
+
+# ---------------------------------------------------------------------------
+# SC001 — collective census vs. contract
+# ---------------------------------------------------------------------------
+
+
+def check_census_against_contract(
+    program: StepProgram,
+    contract: Dict,
+    byte_tolerance: float = DEFAULT_BYTE_TOLERANCE,
+    census: Optional[Dict[str, Dict[str, int]]] = None,
+) -> List[Violation]:
+    """Diff the program's census against a checked-in contract.
+
+    Fails on: a collective cell (op × axes) the contract has never
+    seen; count growth in an existing cell; byte growth beyond
+    ``byte_tolerance``. Shrinkage passes but is reported as a stale
+    note by the CLI (regenerate with ``--fix-contracts`` to bank the
+    improvement) — mirroring the graftlint baseline workflow.
+
+    ``census``: pass a precomputed census to skip re-parsing the HLO
+    (the CLI computes it once for the check, summary and
+    improvements note)."""
+    out: List[Violation] = []
+    if census is None:
+        census = collective_census(program.hlo, program.coords())
+    want: Dict[str, Dict[str, int]] = contract.get("census", {})
+    if contract.get("config_hash") and program.config_hash and \
+            contract["config_hash"] != program.config_hash:
+        out.append(
+            program.violation(
+                "SC001",
+                f"contract config_hash {contract['config_hash']} != "
+                f"program {program.config_hash}: the contract was "
+                "generated for a different model/trainer config — "
+                "regenerate with --fix-contracts",
+            )
+        )
+        return out
+    for key in sorted(census):
+        got = census[key]
+        ref = want.get(key)
+        if ref is None:
+            out.append(
+                program.violation(
+                    "SC001",
+                    f"new collective {key}: {got['count']} op(s), "
+                    f"{got['bytes']} bytes — not in the contract. A new "
+                    "collective on this axis means the partitioner now "
+                    "moves data it did not before; justify and "
+                    "--fix-contracts, or fix the sharding.",
+                    snippet=key,
+                )
+            )
+            continue
+        if got["count"] > ref["count"]:
+            out.append(
+                program.violation(
+                    "SC001",
+                    f"collective {key} count grew {ref['count']} -> "
+                    f"{got['count']}",
+                    snippet=key,
+                )
+            )
+        allowed = ref["bytes"] * (1.0 + byte_tolerance)
+        if got["bytes"] > allowed and got["bytes"] > ref["bytes"]:
+            out.append(
+                program.violation(
+                    "SC001",
+                    f"collective {key} bytes grew {ref['bytes']} -> "
+                    f"{got['bytes']} (> {byte_tolerance:.0%} tolerance)",
+                    snippet=key,
+                )
+            )
+    return out
+
+
+def census_improvements(
+    program_census: Dict[str, Dict[str, int]], contract: Dict
+) -> List[str]:
+    """Cells where the program now does LESS communication than the
+    contract records (vanished, fewer ops, or fewer bytes)."""
+    want: Dict[str, Dict[str, int]] = contract.get("census", {})
+    notes = []
+    for key in sorted(want):
+        got = program_census.get(key)
+        if got is None:
+            notes.append(f"{key}: gone (contract has {want[key]['count']})")
+        elif (
+            got["count"] < want[key]["count"]
+            or got["bytes"] < want[key]["bytes"]
+        ):
+            notes.append(
+                f"{key}: {want[key]['count']}/{want[key]['bytes']}B -> "
+                f"{got['count']}/{got['bytes']}B"
+            )
+    return notes
+
+
+# ---------------------------------------------------------------------------
+# SC002 — replicated large tensor
+# ---------------------------------------------------------------------------
+
+
+def check_replicated_large(
+    program: StepProgram,
+    threshold_bytes: int = DEFAULT_REPLICATED_BYTES,
+) -> List[Violation]:
+    """An explicit ``@Sharding`` constraint that leaves a tensor above
+    ``threshold_bytes`` fully replicated while the mesh has data axes
+    to shard it over. Scope: constraint sites only — unannotated
+    intermediates are XLA's placement choice and fire SC001 via the
+    collectives they imply; entry params are the caller's placement
+    (pure-dp legitimately replicates every parameter)."""
+    out: List[Violation] = []
+    if program.data_axis_product <= 1:
+        return out
+    for lineno, line in enumerate(program.stablehlo.splitlines(), start=1):
+        m = _SHARDING_CONSTRAINT_RE.search(line)
+        if not m:
+            continue
+        sharding = parse_sharding(m.group(1))
+        nbytes = tensor_type_bytes(m.group(2))
+        if nbytes < threshold_bytes:
+            continue
+        replicated = sharding.kind == "replicated" or (
+            sharding.kind == "tiled"
+            and sharding.replicate_ways >= program.data_axis_product
+            and sharding.tile_count == 1
+        )
+        if replicated:
+            out.append(
+                program.violation(
+                    "SC002",
+                    f"sharding constraint pins tensor<{m.group(2)}> "
+                    f"({nbytes} bytes) fully replicated "
+                    f"({sharding.raw}) while the mesh has "
+                    f"{program.data_axis_product} data-parallel ways to "
+                    "shard it — every device holds the whole tensor.",
+                    line=lineno,
+                    snippet=line.strip(),
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SC003 — dense seq×vocab materialization
+# ---------------------------------------------------------------------------
+
+
+def check_dense_vocab(program: StepProgram) -> List[Violation]:
+    """A float ``dot_general`` whose RESULT carries both the sequence
+    dim and the FULL vocab dim — the dense-logits materialization
+    chunked-CE exists to kill. Anchored on dot_general so the one-hot
+    embedding lookup (a [B,T,V] *operand* contracted away in the same
+    dot) and chunked CE (result carries chunk < vocab columns) stay
+    clean. Needs the seq/vocab hints; silent without them."""
+    out: List[Violation] = []
+    seq, vocab = program.seq_len, program.vocab
+    if not seq or not vocab or seq == vocab:
+        # seq == vocab would make every attention score matrix look
+        # like logits; a config that degenerate cannot be gated here
+        return out
+    for lineno, line in enumerate(program.stablehlo.splitlines(), start=1):
+        m = _DOT_GENERAL_RE.search(line)
+        if not m:
+            continue
+        dims, dtype = tensor_type_dims(m.group(1))
+        if not dtype.startswith("f"):
+            continue
+        if seq in dims and vocab in dims:
+            out.append(
+                program.violation(
+                    "SC003",
+                    f"dot_general materializes tensor<{m.group(1)}> "
+                    f"carrying both seq={seq} and vocab={vocab}: dense "
+                    "logits are back (peak activation O(B*T*V) — use "
+                    "the chunked CE path, ops/chunked_ce.py).",
+                    line=lineno,
+                    snippet=line.strip(),
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SC004 — output-sharding drift
+# ---------------------------------------------------------------------------
+
+
+def check_output_sharding_drift(program: StepProgram) -> List[Violation]:
+    """The step donates its state and returns it as the first tuple
+    element (``jax.result_info`` paths under ``[0]``); the next step
+    feeds that output straight back in, so every state output's
+    sharding must be PINNED and IDENTICAL to its input's. Three ways
+    the lowering shows a violation:
+
+    - the output carries no ``mhlo.sharding`` at all: out_shardings
+      were not pinned, XLA is free to return the leaf re-sharded (the
+      PR 2 silent-recompile bug — caught here at lower time instead of
+      via AOT rejection at the first post-resize step);
+    - the output is pinned but its donation alias is GONE: jax drops
+      ``tf.aliasing_output`` exactly when the donated input's sharding
+      cannot alias the output's — i.e. the pin differs from the input
+      (jax also warns "Some donated buffers were not usable");
+    - alias intact but the sharding strings differ (bitcast-compatible
+      layouts can still alias).
+
+    Skips programs with no ``[0]``-prefixed results (not a step)."""
+    out: List[Violation] = []
+    args, results = parse_entry_signature(program.stablehlo)
+    state_results = [
+        r for r in results if r.result_info.startswith("[0]")
+    ]
+    if not state_results:
+        return out
+    aliased_arg = {
+        a.aliases_output: a for a in args if a.aliases_output is not None
+    }
+    for res in state_results:
+        name = res.result_info
+        if res.sharding is None:
+            out.append(
+                program.violation(
+                    "SC004",
+                    f"state leaf {name} has no pinned output sharding: "
+                    "XLA is free to return it re-sharded, changing the "
+                    "next step's input signature (silent recompile "
+                    "under jit, hard reject under AOT). Pin "
+                    "out_shardings to the input state's shardings.",
+                    snippet=f"{name}: -> <unconstrained>",
+                )
+            )
+            continue
+        arg = aliased_arg.get(res.index)
+        if arg is None:
+            out.append(
+                program.violation(
+                    "SC004",
+                    f"state leaf {name} is pinned to {res.sharding} but "
+                    "lost its donation alias — the donated input's "
+                    "sharding differs from this output pin, so step "
+                    "N+1's input signature differs from step N's (and "
+                    "the donation saves no memory).",
+                    snippet=f"{name}: <donation dropped> -> "
+                    f"{res.sharding}",
+                )
+            )
+        elif arg.sharding is not None and arg.sharding != res.sharding:
+            out.append(
+                program.violation(
+                    "SC004",
+                    f"state leaf {name} changes sharding across the "
+                    f"step: in {arg.sharding} -> out {res.sharding}.",
+                    snippet=f"{name}: {arg.sharding} -> {res.sharding}",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SC005 — host transfer inside the step
+# ---------------------------------------------------------------------------
+
+
+def check_host_transfer(program: StepProgram) -> List[Violation]:
+    """Host callbacks / infeed / outfeed / host send-recv inside the
+    jitted step: each one stalls every participating device on the
+    host once per step (or once per scan iteration). Detected in the
+    optimized HLO (the partitioner cannot remove them) with a
+    StableHLO fallback for text generated before compile."""
+    out: List[Violation] = []
+    text = program.hlo or program.stablehlo
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        hit = None
+        tgt = re.search(
+            r'custom_call_target="([^"]+)"', line
+        ) or re.search(r'stablehlo\.custom_call @([\w.\-]+)', line)
+        if tgt:
+            target = tgt.group(1)
+            if target in _BENIGN_CUSTOM_CALLS:
+                continue
+            if any(h in target.lower() for h in _HOST_CALLBACK_HINTS):
+                hit = f"host callback custom-call {target}"
+        if hit is None:
+            if re.search(r"\binfeed\(", line):
+                hit = "infeed"
+            elif re.search(r"\boutfeed\(", line):
+                hit = "outfeed"
+            elif re.search(
+                r"\b(send|recv|send-done|recv-done)\(", line
+            ) and "is_host_transfer=true" in line:
+                hit = "host send/recv"
+        if hit:
+            out.append(
+                program.violation(
+                    "SC005",
+                    f"{hit} inside the jitted step: the device blocks "
+                    "on the host every step (debug callbacks and "
+                    "io_callback do not belong in the hot path — hoist "
+                    "them out or gate them off for training builds).",
+                    line=lineno,
+                    snippet=line.strip(),
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# one-call entry: run all SC rules on a program
+# ---------------------------------------------------------------------------
+
+
+def check_program(
+    program: StepProgram,
+    contract: Optional[Dict] = None,
+    byte_tolerance: float = DEFAULT_BYTE_TOLERANCE,
+    replicated_threshold: int = DEFAULT_REPLICATED_BYTES,
+    census: Optional[Dict[str, Dict[str, int]]] = None,
+) -> List[Violation]:
+    """SC002–SC005 always; SC001 only when a contract is supplied
+    (there is nothing to diff against otherwise)."""
+    out: List[Violation] = []
+    if contract is not None and program.hlo:
+        out.extend(
+            check_census_against_contract(
+                program, contract, byte_tolerance, census=census
+            )
+        )
+    if program.stablehlo:
+        out.extend(check_replicated_large(program, replicated_threshold))
+        out.extend(check_dense_vocab(program))
+        out.extend(check_output_sharding_drift(program))
+    out.extend(check_host_transfer(program))
+    out.sort(key=lambda v: (v.rule, v.line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# contracts on disk
+# ---------------------------------------------------------------------------
+
+
+def contract_path(contracts_dir: str, mesh_spec: str) -> str:
+    return os.path.join(contracts_dir, f"{mesh_spec}.json")
+
+
+def load_contract(contracts_dir: str, mesh_spec: str) -> Optional[Dict]:
+    try:
+        with open(contract_path(contracts_dir, mesh_spec),
+                  encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return None
+    if not isinstance(data, dict) or "census" not in data:
+        raise ValueError(
+            f"{contract_path(contracts_dir, mesh_spec)}: not a shardcheck "
+            "contract file"
+        )
+    return data
+
+
+def write_contract(
+    contracts_dir: str,
+    mesh_spec: str,
+    program: StepProgram,
+    extra: Optional[Dict] = None,
+) -> Dict:
+    os.makedirs(contracts_dir, exist_ok=True)
+    census = collective_census(program.hlo, program.coords())
+    data = {
+        "comment": (
+            "shardcheck SC001 contract: the collective census of the "
+            "lowered step program for this mesh. Regenerate with: "
+            "python -m dlrover_tpu.lint --hlo <spec> --fix-contracts"
+        ),
+        "version": 1,
+        "mesh_spec": mesh_spec,
+        "axis_sizes": {
+            a: s for a, s in program.axis_sizes.items() if s > 1
+        },
+        "world": program.world,
+        "config_hash": program.config_hash,
+        "census": {k: census[k] for k in sorted(census)},
+    }
+    if extra:
+        data.update(extra)
+    path = contract_path(contracts_dir, mesh_spec)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# SC rule catalog (for --list-rules and the docs)
+# ---------------------------------------------------------------------------
+
+SC_RULES: List[Tuple[str, str, str]] = [
+    ("SC001", "collective-census",
+     "Collectives per mesh axis diffed against a checked-in contract."),
+    ("SC002", "replicated-large-tensor",
+     "A big sharding-constrained tensor left fully replicated across "
+     "the data axes."),
+    ("SC003", "dense-vocab-materialization",
+     "A float dot_general result carrying both seq and full-vocab dims "
+     "(dense logits; chunked-CE regression gate)."),
+    ("SC004", "output-sharding-drift",
+     "A donated state leaf whose output sharding is unpinned or differs "
+     "from its input sharding."),
+    ("SC005", "host-transfer-in-jit",
+     "Host callback / infeed / outfeed inside the jitted step."),
+]

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Optional
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.constants import (
     DistributionStrategy,
     JobExitReason,
@@ -92,7 +93,7 @@ class DistributedJobMaster:
         else:
             self.scaler = PodScaler(job_args, self._client)
 
-        brain_addr = os.getenv("DLROVER_TPU_BRAIN_ADDR", "")
+        brain_addr = flags.BRAIN_ADDR.get()
         if brain_addr:
             from dlrover_tpu.master.resource.brain_optimizer import (
                 BrainResourceOptimizer,

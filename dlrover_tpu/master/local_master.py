@@ -37,9 +37,7 @@ class LocalJobMaster:
         min_node_num: Optional[int] = None,
         rdzv_waiting_timeout: float = 60,
     ):
-        import os
-
-        from dlrover_tpu.common.constants import NodeEnv
+        from dlrover_tpu.common import flags
         from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
         from dlrover_tpu.master.state_store import (
             MasterStateManager,
@@ -51,7 +49,7 @@ class LocalJobMaster:
         # standalone contract); DLROVER_TPU_STATE_BACKEND=file makes a
         # killed-and-relaunched master resume shard queues and the ledger
         self.state_manager = MasterStateManager(
-            create_state_backend(os.environ.get(NodeEnv.JOB_NAME, "local"))
+            create_state_backend(flags.JOB_NAME.get())
         )
         self.speed_monitor = SpeedMonitor()
         self.speed_monitor.set_target_worker_num(node_num)

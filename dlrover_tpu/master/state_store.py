@@ -28,10 +28,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
 
-STATE_BACKEND_ENV = "DLROVER_TPU_STATE_BACKEND"
-STATE_DIR_ENV = "DLROVER_TPU_STATE_DIR"
+# names derive from the typed registry — the single owner of the env
+# contract — so a flag rename can never split readers from writers
+STATE_BACKEND_ENV = flags.STATE_BACKEND.name
+STATE_DIR_ENV = flags.STATE_DIR.name
 
 
 class MasterStateBackend:
@@ -215,11 +218,11 @@ def create_state_backend(
     """Backend from env: ``DLROVER_TPU_STATE_BACKEND`` in
     memory|file|configmap (default: configmap when a k8s client is given,
     else memory). ``DLROVER_TPU_STATE_DIR`` roots the file backend."""
-    kind = os.environ.get(STATE_BACKEND_ENV, "").lower()
+    kind = flags.STATE_BACKEND.get().lower()
     if not kind:
         kind = "configmap" if k8s_client is not None else "memory"
     if kind == "file":
-        root = os.environ.get(STATE_DIR_ENV, "") or os.path.join(
+        root = flags.STATE_DIR.get() or os.path.join(
             "/tmp", f"dlrover_tpu_state_{job_name}"
         )
         return FileStateBackend(os.path.join(root, job_name))

@@ -16,6 +16,7 @@ import subprocess
 import urllib.request
 from typing import Dict
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.constants import TpuTimerConsts
 from dlrover_tpu.common.log import logger
 
@@ -83,11 +84,11 @@ def interposer_env(
         return {}
     lib = build_native()
     if peak_tflops <= 0:
-        peak_tflops = float(os.environ.get("DLROVER_TPU_PEAK_TFLOPS", "0"))
+        peak_tflops = float(flags.PEAK_TFLOPS.get())
     if peak_tflops <= 0:
         from dlrover_tpu.utils.tpu_info import peak_bf16_flops
 
-        kind = os.environ.get("DLROVER_TPU_ACCELERATOR", "")
+        kind = flags.ACCELERATOR.get()
         peak_tflops = peak_bf16_flops(kind) / 1e12
     env = {
         "TPU_LIBRARY_PATH": lib,

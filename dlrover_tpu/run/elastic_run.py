@@ -23,6 +23,7 @@ from typing import List, Optional, Tuple
 from dlrover_tpu.agent.config import ElasticLaunchConfig
 from dlrover_tpu.agent.elastic_agent import ElasticAgent
 from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.constants import NodeEnv, TpuTimerConsts
 from dlrover_tpu.common.log import logger
 
@@ -41,13 +42,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--standalone", action="store_true",
                    help="spawn a local job master for single-node runs")
-    p.add_argument("--master_addr", default=os.environ.get(NodeEnv.MASTER_ADDR, ""),
+    p.add_argument("--master_addr", default=flags.MASTER_ADDR.get(),
                    help="host:port of the job master")
     p.add_argument("--nnodes", default="1", help="N or MIN:MAX nodes")
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="JAX processes per host (1 is TPU-canonical)")
     p.add_argument("--node_id", type=int,
-                   default=int(os.environ.get(NodeEnv.NODE_ID, "0")))
+                   default=int(flags.NODE_ID.get()))
     p.add_argument("--job_name", default="dlrover-tpu-job")
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--node_unit", type=int, default=1)
@@ -67,8 +68,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--comm-metrics-port", type=int, default=29700,
                    dest="comm_metrics_port")
     p.add_argument("--compile-cache-dir", dest="compile_cache_dir",
-                   default=os.environ.get("DLROVER_TPU_COMPILE_CACHE_DIR",
-                                          ""),
+                   default=flags.COMPILE_CACHE_DIR.get(),
                    help="persistent XLA compile-cache dir injected into "
                         "workers (put it on the checkpoint volume so "
                         "restarts rebuild the train step from cache "

@@ -22,6 +22,7 @@ import urllib.parse
 import urllib.request
 from typing import Dict, Generator, List, Optional, Tuple
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -63,7 +64,7 @@ class ApiServerTransport:
             self._ctx = ssl.create_default_context()
             # Never silently disable verification: the bearer token rides
             # this connection. Unverified TLS is an explicit opt-in.
-            if os.getenv("DLROVER_TPU_K8S_INSECURE_TLS", "") == "1":
+            if flags.K8S_INSECURE_TLS.get() == "1":
                 logger.warning(
                     "TLS certificate verification DISABLED for %s "
                     "(DLROVER_TPU_K8S_INSECURE_TLS=1) — cluster credentials "

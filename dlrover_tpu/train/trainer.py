@@ -138,6 +138,11 @@ class ElasticTrainer:
         self._state_avatar: Optional[PyTree] = None
         self._batch_avatar: Optional[PyTree] = None
         self._params_avatar: Optional[PyTree] = None
+        # optional semantic hints for the shardcheck IR rules (SC003
+        # needs seq_len and vocab to recognize a dense-logits tensor);
+        # entry scripts that know the model set this, e.g.
+        # trainer.shardcheck_hints = {"seq_len": s, "vocab": v}
+        self.shardcheck_hints: dict = {}
         # open resize event (remesh() stamps the transfer half; the
         # first post-resize step build stamps the compile half and
         # records it to live_reshard.resize_ledger)
@@ -491,18 +496,141 @@ class ElasticTrainer:
             mesh, mesh_config, accum
         )
         t0 = time.perf_counter()
-        compiled = (
-            self._build_step(mesh, mesh_config, out_shardings=out_sh)
-            .lower(state_av, batch_av)
-            .compile()
-        )
+        lowered = self._build_step(
+            mesh, mesh_config, out_shardings=out_sh
+        ).lower(state_av, batch_av)
+        compiled = lowered.compile()
         dt = time.perf_counter() - t0
+        # IR-level analysis of the program just built (lint/shardcheck),
+        # opted in via DLROVER_TPU_SHARDCHECK. Runs for EVERY lowering —
+        # including the speculative neighbor worlds — so a sharding
+        # regression on the post-resize mesh is caught before the
+        # resize happens, not at its first step. Strict mode raises
+        # here, which keeps the poisoned executable out of the cache.
+        self._maybe_shardcheck(lowered, compiled, mesh, mesh_config,
+                               config_hash)
         self.warm.put(sig, compiled)
         warm_compile.compile_ledger.record(mesh.size, config_hash, dt, source)
         return compiled, {
             "cache": "miss", "compile_s": dt,
             "world": mesh.size, "config_hash": config_hash,
         }
+
+    # ---- shardcheck (lint/shardcheck.py) -------------------------------
+    def _program_of(self, lowered, compiled, mesh, config_hash: str):
+        """Build the shardcheck analysis context from one lowering."""
+        from dlrover_tpu.lint import shardcheck
+
+        hints = dict(self.shardcheck_hints)
+        if "seq_len" not in hints and self._batch_avatar is not None:
+            # token batches lead with (accum, micro*dp, seq): the
+            # trailing dim of a rank-3 integer leaf is the sequence
+            for av in jax.tree.leaves(self._batch_avatar):
+                if len(av.shape) == 3 and np.issubdtype(
+                    av.dtype, np.integer
+                ):
+                    hints["seq_len"] = int(av.shape[2])
+                    break
+        return shardcheck.StepProgram(
+            label=f"hlo:{shardcheck.mesh_spec_of(dict(mesh.shape))}",
+            stablehlo=lowered.as_text(),
+            hlo=compiled.as_text(),
+            axis_sizes=dict(mesh.shape),
+            seq_len=hints.get("seq_len"),
+            vocab=hints.get("vocab"),
+            world=mesh.size,
+            config_hash=config_hash,
+        )
+
+    def _maybe_shardcheck(
+        self, lowered, compiled, mesh, mesh_config, config_hash: str
+    ):
+        """Lower-time hook: ``DLROVER_TPU_SHARDCHECK`` 0=off, 1=warn,
+        2=strict (raise — the build is rejected and nothing enters the
+        executable cache). SC001 runs only when a contract for this
+        mesh spec exists (``DLROVER_TPU_SHARDCHECK_CONTRACTS`` dir,
+        default: the checked-in contracts)."""
+        mode = int(flags.SHARDCHECK.get())
+        if not mode:
+            return
+        from dlrover_tpu.lint import shardcheck
+
+        try:
+            program = self._program_of(lowered, compiled, mesh, config_hash)
+            contracts_dir = (
+                flags.SHARDCHECK_CONTRACTS.get()
+                or shardcheck.DEFAULT_CONTRACTS_DIR
+            )
+            contract = shardcheck.load_contract(
+                contracts_dir, shardcheck.mesh_spec_of(dict(mesh.shape))
+            )
+            if (
+                contract is not None
+                and contract.get("config_hash")
+                and contract["config_hash"] != program.config_hash
+            ):
+                # a contract for the same mesh but a DIFFERENT program
+                # (e.g. the checked-in tiny contract-model censuses vs a
+                # real model training on dp4): at lower time that means
+                # "no contract for this program", not a violation — the
+                # CLI, where the program is pinned, keeps the mismatch
+                # loud so stale contracts get regenerated
+                logger.info(
+                    "shardcheck: contract for %s is for config %s (this "
+                    "program: %s); SC001 skipped",
+                    program.label, contract["config_hash"],
+                    program.config_hash,
+                )
+                contract = None
+            violations = shardcheck.check_program(program, contract)
+        except Exception as e:
+            if isinstance(e, shardcheck.ShardcheckError):
+                raise
+            # analysis breakage must never take down a training build
+            logger.warning("shardcheck hook failed: %s", e)
+            return
+        if not violations:
+            logger.info(
+                "shardcheck: %s clean (%s contract)",
+                program.label, "with" if contract else "no",
+            )
+            return
+        if mode >= 2:
+            raise shardcheck.ShardcheckError(violations)
+        for v in violations:
+            logger.warning("shardcheck: %s", v.format())
+
+    def step_ir(self, mesh=None, mesh_config=None, pinned: bool = True):
+        """Lower (and compile — on the host, no device execution) the
+        step for ``(mesh, mesh_config)`` and return the shardcheck
+        ``StepProgram`` for it. This is the CLI / bench / CI entry: the
+        analysis substrate for any admissible world comes from the same
+        avatars the warm-compile path lowers from, so none of it needs
+        a live training process — or a TPU.
+
+        ``pinned=False`` builds the step WITHOUT pinned out_shardings
+        (the kill-switch jit path), which SC004 flags — used by tests
+        to demonstrate the drift gate."""
+        mesh = mesh if mesh is not None else self.mesh
+        mesh_config = (
+            mesh_config if mesh_config is not None else self.mesh_config
+        )
+        if self._state_avatar is None or self._batch_avatar is None:
+            raise RuntimeError(
+                "step_ir needs state/batch avatars: run one step() or "
+                "call record_avatars(state, batch) first"
+            )
+        accum = self._accum_for(mesh, mesh_config)
+        _, config_hash = self._step_signature(mesh, mesh_config, accum)
+        state_av, batch_av, out_sh = self._avatar_args(
+            mesh, mesh_config, accum
+        )
+        lowered = self._build_step(
+            mesh, mesh_config, out_shardings=out_sh if pinned else None
+        ).lower(state_av, batch_av)
+        return self._program_of(
+            lowered, lowered.compile(), mesh, config_hash
+        )
 
     def _acquire_step_fn(self):
         """The step for the live mesh: plain jit when the kill-switch
@@ -515,7 +643,14 @@ class ElasticTrainer:
             return self._build_step()
         try:
             fn, info = self.lower_step(self.mesh, self.mesh_config)
-        except Exception:
+        except Exception as e:
+            # strict shardcheck is a deliberate veto of this program —
+            # falling back to plain jit would run the exact program the
+            # check just rejected
+            from dlrover_tpu.lint import shardcheck
+
+            if isinstance(e, shardcheck.ShardcheckError):
+                raise
             logger.exception(
                 "AOT step build failed; falling back to plain jit"
             )

@@ -20,9 +20,9 @@ def local_ip(probe_addr: str = "8.8.8.8") -> str:
     resolution, UDP-probe route discovery, loopback. The env override matters
     on TPU pods where the right interface is the one libtpu/ICI uses.
     """
-    import os
+    from dlrover_tpu.common import flags
 
-    override = os.environ.get("DLROVER_TPU_NODE_IP", "")
+    override = flags.NODE_IP.get()
     if override:
         return override
     try:

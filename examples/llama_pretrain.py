@@ -98,6 +98,11 @@ def main():
         lambda p, t: llama.loss_fn(p, t, cfg, mesh),
         specs, mesh, mc, tc, worker_ctx=ctx,
     )
+    # semantic hints for the shardcheck IR rules (DLROVER_TPU_SHARDCHECK):
+    # SC003 needs seq/vocab to recognize a dense-logits materialization
+    trainer.shardcheck_hints = {
+        "seq_len": seq, "vocab": cfg.vocab_size,
+    }
     state = trainer.init_state(params)
 
     ckpt = Checkpointer(args.ckpt_dir, save_storage_interval=args.save_every)

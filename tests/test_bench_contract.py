@@ -42,6 +42,11 @@ def test_bench_json_line_contract(tmp_path):
     detail = d["detail"]
     # the watcher's backend check reads detail.backend at top level
     assert detail["backend"] in ("cpu", "tpu")
+    # SC001 comms fingerprint rides every round (lint/shardcheck):
+    # a dict of "op|axes" cells — empty on this single-device mesh,
+    # and never an {"error": ...} marker
+    assert isinstance(detail["collective_census"], dict)
+    assert "error" not in detail["collective_census"]
     # phase accounting: completed phases, in order ("interposer" only
     # runs on TPU, and was not requested here anyway)
     assert detail["phases_done"] == ["mfu", "ckpt"]
@@ -80,6 +85,10 @@ def test_bench_resize_phase_contract(tmp_path):
     assert rz["world"] == 4 and rz["target_world"] == 2
     assert rz["speculation_completed"]
     assert rz["cold_downtime_s"] > 0 and rz["warm_downtime_s"] > 0
+    # the post-resize program's comms fingerprint: the target world is
+    # dp=2, so its data all-reduce must show up attributed to dp
+    census = rz["collective_census"]
+    assert any(k.endswith("|dp") for k in census), census
     # the acceptance bar: a warm cache beats a cold compile. The cold
     # side recompiles a full train step (seconds even for the tiny
     # model); the warm side dispatches a cached executable (~ms) — 0.9

@@ -540,4 +540,125 @@ def test_unparsable_file_reports_error_not_crash(tmp_path):
 
 def test_rule_catalog_complete():
     ids = [rid for rid, _, _ in rule_catalog()]
-    assert ids == ["JG001", "JG002", "JG003", "JG004", "JG005", "JG006"]
+    assert ids == [
+        "JG001", "JG002", "JG003", "JG004", "JG005", "JG006", "JG007",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# JG007 — zero-copy aliasing of live host buffers
+# ---------------------------------------------------------------------------
+
+JG007_BAD_DEVICE_PUT = """
+    import jax
+    import numpy as np
+
+    def restore(shm, sharding):
+        view = np.frombuffer(shm.buf, dtype=np.float32)
+        return jax.device_put(view, sharding)
+"""
+
+JG007_BAD_CALLBACK_SUBSCRIPT = """
+    import jax
+    import numpy as np
+
+    def place(shm, shape, sharding):
+        view = np.frombuffer(shm.buf, dtype=np.float32).reshape(shape)
+        return jax.make_array_from_callback(
+            shape, sharding, lambda idx: view[idx]
+        )
+"""
+
+JG007_BAD_DEVICE_GET_BRIDGE = """
+    import jax
+    import numpy as np
+
+    def bridge(leaf, sharding):
+        host = np.asarray(jax.device_get(leaf))
+        return jax.make_array_from_callback(
+            host.shape, sharding,
+            lambda idx: np.ascontiguousarray(host[idx])
+        )
+"""
+
+JG007_GOOD_COPIED = """
+    import jax
+    import numpy as np
+
+    def restore(shm, shape, sharding):
+        view = np.frombuffer(shm.buf, dtype=np.float32).reshape(shape)
+        return jax.make_array_from_callback(
+            shape, sharding, lambda idx: np.array(view[idx], copy=True)
+        )
+"""
+
+JG007_GOOD_PARAM_SUBSCRIPT = """
+    import jax
+
+    def place(leaf, sharding):
+        # provenance unknown (fresh loader batch): not flagged
+        return jax.make_array_from_callback(
+            leaf.shape, sharding, lambda idx: leaf[idx]
+        )
+"""
+
+
+def test_jg007_flags_device_put_of_frombuffer_view(tmp_path):
+    violations = _lint_snippet(tmp_path, JG007_BAD_DEVICE_PUT)
+    assert _rules_of(violations) == ["JG007"]
+    assert "zero-copy" in violations[0].message
+
+
+def test_jg007_flags_callback_returning_shm_view(tmp_path):
+    assert _rules_of(
+        _lint_snippet(tmp_path, JG007_BAD_CALLBACK_SUBSCRIPT)
+    ) == ["JG007"]
+
+
+def test_jg007_flags_uncopied_device_get_bridge(tmp_path):
+    """np.ascontiguousarray is NOT a copy guarantee (it returns the
+    same buffer for an already-contiguous input) — the PR 4 trap."""
+    assert _rules_of(
+        _lint_snippet(tmp_path, JG007_BAD_DEVICE_GET_BRIDGE)
+    ) == ["JG007"]
+
+
+def test_jg007_quiet_on_explicit_copy(tmp_path):
+    assert _lint_snippet(tmp_path, JG007_GOOD_COPIED) == []
+
+
+def test_jg007_quiet_on_unknown_provenance(tmp_path):
+    assert _lint_snippet(tmp_path, JG007_GOOD_PARAM_SUBSCRIPT) == []
+
+
+def test_jg007_justified_site_is_suppressed_not_baselined():
+    """The one justified alias (live_reshard._bridge_leaf: a private
+    device_get snapshot handed to exactly one consumer) is suppressed
+    in place with its reason — never grandfathered."""
+    baseline = engine.load_baseline(engine.DEFAULT_BASELINE)
+    assert not any(e["rule"] == "JG007" for e in baseline.values())
+    src = os.path.join(
+        REPO_ROOT, "dlrover_tpu", "train", "live_reshard.py"
+    )
+    with open(src) as f:
+        text = f.read()
+    assert "graftlint: disable=JG007" in text
+
+
+JG007_BAD_COPY_FALSE = """
+    import jax
+    import numpy as np
+
+    def restore(shm, sharding):
+        view = np.frombuffer(shm.buf, dtype=np.float32)
+        nocopy = np.array(view, copy=False)
+        return jax.device_put(nocopy, sharding)
+"""
+
+
+def test_jg007_copy_false_does_not_launder_the_taint(tmp_path):
+    """np.array(view, copy=False) is the exact no-copy spelling the
+    rule exists for — it must pass the taint through, not launder it."""
+    assert _rules_of(
+        _lint_snippet(tmp_path, JG007_BAD_COPY_FALSE)
+    ) == ["JG007"]

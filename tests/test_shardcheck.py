@@ -1,0 +1,473 @@
+"""shardcheck (dlrover_tpu/lint/shardcheck.py): IR parsers are exact on
+the forms this jaxlib actually prints; every SC rule fires on a seeded
+regression and stays quiet on the healthy program; the golden contracts
+round-trip (generate → pass, seed → fail); and the trainer's lower-time
+hook vetoes a violating build in strict mode — including for a
+neighbor world that is not live."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlrover_tpu.lint import contract_model, shardcheck
+from dlrover_tpu.lint.__main__ import main as lint_main
+
+# ---------------------------------------------------------------------------
+# parser units (text only — no lowering)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_replica_groups_explicit():
+    assert shardcheck.parse_replica_groups("{{0,2},{1,3}}") == [
+        (0, 2), (1, 3)
+    ]
+
+
+def test_parse_replica_groups_iota():
+    assert shardcheck.parse_replica_groups("[4,2]<=[8]") == [
+        (0, 1), (2, 3), (4, 5), (6, 7)
+    ]
+
+
+def test_parse_replica_groups_iota_transpose():
+    # arange(8).reshape(2,2,2).transpose(2,1,0).reshape(4,2)
+    assert shardcheck.parse_replica_groups("[4,2]<=[2,2,2]T(2,1,0)") == [
+        (0, 4), (2, 6), (1, 5), (3, 7)
+    ]
+
+
+def test_shape_bytes():
+    assert shardcheck.shape_bytes("f32[2,16,64]") == 2 * 16 * 64 * 4
+    assert shardcheck.shape_bytes("bf16[8]") == 16
+    assert shardcheck.shape_bytes("f32[]") == 4
+    assert shardcheck.shape_bytes("token[]") == 0
+
+
+def test_tensor_type_dims():
+    assert shardcheck.tensor_type_dims("8x16x256xf32") == (
+        (8, 16, 256), "f32"
+    )
+    assert shardcheck.tensor_type_dims("f32") == ((), "f32")
+    assert shardcheck.tensor_type_dims("?x4xf32") == ((), "")
+
+
+def test_parse_sharding_forms():
+    assert shardcheck.parse_sharding("{replicated}").kind == "replicated"
+    assert shardcheck.parse_sharding("{maximal device=0}").kind == "maximal"
+    tiled = shardcheck.parse_sharding("{devices=[4,1,2]<=[8]}")
+    assert tiled.kind == "tiled" and tiled.tile_count == 8
+    assert tiled.replicate_ways == 1
+    part = shardcheck.parse_sharding(
+        "{devices=[2,2,2]<=[2,2,2]T(2,1,0) last_tile_dim_replicate}"
+    )
+    assert part.tile_count == 4 and part.replicate_ways == 2
+
+
+def test_mesh_spec_canonicalization():
+    assert shardcheck.mesh_spec_of({"sp": 2, "dp": 2}) == "dp2xsp2"
+    assert shardcheck.parse_mesh_spec("sp2xdp2") == {"sp": 2, "dp": 2}
+    assert shardcheck.mesh_spec_of(
+        shardcheck.parse_mesh_spec("sp2xdp2")
+    ) == "dp2xsp2"
+    with pytest.raises(ValueError):
+        shardcheck.parse_mesh_spec("zz4")
+    with pytest.raises(ValueError):
+        shardcheck.parse_mesh_spec("dp")
+
+
+def test_axis_attribution():
+    coords = shardcheck.MeshCoords({"dp": 2, "fsdp": 2, "tp": 2})
+    # tp: innermost — consecutive ids
+    assert coords.attribute_groups([(0, 1), (2, 3), (4, 5), (6, 7)]) == "tp"
+    # fsdp: middle — stride 2
+    assert coords.attribute_groups([(0, 2), (1, 3), (4, 6), (5, 7)]) == "fsdp"
+    # dp: outermost — stride 4
+    assert coords.attribute_groups([(0, 4), (1, 5), (2, 6), (3, 7)]) == "dp"
+    # fused data reduce over dp+fsdp
+    assert coords.attribute_groups([(0, 2, 4, 6), (1, 3, 5, 7)]) == "dp+fsdp"
+    # everything varies: still named by axes, never collapsed — the
+    # same logical collective must key the same cell on every mesh
+    assert coords.attribute_groups([tuple(range(8))]) == "dp+fsdp+tp"
+    # single-axis mesh: a full-world reduce is labeled by its one axis
+    assert shardcheck.MeshCoords({"dp": 4}).attribute_groups(
+        [(0, 1, 2, 3)]
+    ) == "dp"
+    assert coords.attribute_pairs([(0, 4), (4, 0), (1, 5), (5, 1)]) == "dp"
+
+
+# ---------------------------------------------------------------------------
+# the lowered contract program (one compile, shared)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def contract_setup():
+    trainer, state, batch = contract_model.build_contract_trainer(
+        {"dp": 2, "fsdp": 2}
+    )
+    program = trainer.step_ir()
+    program.label = "hlo:dp2xfsdp2"
+    return trainer, state, batch, program
+
+
+def test_healthy_program_is_clean(contract_setup):
+    _, _, _, program = contract_setup
+    assert shardcheck.check_program(program) == []
+
+
+def test_census_attributes_real_collectives(contract_setup):
+    _, _, _, program = contract_setup
+    census = shardcheck.collective_census(program.hlo, program.coords())
+    assert census, "a dp2xfsdp2 step with no collectives cannot be right"
+    axes_seen = {k.split("|")[1] for k in census}
+    assert "fsdp" in axes_seen  # param gathers / grad reduce-scatters
+    assert "unattributed" not in axes_seen
+    assert all(c["count"] > 0 for c in census.values())
+
+
+def test_contract_roundtrip_and_seeded_regressions(
+    contract_setup, tmp_path
+):
+    """generate → pass; then three seeded regressions each fail: a
+    collective the contract never saw, count growth, byte growth."""
+    _, _, _, program = contract_setup
+    cdir = str(tmp_path)
+    shardcheck.write_contract(cdir, "dp2xfsdp2", program)
+    contract = shardcheck.load_contract(cdir, "dp2xfsdp2")
+    assert shardcheck.check_census_against_contract(program, contract) == []
+
+    key = next(iter(contract["census"]))
+    # count growth: contract remembers one fewer op
+    seeded = json.loads(json.dumps(contract))
+    seeded["census"][key]["count"] -= 1
+    v = shardcheck.check_census_against_contract(program, seeded)
+    assert any("count grew" in x.message for x in v)
+
+    # byte growth beyond tolerance
+    seeded = json.loads(json.dumps(contract))
+    seeded["census"][key]["bytes"] = int(
+        seeded["census"][key]["bytes"] / 2
+    )
+    v = shardcheck.check_census_against_contract(program, seeded)
+    assert any("bytes grew" in x.message for x in v)
+
+    # a whole cell the contract never saw
+    seeded = json.loads(json.dumps(contract))
+    del seeded["census"][key]
+    v = shardcheck.check_census_against_contract(program, seeded)
+    assert any("new collective" in x.message for x in v)
+
+    # model/config change: contract is for a different program
+    seeded = json.loads(json.dumps(contract))
+    seeded["config_hash"] = "0000deadbeef"
+    v = shardcheck.check_census_against_contract(program, seeded)
+    assert any("config_hash" in x.message for x in v)
+
+
+def test_census_improvements_reported(contract_setup, tmp_path):
+    _, _, _, program = contract_setup
+    cdir = str(tmp_path)
+    contract = shardcheck.write_contract(cdir, "dp2xfsdp2", program)
+    key = next(iter(contract["census"]))
+    contract["census"][key]["count"] += 3  # the program now does less
+    census = shardcheck.collective_census(program.hlo, program.coords())
+    notes = shardcheck.census_improvements(census, contract)
+    assert notes and key in notes[0]
+
+
+def test_checked_in_contracts_pass_for_all_three_meshes():
+    """The acceptance gate: ``python -m dlrover_tpu.lint --hlo`` exits
+    0 against the checked-in contracts for dp=4, dp=2×fsdp=2 and
+    sp=2×dp=2."""
+    assert lint_main(
+        ["--hlo", "dp4", "--hlo", "dp2xfsdp2", "--hlo", "sp2xdp2"]
+    ) == 0
+
+
+def test_async_start_collective_records_result_not_operand_bytes():
+    """An async ``all-gather-start`` has a (operand, result) tuple
+    type; the census must record the RESULT payload so sync and async
+    lowerings of the same transfer fingerprint identically."""
+    coords = shardcheck.MeshCoords({"dp": 4})
+    async_hlo = (
+        "  %ags = (f32[4,8]{1,0}, f32[16,8]{1,0}) all-gather-start("
+        "f32[4,8]{1,0} %p), replica_groups={{0,1,2,3}}, dimensions={0},"
+        " use_global_device_ids=true\n"
+    )
+    sync_hlo = (
+        "  %ag = f32[16,8]{1,0} all-gather(f32[4,8]{1,0} %p), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}, "
+        "use_global_device_ids=true\n"
+    )
+    a = shardcheck.collective_census(async_hlo, coords)
+    s = shardcheck.collective_census(sync_hlo, coords)
+    assert a == s == {"all-gather|dp": {"count": 1, "bytes": 16 * 8 * 4}}
+
+
+def test_cli_rejects_mixed_ast_and_ir_modes():
+    assert lint_main(["--hlo", "dp4", "--fix-baseline"]) == 2
+    assert lint_main(["--hlo", "dp4", "--rule", "JG003"]) == 2
+    assert lint_main(["--hlo", "dp4", "dlrover_tpu/"]) == 2
+    assert lint_main(["--fix-contracts"]) == 2
+
+
+def test_census_attribution_by_logical_position_not_device_id():
+    """Replica-group members in post-GSPMD HLO are logical
+    device-assignment positions. On a mesh whose device order is
+    permuted (every real TPU torus mesh), mapping members through
+    hardware ids would invert dp/fsdp attribution — decode them as
+    flat mesh positions directly."""
+    d = jax.devices()[:4]
+    permuted = np.array([d[0], d[2], d[1], d[3]]).reshape(2, 2)
+    mesh = Mesh(permuted, ("dp", "fsdp"))
+
+    f = jax.jit(
+        lambda x: x * 1.0,
+        in_shardings=NamedSharding(mesh, P("dp", "fsdp")),
+        out_shardings=NamedSharding(mesh, P("dp", None)),
+    )
+    hlo = f.lower(
+        jax.ShapeDtypeStruct((8, 8), np.float32)
+    ).compile().as_text()
+    census = shardcheck.collective_census(
+        hlo, shardcheck.MeshCoords({"dp": 2, "fsdp": 2})
+    )
+    assert set(census) == {"all-gather|fsdp"}, census
+
+
+# ---------------------------------------------------------------------------
+# SC002 — replicated large tensor
+# ---------------------------------------------------------------------------
+
+
+def _lower_with_constraint(spec):
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+
+    def f(x):
+        y = jnp.einsum("bi,bj->bij", x, x)
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, spec)
+        )
+        return y.sum()
+
+    av = jax.ShapeDtypeStruct(
+        (8, 64), np.float32, sharding=NamedSharding(mesh, P("dp"))
+    )
+    return jax.jit(f).lower(av).as_text()
+
+
+def test_sc002_fires_on_replicated_constraint():
+    program = shardcheck.StepProgram(
+        label="t", stablehlo=_lower_with_constraint(P()),
+        axis_sizes={"dp": 4},
+    )
+    v = shardcheck.check_replicated_large(program, threshold_bytes=1024)
+    assert v and v[0].rule == "SC002"
+    assert "fully replicated" in v[0].message
+
+
+def test_sc002_quiet_on_sharded_constraint_and_below_threshold():
+    sharded = shardcheck.StepProgram(
+        label="t", stablehlo=_lower_with_constraint(P("dp")),
+        axis_sizes={"dp": 4},
+    )
+    assert shardcheck.check_replicated_large(sharded, 1024) == []
+    replicated = shardcheck.StepProgram(
+        label="t", stablehlo=_lower_with_constraint(P()),
+        axis_sizes={"dp": 4},
+    )
+    # 8*64*64 f32 = 128 KiB < a 1 MiB threshold
+    assert shardcheck.check_replicated_large(replicated, 1 << 20) == []
+
+
+# ---------------------------------------------------------------------------
+# SC003 — dense-vocab materialization (the chunked-CE gate)
+# ---------------------------------------------------------------------------
+
+
+def test_sc003_fires_when_dense_ce_reenabled(monkeypatch):
+    """Flipping the chunked-CE kill-switch brings the [B,T,V] f32
+    logits back — shardcheck sees them in the lowered program."""
+    monkeypatch.setenv("DLROVER_TPU_CHUNKED_CE", "0")
+    trainer, _, _ = contract_model.build_contract_trainer(
+        {"dp": 2, "fsdp": 2}
+    )
+    program = trainer.step_ir()
+    v = [x for x in shardcheck.check_program(program)
+         if x.rule == "SC003"]
+    assert v, "dense CE must materialize a seq×vocab dot_general"
+    assert "vocab=256" in v[0].message
+
+
+def test_sc003_quiet_on_chunked_ce(contract_setup):
+    _, _, _, program = contract_setup
+    assert shardcheck.check_dense_vocab(program) == []
+
+
+def test_sc003_silent_without_hints(contract_setup):
+    _, _, _, program = contract_setup
+    blind = shardcheck.StepProgram(
+        label="t", stablehlo=program.stablehlo,
+        axis_sizes=program.axis_sizes,
+    )
+    assert shardcheck.check_dense_vocab(blind) == []
+
+
+# ---------------------------------------------------------------------------
+# SC004 — output-sharding drift
+# ---------------------------------------------------------------------------
+
+
+def test_sc004_clean_when_pinned(contract_setup):
+    _, _, _, program = contract_setup
+    assert shardcheck.check_output_sharding_drift(program) == []
+
+
+def test_sc004_fires_on_unpinned_outputs(contract_setup):
+    trainer, _, _, _ = contract_setup
+    program = trainer.step_ir(pinned=False)
+    v = shardcheck.check_output_sharding_drift(program)
+    assert v and all(x.rule == "SC004" for x in v)
+    assert any("no pinned output sharding" in x.message for x in v)
+
+
+def test_sc004_fires_on_wrong_pin():
+    """Deliberately pinning a donated leaf to a DIFFERENT sharding than
+    its input = the signature changes every step."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    sh_in = NamedSharding(mesh, P("dp"))
+    sh_out = NamedSharding(mesh, P())  # wrong on purpose
+
+    f = jax.jit(
+        lambda s: ({"w": s["w"] * 2.0}, s["w"].sum()),
+        donate_argnums=(0,),
+        out_shardings=({"w": sh_out}, NamedSharding(mesh, P())),
+    )
+    av = {"w": jax.ShapeDtypeStruct((8, 8), np.float32, sharding=sh_in)}
+    with pytest.warns(UserWarning, match="donated buffers were not usable"):
+        stablehlo = f.lower(av).as_text()
+    program = shardcheck.StepProgram(
+        label="t", stablehlo=stablehlo, axis_sizes={"dp": 4},
+    )
+    v = shardcheck.check_output_sharding_drift(program)
+    assert v and "lost its donation alias" in v[0].message
+
+
+# ---------------------------------------------------------------------------
+# SC005 — host transfer inside the step
+# ---------------------------------------------------------------------------
+
+
+def test_sc005_fires_on_debug_callback():
+    def f(x):
+        jax.debug.print("mean {m}", m=x.mean())
+        return x * 2
+
+    lowered = jax.jit(f).lower(
+        jax.ShapeDtypeStruct((8,), np.float32)
+    )
+    program = shardcheck.StepProgram(
+        label="t", hlo=lowered.compile().as_text(), axis_sizes={},
+    )
+    v = shardcheck.check_host_transfer(program)
+    assert v and v[0].rule == "SC005"
+    assert "callback" in v[0].message
+
+
+def test_sc005_quiet_on_clean_program(contract_setup):
+    _, _, _, program = contract_setup
+    assert shardcheck.check_host_transfer(program) == []
+
+
+# ---------------------------------------------------------------------------
+# the trainer hook (DLROVER_TPU_SHARDCHECK)
+# ---------------------------------------------------------------------------
+
+
+def _bad_contract_for(tmp_path, spec, program):
+    """A contract that makes SC001 fire: same config hash, but a
+    census that has never seen one of the program's collectives."""
+    data = shardcheck.write_contract(str(tmp_path), spec, program)
+    assert data["census"], "seeding needs at least one collective"
+    del data["census"][next(iter(data["census"]))]
+    with open(shardcheck.contract_path(str(tmp_path), spec), "w") as f:
+        json.dump(data, f)
+
+
+def test_hook_strict_vetoes_the_build(tmp_path, monkeypatch):
+    trainer, state, batch = contract_model.build_contract_trainer(
+        {"dp": 4}
+    )
+    program = trainer.step_ir()
+    _bad_contract_for(tmp_path, "dp4", program)
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK", "2")
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK_CONTRACTS", str(tmp_path))
+    trainer.warm.clear()  # force a fresh lowering through the hook
+    with pytest.raises(shardcheck.ShardcheckError):
+        trainer.lower_step(trainer.mesh, trainer.mesh_config)
+    # strict step() build propagates the veto instead of silently
+    # falling back to plain jit (which would run the rejected program)
+    with pytest.raises(shardcheck.ShardcheckError):
+        trainer.step(state, batch)
+
+
+def test_hook_checks_speculative_neighbor_world(tmp_path, monkeypatch):
+    """The hook runs for EVERY lowering, so a regression on the
+    post-resize mesh is caught before the resize happens: lowering a
+    world that is NOT live still gets vetoed."""
+    from dlrover_tpu.parallel import build_mesh
+    from dlrover_tpu.parallel.mesh import MeshConfig
+
+    trainer, _, _ = contract_model.build_contract_trainer({"dp": 4})
+    neighbor_mc = MeshConfig(dp=2).resolve(2)
+    neighbor = build_mesh(neighbor_mc, devices=jax.devices()[:2])
+    program = trainer.step_ir(neighbor, neighbor_mc)
+    _bad_contract_for(tmp_path, "dp2", program)
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK", "2")
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK_CONTRACTS", str(tmp_path))
+    trainer.warm.clear()
+    with pytest.raises(shardcheck.ShardcheckError):
+        trainer.lower_step(neighbor, neighbor_mc, source="speculative")
+
+
+def test_hook_warn_mode_does_not_raise(tmp_path, monkeypatch, caplog):
+    trainer, _, _ = contract_model.build_contract_trainer({"dp": 4})
+    program = trainer.step_ir()
+    _bad_contract_for(tmp_path, "dp4", program)
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK", "1")
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK_CONTRACTS", str(tmp_path))
+    trainer.warm.clear()
+    compiled, info = trainer.lower_step(trainer.mesh, trainer.mesh_config)
+    assert compiled is not None and info["cache"] == "miss"
+
+
+def test_hook_skips_contract_for_different_program(tmp_path, monkeypatch):
+    """At lower time a config-hash mismatch means "no contract for this
+    program" (the checked-in tiny-model contracts must not veto a real
+    model training on the same mesh); only the CLI, where the program
+    is pinned, treats a mismatch as a violation."""
+    trainer, _, _ = contract_model.build_contract_trainer({"dp": 4})
+    program = trainer.step_ir()
+    data = shardcheck.write_contract(str(tmp_path), "dp4", program)
+    data["config_hash"] = "0000deadbeef"  # some other model's contract
+    del data["census"][next(iter(data["census"]))]  # would fire SC001
+    with open(shardcheck.contract_path(str(tmp_path), "dp4"), "w") as f:
+        json.dump(data, f)
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK", "2")
+    monkeypatch.setenv("DLROVER_TPU_SHARDCHECK_CONTRACTS", str(tmp_path))
+    trainer.warm.clear()
+    compiled, _ = trainer.lower_step(trainer.mesh, trainer.mesh_config)
+    assert compiled is not None  # no veto
+
+
+def test_hook_off_by_default(contract_setup, monkeypatch):
+    monkeypatch.delenv("DLROVER_TPU_SHARDCHECK", raising=False)
+    trainer, _, _, _ = contract_setup
+    trainer.warm.clear()
+    compiled, _ = trainer.lower_step(trainer.mesh, trainer.mesh_config)
+    assert compiled is not None
