@@ -792,6 +792,40 @@ class CheckpointEngine:
                 out[sl] = arr.reshape(tuple(e - s for s, e in index))
             return out
 
+        def region_covered(needed, plist) -> bool:
+            """The staged pieces' UNION covers the region — not just a
+            single containing piece. A resize that re-tiles a leaf
+            (zero-1 moments: dp4 staged quarters, dp2 target halves)
+            makes each target shard span several staged pieces, which
+            ``_slice_pieces`` assembles fine; requiring single-piece
+            containment here would reject exactly those restores. The
+            check partitions the region on the pieces' boundary grid
+            and demands every cell lie inside some piece (pieces are
+            per-device shards — the grid stays tiny)."""
+            import itertools
+
+            cuts = []
+            for d, (ns, ne) in enumerate(needed):
+                c = {ns, ne}
+                for p_index, _, _ in plist:
+                    ps, pe = p_index[d]
+                    if ns < ps < ne:
+                        c.add(ps)
+                    if ns < pe < ne:
+                        c.add(pe)
+                edges = sorted(c)
+                cuts.append(list(zip(edges, edges[1:])))
+            for cell in itertools.product(*cuts):
+                if not any(
+                    all(
+                        ps <= cs and ce <= pe
+                        for (cs, ce), (ps, pe) in zip(cell, p_index)
+                    )
+                    for p_index, _, _ in plist
+                ):
+                    return False
+            return True
+
         def covers_target(t_leaf, path: str) -> bool:
             """Partial (shm) data must cover every region the target's
             sharding assigns locally — else zero-fill would corrupt state."""
@@ -812,14 +846,7 @@ class CheckpointEngine:
                     shape
                 ).values()
             }:
-                contained = any(
-                    all(
-                        ps <= ns and ne <= pe
-                        for (ns, ne), (ps, pe) in zip(needed, p_index)
-                    )
-                    for p_index, _, _ in plist
-                )
-                if not contained:
+                if not region_covered(needed, plist):
                     return False
             return True
 

@@ -24,6 +24,7 @@ Semantics, kept bit-identical to the call sites this replaced:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 from typing import Any, Dict, List, Optional
@@ -73,6 +74,26 @@ class EnvFlag:
         this env) inherit it. The registry is the only sanctioned env
         *writer* for its own flags, same as it is the only reader."""
         os.environ[self.name] = str(value)
+
+    @contextlib.contextmanager
+    def scoped(self, value: Optional[Any]):
+        """Temporarily pin the flag (``None`` clears it → unset), then
+        restore the previous environment on exit. For builds whose
+        value an explicit knob decides — contract lowering, bench A/B
+        legs — where an operator's exported override must not leak in
+        and silently flip which program gets built."""
+        prev = os.environ.get(self.name)
+        try:
+            if value is None:
+                os.environ.pop(self.name, None)
+            else:
+                os.environ[self.name] = str(value)
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop(self.name, None)
+            else:
+                os.environ[self.name] = prev
 
 
 _REGISTRY: Dict[str, EnvFlag] = {}
@@ -173,6 +194,13 @@ SHARDCHECK_CONTRACTS = _define(
     "DLROVER_TPU_SHARDCHECK_CONTRACTS", "", "str",
     "Directory of SC001 collective-census contracts for the lower-time "
     "hook (default: the checked-in dlrover_tpu/lint/contracts).",
+)
+ZERO1 = _define(
+    "DLROVER_TPU_ZERO1", "", "str",
+    "ZeRO-1 weight-update sharding across the dp axis (train/zero1.py):"
+    " overrides the TrainConfig.zero1 knob in BOTH directions — 0 "
+    "forces the replicated update, any other non-empty value forces "
+    "zero-1 on; empty defers to the config. Read at step-build time.",
 )
 RETRACE_GUARD = _define(
     "DLROVER_TPU_RETRACE_GUARD", 0, "int",

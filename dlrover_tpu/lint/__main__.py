@@ -63,8 +63,9 @@ def main(argv=None) -> int:
         default=None,
         metavar="MESHSPEC",
         help="IR mode: lower the contract model for this mesh spec "
-        "(e.g. dp4, dp2xfsdp2, sp2xdp2; repeatable) and run the SC "
-        "rules over the lowered program",
+        "(e.g. dp4, dp2xfsdp2, sp2xdp2, or a zero-1 variant like "
+        "dp4+zero1; repeatable) and run the SC rules over the lowered "
+        "program",
     )
     p.add_argument(
         "--contracts",
@@ -161,18 +162,18 @@ def _run_hlo(args) -> int:
     specs = []
     for raw in args.hlo:
         try:
-            axis_sizes = shardcheck.parse_mesh_spec(raw)
+            axis_sizes, zero1 = shardcheck.parse_contract_spec(raw)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
-        specs.append(shardcheck.mesh_spec_of(axis_sizes))
+        specs.append(shardcheck.contract_spec_of(axis_sizes, zero1))
 
     # every spec shares one jax process: size the virtual CPU device
     # pool to the largest world before anything touches jax
     worlds = []
     for spec in specs:
         w = 1
-        for s in shardcheck.parse_mesh_spec(spec).values():
+        for s in shardcheck.parse_contract_spec(spec)[0].values():
             w *= s
         worlds.append(w)
     contract_model.ensure_cpu_devices(max(worlds))
@@ -194,6 +195,7 @@ def _run_hlo(args) -> int:
                     "jax_version": jax.__version__,
                     "seq_len": contract_model.SEQ_LEN,
                     "vocab": contract_model.VOCAB,
+                    "zero1": program.zero1,
                 },
             )
             print(

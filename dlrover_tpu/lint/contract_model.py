@@ -59,9 +59,13 @@ def ensure_cpu_devices(n: int) -> None:
         )
 
 
-def build_contract_trainer(axis_sizes: Dict[str, int]):
+def build_contract_trainer(axis_sizes: Dict[str, int], zero1: bool = False):
     """(trainer, state, batch) for the pinned contract model on the
-    mesh ``axis_sizes`` describes, placed on CPU host devices."""
+    mesh ``axis_sizes`` describes, placed on CPU host devices.
+    ``zero1`` builds the weight-update-sharded variant of the step via
+    the TrainConfig knob; callers that must not let an exported
+    ``DLROVER_TPU_ZERO1`` override it wrap the build in
+    ``flags.ZERO1.scoped(None)`` (``build_program`` does)."""
     import jax
     import numpy as np
 
@@ -91,6 +95,7 @@ def build_contract_trainer(axis_sizes: Dict[str, int]):
         micro_batch_size=MICRO_BATCH,
         warmup_steps=0,
         total_steps=100,
+        zero1=zero1,
     )
     trainer = ElasticTrainer(
         None, specs, mesh, mc, tc,
@@ -115,14 +120,23 @@ def build_contract_trainer(axis_sizes: Dict[str, int]):
 def build_program(
     spec: str, pinned: bool = True
 ) -> Tuple["shardcheck.StepProgram", object]:
-    """Lower the contract model for ``spec`` (e.g. ``"dp2xfsdp2"``)
-    and return ``(StepProgram, trainer)``."""
-    axis_sizes = shardcheck.parse_mesh_spec(spec)
+    """Lower the contract model for ``spec`` (e.g. ``"dp2xfsdp2"`` or
+    the zero-1 variant ``"dp4+zero1"``) and return
+    ``(StepProgram, trainer)``."""
+    from dlrover_tpu.common import flags
+
+    axis_sizes, zero1 = shardcheck.parse_contract_spec(spec)
     world = 1
     for s in axis_sizes.values():
         world *= s
     ensure_cpu_devices(world)
-    trainer, _, _ = build_contract_trainer(axis_sizes)
-    program = trainer.step_ir(pinned=pinned)
-    program.label = f"hlo:{shardcheck.mesh_spec_of(axis_sizes)}"
+    with flags.ZERO1.scoped(None):
+        # the spec decides the variant; an exported DLROVER_TPU_ZERO1
+        # would otherwise override the knob at init_state/lower time
+        # and build (or --fix-contracts: RECORD) the wrong program
+        trainer, _, _ = build_contract_trainer(axis_sizes, zero1=zero1)
+        program = trainer.step_ir(pinned=pinned)
+    program.label = "hlo:" + shardcheck.contract_spec_of(
+        axis_sizes, zero1
+    )
     return program, trainer

@@ -94,6 +94,29 @@ def mesh_spec_of(axis_sizes: Dict[str, int]) -> str:
     return "x".join(parts) if parts else "dp1"
 
 
+#: contract-spec suffix for the zero-1 program variant: the same mesh
+#: lowers a genuinely different step with weight-update sharding on, so
+#: it gets its own contract file (``dp4+zero1.json`` next to
+#: ``dp4.json``)
+ZERO1_SUFFIX = "+zero1"
+
+
+def contract_spec_of(axis_sizes: Dict[str, int], zero1: bool = False) -> str:
+    """Canonical CONTRACT key for a program: the mesh spec, suffixed
+    with ``+zero1`` when the step was built with weight-update sharding
+    — ``contract_spec_of({"dp": 4}, True)`` → ``"dp4+zero1"``."""
+    return mesh_spec_of(axis_sizes) + (ZERO1_SUFFIX if zero1 else "")
+
+
+def parse_contract_spec(spec: str) -> Tuple[Dict[str, int], bool]:
+    """``"dp4+zero1"`` → ``({"dp": 4}, True)``; plain mesh specs parse
+    with ``zero1=False``."""
+    zero1 = spec.endswith(ZERO1_SUFFIX)
+    if zero1:
+        spec = spec[: -len(ZERO1_SUFFIX)]
+    return parse_mesh_spec(spec), zero1
+
+
 def parse_mesh_spec(spec: str) -> Dict[str, int]:
     """``"dp2xfsdp2"`` → ``{"dp": 2, "fsdp": 2}``. Raises on syntax the
     mesh cannot mean (unknown axis, non-integer size)."""
@@ -405,7 +428,7 @@ class MeshCoords:
 class CollectiveOp:
     kind: str
     shape: str  # result shape, e.g. "f32[2,16,64]"
-    bytes: int  # per-device result payload
+    bytes: int  # per-device contribution (see parse_collectives)
     axes: str  # mesh-axis label ("fsdp", "dp+fsdp", "tp", ...)
     line: int  # 1-indexed line in the HLO text
 
@@ -437,9 +460,19 @@ def _result_shape(line: str, op_start: int, is_async: bool) -> str:
 def parse_collectives(
     hlo_text: str, coords: MeshCoords
 ) -> List[CollectiveOp]:
-    """Every collective op in an optimized HLO module, with its result
-    payload and mesh-axis attribution. ``-done`` halves of async pairs
-    are skipped (the ``-start`` carries the transfer)."""
+    """Every collective op in an optimized HLO module, with its payload
+    and mesh-axis attribution. ``-done`` halves of async pairs are
+    skipped (the ``-start`` carries the transfer).
+
+    Byte accounting is the PER-DEVICE CONTRIBUTION of one op — the same
+    unit the analytic comm ledger uses (profiler/comm.py, "what one
+    rank sends"): the full reduced tensor for all-reduce, the scattered
+    shard for reduce-scatter, and for all-gather the operand shard each
+    rank contributes (result bytes / participants), NOT the gathered
+    result. Counting the gathered result would overstate an all-gather
+    by the axis size against every other op — and make the
+    allreduce→reduce-scatter+all-gather rewrite (zero-1) read as MORE
+    communication when it moves strictly less per link."""
     out: List[CollectiveOp] = []
     for lineno, line in enumerate(hlo_text.splitlines(), start=1):
         if "-done" in line:
@@ -449,6 +482,7 @@ def parse_collectives(
             continue
         kind = m.group(1)
         shape = _result_shape(line, m.start(1), m.group(2) is not None)
+        nbytes = sum(shape_bytes(s) for s in shape.split("+"))
         if kind == "collective-permute":
             pairs = parse_source_target_pairs(
                 _attr(line, "source_target_pairs")
@@ -457,11 +491,17 @@ def parse_collectives(
         else:
             groups = parse_replica_groups(_attr(line, "replica_groups"))
             axes = coords.attribute_groups(groups)
+            if kind == "all-gather":
+                participants = (
+                    len(groups[0]) if groups and groups[0]
+                    else max(coords.num_devices, 1)
+                )
+                nbytes //= max(participants, 1)
         out.append(
             CollectiveOp(
                 kind=kind,
                 shape=shape,
-                bytes=sum(shape_bytes(s) for s in shape.split("+")),
+                bytes=nbytes,
                 axes=axes,
                 line=lineno,
             )
@@ -497,10 +537,10 @@ def collective_census(
     hlo_text: str, coords: MeshCoords
 ) -> Dict[str, Dict[str, int]]:
     """``{"all-gather|fsdp": {"count": N, "bytes": B}, ...}`` — the
-    SC001 fingerprint. Bytes are per-device result payloads summed over
-    static ops (a scan body counts once: the fingerprint tracks the
-    *program*, not the per-step issue count — accum lives in the comm
-    ledger, not here)."""
+    SC001 fingerprint. Bytes are per-device contributions (see
+    ``parse_collectives``) summed over static ops (a scan body counts
+    once: the fingerprint tracks the *program*, not the per-step issue
+    count — accum lives in the comm ledger, not here)."""
     census: Dict[str, Dict[str, int]] = {}
     for op in parse_collectives(hlo_text, coords):
         key = f"{op.kind}|{op.axes}"
@@ -632,6 +672,10 @@ class StepProgram:
     vocab: Optional[int] = None
     world: int = 0
     config_hash: str = ""
+    #: the step was built with zero-1 weight-update sharding: arms the
+    #: SC002 replicated-optimizer-moment check (a moment the sharding
+    #: rule left replicated across dp>1 defeats the feature's point)
+    zero1: bool = False
 
     def coords(self) -> MeshCoords:
         return MeshCoords(self.axis_sizes)
@@ -804,6 +848,65 @@ def check_replicated_large(
                     "shard it — every device holds the whole tensor.",
                     line=lineno,
                     snippet=line.strip(),
+                )
+            )
+    return out
+
+
+def check_replicated_moments(
+    program: StepProgram,
+    threshold_bytes: int = DEFAULT_REPLICATED_BYTES,
+) -> List[Violation]:
+    """SC002, zero-1 arm: a large OPTIMIZER-STATE leaf still replicated
+    across dp while the step was built with weight-update sharding on.
+
+    The moments are entry/results, not ``@Sharding`` sites, so the base
+    rule never sees them; with zero-1 off their dp replication is the
+    documented cost of pure-dp. With zero-1 ON it means the sharding
+    rule fell back (non-divisible leading dims) on a leaf big enough
+    that the fallback defeats the feature — resolve by reshaping the
+    param or accepting it with a contract note. Detection reads the
+    pinned output shardings of the ``[0]['opt']…`` results (the step's
+    returned optimizer state): ``replicated``, or untiled with a
+    replication factor covering the dp ways. Same precision limit as
+    the base rule: the sharding string cannot attribute replication to
+    a *specific* mesh axis, so a moment that is tiled over some other
+    axis (sp/tp) yet still replicated across dp escapes — the
+    conservative direction; the alternative misreads a correctly
+    dp-sharded, sp-replicated moment as a fallback and (strict mode)
+    vetoes a correct build."""
+    out: List[Violation] = []
+    dp = program.axis_sizes.get("dp", 1)
+    if not program.zero1 or dp <= 1:
+        return out
+    _, results = parse_entry_signature(program.stablehlo)
+    for res in results:
+        if not res.result_info.startswith("[0]"):
+            continue
+        if "'opt'" not in res.result_info:
+            continue
+        nbytes = tensor_type_bytes(res.type_str)
+        if nbytes < threshold_bytes:
+            continue
+        if res.sharding is None:
+            continue  # unpinned outputs are SC004's finding
+        sharding = parse_sharding(res.sharding)
+        replicated = sharding.kind == "replicated" or (
+            sharding.kind == "tiled"
+            and sharding.tile_count == 1
+            and sharding.replicate_ways >= dp
+        )
+        if replicated:
+            out.append(
+                program.violation(
+                    "SC002",
+                    f"zero-1 is on but optimizer moment "
+                    f"{res.result_info} (tensor<{res.type_str}>, "
+                    f"{nbytes} bytes) is replicated across dp={dp} "
+                    f"({sharding.raw}): the weight-update sharding "
+                    "rule fell back on this leaf — every dp rank "
+                    "still holds the whole moment.",
+                    snippet=f"{res.result_info}: {res.sharding}",
                 )
             )
     return out
@@ -995,6 +1098,7 @@ def check_program(
         )
     if program.stablehlo:
         out.extend(check_replicated_large(program, replicated_threshold))
+        out.extend(check_replicated_moments(program, replicated_threshold))
         out.extend(check_dense_vocab(program))
         out.extend(check_output_sharding_drift(program))
     out.extend(check_host_transfer(program))
@@ -1069,7 +1173,8 @@ SC_RULES: List[Tuple[str, str, str]] = [
      "Collectives per mesh axis diffed against a checked-in contract."),
     ("SC002", "replicated-large-tensor",
      "A big sharding-constrained tensor left fully replicated across "
-     "the data axes."),
+     "the data axes; under zero-1, also an optimizer moment still "
+     "replicated across dp."),
     ("SC003", "dense-vocab-materialization",
      "A float dot_general result carrying both seq and full-vocab dims "
      "(dense logits; chunked-CE regression gate)."),

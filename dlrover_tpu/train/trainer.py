@@ -21,7 +21,10 @@ boundaries. TPU-native version:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import functools
+import threading
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -36,7 +39,7 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.lint import retrace_guard
 from dlrover_tpu.parallel.mesh import MeshConfig
 from dlrover_tpu.parallel.sharding import batch_spec
-from dlrover_tpu.train import live_reshard, warm_compile
+from dlrover_tpu.train import live_reshard, warm_compile, zero1
 
 PyTree = Any
 
@@ -76,6 +79,11 @@ class TrainConfig:
     grad_clip: float = 1.0
     b1: float = 0.9
     b2: float = 0.95
+    # ZeRO-1 weight-update sharding across dp (train/zero1.py):
+    # reduce-scatter grads, update dp-sharded adam moments, all-gather
+    # the params. The DLROVER_TPU_ZERO1 env flag overrides this knob in
+    # both directions. No-op on meshes without a dp axis > 1.
+    zero1: bool = False
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -92,6 +100,18 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(sched, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay),
     )
+
+
+def _pin_zero1(fn):
+    """Run a build entry point under ``ElasticTrainer._zero1_pin`` so
+    every zero-1 read inside one build sees one consistent answer."""
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        with self._zero1_pin():
+            return fn(self, *args, **kwargs)
+
+    return wrapped
 
 
 class ElasticTrainer:
@@ -138,6 +158,9 @@ class ElasticTrainer:
         self._state_avatar: Optional[PyTree] = None
         self._batch_avatar: Optional[PyTree] = None
         self._params_avatar: Optional[PyTree] = None
+        # per-thread zero-1 pin (see _zero1_pin): holds the effective
+        # enabled decision for the duration of one build on that thread
+        self._zero1_tls = threading.local()
         # optional semantic hints for the shardcheck IR rules (SC003
         # needs seq_len and vocab to recognize a dense-logits tensor);
         # entry scripts that know the model set this, e.g.
@@ -170,6 +193,97 @@ class ElasticTrainer:
             _logger.info("comm metrics on 127.0.0.1:%d/metrics", bound)
         except OSError:
             pass  # port taken (another trainer in-process)
+
+    # ---- zero-1 weight-update sharding (train/zero1.py) ----------------
+    @contextlib.contextmanager
+    def _zero1_pin(self):
+        """Pin the effective zero-1 decision for the calling thread.
+
+        The ``DLROVER_TPU_ZERO1`` env flag is read live at build time
+        (flips take effect at the next build — the documented resize/
+        restore-boundary semantics). But ONE build reads it several
+        times (cache key, avatars, contract lookup, the step body), and
+        another thread's ``flags.ZERO1.scoped`` window (bench A/B legs,
+        contract lowering) can flip the env between those reads — a
+        cache key that says scatter over a replicated program, cached
+        forever. Pinning makes every ``_zero1_mode`` call within the
+        ``with`` block (on this thread) see one consistent answer.
+        Re-entrant: an outer pin wins."""
+        tls = self._zero1_tls
+        if getattr(tls, "enabled", None) is not None:
+            yield
+            return
+        tls.enabled = zero1.enabled(self.tc)
+        try:
+            yield
+        finally:
+            tls.enabled = None
+
+    def _zero1_mode(self, mesh: Mesh) -> str:
+        """``"off"`` | ``"scatter"`` | ``"gspmd"`` — how the weight
+        update shards over dp on ``mesh``. Inside a ``_zero1_pin``
+        block the enabled decision is the pinned snapshot."""
+        return zero1.mode_for(
+            mesh, self.tc, self.loss_factory is not None,
+            enabled_override=getattr(self._zero1_tls, "enabled", None),
+        )
+
+    def _state_avatar_for(self, mesh: Mesh) -> Optional[PyTree]:
+        """State avatars with the optimizer-state specs RE-DERIVED for
+        ``mesh``. Zero-1 shards each moment along whatever dim divides
+        on the *current* dp size — a resized dp (or a zero-1 on/off
+        flip at a resize boundary) changes the answer — so every
+        cross-mesh consumer (AOT lowering, live-reshard transfer
+        targets, checkpoint restore placement) re-derives here instead
+        of reusing the captured specs verbatim. Leaves outside ``opt``
+        never carry dp (the zero1.py invariant: dp only enters a state
+        spec through that module) and pass through untouched."""
+        if self._state_avatar is None:
+            return None
+        mode = self._zero1_mode(mesh)
+        axis_sizes = dict(mesh.shape)
+
+        def retarget(av):
+            if not av.shape:
+                return av
+            has_dp = zero1.spec_has_dp(av.spec)
+            if mode == "off" and not has_dp:
+                # nothing to do — and strip_spec's trailing-None
+                # normalization must not churn an untouched spec
+                # (P(None,) and P() place identically but compare
+                # unequal as NamedShardings)
+                return av
+            base = zero1.strip_spec(av.spec) if has_dp else av.spec
+            z = (
+                zero1.partition_spec(base, av.shape, axis_sizes)
+                if mode != "off" else None
+            )
+            spec = z if z is not None else base
+            if spec == av.spec:
+                return av
+            return dataclasses.replace(av, spec=spec)
+
+        out = dict(self._state_avatar)
+        if "opt" in out:
+            out["opt"] = jax.tree.map(retarget, out["opt"])
+        return out
+
+    def state_targets(self, mesh: Optional[Mesh] = None) -> PyTree:
+        """``ShapeDtypeStruct`` (with sharding) restore/transfer targets
+        for ``mesh`` (default: live): state shapes from the avatars,
+        optimizer-state specs re-derived for the target world (zero-1
+        aware). The one tree checkpoint restore and the bench's
+        round-trip leg should place against — placing by raw captured
+        avatars instead would pin a resized world to the OLD dp's
+        moment layout."""
+        mesh = mesh if mesh is not None else self.mesh
+        avatars = self._state_avatar_for(mesh)
+        if avatars is None:
+            raise RuntimeError(
+                "state_targets needs avatars: run one step() or call "
+                "record_avatars(state, batch) first"
+            )
+        return live_reshard.state_targets(avatars, mesh)
 
     # ---- elastic global-batch math (reference trainer.py:307-327) ------
     @property
@@ -224,6 +338,26 @@ class ElasticTrainer:
             == 0 else l,
             opt_state,
         )
+        if self._zero1_mode(self.mesh) != "off":
+            # ZeRO-1 (train/zero1.py): re-place every non-scalar moment
+            # dp-sharded along its leading divisible dim. The step's
+            # update runs on (and returns) exactly this layout, and the
+            # avatars captured from this state carry it into the AOT
+            # signatures, live-reshard targets and restore placements.
+            axis_sizes = dict(self.mesh.shape)
+
+            def _shard_moment(l):
+                if getattr(l, "ndim", 0) == 0:
+                    return l
+                spec = getattr(getattr(l, "sharding", None), "spec", None)
+                z = zero1.partition_spec(
+                    spec if spec is not None else P(), l.shape, axis_sizes
+                )
+                if z is None:
+                    return l  # non-divisible leaf: replicated fallback
+                return jax.device_put(l, NamedSharding(self.mesh, z))
+
+            opt_state = jax.tree.map(_shard_moment, opt_state)
         return {
             "params": params,
             "opt": opt_state,
@@ -271,13 +405,42 @@ class ElasticTrainer:
                 "fsdp.grad_reduce_scatter", "reduce_scatter", "fsdp",
                 nbytes=param_bytes // fsdp, count=1,
             )
-        if shape.get("dp", 1) > 1:
-            # grads entering the dp psum are fsdp-sharded when fsdp>1:
-            # per-shard payload is param_bytes/fsdp
-            record_collective(
-                "dp.grad_allreduce", "psum", "dp",
-                nbytes=param_bytes // max(fsdp, 1), count=1,
-            )
+        dp = shape.get("dp", 1)
+        if dp > 1:
+            mode = self._zero1_mode(self.mesh)
+            # grads entering the dp reduction are fsdp-sharded when
+            # fsdp>1: per-shard payload is param_bytes/fsdp. Under grad
+            # accumulation the partitioner reduces each microbatch's
+            # grads inside the scan body (a GSPMD grad is a *global*
+            # value the moment value_and_grad returns it — there is no
+            # unreduced representation for the accumulator to hold), so
+            # the reduction issues once per LOSS CALL, not once per
+            # step; the census-diff test (tests/test_zero1.py) pins
+            # this inventory against the lowered IR.
+            grad_payload = param_bytes // max(fsdp, 1)
+            if mode == "scatter":
+                # explicit psum_scatter straight into the zero-1 layout
+                # (train/zero1.py sharded_value_and_grad)
+                record_collective(
+                    "dp.grad_reduce_scatter", "reduce_scatter", "dp",
+                    nbytes=grad_payload // dp, count=1, per="loss_call",
+                )
+            else:
+                # replicated path AND gspmd zero-1: the dp reduction is
+                # a psum (under gspmd zero-1 the backend's
+                # allreduce-rewrite pass may lower it reduce-scatter;
+                # the SC001 census records what actually happened)
+                record_collective(
+                    "dp.grad_allreduce", "psum", "dp",
+                    nbytes=grad_payload, count=1, per="loss_call",
+                )
+            if mode != "off":
+                # zero-1's second half: the dp-sharded updates gather
+                # back into full params once per optimizer step
+                record_collective(
+                    "dp.param_all_gather", "all_gather", "dp",
+                    nbytes=grad_payload // dp, count=1,
+                )
 
     def _build_step(
         self,
@@ -308,15 +471,59 @@ class ElasticTrainer:
             if self.loss_factory is not None
             else self.loss_fn
         )
+        z1_mode = self._zero1_mode(mesh)
+        if z1_mode != "off" and self._params_avatar is None:
+            # zero-1 derives its per-leaf layout from the param shapes;
+            # a step built before any state exists (init_state and
+            # record_avatars both set the avatar) has nothing to derive
+            # from — and nothing it could run on either
+            logger.warning(
+                "zero-1 requested but no params avatar captured yet; "
+                "building the replicated step"
+            )
+            z1_mode = "off"
+        is_spec = lambda s: isinstance(s, P)  # noqa: E731
+        # the params' own layout, as placement targets: pins the f32
+        # grad accumulator (a full extra param-sized pytree that used
+        # to materialize with NO constraint — replicated under pure dp)
+        # and, under zero-1, the post-update param all-gather
+        param_put = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self.p_specs,
+            is_leaf=is_spec,
+        )
+        z1_grad_put = None
+        z1_grad_fn = None
+        if z1_mode != "off":
+            axis_sizes = dict(mesh.shape)
+            z1_grad_put = jax.tree.map(
+                lambda s, av: NamedSharding(
+                    mesh,
+                    zero1.partition_spec(s, av.shape, axis_sizes) or s,
+                ),
+                self.p_specs, self._params_avatar, is_leaf=is_spec,
+            )
+        if z1_mode == "scatter":
+            # pure-dp mesh: the loss+grad runs full-manual and the dp
+            # reduction is an explicit psum_scatter straight into the
+            # zero-1 layout — a REAL reduce-scatter in the lowered HLO
+            # on every backend (the dp4+zero1 contract pins it)
+            z1_grad_fn = zero1.sharded_value_and_grad(
+                self.loss_factory(None), mesh, self.p_specs,
+                self._params_avatar,
+            )
 
         def step(state, batch):
             # batch: any pytree whose leaves lead with (accum, micro*dp):
             # token arrays for the LM families, (images, labels) for CV
+            grad_of = (
+                z1_grad_fn if z1_grad_fn is not None
+                else jax.value_and_grad(loss_fn)
+            )
             if accum == 1:
                 # single microbatch: no accumulator scan — grads stay in
                 # param dtype and the f32 accumulation buffer (a full extra
                 # param-sized pytree) is never allocated
-                loss_sum, grads = jax.value_and_grad(loss_fn)(
+                loss_sum, grads = grad_of(
                     state["params"], jax.tree.map(lambda x: x[0], batch)
                 )
             else:
@@ -328,21 +535,33 @@ class ElasticTrainer:
                 # below absorbs its param-dtype dw chunks via promotion)
                 def micro_grads(carry, micro):
                     loss_sum, grads = carry
-                    loss, g = jax.value_and_grad(loss_fn)(
-                        state["params"], micro
-                    )
+                    loss, g = grad_of(state["params"], micro)
                     grads = jax.tree.map(jnp.add, grads, g)
                     return (loss_sum + loss, grads), None
 
+                # under zero-1 the accumulator itself lives dp-sharded
+                # (1/dp of the f32 tree per device — the same layout the
+                # scattered grads and the moments use)
+                acc_put = param_put if z1_mode == "off" else z1_grad_put
                 zero = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32),
-                    state["params"],
+                    lambda p, sh: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), sh
+                    ),
+                    state["params"], acc_put,
                 )
                 (loss_sum, grads), _ = jax.lax.scan(
                     micro_grads, (jnp.zeros((), jnp.float32), zero), batch
                 )
             scale = 1.0 / accum
             grads = jax.tree.map(lambda g: g * scale, grads)
+            if z1_mode != "off":
+                # the optimizer update runs on the dp shard: grads,
+                # moments (born sharded in init_state) and updates all
+                # carry the zero-1 layout; clip's global norm reduces a
+                # few scalars across dp, nothing param-sized
+                grads = jax.tree.map(
+                    jax.lax.with_sharding_constraint, grads, z1_grad_put
+                )
             updates, opt_state = self.optimizer.update(
                 grads, state["opt"], state["params"]
             )
@@ -351,7 +570,19 @@ class ElasticTrainer:
                 updates = jax.tree.map(
                     lambda u: u * lr_scale.astype(u.dtype), updates
                 )
+            if z1_mode != "off":
+                updates = jax.tree.map(
+                    jax.lax.with_sharding_constraint, updates, z1_grad_put
+                )
             params = optax.apply_updates(state["params"], updates)
+            if z1_mode != "off":
+                # zero-1's second half: the dp-sharded updates gather
+                # back into the params' own layout — the param
+                # all-gather that replaces the grad all-reduce's
+                # broadcast half
+                params = jax.tree.map(
+                    jax.lax.with_sharding_constraint, params, param_put
+                )
             out = {
                 "params": params,
                 "opt": opt_state,
@@ -384,11 +615,12 @@ class ElasticTrainer:
         self._params_avatar = jax.tree.map(_avatar_of, state["params"])
         self._batch_avatar = jax.tree.map(_avatar_of, batch)
 
-    def _config_hash(self) -> str:
+    def _config_hash(self, mesh: Mesh) -> str:
         """Model/config identity for the compile ledger: state-avatar
         shapes+dtypes (the program's real input signature — a model
         change or dtype change re-keys it) plus the trainer knobs that
-        shape the step. World-independent by construction."""
+        shape the step. World-independent except for the zero-1 marker,
+        which keys on what the step for ``mesh`` actually builds."""
         parts = [
             f"gb={self.tc.global_batch_size}",
             f"mb={self.tc.micro_batch_size}",
@@ -396,6 +628,16 @@ class ElasticTrainer:
             f"wd={self.tc.weight_decay}",
             f"clip={self.tc.grad_clip}",
         ]
+        if self._zero1_mode(mesh) != "off":
+            # asymmetric on purpose: contracts and compile-ledger keys
+            # generated before zero-1 existed keep their hashes while
+            # the feature is off. Keyed on the EFFECTIVE mode, not the
+            # request: a mesh where zero-1 cannot apply (dp<=1, pp>1)
+            # builds the replicated program and must hash like it —
+            # else an exported DLROVER_TPU_ZERO1=1 makes that program
+            # miss its own checked-in plain contract (a spurious
+            # config_hash-mismatch failure, a veto under strict mode)
+            parts.append("zero1=1")
         for av in jax.tree.leaves(self._state_avatar):
             parts.append(f"{av.shape}/{av.dtype}")
         return warm_compile.signature_hash(parts)
@@ -408,7 +650,7 @@ class ElasticTrainer:
         on the devices it was compiled for, so a mesh over different
         devices must miss here (and fall through to the persistent
         cache, which keys on topology, not identity)."""
-        config_hash = self._config_hash()
+        config_hash = self._config_hash(mesh)
         parts = [
             config_hash,
             str(sorted(mesh.shape.items())),
@@ -418,8 +660,11 @@ class ElasticTrainer:
             str(sorted(mesh_config.resolve(mesh.size).shape().items())),
             str(tuple(d.id for d in mesh.devices.flat)),
             f"accum={accum}",
+            # scatter and gspmd lower different programs, and a flag
+            # flip between builds must never warm-hit a stale executable
+            f"zero1={self._zero1_mode(mesh)}",
         ]
-        for av in jax.tree.leaves(self._state_avatar):
+        for av in jax.tree.leaves(self._state_avatar_for(mesh)):
             parts.append(f"{av.spec}")
         for av in jax.tree.leaves(self._batch_avatar):
             parts.append(f"{av.shape[2:]}/{av.dtype}")
@@ -431,11 +676,16 @@ class ElasticTrainer:
         to the target mesh; batch leading dims re-derive from the
         target's accumulation split."""
         dp = mesh_config.resolve(mesh.size).data_parallel_size
+        # zero-1 aware: the optimizer-state specs re-derive against the
+        # TARGET mesh (its dp size decides which dims shard), so the
+        # AOT signature, the transfer target and the restore placement
+        # all come from the same derivation
+        avatar = self._state_avatar_for(mesh)
         state_av = jax.tree.map(
             lambda av: jax.ShapeDtypeStruct(
                 av.shape, av.dtype, sharding=NamedSharding(mesh, av.spec)
             ),
-            self._state_avatar,
+            avatar,
         )
         bspec = NamedSharding(mesh, P(None, *batch_spec()))
         batch_av = jax.tree.map(
@@ -452,14 +702,15 @@ class ElasticTrainer:
         out_state_sh = {
             k: jax.tree.map(
                 lambda av: NamedSharding(mesh, av.spec),
-                self._state_avatar[k],
+                avatar[k],
             )
             for k in ("params", "opt", "step", "lr_scale")
-            if k in self._state_avatar
+            if k in avatar
         }
         out_sh = (out_state_sh, NamedSharding(mesh, P()))
         return state_av, batch_av, out_sh
 
+    @_pin_zero1
     def lower_step(
         self,
         mesh: Mesh,
@@ -531,8 +782,11 @@ class ElasticTrainer:
                 ):
                     hints["seq_len"] = int(av.shape[2])
                     break
+        z1 = self._zero1_mode(mesh) != "off"
         return shardcheck.StepProgram(
-            label=f"hlo:{shardcheck.mesh_spec_of(dict(mesh.shape))}",
+            label="hlo:" + shardcheck.contract_spec_of(
+                dict(mesh.shape), z1
+            ),
             stablehlo=lowered.as_text(),
             hlo=compiled.as_text(),
             axis_sizes=dict(mesh.shape),
@@ -540,6 +794,7 @@ class ElasticTrainer:
             vocab=hints.get("vocab"),
             world=mesh.size,
             config_hash=config_hash,
+            zero1=z1,
         )
 
     def _maybe_shardcheck(
@@ -562,7 +817,10 @@ class ElasticTrainer:
                 or shardcheck.DEFAULT_CONTRACTS_DIR
             )
             contract = shardcheck.load_contract(
-                contracts_dir, shardcheck.mesh_spec_of(dict(mesh.shape))
+                contracts_dir,
+                shardcheck.contract_spec_of(
+                    dict(mesh.shape), self._zero1_mode(mesh) != "off"
+                ),
             )
             if (
                 contract is not None
@@ -600,6 +858,7 @@ class ElasticTrainer:
         for v in violations:
             logger.warning("shardcheck: %s", v.format())
 
+    @_pin_zero1
     def step_ir(self, mesh=None, mesh_config=None, pinned: bool = True):
         """Lower (and compile — on the host, no device execution) the
         step for ``(mesh, mesh_config)`` and return the shardcheck
@@ -942,11 +1201,22 @@ class ElasticTrainer:
             # ladder falls through: state stays placed for the old mesh
             # and the caller's checkpoint restore path is untouched
             try:
-                avatars = (
-                    self._state_avatar
-                    if self._state_avatar is not None
-                    else jax.tree.map(_avatar_of, state)
-                )
+                if self._state_avatar is None:
+                    self._state_avatar = jax.tree.map(_avatar_of, state)
+                if self._params_avatar is None and "params" in state:
+                    # zero-1 derives its layout from the params avatar;
+                    # leaving it unseeded here would downgrade the next
+                    # _build_step to the replicated path while the
+                    # signature/ledger/contracts still say zero-1
+                    self._params_avatar = jax.tree.map(
+                        _avatar_of, state["params"]
+                    )
+                # zero-1 aware retarget: the new dp size (or a zero-1
+                # on/off flip taking effect at this resize boundary)
+                # re-derives every moment's layout, so dp-sharded
+                # moments remesh device-to-device like any other leaf —
+                # including the zero↔off transitions
+                avatars = self._state_avatar_for(mesh)
                 shardings = live_reshard.state_shardings(avatars, mesh)
                 new_state, transfer_info = live_reshard.transfer_state(
                     state, shardings

@@ -39,6 +39,9 @@ def parse_args():
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 weight-update sharding across dp "
+                   "(train/zero1.py; DLROVER_TPU_ZERO1 overrides)")
     p.add_argument("--ckpt-dir", default="/tmp/llama_pretrain_ckpt")
     p.add_argument("--save-every", type=int, default=10)
     p.add_argument("--data", default="",
@@ -93,6 +96,7 @@ def main():
         global_batch_size=args.global_batch or default_gb,
         micro_batch_size=args.micro_batch,
         total_steps=args.steps,
+        zero1=args.zero1,
     )
     trainer = ElasticTrainer(
         lambda p, t: llama.loss_fn(p, t, cfg, mesh),

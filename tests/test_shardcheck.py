@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.lint import contract_model, shardcheck
 from dlrover_tpu.lint.__main__ import main as lint_main
 
@@ -180,19 +181,25 @@ def test_census_improvements_reported(contract_setup, tmp_path):
     assert notes and key in notes[0]
 
 
-def test_checked_in_contracts_pass_for_all_three_meshes():
+def test_checked_in_contracts_pass_for_all_three_meshes(monkeypatch):
     """The acceptance gate: ``python -m dlrover_tpu.lint --hlo`` exits
     0 against the checked-in contracts for dp=4, dp=2×fsdp=2 and
-    sp=2×dp=2."""
+    sp=2×dp=2 — including with ``DLROVER_TPU_ZERO1`` exported, which
+    must NOT leak into the contract build (the spec decides the
+    variant; a leak would lower the zero-1 program and diff its
+    reduce-scatters against the plain census)."""
+    monkeypatch.setenv(flags.ZERO1.name, "1")
     assert lint_main(
         ["--hlo", "dp4", "--hlo", "dp2xfsdp2", "--hlo", "sp2xdp2"]
     ) == 0
 
 
-def test_async_start_collective_records_result_not_operand_bytes():
-    """An async ``all-gather-start`` has a (operand, result) tuple
-    type; the census must record the RESULT payload so sync and async
-    lowerings of the same transfer fingerprint identically."""
+def test_async_start_collective_records_sent_shard_bytes():
+    """An all-gather records the per-device SENT shard (result bytes /
+    participants) — the unit every other op and the analytic comm
+    ledger already use — and an async ``all-gather-start`` (whose type
+    is an (operand, result) tuple) must fingerprint identically to the
+    sync lowering of the same transfer."""
     coords = shardcheck.MeshCoords({"dp": 4})
     async_hlo = (
         "  %ags = (f32[4,8]{1,0}, f32[16,8]{1,0}) all-gather-start("
@@ -206,7 +213,7 @@ def test_async_start_collective_records_result_not_operand_bytes():
     )
     a = shardcheck.collective_census(async_hlo, coords)
     s = shardcheck.collective_census(sync_hlo, coords)
-    assert a == s == {"all-gather|dp": {"count": 1, "bytes": 16 * 8 * 4}}
+    assert a == s == {"all-gather|dp": {"count": 1, "bytes": 4 * 8 * 4}}
 
 
 def test_cli_rejects_mixed_ast_and_ir_modes():
