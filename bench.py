@@ -351,6 +351,132 @@ def _zero1_hbm_compare_legs(jax, llama) -> dict:
     return out
 
 
+def _bench_ckpt_dedup(jax, jnp, llama) -> dict:
+    """Replica-deduplicated persist + tiered restore legs of the ckpt
+    phase (checkpoint/ownership.py, docs/design/checkpoint_tiers.md).
+
+    ``persist``: the full-device dp world simulated as dp virtual
+    nodes (one engine per dp slice, ``ownership_world``); each persists
+    only its owned pieces through the local-disk tier, and the
+    per-node persisted bytes are compared against the replicated
+    baseline (every node writing the whole state — what every save
+    paid before dedup). ``tiered_restore``: node 0's shm AND local
+    disk are destroyed, then a replacement engine restores through the
+    tier ladder — union of the survivors' pieces + the object tier —
+    with the tier attribution from ``last_restore_stats``."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from dlrover_tpu.checkpoint.engine import CheckpointEngine
+    from dlrover_tpu.checkpoint.saver import local_tier_dir, step_dir
+    from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+
+    devs = jax.devices()
+    world = len(devs)
+    if world < 2:
+        return {"skipped": "single-device world: no replicas to dedup"}
+    mc = MeshConfig(dp=-1).resolve(world)
+    mesh = build_mesh(mc, devices=devs)
+    dp = int(mc.data_parallel_size)
+    if dp < 2:
+        return {"skipped": f"dp={dp}: no replicas to dedup"}
+    cfg = llama.LlamaConfig.tiny()
+    specs = llama.param_specs(cfg)
+    params = jax.jit(
+        lambda k: llama.init_params(cfg, k),
+        out_shardings=named_shardings(mesh, specs),
+    )(jax.random.key(3))
+    state = {"params": params, "step": jnp.array(7)}
+    # replicated baseline: each node used to stage+persist every unique
+    # shard it addresses — on this dp mesh the params are replicated, so
+    # that is the full state bytes PER NODE
+    baseline = int(sum(
+        int(np.prod(l.shape, dtype=np.int64)) * l.dtype.itemsize
+        for l in jax.tree.leaves(state)
+    ))
+    if baseline > (1 << 30):
+        _release(jax, params, state)
+        return {"skipped": f"state too large for the disk legs "
+                           f"({baseline} bytes)"}
+    from dlrover_tpu.common import flags as _flags
+
+    base = tempfile.mkdtemp(prefix="dlrover_bench_dedup_")
+    obj_dir = os.path.join(base, "obj")
+    engines = []
+    out = {"dp": dp, "replicated_baseline_bytes": baseline}
+    # pin the local tier INSIDE the bench tempdir: an operator's
+    # exported DLROVER_TPU_CKPT_LOCAL_DIR points at a real node SSD
+    # shared with live jobs — this leg deletes node dirs to simulate
+    # loss, and must never do that to the real tier
+    ctx = _flags.CKPT_LOCAL_DIR.scoped(os.path.join(base, "local"))
+    ctx.__enter__()
+    try:
+        t0 = time.perf_counter()
+        for k in range(dp):
+            eng = CheckpointEngine(
+                obj_dir, job_name="bench-dedup", node_id=k, process_id=k,
+                async_staging=False, ownership_world=(k, dp),
+            )
+            engines.append(eng)
+            eng.save_to_storage(1, state)
+            eng.wait_staging()
+        persist_wall = time.perf_counter() - t0
+        per_node = []
+        for k in range(dp):
+            node_dir = step_dir(local_tier_dir(obj_dir, k), 1)
+            nbytes = 0
+            for root, _, files in os.walk(node_dir):
+                nbytes += sum(
+                    os.path.getsize(os.path.join(root, f))
+                    for f in files if f.endswith(".bin")
+                )
+            per_node.append(nbytes)
+        out.update({
+            "per_node_persisted_bytes": per_node,
+            "max_node_bytes": max(per_node),
+            "dedup_ratio": round(max(per_node) / max(baseline, 1), 4),
+            "persist_wall_s": round(persist_wall, 4),
+        })
+        # ---- tiered restore with node 0 LOST (shm + local disk) ----
+        engines[0]._shm.close(unlink=True)
+        shutil.rmtree(local_tier_dir(obj_dir, 0), ignore_errors=True)
+        eng_r = CheckpointEngine(
+            obj_dir, job_name="bench-dedup", node_id=0, process_id=0,
+            async_staging=False, ownership_world=(0, dp),
+        )
+        engines.append(eng_r)
+        t0 = time.perf_counter()
+        restored = eng_r.load(target=state)
+        tiered = {"ok": restored is not None}
+        if restored is not None:
+            jax.block_until_ready(restored[1])
+            tiered["restore_s"] = round(time.perf_counter() - t0, 4)
+            tiered.update({
+                k: v for k, v in eng_r.last_restore_stats.items()
+                if k in ("tier", "tiers_read", "pieces", "bytes")
+            })
+            tiered["bitwise_equal"] = bool(all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(
+                    jax.tree.leaves(restored[1]), jax.tree.leaves(state)
+                )
+            ))
+            _release(jax, restored[1])
+        out["tiered_restore"] = tiered
+    finally:
+        ctx.__exit__(None, None, None)
+        _release(jax, params, state)
+        for eng in engines:
+            try:
+                eng.close(unlink_shm=True)
+            except Exception:
+                pass
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 LAST_TPU_RESULT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
 )
@@ -910,6 +1036,13 @@ def main():
                 "restore_from_shm_s": (
                     round(restore_s, 4) if restored is not None else None
                 ),
+                # tier + piece/byte attribution of that restore (the
+                # tiered ladder's tier-0 fast path — pinned by the
+                # bench contract alongside the dedup legs below)
+                "restore_stats": (
+                    dict(engine.last_restore_stats)
+                    if restored is not None else None
+                ),
                 "staged_gb": round(param_bytes / 2**30, 3),
                 "d2h_gbps": round(rate, 3) if on_tpu else None,
                 "trials": trials,
@@ -927,6 +1060,14 @@ def main():
         finally:
             engine.close()
             shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    if "skipped" not in ckpt and "error" not in ckpt:
+        # dedup persist + missing-node tiered restore legs (multi-device
+        # dp worlds only; self-skips on one device / oversized states)
+        try:
+            ckpt["dedup"] = _bench_ckpt_dedup(jax, jnp, llama)
+        except Exception as e:
+            ckpt["dedup"] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
 
     detail["ckpt"] = ckpt
     if "skipped" not in ckpt and "error" not in ckpt:

@@ -299,6 +299,7 @@ class MasterClient:
         rendezvous_s: float = 0.0,
         compile_s: float = 0.0,
         state_transfer_s: float = 0.0,
+        restore_tier: str = "",
     ):
         return self._client.report(
             msg.ResizeBreakdownReport(
@@ -306,6 +307,7 @@ class MasterClient:
                 rendezvous_s=rendezvous_s,
                 compile_s=compile_s,
                 state_transfer_s=state_transfer_s,
+                restore_tier=restore_tier,
             )
         )
 

@@ -108,6 +108,14 @@ class Checkpointer:
             logger.exception("orbax fallback restore failed")
         return None
 
+    @property
+    def last_restore_stats(self) -> dict:
+        """How the last targeted restore placed its leaves — including
+        ``tier`` (shm | disk | object) for tiered restores. Feed it to
+        ``report_resize_breakdown(restore_tier=...)`` /
+        ``trainer.note_restore_tier`` for goodput tier attribution."""
+        return self._engine.last_restore_stats
+
     def wait_staging(self, timeout: Optional[float] = None):
         """Join any in-flight background stage (and, in bare runs without
         an agent saver, its inline persist); re-raises a staging failure."""

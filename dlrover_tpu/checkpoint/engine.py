@@ -7,11 +7,27 @@ shm segment — the blocking cost of a save is one ``jax.device_get`` of local
 shards plus a host memcpy. Persist/commit happens asynchronously in the
 agent's saver.
 
-Restore:
-- same-world restart -> reassemble from this process's shm (seconds);
-- resized world      -> read the committed step from shared storage and
-  reshard via ``jax.make_array_from_callback`` (the reference only supports
-  same-world memory restore; mesh-aware resharding is TPU-native new work).
+Replica-deduplicated staging (``DLROVER_TPU_CKPT_DEDUP``, default on):
+on a dp-replicated mesh every process used to stage its full
+addressable view — dp identical copies of the replicated leaves per
+save. With dedup each process stages (and the saver persists) only the
+pieces it OWNS under the disjoint partition ``checkpoint/ownership.py``
+derives from the leaves' shardings — per-process staged+persisted
+bytes drop to ~1/dp on pure-dp meshes (Orbax's replica-aware
+persistence, arXiv:2605.23066).
+
+Restore is a tier ladder whose rungs UNION (each adds the pieces the
+previous rungs were missing, per step):
+- tier 0, shm      — this process's staged segment (fast restart);
+- tier 1, disk     — the node-local tier (union across this node's
+  process manifests);
+- tier 2, object   — the shared storage tier (union across ALL nodes'
+  manifests) — so a restore survives losing any single node's shm AND
+  local disk. ``last_restore_stats`` records the tier, piece count and
+  bytes. Disk/object pieces are CRC-verified; a corrupt piece demotes
+  to the next tier instead of restoring garbage. Incomplete coverage
+  after the last rung fails loudly (None + error log), never a
+  silently zero-filled state.
 """
 
 from __future__ import annotations
@@ -20,16 +36,19 @@ import os
 import threading
 import time
 import weakref
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from dlrover_tpu.checkpoint import ownership
 from dlrover_tpu.checkpoint.saver import (
     CKPT_EVENT_QUEUE,
     PERSIST_STATE_DICT,
     SHM_LOCK,
     CheckpointEvent,
     TRACKER_FILE,
+    local_tier_dir,
     step_dir,
 )
 from dlrover_tpu.checkpoint.shm_handler import (
@@ -51,14 +70,9 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.storage import CheckpointStorage, PosixDiskStorage
 
 
-def _index_to_ranges(index, shape) -> Tuple[Tuple[int, int], ...]:
-    """Normalize a jax shard index (tuple of slices) to (start, stop) pairs."""
-    out = []
-    for sl, dim in zip(index, shape):
-        start = 0 if sl.start is None else int(sl.start)
-        stop = dim if sl.stop is None else int(sl.stop)
-        out.append((start, stop))
-    return tuple(out)
+# save-side region keys and the ownership plan's must stay
+# byte-identical (staging matches one against the other) — single impl
+_index_to_ranges = ownership.index_to_ranges
 
 
 def _slice_pieces(
@@ -175,6 +189,8 @@ class CheckpointEngine:
         socket_path: str = "",
         master_client=None,
         async_staging: Optional[bool] = None,
+        dedup: Optional[bool] = None,
+        ownership_world: Optional[Tuple[int, int]] = None,
     ):
         from dlrover_tpu.common.constants import NodeEnv
 
@@ -242,8 +258,26 @@ class CheckpointEngine:
         #: how the last targeted restore placed its leaves: counts of
         #: "sliced" (single containing piece — zero assembly),
         #: "region_assembled" (requested extent built from overlapping
-        #: pieces) and "full_assembled" (host-target fallback)
-        self.last_restore_stats: Dict[str, int] = {}
+        #: pieces) and "full_assembled" (host-target fallback); tiered
+        #: restores add "tier" (shm|disk|object — the deepest rung that
+        #: had to contribute pieces), "tiers_read", "pieces" and "bytes"
+        self.last_restore_stats: Dict[str, Any] = {}
+        #: what the last stage kept vs skipped (replica-deduplicated
+        #: staging): staged_bytes / skipped_replica_bytes / dedup
+        self.last_stage_stats: Dict[str, Any] = {}
+        # Replica-deduplicated tiered checkpointing (ownership.py):
+        # `dedup` overrides the DLROVER_TPU_CKPT_DEDUP kill-switch;
+        # `ownership_world` = (rank, world) simulates an N-process world
+        # from one process (tests / the bench dedup leg) — the device
+        # list is split into `world` contiguous virtual nodes.
+        self._dedup = dedup
+        self._ownership_world = ownership_world
+        # the local tier is node-local disk by definition — plain posix,
+        # independent of the configurable object-tier storage
+        self._local_tier_storage = PosixDiskStorage()
+        # lazy; lives as long as the engine so its pending-fanout retry
+        # state survives across bare-run saves (_persist_inline)
+        self._inline_persister = None
 
     # -- IPC (lazy: standalone use without an agent works too) --------------
 
@@ -300,22 +334,98 @@ class CheckpointEngine:
 
     # -- save ---------------------------------------------------------------
 
-    def _gather_local_shards(self, state):
-        """device_get each leaf's unique addressable shards.
+    def _ownership_info(self):
+        """(rank, world, device->rank) when replica-deduplicated staging
+        applies, else None. Real worlds partition by process; the
+        ``ownership_world`` ctor override simulates N virtual nodes from
+        one process (tests, the bench dedup leg)."""
+        enabled = (
+            bool(self._dedup) if self._dedup is not None
+            else flags.CKPT_DEDUP.get()
+        )
+        if not enabled:
+            return None
+        if self._ownership_world is not None:
+            rank, world = self._ownership_world
+            if world <= 1:
+                return None
+            return int(rank), int(world), ownership.virtual_proc_of(world)
+        import jax
 
-        Returns (named_leaves, shard_info, host_state_leaves) where
-        named_leaves are (path#k, np array) entries for the shm segment.
+        world = jax.process_count()
+        if world <= 1:
+            return None
+        return jax.process_index(), world, ownership.real_proc_of()
+
+    def _tiering_enabled(self) -> bool:
+        """Tiered restore rides the same kill-switch as dedup staging —
+        but unlike staging it applies at ANY world size (a 1-process
+        world still restores through shm -> local disk -> object)."""
+        if self._dedup is not None:
+            return bool(self._dedup)
+        return flags.CKPT_DEDUP.get()
+
+    def _gather_local_shards(self, state):
+        """device_get each leaf's unique addressable shards — under
+        replica-deduplicated staging, only the shards this process OWNS
+        (ownership.py's disjoint partition; replicated leaves round-robin
+        across the dp replicas so each stages ~1/dp of them).
+
+        Returns (named_leaves, shard_info, treedef_bytes, leaf_paths)
+        where named_leaves are (path#k, np array) entries for the shm
+        segment and leaf_paths is the FULL flattened leaf set (restore
+        uses it to tell "never saved" from "piece missing").
         """
         import jax
 
         flat, treedef_bytes = flatten_state_lazy(state)
+        leaf_paths = [p for p, _ in flat]
+        own = self._ownership_info()
+        # one round-robin stream per stage, advanced in flatten order —
+        # identical on every process (ownership.py's determinism rule)
+        rr = ownership.RoundRobin() if own is not None else None
+        skipped_bytes = 0
         # Pass 1: select each leaf's unique addressable shards (replicated
         # duplicates are skipped, never transferred) and issue all their
         # device->host transfers together, so the copies overlap on the
         # transfer engine instead of serializing behind np.asarray.
-        plan: List[Tuple[str, Any, Tuple[int, ...], Tuple, Tuple[int, ...]]] = []
+        # Plan entries: (name, data, extent, shard ranges, global shape,
+        # owned sub-pieces). ``subs`` None stages the whole shard; a
+        # list means the shard region was dp-round-robin SPLIT and only
+        # the listed owned chunks are staged (sliced on host in pass 2).
+        plan: List[Tuple[str, Any, Tuple[int, ...], Tuple,
+                         Tuple[int, ...], Optional[list]]] = []
+
+        def _owned_vol(pieces) -> int:
+            total = 0
+            for a in pieces:
+                v = 1
+                for s, e in a.ranges:
+                    v *= max(0, e - s)
+                total += v
+            return total
+
         for path, leaf in flat:
             if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+                owned_by_parent = None
+                if own is not None:
+                    try:
+                        assigns = ownership.assign_leaf(
+                            tuple(leaf.shape), leaf.sharding, own[2], rr
+                        )
+                        owned_by_parent = {}
+                        for a in assigns:
+                            if a.owner == own[0]:
+                                owned_by_parent.setdefault(
+                                    a.parent_ranges, []
+                                ).append(a)
+                    except Exception as e:
+                        # degrade to staging every unique shard: a leaf we
+                        # cannot partition must never be silently dropped
+                        logger.warning(
+                            "ownership derivation failed for %s (%s); "
+                            "staging all unique shards", path, e,
+                        )
                 seen = set()
                 k = 0
                 for shard in leaf.addressable_shards:
@@ -323,6 +433,22 @@ class CheckpointEngine:
                     if ranges in seen:
                         continue
                     seen.add(ranges)
+                    shard_bytes = int(
+                        np.prod(shard.data.shape, dtype=np.int64)
+                        * shard.data.dtype.itemsize
+                    )
+                    subs = None
+                    if owned_by_parent is not None:
+                        mine = owned_by_parent.get(ranges, [])
+                        if not mine:
+                            skipped_bytes += shard_bytes
+                            continue
+                        if len(mine) > 1 or mine[0].ranges != ranges:
+                            subs = mine
+                            skipped_bytes += shard_bytes - (
+                                _owned_vol(mine)
+                                * shard.data.dtype.itemsize
+                            )
                     try:
                         shard.data.copy_to_host_async()
                     except Exception:
@@ -330,23 +456,64 @@ class CheckpointEngine:
                     extent = tuple(e - s for s, e in ranges)
                     plan.append(
                         (f"{path}#s{k}", shard.data, extent, ranges,
-                         tuple(leaf.shape))
+                         tuple(leaf.shape), subs)
                     )
                     k += 1
             else:
                 arr = np.asarray(leaf)
+                full = tuple((0, d) for d in arr.shape)
+                subs = None
+                if own is not None:
+                    mine = [
+                        a
+                        for a in ownership.assign_host_leaf(
+                            tuple(arr.shape), own[1], rr
+                        )
+                        if a.owner == own[0]
+                    ]
+                    if not mine:
+                        skipped_bytes += int(arr.nbytes)
+                        continue
+                    if len(mine) > 1 or mine[0].ranges != full:
+                        subs = mine
+                        skipped_bytes += int(arr.nbytes) - (
+                            _owned_vol(mine) * arr.dtype.itemsize
+                        )
                 plan.append(
-                    (f"{path}#s0", arr, tuple(arr.shape),
-                     tuple((0, d) for d in arr.shape), tuple(arr.shape))
+                    (f"{path}#s0", arr, tuple(arr.shape), full,
+                     tuple(arr.shape), subs)
                 )
         # Pass 2: consume (np.asarray reuses the host literal the async
         # copy produced, so this is a wait + memcpy, not a transfer).
+        # Split shards stage only their owned chunks — sliced views of
+        # the host shard, materialized by the shm memcpy.
         named_leaves: List[Tuple[str, np.ndarray]] = []
         shard_info: Dict[str, Tuple[Tuple[int, ...], Tuple]] = {}
-        for name, data, extent, ranges, gshape in plan:
-            named_leaves.append((name, np.asarray(data).reshape(extent)))
-            shard_info[name] = (gshape, ranges)
-        return named_leaves, shard_info, treedef_bytes
+        staged_bytes = 0
+        for name, data, extent, ranges, gshape, subs in plan:
+            host = np.asarray(data).reshape(extent)
+            if subs is None:
+                staged_bytes += int(host.nbytes)
+                named_leaves.append((name, host))
+                shard_info[name] = (gshape, ranges)
+                continue
+            for j, a in enumerate(subs):
+                rel = tuple(
+                    slice(s - ps, e - ps)
+                    for (s, e), (ps, _) in zip(a.ranges, ranges)
+                )
+                piece = np.ascontiguousarray(host[rel])
+                staged_bytes += int(piece.nbytes)
+                sub_name = f"{name}.{j}"
+                named_leaves.append((sub_name, piece))
+                shard_info[sub_name] = (gshape, a.ranges)
+        # single-writer (the staging thread); readers only sample it
+        self.last_stage_stats = {
+            "staged_bytes": staged_bytes,
+            "skipped_replica_bytes": skipped_bytes,
+            "dedup": own is not None,
+        }
+        return named_leaves, shard_info, treedef_bytes, leaf_paths
 
     def save_to_memory(self, step: int, state: Any) -> float:
         """Stage into shm; returns the blocking seconds (the training pause).
@@ -580,7 +747,7 @@ class CheckpointEngine:
     def _write_shm(self, step: int, snapshot):
         import jax
 
-        named_leaves, shard_info, treedef_bytes = snapshot
+        named_leaves, shard_info, treedef_bytes, leaf_paths = snapshot
         lock = self._lock()
         if lock is not None and not lock.acquire(timeout=120):
             raise TimeoutError(
@@ -595,6 +762,7 @@ class CheckpointEngine:
                 world_size=jax.process_count(),
                 process_id=self.process_id,
                 ckpt_dir=os.path.abspath(self.ckpt_dir),
+                leaf_paths=leaf_paths,
             )
         finally:
             if lock is not None:
@@ -643,29 +811,296 @@ class CheckpointEngine:
 
         from dlrover_tpu.checkpoint.saver import CheckpointPersister
 
-        persister = CheckpointPersister(
-            job_name=self.job_name,
-            node_id=self.node_id,
-            node_rank=jax.process_index(),
-            num_nodes=jax.process_count(),
-            local_process_ids=[self.process_id],
-            storage=self._storage,
-        )
-        persister.persist_step(self.ckpt_dir, step)
+        # one long-lived persister per engine, NOT per save: its
+        # _pending_fanout set is what lets a transiently-failed object
+        # fanout retry on the next cycle (and protects those steps from
+        # local-tier pruning) — a throwaway instance would discard both
+        if self._inline_persister is None:
+            self._inline_persister = CheckpointPersister(
+                job_name=self.job_name,
+                node_id=self.node_id,
+                node_rank=jax.process_index(),
+                num_nodes=jax.process_count(),
+                local_process_ids=[self.process_id],
+                storage=self._storage,
+            )
+        self._inline_persister.persist_step(self.ckpt_dir, step)
 
     # -- load ---------------------------------------------------------------
 
     def load(self, target: Any = None) -> Optional[Tuple[int, Any]]:
-        """Restore (step, state). shm first, storage fallback."""
+        """Restore (step, state) through the tier ladder: shm, then the
+        node-local disk tier, then the shared object tier — each rung
+        ADDING the pieces the previous rungs were missing (replica-
+        deduplicated saves spread the pieces across processes, so no
+        single rung need be complete). Kill-switch off: the legacy
+        two-rung shm -> storage restore."""
         try:
             self.wait_staging()
         except Exception as e:
             logger.warning("in-flight staging failed before load: %s", e)
-        result = self._load_from_memory(target)
-        if result is not None:
-            logger.info("restored step %s from shared memory", result[0])
+        if not self._tiering_enabled():
+            result = self._load_from_memory(target)
+            if result is not None:
+                logger.info("restored step %s from shared memory", result[0])
+                return result
+            return self._load_from_storage(target)
+        return self._load_tiered(target)
+
+    # -- tiered load (shm -> local disk -> object) --------------------------
+
+    def _staged_shm_meta(self):
+        """This process's staged shm meta, after the ownership gate
+        (a different job's Checkpointer staging under the same shm name
+        is not ours to restore)."""
+        meta = self._shm.read_meta()
+        if (
+            meta is not None and meta.ckpt_dir
+            and meta.ckpt_dir != os.path.abspath(self.ckpt_dir)
+        ):
+            logger.info(
+                "staged shm belongs to %s (this engine: %s); ignoring",
+                meta.ckpt_dir, os.path.abspath(self.ckpt_dir),
+            )
+            return None
+        return meta
+
+    def _load_tiered(self, target: Any = None):
+        import jax
+
+        shm_meta = self._staged_shm_meta()
+        shm_step = shm_meta.step if shm_meta is not None else -1
+        # Restore-time consistency gate: every process must attempt the
+        # SAME staged step, else one host restores step N and another
+        # N-1 and the job trains from a torn state (the legacy memory
+        # path's gate, kept verbatim for the shm rung).
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            steps = np.asarray(
+                multihost_utils.process_allgather(np.array([shm_step]))
+            ).reshape(-1)
+            if not (steps == steps[0]).all():
+                logger.warning(
+                    "staged steps disagree across processes (%s); "
+                    "ignoring the shm tier",
+                    steps.tolist(),
+                )
+                shm_meta, shm_step = None, -1
+        committed = self.committed_step()
+        # Agree on the committed candidate too: the tracker is a shared
+        # file a concurrent commit may be rewriting, so per-process
+        # reads can return N and N-1 — candidate lists of different
+        # content (torn adoption) or length (mismatched collective
+        # counts below = hang). The MIN is the value every process has
+        # definitely observed as committed.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            committed = int(
+                np.asarray(
+                    multihost_utils.process_allgather(
+                        np.array([committed])
+                    )
+                ).min()
+            )
+        candidates = []
+        if shm_step >= 0:
+            candidates.append(shm_step)
+        if committed >= 0 and committed != shm_step:
+            candidates.append(committed)
+        # The candidate list is now identical on every process (both
+        # entries were just agreed), so the loop ITSELF needs agreement
+        # only on each attempt's OUTCOME: one node may cover an
+        # uncommitted staged step from its tiers while another cannot
+        # (its peer's fanout died mid-write) — returning per-process
+        # would resume one host at step N and another at M < N, a torn
+        # state. Every process therefore votes after each attempt and a
+        # candidate is adopted only unanimously.
+        for step in candidates:
+            result = self._restore_step_tiered(
+                step, target, shm_meta if step == shm_step else None
+            )
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                oks = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.array([1 if result is not None else 0])
+                    )
+                ).reshape(-1)
+                if not oks.all():
+                    if result is not None:
+                        logger.warning(
+                            "step %s restorable here but not on %d peer "
+                            "process(es); discarding for the next "
+                            "candidate", step, int((oks == 0).sum()),
+                        )
+                    result = None
+            if result is not None:
+                return result
+        if candidates:
+            # fail LOUDLY: coverage gaps after the last rung mean lost
+            # pieces, and a silently partial (zero-filled) state is the
+            # one outcome worse than no restore at all
+            logger.error(
+                "tiered restore failed: no tier union covers the target "
+                "for candidate steps %s (shm/local-disk/object read)",
+                candidates,
+            )
+        return None
+
+    def _merge_tier_pieces(
+        self, storage, sdir: str, step: int, pieces, seen, expected
+    ) -> Tuple[str, int, int]:
+        """Merge one disk-layout tier's pieces for ``step`` into
+        ``pieces``, skipping (leaf, region)s already supplied by an
+        earlier rung and CRC-verifying each leaf file (a corrupt piece
+        is dropped with a warning — the next rung supplies it).
+        Returns (treedef_hex, pieces_added, bytes_added)."""
+        added_p = added_b = 0
+        tdef = ""
+        for name in storage.listdir(sdir):
+            if not name.startswith("proc-"):
+                continue
+            pdir = os.path.join(sdir, name)
+            try:
+                meta = CheckpointMeta.from_json(
+                    storage.read(os.path.join(pdir, "meta.json")).decode()
+                )
+            except (FileNotFoundError, ValueError, KeyError):
+                continue  # manifest-less dir = torn write; skip it
+            if meta.step != step:
+                continue
+            tdef = tdef or meta.treedef_hex
+            if not expected and meta.leaf_paths:
+                # every manifest records the same complete list, in
+                # flatten order — first one wins
+                expected.extend(meta.leaf_paths)
+            for i, lm in enumerate(meta.leaves):
+                base = lm.path.rsplit("#", 1)[0]
+                key = (base, lm.index)
+                if key in seen:
+                    continue
+                try:
+                    data = storage.read(os.path.join(pdir, f"leaf-{i}.bin"))
+                except (FileNotFoundError, OSError):
+                    continue
+                if lm.crc32 and zlib.crc32(data) != (lm.crc32 & 0xFFFFFFFF):
+                    logger.warning(
+                        "CRC mismatch for %s piece %s under %s; dropping "
+                        "the corrupt piece (a later tier supplies it)",
+                        base, lm.index, pdir,
+                    )
+                    continue
+                try:
+                    arr = np.frombuffer(
+                        data, dtype=resolve_dtype(lm.dtype)
+                    ).reshape(lm.shape)
+                except (ValueError, TypeError) as e:
+                    logger.warning(
+                        "unreadable piece %s under %s (%s); dropping",
+                        base, pdir, e,
+                    )
+                    continue
+                pieces.setdefault(base, []).append(
+                    (lm.index, arr, lm.global_shape)
+                )
+                seen.add(key)
+                added_p += 1
+                added_b += len(data)
+        return tdef, added_p, added_b
+
+    def _restore_step_tiered(self, step: int, target, shm_meta):
+        """Accumulate pieces for ``step`` rung by rung, attempting the
+        assemble after every rung that contributed — the deepest rung
+        actually read is the restore's tier attribution."""
+        pieces: Dict[str, List[Tuple[Tuple, np.ndarray, Tuple[int, ...]]]] = {}
+        seen: set = set()
+        expected: List[str] = []  # full leaf list, manifest flatten order
+        treedef_hex = ""
+        contributed: List[str] = []
+        total_p = total_b = 0
+
+        def attempt():
+            if not pieces:
+                return None
+            return self._assemble(
+                step, (treedef_hex, pieces), target, full_data=False,
+                expected_paths=expected or None,
+            )
+
+        def success(result):
+            stats = (
+                self.last_restore_stats if target is not None else {}
+            )
+            stats["tier"] = contributed[-1] if contributed else "shm"
+            stats["tiers_read"] = list(contributed)
+            stats["pieces"] = total_p
+            stats["bytes"] = total_b
+            self.last_restore_stats = stats
+            logger.info(
+                "restored step %s via tier %s (%d pieces, %d bytes, "
+                "rungs read: %s)",
+                step, stats["tier"], total_p, total_b, contributed,
+            )
             return result
-        return self._load_from_storage(target)
+
+        # tier 0: this process's shm segment
+        if shm_meta is not None and shm_meta.step == step:
+            treedef_hex = shm_meta.treedef_hex
+            if not expected and shm_meta.leaf_paths:
+                expected.extend(shm_meta.leaf_paths)
+            _, shm_pieces = self._read_pieces_from_shm(
+                shm_meta, copy=target is None
+            )
+            added = 0
+            for base, plist in shm_pieces.items():
+                for idx, arr, gshape in plist:
+                    key = (base, tuple(idx))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    pieces.setdefault(base, []).append((idx, arr, gshape))
+                    added += 1
+                    total_b += int(arr.nbytes)
+            if added:
+                total_p += added
+                contributed.append("shm")
+                result = attempt()
+                if result is not None:
+                    return success(result)
+        # tier 1: the node-local disk tier (union across this node's
+        # process manifests)
+        local_sdir = step_dir(
+            local_tier_dir(self.ckpt_dir, self.node_id), step
+        )
+        tdef, p, b = self._merge_tier_pieces(
+            self._local_tier_storage, local_sdir, step, pieces, seen,
+            expected,
+        )
+        treedef_hex = treedef_hex or tdef
+        if p:
+            total_p += p
+            total_b += b
+            contributed.append("disk")
+            result = attempt()
+            if result is not None:
+                return success(result)
+        # tier 2: the shared object tier (union across ALL nodes)
+        obj_sdir = step_dir(self.ckpt_dir, step)
+        tdef, p, b = self._merge_tier_pieces(
+            self._storage, obj_sdir, step, pieces, seen, expected
+        )
+        treedef_hex = treedef_hex or tdef
+        if p:
+            total_p += p
+            total_b += b
+            contributed.append("object")
+            result = attempt()
+            if result is not None:
+                return success(result)
+        return None
 
     def _load_from_memory(self, target: Any = None):
         import jax
@@ -720,41 +1155,45 @@ class CheckpointEngine:
         # (no up-front whole-leaf memcpy). Without a target the restored
         # pytree itself would alias shm — copy as before.
         pieces = self._read_pieces_from_shm(meta, copy=target is None)
-        return self._assemble(meta.step, pieces, target, full_data=False)
+        result = self._assemble(meta.step, pieces, target, full_data=False)
+        if result is not None and target is not None:
+            stats = self.last_restore_stats
+            stats["tier"] = "shm"
+            stats["tiers_read"] = ["shm"]
+            stats["pieces"] = len(meta.leaves)
+            stats["bytes"] = int(sum(lm.nbytes for lm in meta.leaves))
+        return result
 
     def _load_from_storage(self, target: Any = None):
+        """Legacy (kill-switch-off) storage restore — one object rung
+        through the same manifest reader as the ladder, so CRC
+        verification and torn-dir skipping apply here too."""
         step = self.committed_step()
         if step < 0:
             return None
         sdir = step_dir(self.ckpt_dir, step)
         pieces: Dict[str, List[Tuple[Tuple, np.ndarray, Tuple[int, ...]]]] = {}
-        treedef_hex = ""
-        for name in self._storage.listdir(sdir):
-            if not name.startswith("proc-"):
-                continue
-            proc_dir = os.path.join(sdir, name)
-            try:
-                meta = CheckpointMeta.from_json(
-                    self._storage.read(os.path.join(proc_dir, "meta.json")).decode()
-                )
-            except FileNotFoundError:
-                continue
-            treedef_hex = treedef_hex or meta.treedef_hex
-            for i, leaf_meta in enumerate(meta.leaves):
-                data = self._storage.read(os.path.join(proc_dir, f"leaf-{i}.bin"))
-                arr = np.frombuffer(
-                    data, dtype=resolve_dtype(leaf_meta.dtype)
-                ).reshape(leaf_meta.shape)
-                base = leaf_meta.path.rsplit("#", 1)[0]
-                pieces.setdefault(base, []).append(
-                    (leaf_meta.index, arr, leaf_meta.global_shape)
-                )
+        expected: List[str] = []
+        treedef_hex, _, _ = self._merge_tier_pieces(
+            self._storage, sdir, step, pieces, set(), expected
+        )
         if not pieces:
             return None
         result = self._assemble(
-            step, (treedef_hex, pieces), target, full_data=True
+            step, (treedef_hex, pieces), target, full_data=True,
+            expected_paths=expected or None,
         )
         if result is not None:
+            if target is not None:
+                stats = self.last_restore_stats
+                stats["tier"] = "object"
+                stats["tiers_read"] = ["object"]
+                stats["pieces"] = sum(len(p) for p in pieces.values())
+                stats["bytes"] = int(sum(
+                    a.nbytes
+                    for plist in pieces.values()
+                    for _, a, _ in plist
+                ))
             logger.info("restored step %s from storage %s", step, sdir)
         return result
 
@@ -768,13 +1207,26 @@ class CheckpointEngine:
             )
         return meta.treedef_hex, pieces
 
-    def _assemble(self, step, treedef_and_pieces, target, full_data: bool):
+    def _assemble(
+        self, step, treedef_and_pieces, target, full_data: bool,
+        expected_paths: Optional[List[str]] = None,
+    ):
         """Rebuild the pytree. With a ``target`` (pytree of jax.Arrays or
         ShapeDtypeStructs with shardings) arrays are placed per the target's
-        sharding; otherwise plain numpy arrays are returned."""
+        sharding; otherwise plain numpy arrays are returned.
+
+        ``expected_paths`` (tiered restores): the checkpoint's FULL leaf
+        list from its manifests, in flatten order. A target leaf in that
+        set with no pieces is MISSING DATA — return None so the caller
+        reads the next tier (or fails loudly) — while a target leaf
+        outside it was never saved (a new state field) and legitimately
+        keeps its target value. Targetless restores also rebuild in the
+        manifest's leaf order (merged multi-process pieces arrive
+        grouped by process, not in flatten order)."""
         import jax
 
         treedef_hex, pieces = treedef_and_pieces
+        expected_set = set(expected_paths) if expected_paths else None
 
         def build_full(path: str) -> Optional[np.ndarray]:
             plist = pieces.get(path)
@@ -835,7 +1287,15 @@ class CheckpointEngine:
             if not plist:
                 return False
             if not (isinstance(t_leaf, jax.Array) or hasattr(t_leaf, "sharding")):
-                return True
+                # host (unsharded) target: build_full materializes the
+                # WHOLE array, so the pieces' union must tile all of it
+                # — under dedup staging this process's shm holds only
+                # its chunk of a split host leaf, and waving it through
+                # would zero-fill the non-owned ranges
+                gshape = tuple(plist[0][2])
+                return region_covered(
+                    tuple((0, d) for d in gshape), plist
+                )
             shape = tuple(t_leaf.shape)
             # dedup via the normalized (start, stop) form: raw shard
             # indices are tuples of slice objects, which are unhashable
@@ -858,6 +1318,13 @@ class CheckpointEngine:
                 key = jax.tree_util.keystr(path)
                 plist = pieces.get(key)
                 if not plist:
+                    if expected_set is not None and key in expected_set:
+                        logger.warning(
+                            "leaf %s is in the checkpoint manifest but no "
+                            "pieces are available from the tiers read so "
+                            "far", key,
+                        )
+                        return None
                     logger.warning("checkpoint missing leaf %s; keeping target", key)
                     out_leaves.append(t_leaf)
                     continue
@@ -914,8 +1381,19 @@ class CheckpointEngine:
 
         # no target: numpy pytree via stored treedef
         full_leaves = []
-        paths = list(pieces.keys())
-        # stored leaf order == flatten order (paths recorded in order)
+        if expected_set is not None:
+            missing = [p for p in expected_paths if p not in pieces]
+            if missing:
+                logger.warning(
+                    "checkpoint leaves %s have no pieces in the tiers "
+                    "read so far", missing[:3],
+                )
+                return None
+            paths = list(expected_paths)  # manifest flatten order
+        else:
+            # legacy: stored leaf order == flatten order (single-process
+            # metas record paths in order)
+            paths = list(pieces.keys())
         for path in paths:
             plist = pieces[path]
             if not full_data:

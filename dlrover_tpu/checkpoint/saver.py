@@ -7,17 +7,31 @@ via per-node done-files + a tracker file, and persists the latest staged
 shm checkpoint when the node is about to die (save-on-failure /
 save-on-SIGTERM).
 
-Storage layout::
+Storage layout (mirrored by BOTH disk tiers)::
 
-    <ckpt_dir>/
+    <ckpt_dir>/                        # tier 2: shared "object" storage
       latest_step.txt                  # tracker: last committed step
       step-<N>/
-        node-<node_rank>.done          # commit votes
+        node-<node_rank>.done          # commit votes (written after fanout)
         proc-<pid>/
-          meta.json                    # CheckpointMeta (incl. shard index)
+          meta.json                    # CheckpointMeta manifest (shard
+                                       # index + per-leaf CRC32)
           leaf-<i>.bin                 # raw little-endian bytes per staged
                                        # shard (dtype/shape in meta.json —
                                        # np.save can't round-trip bfloat16)
+    <local_root>/node-<id>/            # tier 1: node-local disk
+      step-<N>/proc-<pid>/...          # same proc-dir layout
+
+Tiered persist (``DLROVER_TPU_CKPT_DEDUP``, the default): the shm
+copy lands on the node-LOCAL disk tier first — a parallel pool of leaf
+writers (FastPersist-style, arXiv:2406.13768), per-piece manifests
+with CRC32 checksums, manifest written last so a torn proc dir is
+never read as valid — and only then fans out to the shared object tier
+in the background, off the shm lock. The commit vote moves to the end
+of the fanout: a node votes once its pieces are durable on SHARED
+storage, so the tracker's committed step is restorable after full node
+loss. With the kill-switch off the legacy single-hop shm->object copy
+(and its vote placement) is byte-identical to before.
 
 ``CheckpointPersister`` is the storage-side logic; ``AsyncCheckpointSaver``
 adds the IPC server + event loop the agent hosts.
@@ -25,15 +39,19 @@ adds the IPC server + event loop the agent hosts.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import queue
 import threading
 import time
+import zlib
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.constants import CheckpointConstant
 from dlrover_tpu.common.ipc import IpcServer, SharedQueue, default_socket_path
 from dlrover_tpu.common.log import logger
@@ -84,6 +102,22 @@ def step_dir(ckpt_dir: str, step: int) -> str:
     return os.path.join(ckpt_dir, f"step-{step}")
 
 
+def local_tier_dir(ckpt_dir: str, node_id: int) -> str:
+    """This node's local-disk checkpoint tier (tier 1).
+
+    ``DLROVER_TPU_CKPT_LOCAL_DIR`` points it at a node-local SSD /
+    emptyDir volume (deploy/k8s/README.md); unset, it defaults under
+    the checkpoint dir — correctness-equivalent (the tier ladder still
+    works), just without the locality win. The ``node-<id>`` suffix
+    keeps simulated multi-node worlds (tests, the bench dedup leg) on
+    one host from sharing a tier they are supposed to lose
+    independently."""
+    root = flags.CKPT_LOCAL_DIR.get()
+    if not root:
+        root = os.path.join(os.path.abspath(ckpt_dir), "_local")
+    return os.path.join(root, f"node-{node_id}")
+
+
 class CheckpointPersister:
     """shm -> storage persistence + the commit/tracker protocol."""
 
@@ -104,10 +138,16 @@ class CheckpointPersister:
         self.num_nodes = num_nodes
         self.local_process_ids = local_process_ids or [0]
         self._storage = storage or PosixDiskStorage()
+        # the local tier is node-local disk BY DEFINITION — always posix,
+        # independent of the (configurable) object-tier storage impl
+        self._local_storage = PosixDiskStorage()
         self._deletion = deletion_strategy or KeepLatestStepStrategy(3)
         self._commit_timeout = commit_timeout
         self._stop_evt = threading.Event()
         self._persisted_steps: set = set()
+        #: steps copied to the local tier whose object fanout (+ vote)
+        #: has not run yet — fan_out_step drains it
+        self._pending_fanout: set = set()
         self.last_persist_dir = ""
 
     def stop(self):
@@ -156,15 +196,24 @@ class CheckpointPersister:
                 by_step.setdefault(meta.step, []).append((meta, h))
             if not by_step:
                 return []
+            tiered = flags.CKPT_DEDUP.get()
             complete_steps = []
             for s, pairs in sorted(by_step.items()):
                 for meta, h in pairs:
-                    self._write_process_ckpt(ckpt_dir, meta, h)
+                    self._write_process_ckpt(ckpt_dir, meta, h, tiered)
                 if len(pairs) == len(self.local_process_ids):
-                    done_path = os.path.join(
-                        step_dir(ckpt_dir, s), f"node-{self.node_rank}.done"
-                    )
-                    self._storage.write(b"1", done_path)
+                    if tiered:
+                        # pieces are durable on the LOCAL tier; the
+                        # commit vote waits for the object fanout
+                        # (fan_out_step) so a committed step survives
+                        # losing this node outright
+                        self._pending_fanout.add(s)
+                    else:
+                        done_path = os.path.join(
+                            step_dir(ckpt_dir, s),
+                            f"node-{self.node_rank}.done",
+                        )
+                        self._storage.write(b"1", done_path)
                     self._persisted_steps.add(s)
                     complete_steps.append(s)
                 else:
@@ -190,38 +239,170 @@ class CheckpointPersister:
         self, ckpt_dir: str, step: int = -1,
         commit_timeout: Optional[float] = None,
     ) -> bool:
-        """Copy + commit (commit waits for other nodes; call off the shm
-        lock — see AsyncCheckpointSaver's event loop)."""
+        """Copy + fan out + commit (the commit waits for other nodes;
+        call off the shm lock — see AsyncCheckpointSaver's event loop)."""
         steps = self.copy_step_to_storage(ckpt_dir, step)
-        for s in steps:
+        # drain ALL pending fanouts (retries earlier transient object-
+        # store failures), then vote-wait on every step that either was
+        # just copied (legacy mode) or just cleared its fanout —
+        # including earlier steps whose retry finally landed
+        cleared = self.drain_fanouts(ckpt_dir)
+        for s in sorted(set(steps) | set(cleared)):
             self._maybe_commit(ckpt_dir, s, timeout=commit_timeout)
         return bool(steps)
 
+    def _persist_pool_size(self, n_files: int) -> int:
+        return max(1, min(int(flags.CKPT_PERSIST_WORKERS.get()), n_files))
+
     def _write_process_ckpt(
-        self, ckpt_dir: str, meta: CheckpointMeta, handler: SharedMemoryHandler
+        self,
+        ckpt_dir: str,
+        meta: CheckpointMeta,
+        handler: SharedMemoryHandler,
+        tiered: bool = False,
     ):
+        """One process's staged pieces -> a proc dir: leaf files written
+        by the parallel persist pool, then the manifest (meta.json, with
+        per-leaf CRC32) LAST — a crash mid-write leaves a manifest-less
+        dir that restore skips, never a torn-but-valid checkpoint.
+        ``tiered`` writes to the node-local disk tier (the object copy
+        is fan_out_step's job); legacy mode writes straight to the
+        object storage as before."""
+        dest = self._local_storage if tiered else self._storage
+        root = (
+            local_tier_dir(ckpt_dir, self.node_id) if tiered else ckpt_dir
+        )
         proc_dir = os.path.join(
-            step_dir(ckpt_dir, meta.step), f"proc-{meta.process_id}"
+            step_dir(root, meta.step), f"proc-{meta.process_id}"
         )
-        self._storage.makedirs(proc_dir)
-        for i, leaf_meta in enumerate(meta.leaves):
+        dest.makedirs(proc_dir)
+
+        def write_leaf(item):
+            i, leaf_meta = item
             arr = handler.read_leaf(leaf_meta, copy=False)
-            # raw bytes, not np.save: extended dtypes (bfloat16 etc.) do not
-            # survive a .npy round-trip (they come back as void); dtype and
-            # shape live in meta.json
-            self._storage.write(
-                np.ascontiguousarray(arr).tobytes(),
-                os.path.join(proc_dir, f"leaf-{i}.bin"),
-            )
-        self._storage.write(
-            meta.to_json().encode(), os.path.join(proc_dir, "meta.json")
+            # raw bytes, not np.save: extended dtypes (bfloat16 etc.) do
+            # not survive a .npy round-trip (they come back as void);
+            # dtype and shape live in meta.json
+            data = np.ascontiguousarray(arr).tobytes()
+            dest.write(data, os.path.join(proc_dir, f"leaf-{i}.bin"))
+            return zlib.crc32(data)
+
+        items = list(enumerate(meta.leaves))
+        workers = self._persist_pool_size(len(items))
+        if workers > 1:
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="ckpt-persist"
+            ) as pool:
+                crcs = list(pool.map(write_leaf, items))
+        else:
+            crcs = [write_leaf(it) for it in items]
+        manifest = dataclasses.replace(
+            meta,
+            leaves=[
+                dataclasses.replace(lm, crc32=crc)
+                for lm, crc in zip(meta.leaves, crcs)
+            ],
         )
+        dest.write(
+            manifest.to_json().encode(), os.path.join(proc_dir, "meta.json")
+        )
+
+    def drain_fanouts(self, ckpt_dir: str) -> List[int]:
+        """Fan out every pending step (oldest first) — the retry path:
+        a step whose object fanout failed transiently stays pending and
+        is re-attempted on the next persist cycle. Returns the steps
+        that cleared (callers owe them a commit wait)."""
+        pending = sorted(self._pending_fanout)
+        for s in pending:
+            self.fan_out_step(ckpt_dir, s)
+        return [s for s in pending if s not in self._pending_fanout]
+
+    def fan_out_step(self, ckpt_dir: str, step: int):
+        """Background half of a tiered persist: copy the step's local
+        proc dirs to the shared object tier (parallel pool, manifests
+        last), then cast this node's commit vote. Runs OFF the shm lock
+        — it reads local files, not shm — so a slow object store never
+        stalls the trainer's next save. No-op for steps the local copy
+        didn't mark pending (legacy mode, or another saver's step). On
+        failure the step STAYS pending (drain_fanouts retries it);
+        only a successful fanout — or the step's local dir having been
+        pruned — unqueues it."""
+        if step not in self._pending_fanout:
+            return
+        local_sdir = step_dir(local_tier_dir(ckpt_dir, self.node_id), step)
+        if not self._local_storage.exists(local_sdir):
+            # pruned from the local tier before the fanout ever
+            # succeeded: nothing left to ship, stop retrying
+            self._pending_fanout.discard(step)
+            logger.warning(
+                "pending fanout of step %s dropped: local dir %s is gone",
+                step, local_sdir,
+            )
+            return
+        obj_sdir = step_dir(ckpt_dir, step)
+        copies: List[tuple] = []
+        manifests: List[tuple] = []
+        for proc in self._local_storage.listdir(local_sdir):
+            if not proc.startswith("proc-"):
+                continue
+            pdir = os.path.join(local_sdir, proc)
+            for name in self._local_storage.listdir(pdir):
+                pair = (
+                    os.path.join(pdir, name),
+                    os.path.join(obj_sdir, proc, name),
+                )
+                (manifests if name == "meta.json" else copies).append(pair)
+        try:
+            workers = self._persist_pool_size(len(copies))
+            if workers > 1:
+                with ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="ckpt-fanout"
+                ) as pool:
+                    list(
+                        pool.map(lambda p: self._storage.put_file(*p), copies)
+                    )
+            else:
+                for pair in copies:
+                    self._storage.put_file(*pair)
+            for pair in manifests:  # manifests last: object commit marker
+                self._storage.put_file(*pair)
+            self._storage.write(
+                b"1",
+                os.path.join(obj_sdir, f"node-{self.node_rank}.done"),
+            )
+        except Exception:
+            # the step stays restorable from the local tier AND stays
+            # pending — drain_fanouts retries it next cycle; without
+            # this node's vote the tracker will not advance to it
+            logger.exception(
+                "object-tier fanout of step %s failed; no commit vote "
+                "cast (will retry)", step,
+            )
+            return
+        self._pending_fanout.discard(step)
+        # every node prunes its OWN local tier (the object tier is
+        # pruned by node-rank 0 at commit time; non-rank-0 nodes would
+        # otherwise grow their node-local SSD without bound)
+        try:
+            self._apply_local_deletion(ckpt_dir)
+        except Exception:
+            logger.exception("local-tier pruning failed")
 
     def _maybe_commit(
         self, ckpt_dir: str, step: int, timeout: Optional[float] = None
     ):
         """Node-rank-0's saver waits for all nodes' votes then commits."""
         if self.node_rank != 0:
+            return
+        if step in self._pending_fanout:
+            # our own fanout (and so our own vote) has not landed —
+            # polling for all votes would block the event loop for the
+            # full commit timeout; the drain retry will bring the step
+            # back through here once the vote is cast
+            logger.warning(
+                "step %s: fanout still pending, skipping the commit wait",
+                step,
+            )
             return
         sdir = step_dir(ckpt_dir, step)
         deadline = time.time() + (
@@ -243,19 +424,41 @@ class CheckpointPersister:
             time.sleep(0.5)
         logger.warning("step %s: only partial commit votes after timeout", step)
 
-    def _apply_deletion(self, ckpt_dir: str):
+    def _prune_tier(self, store, root: str, committed: int, protect=()):
         steps = []
-        for name in self._storage.listdir(ckpt_dir):
+        for name in store.listdir(root):
             if name.startswith("step-"):
                 try:
                     steps.append(int(name.split("-", 1)[1]))
                 except ValueError:
                     continue
-        committed = self.committed_step(ckpt_dir)
-        removable = [s for s in self._deletion.to_delete(steps) if s != committed]
+        removable = [
+            s
+            for s in self._deletion.to_delete(steps)
+            if s != committed and s not in protect
+        ]
         for s in removable:
-            self._storage.delete(step_dir(ckpt_dir, s))
-            logger.info("deleted old checkpoint step %s", s)
+            store.delete(step_dir(root, s))
+            logger.info("deleted old checkpoint step %s under %s", s, root)
+
+    def _apply_deletion(self, ckpt_dir: str):
+        """Object-tier pruning — node-rank 0 only (commit time)."""
+        committed = self.committed_step(ckpt_dir)
+        self._prune_tier(self._storage, ckpt_dir, committed)
+
+    def _apply_local_deletion(self, ckpt_dir: str):
+        """Local-tier pruning — EVERY node, after each successful
+        fanout: the node-local SSD holds the same step dirs as the
+        object tier with far less room. Steps still awaiting their
+        object fanout are protected (their only durable copy is
+        local)."""
+        committed = self.committed_step(ckpt_dir)
+        self._prune_tier(
+            self._local_storage,
+            local_tier_dir(ckpt_dir, self.node_id),
+            committed,
+            protect=frozenset(self._pending_fanout),
+        )
 
     def save_shm_to_storage(
         self, ckpt_dir: str = "", commit_timeout: Optional[float] = None
@@ -284,7 +487,13 @@ class CheckpointPersister:
             )
             return False
         if steps <= self._persisted_steps:
-            return True
+            # the staged steps' local copies exist — but a step whose
+            # OBJECT fanout failed transiently is still pending, and
+            # this (death-path) save is its last chance to reach
+            # storage that outlives the node
+            if self._pending_fanout:
+                self.drain_fanouts(ckpt_dir)
+            return not self._pending_fanout
         return self.persist_step(ckpt_dir, commit_timeout=commit_timeout)
 
     def committed_step(self, ckpt_dir: str) -> int:
@@ -506,10 +715,14 @@ class AsyncCheckpointSaver:
                             event.ckpt_dir, event.step
                         )
                     # release back-pressure NOW: the copy the trainer is
-                    # waiting on is done; commit waits and replica pushes
-                    # below can take minutes and must not stall training
+                    # waiting on is done; the object fanout reads LOCAL
+                    # files (not shm), and commit waits and replica pushes
+                    # can take minutes — none of it may stall training
                     self._release_persist_waiters(event.step)
-                    for s in steps:
+                    # drain retries earlier failed fanouts too; commit-
+                    # wait everything that copied or newly cleared
+                    cleared = self.persister.drain_fanouts(event.ckpt_dir)
+                    for s in sorted(set(steps) | set(cleared)):
                         self.persister._maybe_commit(event.ckpt_dir, s)
                     if self.replica_manager is not None:
                         self._push_replica(step_hint=event.step)

@@ -78,6 +78,11 @@ class TensorMeta:
     # sharded-save metadata: where this local shard sits in the global array
     global_shape: Tuple[int, ...] = ()
     index: Tuple[Tuple[int, int], ...] = ()  # (start, stop) per dim
+    # zlib.crc32 of the persisted leaf file's bytes, filled at persist
+    # time (0 = not computed — shm-only metas and legacy checkpoints);
+    # disk/object-tier restores verify it and demote a corrupt piece to
+    # the next tier instead of returning garbage
+    crc32: int = 0
 
     def to_dict(self) -> Dict:
         return {
@@ -88,6 +93,7 @@ class TensorMeta:
             "nbytes": self.nbytes,
             "global_shape": list(self.global_shape),
             "index": [list(p) for p in self.index],
+            "crc32": self.crc32,
         }
 
     @classmethod
@@ -100,6 +106,7 @@ class TensorMeta:
             nbytes=d["nbytes"],
             global_shape=tuple(d.get("global_shape", [])),
             index=tuple(tuple(p) for p in d.get("index", [])),
+            crc32=int(d.get("crc32", 0)),
         )
 
 
@@ -116,6 +123,13 @@ class CheckpointMeta:
     # key on (job, node, process), so two Checkpointers with the default
     # job name but different directories would otherwise cross-restore
     ckpt_dir: str = ""
+    # the FULL flattened leaf-path set of the saved state (base paths,
+    # no "#sK" suffix). Under replica-deduplicated staging this process
+    # holds only its owned subset in `leaves`; restore uses this list to
+    # tell "leaf the checkpoint never had" (keep the target, warn) from
+    # "leaf whose pieces are missing" (demote to the next tier / fail
+    # loudly). Empty for legacy checkpoints.
+    leaf_paths: List[str] = field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(
@@ -128,6 +142,7 @@ class CheckpointMeta:
                 "process_id": self.process_id,
                 "total_bytes": self.total_bytes,
                 "ckpt_dir": self.ckpt_dir,
+                "leaf_paths": list(self.leaf_paths),
             }
         )
 
@@ -143,6 +158,7 @@ class CheckpointMeta:
             process_id=d.get("process_id", 0),
             total_bytes=d.get("total_bytes", 0),
             ckpt_dir=d.get("ckpt_dir", ""),
+            leaf_paths=list(d.get("leaf_paths", [])),
         )
 
 
@@ -276,6 +292,7 @@ class SharedMemoryHandler:
         world_size: int = 1,
         process_id: int = 0,
         ckpt_dir: str = "",
+        leaf_paths: Optional[List[str]] = None,
     ):
         """Copy leaves into shm and publish the header."""
         total = sum(int(a.nbytes) for _, a in named_leaves)
@@ -315,6 +332,7 @@ class SharedMemoryHandler:
             process_id=process_id,
             total_bytes=offset - HEADER_SPACE,
             ckpt_dir=ckpt_dir,
+            leaf_paths=list(leaf_paths or []),
         )
         header = meta.to_json().encode()
         if _LEN_SIZE + len(header) > HEADER_SPACE:

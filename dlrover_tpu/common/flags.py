@@ -180,6 +180,24 @@ CKPT_REPLICA = _define(
     "Agent-set replica mode: exactly '1' streams staged checkpoints "
     "to the backup peer (checkpoint/replica.py).",
 )
+CKPT_DEDUP = _define(
+    "DLROVER_TPU_CKPT_DEDUP", True, "bool",
+    "Replica-deduplicated tiered checkpointing kill-switch "
+    "(checkpoint/ownership.py, docs/design/checkpoint_tiers.md): 0 "
+    "restores the one-full-copy-per-process stage/persist and the "
+    "two-rung shm->storage restore.",
+)
+CKPT_LOCAL_DIR = _define(
+    "DLROVER_TPU_CKPT_LOCAL_DIR", "", "str",
+    "Root of the node-local disk checkpoint tier (tier 1; a node-local "
+    "SSD/emptyDir volume — deploy/k8s/README.md). Empty: "
+    "<ckpt_dir>/_local. Each node writes under <root>/node-<id>.",
+)
+CKPT_PERSIST_WORKERS = _define(
+    "DLROVER_TPU_CKPT_PERSIST_WORKERS", 4, "int",
+    "Concurrent leaf-file writers in the persist pool (local-tier "
+    "writes and object-tier fanout run this many files in parallel).",
+)
 REPLICA_MAX_BYTES = _define(
     "DLROVER_TPU_REPLICA_MAX_BYTES", 64 << 30, "int",
     "Replica server per-payload size bound (memory-DoS refusal).",
@@ -256,6 +274,15 @@ STATE_BACKEND = _define(
 STATE_DIR = _define(
     "DLROVER_TPU_STATE_DIR", "", "str",
     "Root directory of the file state backend (master relaunch state).",
+)
+ELASTICJOB_NAME = _define(
+    "ELASTICJOB_NAME", "", "str",
+    "Name of this job's ElasticJob custom resource (k8s "
+    "operator-injected; the master pod reads its own CR through it).",
+)
+POD_NAMESPACE = _define(
+    "POD_NAMESPACE", "default", "str",
+    "Kubernetes namespace this pod runs in (downward-API-injected).",
 )
 K8S_INSECURE_TLS = _define(
     "DLROVER_TPU_K8S_INSECURE_TLS", "", "str",

@@ -442,6 +442,10 @@ class ResizeBreakdownReport:
     rendezvous_s: float = 0.0
     compile_s: float = 0.0
     state_transfer_s: float = 0.0
+    # where the state that ended the downtime came from: "live"
+    # (device-to-device reshard) or the checkpoint engine's restore
+    # tier ("shm" | "disk" | "object"); "" = unreported
+    restore_tier: str = ""
 
 
 # ---------------------------------------------------------------------------

@@ -53,6 +53,13 @@ class CheckpointStorage(ABC):
     def read(self, path: str) -> bytes:
         ...
 
+    def put_file(self, src_path: str, path: str):
+        """Upload a local file to ``path`` — the object-tier fanout's
+        unit of work (checkpoint/saver.py). Default: read + atomic
+        write; object-store impls override with their native upload."""
+        with open(src_path, "rb") as f:
+            self.write(f.read(), path)
+
     @abstractmethod
     def exists(self, path: str) -> bool:
         ...

@@ -45,6 +45,13 @@ class SpeedMonitor:
         }
         self._breakdown_last: Dict[str, float] = {}
         self._breakdown_events: int = 0
+        # which tier ended each downtime: "live" (device-to-device
+        # reshard — no restore at all) vs the checkpoint ladder's
+        # shm/disk/object rungs. Tier-0 (live/shm) restarts are the
+        # warm-path SLO; disk/object counts rising means nodes are
+        # actually being LOST, not just restarted.
+        self._restore_tiers: Dict[str, int] = {}
+        self._last_restore_tier: str = ""
 
     # -- step samples -------------------------------------------------------
 
@@ -128,11 +135,13 @@ class SpeedMonitor:
         rendezvous_s: float = 0.0,
         compile_s: float = 0.0,
         state_transfer_s: float = 0.0,
+        restore_tier: str = "",
     ):
         """Attribute one resize's downtime to its phases. Complements
         the bracket timers: ``total_downtime`` says how long training
         stood still, this says on WHAT (and so which half — executable
-        or state — still needs warming)."""
+        or state — still needs warming). ``restore_tier`` attributes
+        where the state came from (live | shm | disk | object)."""
         with self._lock:
             last = {
                 "rendezvous": max(0.0, float(rendezvous_s)),
@@ -143,15 +152,24 @@ class SpeedMonitor:
                 self._breakdown_totals[phase] += secs
             self._breakdown_last = last
             self._breakdown_events += 1
+            if restore_tier:
+                self._restore_tiers[restore_tier] = (
+                    self._restore_tiers.get(restore_tier, 0) + 1
+                )
+                self._last_restore_tier = restore_tier
 
     def downtime_breakdown(self) -> Dict:
         """{"totals": per-phase seconds, "last": the latest resize's
-        phases, "events": how many resizes reported}."""
+        phases, "events": how many resizes reported, "restore_tiers":
+        restore count per tier (tier-0 live/shm vs tier-1/2
+        disk/object), "last_restore_tier": the latest one}."""
         with self._lock:
             return {
                 "totals": dict(self._breakdown_totals),
                 "last": dict(self._breakdown_last),
                 "events": self._breakdown_events,
+                "restore_tiers": dict(self._restore_tiers),
+                "last_restore_tier": self._last_restore_tier,
             }
 
     def avg_downtime(self) -> float:
@@ -203,6 +221,8 @@ class SpeedMonitor:
                 "downtime_start": self._downtime_start,
                 "breakdown_totals": dict(self._breakdown_totals),
                 "breakdown_events": self._breakdown_events,
+                "restore_tiers": dict(self._restore_tiers),
+                "last_restore_tier": self._last_restore_tier,
                 # when the old master dies with no open bracket, the
                 # restore path backdates the relaunch gap to this stamp
                 "snapshot_time": time.time(),
@@ -227,3 +247,10 @@ class SpeedMonitor:
                     totals.get(phase, 0.0)
                 )
             self._breakdown_events = int(state.get("breakdown_events", 0))
+            self._restore_tiers = {
+                str(k): int(v)
+                for k, v in (state.get("restore_tiers") or {}).items()
+            }
+            self._last_restore_tier = str(
+                state.get("last_restore_tier", "")
+            )

@@ -388,5 +388,6 @@ class MasterServicer:
                 rendezvous_s=request.rendezvous_s,
                 compile_s=request.compile_s,
                 state_transfer_s=request.state_transfer_s,
+                restore_tier=request.restore_tier,
             )
         return msg.SimpleResponse()

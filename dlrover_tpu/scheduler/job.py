@@ -10,10 +10,10 @@ stays a property of the slice type.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from dlrover_tpu.common import flags
 from dlrover_tpu.common.constants import DistributionStrategy, NodeType
 from dlrover_tpu.common.global_context import parse_bool as _parse_bool
 from dlrover_tpu.common.log import logger
@@ -98,8 +98,8 @@ class JobArgs:
     @classmethod
     def from_k8s_env(cls, job_name: str = "", namespace: str = "") -> "JobArgs":
         """Master-pod entry: read our ElasticJob CR from the API server."""
-        job_name = job_name or os.getenv("ELASTICJOB_NAME", "")
-        namespace = namespace or os.getenv("POD_NAMESPACE", "default")
+        job_name = job_name or flags.ELASTICJOB_NAME.get()
+        namespace = namespace or flags.POD_NAMESPACE.get()
         client = get_k8s_client(namespace)
         cr = client.get_custom_resource(ELASTICJOB_PLURAL, job_name)
         if cr is None:

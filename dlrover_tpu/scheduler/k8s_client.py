@@ -327,7 +327,7 @@ def get_k8s_client(namespace: str = "", transport=None) -> K8sClient:
     global _singleton
     with _singleton_lock:
         if _singleton is None:
-            namespace = namespace or os.getenv("POD_NAMESPACE", "default")
+            namespace = namespace or flags.POD_NAMESPACE.get()
             _singleton = K8sClient(namespace, transport=transport)
         return _singleton
 

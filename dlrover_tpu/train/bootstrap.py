@@ -123,11 +123,15 @@ class WorkerContext:
         rendezvous_s: float = 0.0,
         compile_s: float = 0.0,
         state_transfer_s: float = 0.0,
+        restore_tier: str = "",
     ):
         """Per-resize downtime breakdown for the master's goodput
         ledger: what this membership change spent on rendezvous vs the
         step rebuild vs moving the train state (live reshard or
-        checkpoint restore). Chief-only, like model info — every
+        checkpoint restore), and — ``restore_tier`` — which tier the
+        state came back through (live | shm | disk | object), so the
+        goodput report separates tier-0 fast restarts from real
+        node-loss recoveries. Chief-only, like model info — every
         worker sees the same resize."""
         if self.client is None or not self.is_chief:
             return
@@ -136,6 +140,7 @@ class WorkerContext:
                 rendezvous_s=rendezvous_s,
                 compile_s=compile_s,
                 state_transfer_s=state_transfer_s,
+                restore_tier=restore_tier,
             )
         except Exception as e:
             logger.warning("resize breakdown report failed: %s", e)

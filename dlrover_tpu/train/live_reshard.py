@@ -216,9 +216,15 @@ class ResizeLedger:
         compile_s: float = 0.0,
         state_transfer_s: float = 0.0,
         path: str = "",
+        restore_tier: str = "",
     ) -> dict:
         """``path``: ``direct`` | ``leafwise`` | ``bridge`` (live
-        transfer rung) or ``checkpoint`` (the round-trip fallback)."""
+        transfer rung) or ``checkpoint`` (the round-trip fallback).
+        ``restore_tier``: where the state that ended this downtime came
+        from — ``live`` (device-to-device, no restore) or the checkpoint
+        engine's tier (``shm`` | ``disk`` | ``object``) — so the goodput
+        ledger can separate tier-0 fast restarts from the slower
+        disk/object recoveries."""
         event = {
             "world_from": int(world_from),
             "world_to": int(world_to),
@@ -226,6 +232,7 @@ class ResizeLedger:
             "compile_s": round(float(compile_s), 6),
             "state_transfer_s": round(float(state_transfer_s), 6),
             "path": path,
+            "restore_tier": restore_tier,
             "ts": time.time(),
         }
         with self._lock:

@@ -1151,19 +1151,31 @@ class ElasticTrainer:
             compile_s=compile_s,
             state_transfer_s=pending.get("state_transfer_s", 0.0),
             path=pending.get("path", "checkpoint"),
+            restore_tier=pending.get("restore_tier", ""),
         )
         logger.info(
             "resize %d->%d downtime breakdown: compile=%.3fs "
-            "state_transfer=%.3fs (path=%s)",
+            "state_transfer=%.3fs (path=%s, restore_tier=%s)",
             event["world_from"], event["world_to"], event["compile_s"],
             event["state_transfer_s"], event["path"],
+            event["restore_tier"] or "?",
         )
         if self.worker_ctx is not None:
             self.worker_ctx.report_resize_breakdown(
                 rendezvous_s=event["rendezvous_s"],
                 compile_s=event["compile_s"],
                 state_transfer_s=event["state_transfer_s"],
+                restore_tier=event["restore_tier"],
             )
+
+    def note_restore_tier(self, tier: str):
+        """Stamp which checkpoint tier supplied the state for the resize
+        in flight (``engine.last_restore_stats["tier"]``). Call between
+        ``remesh()`` (when it returned None — the checkpoint path) and
+        the first post-resize ``step()``; the breakdown event then
+        attributes the downtime-ending restore to its tier."""
+        if self._pending_resize is not None and tier:
+            self._pending_resize["restore_tier"] = str(tier)
 
     # ---- elasticity ----------------------------------------------------
     def remesh(
@@ -1240,6 +1252,10 @@ class ElasticTrainer:
             "path": (
                 transfer_info["path"] if transfer_info else "checkpoint"
             ),
+            # "live" = no restore happened at all; the checkpoint path
+            # stamps its tier via note_restore_tier once the caller's
+            # engine.load() reports which rung supplied the state
+            "restore_tier": "live" if transfer_info else "",
         }
         if self.loss_factory is not None:
             # re-derive the loss for the new mesh (a loss closing over

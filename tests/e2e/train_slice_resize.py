@@ -114,6 +114,10 @@ for step in range(start_step + 1, TOTAL_STEPS + 1):
         ctx.report_resize_breakdown(
             compile_s=_time.perf_counter() - _t_step,
             state_transfer_s=restore_s,
+            # which tier the restore came through (shm for a fast
+            # restart, disk/object after node loss) — goodput ledger
+            # separates tier-0 from tier-1/2 recoveries
+            restore_tier=str(ckpt.last_restore_stats.get("tier", "")),
         )
     if first_loss is None:
         first_loss = loss

@@ -212,6 +212,25 @@ def test_kill_switch_restores_old_behavior(monkeypatch):
     ev = lr.resize_ledger.last()
     # pending event closes at the next step build with the checkpoint path
     assert ev is None or ev["path"] == "checkpoint"
+    # the caller's checkpoint restore stamps its tier onto the resize
+    # in flight; the next step closes the breakdown event carrying it
+    # (the goodput ledger's tier-0-vs-node-loss attribution, ISSUE 7)
+    tr.note_restore_tier("shm")
+    specs = llama.param_specs(CFG)
+    params_b = jax.device_put(
+        llama.init_params(CFG, jax.random.key(0)),
+        named_shardings(mesh_b, specs),
+    )
+    state_b = tr.init_state(params_b)
+    a, b = tr.step_batch_shape
+    batch_b = jax.random.randint(
+        jax.random.key(1), (a, b, SEQ), 0, CFG.vocab_size
+    )
+    state_b, _ = tr.step(state_b, batch_b)
+    ev = lr.resize_ledger.last()
+    assert ev is not None
+    assert ev["path"] == "checkpoint"
+    assert ev["restore_tier"] == "shm"
 
 
 def test_remesh_without_state_unchanged():
