@@ -184,7 +184,8 @@ def _bench_candidates(llama, jnp):
 
 def _run_mfu(jax, jnp, llama, cfg, micro_batch: int, seq: int, steps: int):
     """Build trainer + state, time `steps` donated train steps. Returns
-    (trainer, state, batch, step_seconds). Raises on OOM."""
+    (trainer, state, batch, mean_step_seconds, per_step_seconds).
+    Raises on OOM."""
     from dlrover_tpu.parallel import MeshConfig, build_mesh
     from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
 
@@ -223,13 +224,20 @@ def _run_mfu(jax, jnp, llama, cfg, micro_batch: int, seq: int, steps: int):
     lat = time.perf_counter() - t0
 
     t0 = time.perf_counter()
+    step_times = []
     for _ in range(steps):
+        t_i = time.perf_counter()
         state, loss = trainer.step(state, batch)
+        # per-step wall WITHOUT a sync: dispatch of step N blocks on
+        # donation until N-1's buffers free, so these samples carry the
+        # step-time distribution (p50/p95 in the candidate detail) —
+        # the straggler-shaped signal a mean alone hides
+        step_times.append(time.perf_counter() - t_i)
     lval = float(jax.device_get(loss))
     dt = (time.perf_counter() - t0 - lat) / steps
     if lval != lval:
         raise NanLossError(f"loss is NaN after {steps} steps")
-    return trainer, state, batch, dt
+    return trainer, state, batch, dt, step_times
 
 
 def _comm_census(trainer) -> dict:
@@ -829,6 +837,16 @@ def main():
 
     _enable_jit_cache(jax)
 
+    # the bench observes itself through the trace spine: every phase's
+    # step/compile/ckpt spans accumulate per-kind seconds, and the
+    # goodput detail block at the end decomposes the bench wall time
+    # (observability/trace.py). propagate() so subprocess legs inherit.
+    from dlrover_tpu.common import flags as _flags
+    from dlrover_tpu.observability import trace as _trace
+
+    _flags.TRACE.propagate("1")
+    bench_wall_t0 = time.perf_counter()
+
     on_tpu = jax.default_backend() == "tpu"
     dev = jax.devices()[0]
     peak = _peak_flops(dev)
@@ -862,7 +880,7 @@ def main():
         max_measured = 1
     for name, cand, cand_micro, cand_seq in candidates:
         try:
-            c_trainer, c_state, c_batch, c_step_s = _run_mfu(
+            c_trainer, c_state, c_batch, c_step_s, c_samples = _run_mfu(
                 jax, jnp, llama, cand, cand_micro, cand_seq, timed_steps
             )
         except NanLossError:
@@ -886,8 +904,15 @@ def main():
               f"({c_step_s:.3f}s/step)", file=sys.stderr)
         # per-candidate HBM fingerprint while its executable is warm
         cand_hbm = _memory_stats(c_trainer)
+        # step-time distribution, not just the mean behind MFU: a
+        # straggler-shaped regression (fine p50, fat p95 tail) shows in
+        # the bench trajectory (observability/digest.py percentiles)
+        from dlrover_tpu.observability.digest import digest_of
+
+        cand_digest = digest_of(c_samples) or {}
         results.append(
-            (rate, name, cand, cand_micro, cand_seq, c_step_s, cand_hbm)
+            (rate, name, cand, cand_micro, cand_seq, c_step_s, cand_hbm,
+             cand_digest)
         )
         measured += 1
         _free(c_state, c_batch)
@@ -899,13 +924,14 @@ def main():
     step_s = float("nan")
     model_name = "none"
     cfg = None
+    win_digest = {}
     if results:
-        _, model_name, cfg, micro, seq, step_s, _ = max(
+        _, model_name, cfg, micro, seq, step_s, _, win_digest = max(
             results, key=lambda r: r[0]
         )
         # rebuild the winner (its arrays were freed during the sweep) for
         # the flash-checkpoint measurement below; untimed
-        trainer, state, batch, _ = _run_mfu(
+        trainer, state, batch, _, _ = _run_mfu(
             jax, jnp, llama, cfg, micro, seq, 1
         )
     if cfg is None:
@@ -937,11 +963,15 @@ def main():
         "params": nparams,
         "tokens_per_step": micro * seq,
         "step_time_s": round(step_s, 4),
+        "step_time_p50_s": win_digest.get("p50_s"),
+        "step_time_p95_s": win_digest.get("p95_s"),
         "achieved_tflops": round(achieved / 1e12, 2),
         "sweep": [
             {"name": n, "model_tflops": round(r / 1e12, 2),
-             "step_s": round(t, 4), "hbm": h}
-            for r, n, _, _, _, t, h in results
+             "step_s": round(t, 4),
+             "step_p50_s": dg.get("p50_s"), "step_p95_s": dg.get("p95_s"),
+             "hbm": h}
+            for r, n, _, _, _, t, h, dg in results
         ],
         "phases_done": ["mfu"] if "mfu" in phases else [],
         # ckpt/interposer re-measure THIS program, so one census covers
@@ -1137,6 +1167,20 @@ def main():
         detail["resize"] = rz
         if "error" not in rz:
             detail["phases_done"].append("resize")
+
+    # ---- goodput self-accounting: where did the bench's wall time go? --
+    # The same category vocabulary as the master's attribution
+    # (productive/compile/checkpoint/.../unattributed); the contract
+    # bound on `unattributed` lives with the chaos e2e's master-side
+    # ledger, this block keeps the single-process view in the bench
+    # trajectory. Telemetry only — never fails a bench.
+    try:
+        detail["goodput"] = _trace.attribution_from_kind_seconds(
+            _trace.trace_ring.kind_seconds(),
+            time.perf_counter() - bench_wall_t0,
+        )
+    except Exception as e:
+        detail["goodput"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
 
     if on_tpu:
         # remember the last real-TPU measurement so a CPU fallback run

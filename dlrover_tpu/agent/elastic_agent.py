@@ -101,6 +101,14 @@ class ElasticAgent:
                 client=self._client,
             )
         self._paral_tuner = None
+        from dlrover_tpu.observability import trace
+
+        if trace.enabled():
+            # the agent's spine (rendezvous spans) dumps next to the
+            # workers' at exit; JOB_NAME rides the registry so the
+            # default dump dir matches theirs
+            flags.JOB_NAME.propagate(config.job_name)
+            trace.dump_at_exit(role="agent", node_id=config.node_id)
         if config.tpu_timer:
             self._setup_tpu_timer()
         if config.comm_metrics:

@@ -166,10 +166,13 @@ class MasterClient:
             )
         )
 
-    def report_global_step(self, step: int):
+    def report_global_step(self, step: int, digest: Optional[Dict] = None):
         return self._client.report(
             msg.GlobalStepReport(
-                node_id=self.node_id, step=step, timestamp=time.time()
+                node_id=self.node_id,
+                step=step,
+                timestamp=time.time(),
+                digest=dict(digest) if digest else {},
             )
         )
 

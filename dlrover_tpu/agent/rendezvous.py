@@ -80,7 +80,10 @@ class MasterRendezvousHandler:
         prevents a rejoining node — or its still-seated peers — from acting
         on the stale previous world whose coordinator is already dead.
         """
+        from dlrover_tpu.observability import trace
+
         rank_hint = node_rank_hint if node_rank_hint >= 0 else self._client.node_id
+        t_mono = time.monotonic()
         start_round = self._client.join_rendezvous(
             node_rank=rank_hint,
             local_world_size=self.local_world_size,
@@ -102,7 +105,16 @@ class MasterRendezvousHandler:
                     for info in resp.world.values()
                 )
             ):
-                return self._build_comm_world(resp)
+                world = self._build_comm_world(resp)
+                # trace spine: join -> seated, the rendezvous half of
+                # any downtime bracket (observability/trace.py)
+                trace.record(
+                    "rendezvous", f"rendezvous.{self.rdzv_name}",
+                    t_mono, time.monotonic() - t_mono,
+                    round=world.rdzv_round, world_size=world.world_size,
+                    node_rank=world.node_rank,
+                )
+                return world
             time.sleep(self.poll_interval)
         raise RendezvousTimeoutError(
             f"rendezvous {self.rdzv_name} (joined round {start_round}) "

@@ -60,6 +60,7 @@ from dlrover_tpu.checkpoint.shm_handler import (
     unflatten_state,
 )
 from dlrover_tpu.common import flags
+from dlrover_tpu.observability import trace
 from dlrover_tpu.common.ipc import (
     SharedDict,
     SharedLock,
@@ -523,15 +524,23 @@ class CheckpointEngine:
         joins the in-flight stage first.
         """
         t0 = time.time()
+        m0 = time.monotonic()
         if self._async_staging:
-            return self._start_async_stage(t0, step, state, persist=False)
-        try:
-            self._stage_sync(step, state)
-        except TimeoutError as e:
-            logger.warning("%s; skipping memory save", e)
-            return time.time() - t0
-        blocking = time.time() - t0
-        self._report_save(step, blocking)
+            blocking = self._start_async_stage(t0, step, state, persist=False)
+        else:
+            try:
+                self._stage_sync(step, state)
+            except TimeoutError as e:
+                logger.warning("%s; skipping memory save", e)
+                return time.time() - t0
+            blocking = time.time() - t0
+            self._report_save(step, blocking)
+        # trace spine: the training PAUSE this save cost (the background
+        # stage records its own span from the staging thread)
+        trace.record(
+            "ckpt_save", "save.blocking", m0, blocking,
+            tier="shm", step=step, mode=self.last_stage_mode,
+        )
         return blocking
 
     def _install_crash_drain(self):
@@ -714,12 +723,15 @@ class CheckpointEngine:
         pause: float
     ):
         try:
-            if on_device:
-                # d2h off the training critical path: the source is the
-                # private device snapshot, untouchable by donation.
-                payload = self._gather_local_shards(payload)
-            self._wait_pending_persist()
-            self._write_shm(step, payload)
+            with trace.span("ckpt_save", "stage.background", tier="shm",
+                            step=step):
+                if on_device:
+                    # d2h off the training critical path: the source is
+                    # the private device snapshot, untouchable by
+                    # donation.
+                    payload = self._gather_local_shards(payload)
+                self._wait_pending_persist()
+                self._write_shm(step, payload)
             if persist:
                 self._queue_persist(step)
             self._report_save(step, pause)
@@ -791,8 +803,15 @@ class CheckpointEngine:
     def save_to_storage(self, step: int, state: Any) -> float:
         """Stage + hand persistence to the agent saver (async)."""
         t0 = time.time()
+        m0 = time.monotonic()
         if self._async_staging:
-            return self._start_async_stage(t0, step, state, persist=True)
+            blocking = self._start_async_stage(t0, step, state, persist=True)
+            trace.record(
+                "ckpt_save", "save.blocking", m0, blocking,
+                tier="shm", step=step, mode=self.last_stage_mode,
+                persist=True,
+            )
+            return blocking
         try:
             self._stage_sync(step, state)
         except TimeoutError as e:
@@ -804,6 +823,10 @@ class CheckpointEngine:
         self._queue_persist(step)
         blocking = time.time() - t0
         self._report_save(step, blocking)
+        trace.record(
+            "ckpt_save", "save.blocking", m0, blocking,
+            tier="shm", step=step, mode=self.last_stage_mode, persist=True,
+        )
         return blocking
 
     def _persist_inline(self, step: int):
@@ -839,13 +862,24 @@ class CheckpointEngine:
             self.wait_staging()
         except Exception as e:
             logger.warning("in-flight staging failed before load: %s", e)
+        m0 = time.monotonic()
         if not self._tiering_enabled():
             result = self._load_from_memory(target)
             if result is not None:
                 logger.info("restored step %s from shared memory", result[0])
-                return result
-            return self._load_from_storage(target)
-        return self._load_tiered(target)
+            else:
+                result = self._load_from_storage(target)
+        else:
+            result = self._load_tiered(target)
+        # trace spine: one restore span, stamped with the tier that
+        # actually supplied the state (shm | disk | object | storage)
+        trace.record(
+            "ckpt_restore", "restore", m0, time.monotonic() - m0,
+            tier=str((self.last_restore_stats or {}).get("tier", "")),
+            step=result[0] if result is not None else -1,
+            ok=result is not None,
+        )
+        return result
 
     # -- tiered load (shm -> local disk -> object) --------------------------
 

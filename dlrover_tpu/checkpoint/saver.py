@@ -268,6 +268,8 @@ class CheckpointPersister:
         ``tiered`` writes to the node-local disk tier (the object copy
         is fan_out_step's job); legacy mode writes straight to the
         object storage as before."""
+        from dlrover_tpu.observability import trace
+
         dest = self._local_storage if tiered else self._storage
         root = (
             local_tier_dir(ckpt_dir, self.node_id) if tiered else ckpt_dir
@@ -276,6 +278,7 @@ class CheckpointPersister:
             step_dir(root, meta.step), f"proc-{meta.process_id}"
         )
         dest.makedirs(proc_dir)
+        persist_m0 = time.monotonic()
 
         def write_leaf(item):
             i, leaf_meta = item
@@ -305,6 +308,14 @@ class CheckpointPersister:
         )
         dest.write(
             manifest.to_json().encode(), os.path.join(proc_dir, "meta.json")
+        )
+        # trace spine: one per-tier persist span (disk = the node-local
+        # tier; storage = the legacy direct-to-object path)
+        trace.record(
+            "ckpt_save", "persist.proc", persist_m0,
+            time.monotonic() - persist_m0,
+            tier="disk" if tiered else "storage",
+            step=meta.step, leaves=len(meta.leaves),
         )
 
     def drain_fanouts(self, ckpt_dir: str) -> List[int]:
@@ -339,6 +350,9 @@ class CheckpointPersister:
                 step, local_sdir,
             )
             return
+        from dlrover_tpu.observability import trace
+
+        fanout_m0 = time.monotonic()
         obj_sdir = step_dir(ckpt_dir, step)
         copies: List[tuple] = []
         manifests: List[tuple] = []
@@ -380,6 +394,11 @@ class CheckpointPersister:
             )
             return
         self._pending_fanout.discard(step)
+        trace.record(
+            "ckpt_save", "fanout.object", fanout_m0,
+            time.monotonic() - fanout_m0, tier="object", step=step,
+            files=len(copies) + len(manifests),
+        )
         # every node prunes its OWN local tier (the object tier is
         # pruned by node-rank 0 at commit time; non-rank-0 nodes would
         # otherwise grow their node-local SSD without bound)

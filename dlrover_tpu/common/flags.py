@@ -227,6 +227,48 @@ RETRACE_GUARD = _define(
     "jitted function.",
 )
 
+# -- observability: unified trace spine + straggler policy
+# (dlrover_tpu/observability, docs/design/observability.md)
+
+TRACE = _define(
+    "DLROVER_TPU_TRACE", False, "bool",
+    "Unified trace spine (observability/trace.py): record typed spans "
+    "(step/compile/rendezvous/state_transfer/ckpt_save/ckpt_restore/"
+    "input_wait/gc_pause/eval) into the process-wide ring. Off by "
+    "default; recording is lock+append only, never a host sync.",
+)
+TRACE_DIR = _define(
+    "DLROVER_TPU_TRACE_DIR", "", "str",
+    "Directory where traced processes dump their span ring at exit "
+    "(trace-<role>-*.json, merged by `profiler.analysis job-timeline`)."
+    " Empty: /tmp/dlrover_tpu_logs/<job>/traces.",
+)
+TRACE_RING_CAP = _define(
+    "DLROVER_TPU_TRACE_RING_CAP", 200_000, "int",
+    "Bound on the trace spine's span ring; the oldest half is dropped "
+    "on overflow (per-kind seconds totals keep counting).",
+)
+PY_TRACING = _define(
+    "DLROVER_TPU_PY_TRACING", False, "bool",
+    "Host-side PyTracer (profiler/py_tracing.py): GC pauses + user "
+    "spans into the chrome-trace ring (and, when DLROVER_TPU_TRACE is "
+    "on, into the trace spine as gc_pause/input_wait spans).",
+)
+PY_TRACING_CAP = _define(
+    "DLROVER_TPU_PY_TRACING_CAP", 100_000, "int",
+    "PyTracer ring capacity (events; oldest half dropped on overflow).",
+)
+STRAGGLER_RATIO = _define(
+    "DLROVER_TPU_STRAGGLER_RATIO", 1.5, "float",
+    "Straggler policy (master/monitor/straggler.py): a rank is slow "
+    "when its windowed step-time p50 exceeds ratio x the fleet median.",
+)
+STRAGGLER_WINDOWS = _define(
+    "DLROVER_TPU_STRAGGLER_WINDOWS", 3, "int",
+    "Consecutive slow digest windows before a rank is flagged as a "
+    "straggler (and a StragglerRecord enters the diagnosis pipeline).",
+)
+
 # -- agent/master wiring (NodeEnv names; injected by the agent/launcher)
 
 NODE_ID = _define(

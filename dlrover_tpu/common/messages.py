@@ -198,6 +198,13 @@ class GlobalStepReport:
     node_id: int = -1
     step: int = 0
     timestamp: float = 0.0
+    # windowed step-time digest (observability/digest.py): {count,
+    # mean_s, p50_s, p95_s, max_s, input_wait_s} folded worker-side and
+    # drained once per (throttled) report — per-rank timing reaches the
+    # master's straggler detector and lost-time attribution with zero
+    # extra RPCs. Empty = a pre-digest worker (serde drops unknown
+    # fields both ways, so version skew is harmless).
+    digest: Dict = field(default_factory=dict)
 
 
 @message

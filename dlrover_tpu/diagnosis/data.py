@@ -22,6 +22,7 @@ class DiagnosisDataType:
     RESOURCE_USAGE = "resource_usage"
     HANG_DUMP = "hang_dump"  # all-rank stacks + pending device programs
     COMM_METRICS = "comm_metrics"  # per-collective attribution rollup
+    STRAGGLER = "straggler"  # runtime step-digest straggler flags
 
 
 class DiagnosisData:
@@ -228,6 +229,41 @@ class HangDumpRecord(DiagnosisData):
         return rec
 
 
+class StragglerRecordData(DiagnosisData):
+    """A runtime straggler flagged by the step-digest detector
+    (``master/monitor/straggler.py``): the rank's windowed step-time
+    p50 vs the fleet median, plus the policy that flagged it. Fed by
+    the servicer when a digest observation newly crosses the policy."""
+
+    def __init__(self, p50_s: float = 0.0, fleet_median_s: float = 0.0,
+                 ratio: float = 0.0, windows: int = 0, **kw):
+        kw.setdefault("data_type", DiagnosisDataType.STRAGGLER)
+        super().__init__(**kw)
+        self.p50_s = p50_s
+        self.fleet_median_s = fleet_median_s
+        self.ratio = ratio
+        self.windows = windows
+
+    @classmethod
+    def from_json(cls, text: str) -> "StragglerRecordData":
+        rec = cls()
+        rec.data_content = text
+        try:
+            payload = json.loads(text)
+        except (ValueError, TypeError):
+            return rec
+        if isinstance(payload, dict):
+            rec.p50_s = float(payload.get("p50_s", 0.0) or 0.0)
+            rec.fleet_median_s = float(
+                payload.get("fleet_median_s", 0.0) or 0.0
+            )
+            rec.ratio = float(payload.get("ratio", 0.0) or 0.0)
+            rec.windows = int(payload.get("windows", 0) or 0)
+            if payload.get("node_id") is not None:
+                rec.node_id = int(payload["node_id"])
+        return rec
+
+
 _DATA_CLASSES: Dict[str, Type[DiagnosisData]] = {
     "DiagnosisData": DiagnosisData,
     "TrainingLogRecord": TrainingLogRecord,
@@ -235,6 +271,7 @@ _DATA_CLASSES: Dict[str, Type[DiagnosisData]] = {
     "CommMetricsRecord": CommMetricsRecord,
     "AcceleratorMetricsRecord": AcceleratorMetricsRecord,
     "HangDumpRecord": HangDumpRecord,
+    "StragglerRecordData": StragglerRecordData,
 }
 
 

@@ -313,3 +313,19 @@ class DistributedJobMaster:
         self.metric_collector.stop()
         self.diagnosis_manager.stop()
         self._server.stop(grace=1)
+        self._dump_master_trace()
+
+    def _dump_master_trace(self):
+        """Master contribution to the merged job timeline (behind
+        ``DLROVER_TPU_TRACE``): downtime brackets as chrome events,
+        picked up by ``profiler.analysis job-timeline``."""
+        from dlrover_tpu.observability import trace
+
+        try:
+            path = trace.dump_events(
+                self.speed_monitor.trace_events(), role="master"
+            )
+            if path:
+                logger.info("master trace dumped to %s", path)
+        except OSError as e:
+            logger.warning("master trace dump failed: %s", e)

@@ -7,6 +7,7 @@ sync service onto one gRPC port.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -154,6 +155,23 @@ class LocalJobMaster:
         if self.diagnosis_manager is not None:
             self.diagnosis_manager.stop()
         self._server.stop(grace=1)
+        self._dump_master_trace()
+
+    def _dump_master_trace(self):
+        """Job-timeline contribution of the master itself (behind
+        ``DLROVER_TPU_TRACE``): the SpeedMonitor's downtime brackets as
+        chrome-trace events, merged with the rank dumps by
+        ``profiler.analysis job-timeline``."""
+        from dlrover_tpu.observability import trace
+
+        try:
+            path = trace.dump_events(
+                self.speed_monitor.trace_events(), role="master"
+            )
+            if path:
+                logger.info("master trace dumped to %s", path)
+        except OSError as e:
+            logger.warning("master trace dump failed: %s", e)
 
 
 def start_local_master(

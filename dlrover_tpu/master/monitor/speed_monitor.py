@@ -15,6 +15,10 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
 from dlrover_tpu.common.constants import DefaultValues
+from dlrover_tpu.master.monitor.straggler import (
+    StragglerDetector,
+    StragglerRecord,
+)
 
 
 @dataclass
@@ -52,6 +56,24 @@ class SpeedMonitor:
         # actually being LOST, not just restarted.
         self._restore_tiers: Dict[str, int] = {}
         self._last_restore_tier: str = ""
+        # -- lost-time attribution ledger (the goodput observatory) --
+        # per-rank step-time digests ride the (throttled) step RPC
+        # (observability/digest.py): productive seconds fold from them,
+        # the straggler detector reads their p50s, and input-stall
+        # seconds ride along from the worker trace spine.
+        self._digest_last: Dict[int, Dict] = {}
+        self._productive_s: Dict[int, float] = {}
+        self._input_wait_s: Dict[int, float] = {}
+        # checkpoint seconds: save blocking (CheckpointStepReport) plus
+        # the state_transfer half of any resize whose restore_tier says
+        # the state came back through the checkpoint ladder (the live
+        # device-to-device moves stay in state_transfer)
+        self._ckpt_blocking_s: Dict[int, float] = {}
+        self._ckpt_restore_s: float = 0.0
+        self.straggler_detector = StragglerDetector()
+        # master-side span buffer for the job timeline: closed downtime
+        # brackets as (start, end) epoch pairs (bounded)
+        self._downtime_spans: List[Tuple[float, float]] = []
 
     # -- step samples -------------------------------------------------------
 
@@ -102,6 +124,10 @@ class SpeedMonitor:
     def remove_running_worker(self, node_type: str, node_id: int):
         with self._lock:
             self._workers.discard((node_type, node_id))
+        # a departed rank leaves the straggler fleet too: stale p50s
+        # skew the median and a flagged-but-gone id would be reported
+        # forever (detector has its own lock — kept out of ours)
+        self.straggler_detector.forget(node_id)
 
     def all_worker_joined(self) -> bool:
         with self._lock:
@@ -122,11 +148,12 @@ class SpeedMonitor:
     def mark_downtime_end(self, ts: Optional[float] = None):
         with self._lock:
             if self._downtime_start > 0.0:
+                end = ts or time.time()
                 # clamp: downtime_start may come from the OLD master pod's
                 # clock (relaunch backdating); skew must never subtract
-                self._total_downtime += max(
-                    0.0, (ts or time.time()) - self._downtime_start
-                )
+                self._total_downtime += max(0.0, end - self._downtime_start)
+                self._downtime_spans.append((self._downtime_start, end))
+                del self._downtime_spans[:-256]
                 self._downtime_start = 0.0
                 self._downtime_events += 1
 
@@ -152,6 +179,12 @@ class SpeedMonitor:
                 self._breakdown_totals[phase] += secs
             self._breakdown_last = last
             self._breakdown_events += 1
+            if restore_tier in ("shm", "disk", "object"):
+                # the transfer half of this resize was a checkpoint
+                # restore, not a live device-to-device move: the
+                # attribution bills it to "checkpoint" (breakdown
+                # totals keep the raw phase split unchanged)
+                self._ckpt_restore_s += last["state_transfer"]
             if restore_tier:
                 self._restore_tiers[restore_tier] = (
                     self._restore_tiers.get(restore_tier, 0) + 1
@@ -171,6 +204,160 @@ class SpeedMonitor:
                 "restore_tiers": dict(self._restore_tiers),
                 "last_restore_tier": self._last_restore_tier,
             }
+
+    # -- per-rank digests -> straggler detection + attribution ------------
+
+    def collect_step_digest(
+        self,
+        node_id: int,
+        digest: Dict,
+        ts: Optional[float] = None,
+    ) -> Optional[StragglerRecord]:
+        """Fold one rank's windowed step-time digest
+        ({count, mean_s, p50_s, p95_s, max_s[, input_wait_s]}).
+        Returns the StragglerRecord iff this window NEWLY flags the
+        rank (the servicer forwards it into the diagnosis pipeline)."""
+        if not digest:
+            return None
+        try:
+            count = int(digest.get("count", 0))
+            mean_s = float(digest.get("mean_s", 0.0))
+            p50_s = float(digest.get("p50_s", 0.0))
+        except (TypeError, ValueError):
+            return None
+        if count <= 0:
+            return None
+        node = int(node_id)
+        with self._lock:
+            self._digest_last[node] = dict(digest)
+            self._productive_s[node] = (
+                self._productive_s.get(node, 0.0) + count * max(0.0, mean_s)
+            )
+            self._input_wait_s[node] = self._input_wait_s.get(node, 0.0) + max(
+                0.0, float(digest.get("input_wait_s", 0.0) or 0.0)
+            )
+        # detector has its own lock; keep it out of ours
+        return self.straggler_detector.observe(
+            node, p50_s, count=count, ts=ts
+        )
+
+    def record_ckpt_blocking(self, seconds: float, node_id: int = -1):
+        """Training seconds a checkpoint save blocked the step loop for
+        (CheckpointStepReport.blocking_s) — the save half of the
+        attribution's ``checkpoint`` category. Accumulated PER RANK:
+        every process reports the same job-wide pause, so the
+        attribution reads the max across ranks (one save = one pause),
+        never the sum (which would overcount world_size times)."""
+        with self._lock:
+            node = int(node_id)
+            self._ckpt_blocking_s[node] = (
+                self._ckpt_blocking_s.get(node, 0.0)
+                + max(0.0, float(seconds))
+            )
+
+    def stragglers(self) -> List[int]:
+        return self.straggler_detector.stragglers()
+
+    def straggler_report(self) -> Dict:
+        """Detector snapshot + the last digest per rank (goodput report
+        and /metrics consumers)."""
+        snap = self.straggler_detector.snapshot()
+        with self._lock:
+            snap["rank_digests"] = {
+                str(k): dict(v) for k, v in self._digest_last.items()
+            }
+        return snap
+
+    # -- lost-time attribution --------------------------------------------
+
+    def attribution(self, now: Optional[float] = None) -> Dict:
+        """Decompose wall time since the first step into
+        productive / compile / rendezvous / state_transfer / checkpoint
+        / input_stall / straggler_wait / unattributed — categories sum
+        to ``elapsed_wall_s`` by construction (``unattributed`` is the
+        residual; when measured categories overflow the wall —
+        clock skew, double-reported windows — productive absorbs the
+        overage first)."""
+        now = now or time.time()
+        straggler_wait = self.straggler_detector.lost_seconds()
+        with self._lock:
+            start = self._start_training_time
+            wall = max(0.0, now - start) if start > 0.0 else 0.0
+            bt = dict(self._breakdown_totals)
+            ckpt_restore = min(self._ckpt_restore_s, bt["state_transfer"])
+            lost = {
+                "compile": bt["compile"],
+                "rendezvous": bt["rendezvous"],
+                "state_transfer": bt["state_transfer"] - ckpt_restore,
+                "checkpoint": (
+                    max(self._ckpt_blocking_s.values(), default=0.0)
+                    + ckpt_restore
+                ),
+                "input_stall": max(
+                    self._input_wait_s.values(), default=0.0
+                ),
+                "straggler_wait": straggler_wait,
+            }
+            lost_sum = sum(lost.values())
+            if lost_sum > wall:
+                # measured lost seconds can overflow the wall (catch-up
+                # digest reports compressing many windows into a young
+                # job, clock skew): scale them down proportionally so
+                # the category sum NEVER exceeds elapsed — the report's
+                # one hard invariant
+                scale = (wall / lost_sum) if lost_sum > 0 else 0.0
+                lost = {k: v * scale for k, v in lost.items()}
+                lost_sum = sum(lost.values())
+            budget = max(0.0, wall - lost_sum)
+            productive = max(self._productive_s.values(), default=None)
+            if productive is None:
+                # no digest-reporting workers (version skew / toy
+                # scripts): productive is the wall minus downtime and
+                # the lost categories; unattributed keeps the downtime
+                # seconds no breakdown explained
+                resid_downtime = max(
+                    0.0,
+                    self._total_downtime
+                    - (bt["compile"] + bt["rendezvous"]
+                       + bt["state_transfer"]),
+                )
+                productive = max(0.0, budget - resid_downtime)
+                source = "residual"
+            else:
+                productive = min(productive, budget)
+                source = "digest"
+        categories = dict(lost)
+        categories["productive"] = productive
+        categories["unattributed"] = max(
+            0.0, wall - productive - lost_sum
+        )
+        return {
+            "elapsed_wall_s": round(wall, 6),
+            "categories": {
+                k: round(v, 6) for k, v in categories.items()
+            },
+            "productive_source": source,
+            "stragglers": self.straggler_detector.stragglers(),
+        }
+
+    # -- master-side spans for the job timeline ---------------------------
+
+    def trace_events(self) -> List[Dict]:
+        """The master's view as chrome-trace events (epoch-us clock):
+        closed downtime brackets plus each resize's reported phase
+        breakdown laid back-to-back before its report time."""
+        events: List[Dict] = []
+        with self._lock:
+            spans = list(self._downtime_spans)
+            if self._downtime_start > 0.0:
+                spans.append((self._downtime_start, time.time()))
+        for s, e in spans:
+            events.append({
+                "name": "job.downtime", "cat": "downtime", "ph": "X",
+                "ts": int(s * 1e6), "dur": int(max(0.0, e - s) * 1e6),
+                "pid": 0, "tid": 1, "args": {"kind": "downtime"},
+            })
+        return events
 
     def avg_downtime(self) -> float:
         """Mean seconds per completed downtime bracket — what one
@@ -223,6 +410,23 @@ class SpeedMonitor:
                 "breakdown_events": self._breakdown_events,
                 "restore_tiers": dict(self._restore_tiers),
                 "last_restore_tier": self._last_restore_tier,
+                # attribution ledger: per-rank productive/input-wait
+                # accumulators, checkpoint seconds and the straggler
+                # detector — master relaunch must not lose accounting
+                "productive_s": {
+                    str(k): v for k, v in self._productive_s.items()
+                },
+                "input_wait_s": {
+                    str(k): v for k, v in self._input_wait_s.items()
+                },
+                "digest_last": {
+                    str(k): dict(v) for k, v in self._digest_last.items()
+                },
+                "ckpt_blocking_s": {
+                    str(k): v for k, v in self._ckpt_blocking_s.items()
+                },
+                "ckpt_restore_s": self._ckpt_restore_s,
+                "straggler": self.straggler_detector.export_state(),
                 # when the old master dies with no open bracket, the
                 # restore path backdates the relaunch gap to this stamp
                 "snapshot_time": time.time(),
@@ -254,3 +458,24 @@ class SpeedMonitor:
             self._last_restore_tier = str(
                 state.get("last_restore_tier", "")
             )
+            self._productive_s = {
+                int(k): float(v)
+                for k, v in (state.get("productive_s") or {}).items()
+            }
+            self._input_wait_s = {
+                int(k): float(v)
+                for k, v in (state.get("input_wait_s") or {}).items()
+            }
+            self._digest_last = {
+                int(k): dict(v)
+                for k, v in (state.get("digest_last") or {}).items()
+            }
+            raw_blocking = state.get("ckpt_blocking_s") or {}
+            if isinstance(raw_blocking, dict):
+                self._ckpt_blocking_s = {
+                    int(k): float(v) for k, v in raw_blocking.items()
+                }
+            else:  # pre-per-rank snapshot: one untagged total
+                self._ckpt_blocking_s = {-1: float(raw_blocking)}
+            self._ckpt_restore_s = float(state.get("ckpt_restore_s", 0.0))
+        self.straggler_detector.import_state(state.get("straggler") or {})

@@ -199,6 +199,11 @@ class MasterServicer:
     def _get_stragglers(self, request: msg.StragglersRequest):
         mgr = self._rdzv_managers[RendezvousName.NETWORK_CHECK]
         nodes, _ = mgr.get_straggler()
+        if self._speed_monitor is not None:
+            # union of the pre-training network-check stragglers and
+            # the RUNTIME ones the step-digest detector flagged
+            # (master/monitor/straggler.py)
+            nodes = sorted(set(nodes) | set(self._speed_monitor.stragglers()))
         return msg.StragglersResponse(nodes=nodes)
 
     def _report_network_check(self, request: msg.NetworkCheckResult):
@@ -278,6 +283,25 @@ class MasterServicer:
                 request.step, request.timestamp or time.time()
             )
             self._speed_monitor.mark_downtime_end()
+            digest = getattr(request, "digest", None)
+            if digest:
+                record = self._speed_monitor.collect_step_digest(
+                    request.node_id, digest,
+                    ts=request.timestamp or time.time(),
+                )
+                if record is not None and self._diagnosis_manager is not None:
+                    # a NEWLY flagged straggler enters the diagnosis
+                    # pipeline like any other observation; the resolve
+                    # chain decides whether to act on it
+                    import json as _json
+
+                    self._diagnosis_manager.collect_diagnosis_data(
+                        msg.DiagnosisReportData(
+                            data_cls="StragglerRecordData",
+                            data_content=_json.dumps(record.to_dict()),
+                            node_id=record.node_id,
+                        )
+                    )
         return msg.SimpleResponse()
 
     def _report_model_info(self, request: msg.ModelInfoReport):
@@ -380,6 +404,15 @@ class MasterServicer:
         return msg.SimpleResponse()
 
     def _report_ckpt_step(self, request: msg.CheckpointStepReport):
+        if self._speed_monitor is not None:
+            # the seconds a save blocked training feed the goodput
+            # attribution's "checkpoint" category (it used to be
+            # reported and then dropped on the floor here); per-rank so
+            # the attribution can max instead of N-x-overcounting the
+            # same job-wide pause
+            self._speed_monitor.record_ckpt_blocking(
+                request.blocking_s, node_id=request.node_id
+            )
         return msg.SimpleResponse()
 
     def _report_resize_breakdown(self, request: msg.ResizeBreakdownReport):

@@ -241,6 +241,172 @@ def analyze_timeline_file(path: str) -> Dict:
 
 
 # ---------------------------------------------------------------------------
+# job-timeline: merge every rank's trace-spine dump + the master's
+# events (+ interposer /timeline dumps) into ONE perfetto-loadable file
+# ---------------------------------------------------------------------------
+
+
+def validate_trace_events(events, label: str = "") -> List[str]:
+    """Structural validation of chrome-trace events: required fields,
+    non-negative durations, and — per (pid, tid) lane — proper nesting
+    of complete ("X") spans. Two spans on one lane must either be
+    disjoint or fully contained; a partial overlap means a broken clock
+    basis or a torn emitter, which would render as garbage in perfetto
+    and silently corrupt any attribution derived from the file."""
+    errors: List[str] = []
+    lanes: Dict[Tuple, List[Tuple[float, float, str]]] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"{label}: event #{i} is not an object")
+            continue
+        ph = ev.get("ph")
+        if ph == "M":
+            continue  # metadata events carry no clock
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            errors.append(f"{label}: event #{i} ({ev.get('name')!r}) has "
+                          f"non-numeric ts {ts!r}")
+            continue
+        if ph == "X":
+            dur = ev.get("dur", 0)
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(
+                    f"{label}: span #{i} ({ev.get('name')!r}) has invalid "
+                    f"dur {dur!r}"
+                )
+                continue
+            lanes.setdefault((ev.get("pid"), ev.get("tid")), []).append(
+                (float(ts), float(ts) + float(dur), str(ev.get("name")))
+            )
+    tol = 1.0  # one microsecond of rounding slack
+    for (pid, tid), spans in lanes.items():
+        spans.sort(key=lambda s: (s[0], -(s[1] - s[0])))
+        stack: List[Tuple[float, float, str]] = []
+        for s, e, name in spans:
+            while stack and s >= stack[-1][1] - tol:
+                stack.pop()
+            if stack and e > stack[-1][1] + tol:
+                errors.append(
+                    f"{label}: lane (pid={pid}, tid={tid}): span {name!r} "
+                    f"[{s:.0f},{e:.0f}]us partially overlaps "
+                    f"{stack[-1][2]!r} [{stack[-1][0]:.0f},"
+                    f"{stack[-1][1]:.0f}]us"
+                )
+            stack.append((s, e, name))
+    return errors
+
+
+def _load_trace_file(path: str):
+    """-> (events, meta, errors). Accepts trace-spine dumps (``dlrover``
+    metadata block, epoch-us clock), raw chrome-trace docs and bare
+    event arrays (interposer ``/timeline`` dumps)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [], {}, [f"{os.path.basename(path)}: unparseable ({e})"]
+    if isinstance(doc, list):
+        events, meta = doc, {}
+    elif isinstance(doc, dict):
+        events = doc.get("traceEvents", [])
+        meta = doc.get("dlrover", {}) or {}
+        if not isinstance(events, list):
+            return [], meta, [
+                f"{os.path.basename(path)}: traceEvents is not a list"
+            ]
+    else:
+        return [], {}, [f"{os.path.basename(path)}: not a trace document"]
+    return events, meta, []
+
+
+def merge_job_timeline(paths: List[str]) -> Tuple[Dict, List[str]]:
+    """Merge per-role trace dumps into one chrome-trace document.
+
+    Sources carrying the spine's ``dlrover.clock == "epoch_us"``
+    metadata already share an absolute clock (NTP across hosts) and
+    merge as-is. Sources without it (interposer dumps: raw monotonic
+    microseconds) are re-based so their first event aligns with the
+    earliest epoch-clock event — best-effort, flagged in the source
+    table. Every file becomes its own pid with a ``process_name``
+    metadata row, so perfetto shows one track group per rank/role.
+    """
+    loaded = []
+    errors: List[str] = []
+    for path in sorted(paths):
+        events, meta, errs = _load_trace_file(path)
+        errors.extend(errs)
+        if errs:
+            continue
+        loaded.append((os.path.basename(path), events, meta))
+    epoch_min = None
+    for _, events, meta in loaded:
+        if meta.get("clock") == "epoch_us":
+            for ev in events:
+                ts = ev.get("ts")
+                if isinstance(ts, (int, float)):
+                    epoch_min = ts if epoch_min is None else min(epoch_min, ts)
+    merged: List[Dict] = []
+    sources = []
+    for pid, (name, events, meta) in enumerate(loaded):
+        offset = 0.0
+        aligned = meta.get("clock") == "epoch_us"
+        if not aligned and epoch_min is not None:
+            first = min(
+                (ev["ts"] for ev in events
+                 if isinstance(ev.get("ts"), (int, float))),
+                default=None,
+            )
+            if first is not None:
+                offset = epoch_min - first
+        role = meta.get("role") or os.path.splitext(name)[0]
+        label = role
+        if meta.get("node_id") is not None:
+            label += f"-n{meta['node_id']}"
+        if meta.get("process_id") is not None:
+            label += f"-p{meta['process_id']}"
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+        n = 0
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = ev["ts"] + offset
+            merged.append(ev)
+            n += 1
+        sources.append({
+            "file": name, "pid": pid, "label": label, "events": n,
+            "clock": "epoch_us" if aligned else
+            ("rebased" if offset else "unaligned"),
+        })
+        errors.extend(validate_trace_events(events, label=name))
+    merged.sort(key=lambda ev: (ev.get("ts") is not None,
+                                ev.get("ts") or 0))
+    doc = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "dlrover": {"merged_from": sources},
+    }
+    return doc, errors
+
+
+def job_timeline_paths(target: str) -> List[str]:
+    """Expand one CLI operand: a directory yields every ``*.json``
+    inside it (the trace-spine dump dir), a file is itself."""
+    if os.path.isdir(target):
+        return [
+            os.path.join(target, fn)
+            for fn in sorted(os.listdir(target))
+            if fn.endswith(".json")
+        ]
+    return [target]
+
+
+# ---------------------------------------------------------------------------
 # Matmul replay microbench (reference matmul replay, XLA-shaped)
 # ---------------------------------------------------------------------------
 
@@ -320,6 +486,21 @@ def main(argv=None) -> int:
     ps.add_argument("--min-share", type=float, default=0.05)
     pt = sub.add_parser("timeline", help="per-program stats from a timeline")
     pt.add_argument("path")
+    pj = sub.add_parser(
+        "job-timeline",
+        help="merge all ranks' trace-spine dumps + master events (+ "
+             "interposer timelines) into one perfetto-loadable trace",
+    )
+    pj.add_argument(
+        "paths", nargs="+",
+        help="trace dump dirs and/or files (a dir expands to its *.json)",
+    )
+    pj.add_argument("-o", "--output", default="job_timeline.json")
+    pj.add_argument(
+        "--check", action="store_true",
+        help="exit 1 on unparseable sources or overlap-invalid spans "
+             "(CI gate over the chaos e2e artifacts)",
+    )
     pm = sub.add_parser("matmul-bench", help="replay an (M,K,N) matmul")
     pm.add_argument("m", type=int)
     pm.add_argument("k", type=int)
@@ -346,6 +527,30 @@ def main(argv=None) -> int:
             print(f"\nhot path leaf: {hot[-1]}")
     elif args.cmd == "timeline":
         print(json.dumps(analyze_timeline_file(args.path), indent=2))
+    elif args.cmd == "job-timeline":
+        files: List[str] = []
+        for target in args.paths:
+            files.extend(job_timeline_paths(target))
+        if not files:
+            print(f"job-timeline: no trace files under {args.paths}")
+            return 1
+        doc, errors = merge_job_timeline(files)
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        srcs = doc["dlrover"]["merged_from"]
+        print(
+            f"job-timeline: merged {len(srcs)} source(s), "
+            f"{sum(s['events'] for s in srcs)} events -> {args.output}"
+        )
+        for s in srcs:
+            print(f"  pid {s['pid']}: {s['label']} ({s['file']}, "
+                  f"{s['events']} events, clock={s['clock']})")
+        if errors:
+            for e in errors:
+                print(f"  INVALID: {e}")
+            if args.check:
+                return 1
+        return 0
     else:
         print(json.dumps(
             matmul_bench(args.m, args.k, args.n, args.dtype, args.iters)

@@ -262,6 +262,18 @@ def _start_metrics_server(port: int):
                     rows = rows + resize_lines()
                 except Exception:
                     pass
+                try:
+                    # trace-spine rollup: cumulative seconds per span
+                    # kind + the last step-time digest window (p50/p95)
+                    # — the per-rank signal the master's straggler
+                    # detector consumes (observability/trace.py)
+                    from dlrover_tpu.observability.trace import (
+                        prometheus_lines as trace_lines,
+                    )
+
+                    rows = rows + trace_lines()
+                except Exception:
+                    pass
                 body = ("\n".join(rows) + "\n").encode()
                 self.send_response(200)
                 self.send_header("Content-Type",

@@ -127,12 +127,12 @@ class HangDumper:
 
     @staticmethod
     def _fetch_pending(port: int) -> Dict:
-        import urllib.request
+        # shared bounded-timeout + retry-with-warning scrape helper
+        # (profiler/tpu_timer.py): a wedged interposer degrades this
+        # bundle to an error entry instead of hanging the dumper
+        from dlrover_tpu.profiler.tpu_timer import _http_get
 
         try:
-            with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/pending", timeout=2.0
-            ) as resp:
-                return json.loads(resp.read().decode())
+            return json.loads(_http_get(port, "/pending", timeout=2.0))
         except (OSError, ValueError) as e:
             return {"error": str(e)}

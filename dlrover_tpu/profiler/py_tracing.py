@@ -24,18 +24,38 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from dlrover_tpu.common import flags
+from dlrover_tpu.observability import trace
+
+#: PyTracer categories -> trace-spine span kinds: GC pauses and
+#: dataloader fetches adopt the spine's taxonomy, everything else is a
+#: generic host span (docs/design/observability.md)
+_CAT_TO_KIND = {"gc": "gc_pause", "dataloader": "input_wait"}
+
 
 class PyTracer:
-    """Process-wide host-span recorder (bounded ring, thread-safe)."""
+    """Process-wide host-span recorder (bounded ring, thread-safe).
 
-    def __init__(self, capacity: int = 100_000):
+    Capacity and enablement live on the typed flag registry
+    (``DLROVER_TPU_PY_TRACING`` / ``DLROVER_TPU_PY_TRACING_CAP``): an
+    explicit constructor capacity still wins (tests), but the singleton
+    sizes itself from the flag, and ``maybe_start()`` lets any call
+    site turn the tracer on without plumbing a constructor knob."""
+
+    def __init__(self, capacity: Optional[int] = None):
         self._events: List[Dict] = []
-        self._cap = capacity
+        self._cap_override = capacity
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._gc_start: Optional[float] = None
         self._gc_installed = False
         self._enabled = False
+
+    @property
+    def _cap(self) -> int:
+        if self._cap_override is not None:
+            return int(self._cap_override)
+        return max(16, int(flags.PY_TRACING_CAP.get()))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -44,6 +64,16 @@ class PyTracer:
         if not self._gc_installed:
             gc.callbacks.append(self._on_gc)
             self._gc_installed = True
+
+    def maybe_start(self) -> bool:
+        """Start iff the registry asks for it: ``DLROVER_TPU_PY_TRACING``
+        or (the spine needs these emitters) ``DLROVER_TPU_TRACE``."""
+        if self._enabled:
+            return True
+        if flags.PY_TRACING.get() or flags.TRACE.get():
+            self.start()
+            return True
+        return False
 
     def stop(self):
         self._enabled = False
@@ -69,6 +99,13 @@ class PyTracer:
             self._events.append(ev)
             if len(self._events) > self._cap:
                 del self._events[: len(self._events) // 2]
+        # mirror into the unified trace spine (no-op when it is off):
+        # GC + user spans adopt the typed-span taxonomy, so one merged
+        # job timeline carries them next to step/compile/ckpt spans
+        trace.record(
+            _CAT_TO_KIND.get(cat, "host"), name,
+            self._t0 + start_us / 1e6, dur_us / 1e6,
+        )
 
     def _on_gc(self, phase: str, info: Dict):
         if not self._enabled:

@@ -101,10 +101,28 @@ def interposer_env(
     return env
 
 
-def _http_get(port: int, path: str, timeout: float = 2.0) -> str:
+def _http_get(
+    port: int, path: str, timeout: float = 2.0, retries: int = 1
+) -> str:
+    """GET from the local interposer with a HARD timeout + bounded
+    retry. A wedged interposer (the exact failure the hang detector
+    exists to catch) must never hang the diagnosis collector that is
+    trying to diagnose it: every attempt is bounded, transient failures
+    retry once with a warning, and the last failure propagates as
+    ``OSError`` for the caller's existing degraded path."""
     url = f"http://127.0.0.1:{port}{path}"
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        return resp.read().decode()
+    for attempt in range(retries + 1):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return resp.read().decode()
+        except OSError as e:
+            if attempt >= retries:
+                raise
+            logger.warning(
+                "tpu_timer scrape %s failed (%s); retry %d/%d",
+                path, e, attempt + 1, retries,
+            )
+    raise OSError(f"unreachable: {url}")  # not reached; keeps mypy honest
 
 
 def scrape_metrics(port: int = DEFAULT_PORT) -> Dict:

@@ -71,6 +71,12 @@ class WorkerContext:
         self._last_reported_step = 0
         self._last_report_ts = 0.0
         self.step_report_interval = 15.0
+        # input-wait seconds already shipped with earlier digests (the
+        # spine counter is cumulative; reports carry the delta)
+        self._input_wait_mark = 0.0
+        # drained-but-unsent digest window (failed report): merged into
+        # the next report so the master's ledger never loses it
+        self._unreported_digest = None
 
     @property
     def process_id(self) -> int:
@@ -145,18 +151,52 @@ class WorkerContext:
         except Exception as e:
             logger.warning("resize breakdown report failed: %s", e)
 
-    def report_step(self, step: int, force: bool = False):
-        """Throttled global-step report feeding the master's SpeedMonitor."""
+    def report_step(self, step: int, force: bool = False, digest=None):
+        """Throttled global-step report feeding the master's SpeedMonitor.
+
+        ``digest``: a :class:`~dlrover_tpu.observability.digest.
+        StepTimeDigest` the caller folds per-step wall times into; the
+        report DRAINS one window from it (count/mean/p50/p95/max) and
+        attaches the worker's input-wait seconds since the last report
+        (trace spine ``input_wait`` counter) — per-rank step-time
+        distributions ride the existing throttled RPC, so the master's
+        straggler detector and attribution cost no extra chatter."""
         if self.client is None:
             return
         now = time.time()
         if not force and now - self._last_report_ts < self.step_report_interval:
             return
+        payload = None
+        if digest is not None:
+            try:
+                payload = digest.snapshot_and_reset()
+            except Exception as e:
+                logger.warning("step digest drain failed: %s", e)
+                payload = None
+        if payload:
+            from dlrover_tpu.observability import digest as digest_mod
+            from dlrover_tpu.observability import trace
+
+            total_iw = trace.trace_ring.kind_seconds().get("input_wait", 0.0)
+            payload["input_wait_s"] = round(
+                max(0.0, total_iw - self._input_wait_mark), 6
+            )
+            self._input_wait_mark = total_iw
+            digest_mod.set_last_window(payload)  # worker /metrics gauge
+        if self._unreported_digest:
+            # a window whose report failed (master relaunch gap) rides
+            # the next attempt instead of vanishing from the
+            # attribution's productive/input-wait ledgers
+            from dlrover_tpu.observability.digest import merge_windows
+
+            payload = merge_windows(self._unreported_digest, payload)
+            self._unreported_digest = None
         try:
-            self.client.report_global_step(step)
+            self.client.report_global_step(step, digest=payload)
             self._last_reported_step = step
             self._last_report_ts = now
         except Exception as e:
+            self._unreported_digest = payload
             logger.warning("step report failed: %s", e)
 
 
@@ -186,10 +226,25 @@ def init(
             install_stack_dump_handler(stack_dir)
         except Exception:
             logger.exception("stack-dump handler install failed; continuing")
-    if os.environ.get("DLROVER_TPU_PY_TRACING", "") == "1":
+    from dlrover_tpu.common import flags as _flags
+
+    if _flags.PY_TRACING.get() or _flags.TRACE.get():
+        # GC pauses + user spans into the host timeline; the trace
+        # spine needs the same emitters (gc_pause/input_wait spans), so
+        # either flag turns the tracer on (typed registry, was a raw
+        # DLROVER_TPU_PY_TRACING env read)
         from dlrover_tpu.profiler.py_tracing import py_tracer
 
-        py_tracer.start()  # GC pauses + user spans into the host timeline
+        py_tracer.start()
+    if _flags.TRACE.get():
+        # dump this process's span ring at exit so the job-timeline CLI
+        # (profiler/analysis.py) can merge every rank + the master into
+        # one perfetto-loadable trace
+        from dlrover_tpu.observability import trace as _trace
+
+        _trace.dump_at_exit(
+            role="worker", node_id=env.node_id, process_id=env.process_id
+        )
     try:
         sampler_ms = float(
             os.environ.get("DLROVER_TPU_STACK_SAMPLER_MS", "0") or 0

@@ -286,11 +286,16 @@ class ElasticDataLoader:
     def __iter__(self):
         from dlrover_tpu.profiler.py_tracing import py_tracer
 
+        # flag-registry enablement (DLROVER_TPU_PY_TRACING / _TRACE):
+        # entry scripts that never call bootstrap.init still get their
+        # input-wait spans into the spine
+        py_tracer.maybe_start()
         self.update_batch_size_from_config()
         for indices in self.sampler:
             # span only when tracing is on: fetch+collate stalls explain
             # device-idle gaps in the merged timeline (reference
-            # py_tracing's dataloader interception)
+            # py_tracing's dataloader interception); cat="dataloader"
+            # maps onto the spine's `input_wait` span kind
             with py_tracer.span("dataloader.next", cat="dataloader"):
                 batch = self._collate([self.dataset[i] for i in indices])
             yield batch

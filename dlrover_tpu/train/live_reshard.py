@@ -152,7 +152,10 @@ def transfer_state(
     """
     import jax
 
+    from dlrover_tpu.observability import trace
+
     t0 = time.perf_counter()
+    m0 = time.monotonic()
     info: Dict[str, Any] = {"path": "direct", "leaves_bridged": 0}
     try:
         new_state = jax.device_put(state, shardings)
@@ -167,6 +170,14 @@ def transfer_state(
     if block:
         jax.block_until_ready(new_state)
     info["transfer_s"] = time.perf_counter() - t0
+    # trace spine: the state half of a live resize is a state_transfer
+    # span (the resize ledger keeps the per-event breakdown; the spine
+    # is what merges into the job timeline)
+    trace.record(
+        "state_transfer", "live_reshard.transfer", m0,
+        info["transfer_s"], path=info["path"],
+        leaves_bridged=info["leaves_bridged"],
+    )
     return new_state, info
 
 

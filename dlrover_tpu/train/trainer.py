@@ -37,6 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.lint import retrace_guard
+from dlrover_tpu.observability import trace
+from dlrover_tpu.observability.digest import StepTimeDigest
 from dlrover_tpu.parallel.mesh import MeshConfig
 from dlrover_tpu.parallel.sharding import batch_spec
 from dlrover_tpu.train import live_reshard, warm_compile, zero1
@@ -175,6 +177,11 @@ class ElasticTrainer:
         # any jitted fn) recompiles an already-seen signature or drifts
         # through too many distinct ones
         self._retrace_guard = retrace_guard.maybe_install()
+        # per-rank step-time digest (observability/digest.py): every
+        # step folds its host wall seconds; the throttled report_step
+        # drains one window to the master's straggler detector and
+        # lost-time attribution
+        self.step_digest = StepTimeDigest()
         self._maybe_serve_comm_metrics()
 
     def _maybe_serve_comm_metrics(self):
@@ -747,11 +754,18 @@ class ElasticTrainer:
             mesh, mesh_config, accum
         )
         t0 = time.perf_counter()
+        m0 = time.monotonic()
         lowered = self._build_step(
             mesh, mesh_config, out_shardings=out_sh
         ).lower(state_av, batch_av)
         compiled = lowered.compile()
         dt = time.perf_counter() - t0
+        # trace spine: every real XLA compile (cold AND speculative) is
+        # a span — warm hits returned above and cost nothing
+        trace.record(
+            "compile", f"lower_step.w{mesh.size}", m0, dt,
+            world=mesh.size, source=source, config=config_hash,
+        )
         # IR-level analysis of the program just built (lint/shardcheck),
         # opted in via DLROVER_TPU_SHARDCHECK. Runs for EVERY lowering —
         # including the speculative neighbor worlds — so a sharding
@@ -1040,10 +1054,11 @@ class ElasticTrainer:
         dispatch is the whole point of the jitted eval)."""
         total = None
         count = 0
-        for batch in batches:
-            loss = self.eval_step(state, batch)
-            total = loss if total is None else total + loss
-            count += 1
+        with trace.span("eval", "evaluate"):
+            for batch in batches:
+                loss = self.eval_step(state, batch)
+                total = loss if total is None else total + loss
+                count += 1
         if count == 0:
             # 0.0 would read as a perfect loss to early-stopping logic
             raise ValueError(
@@ -1065,6 +1080,11 @@ class ElasticTrainer:
             self._step_fn = self._acquire_step_fn()
         if self.worker_ctx is not None:
             state = self.poll_runtime_config(state)
+        # step wall clock, measured WITHOUT a device sync: dispatch of
+        # step N blocks on donation until step N-1's buffers free, so in
+        # steady state this converges to the device step time. Feeds the
+        # per-rank digest and (when the spine is on) a `step` span.
+        step_m0 = time.monotonic()
         try:
             new_state, loss = self._step_fn(state, batch)
         except (ValueError, TypeError) as e:
@@ -1099,13 +1119,29 @@ class ElasticTrainer:
             self._last_build_info = {"cache": "jit", "compile_s": None}
             self._step_fn = self._build_step()
             new_state, loss = self._step_fn(state, batch)
+        step_dur = time.monotonic() - step_m0
         if first_build and self._pending_resize is not None:
             self._finalize_resize(loss, build_t0)
         # host-side step counter: reading new_state["step"] would block on
         # the just-dispatched computation and kill async dispatch
         self._host_step += 1
+        if not first_build:
+            # the first call's wall is compile/build-dominated — keeping
+            # it out of the digest stops every (re)start from feeding
+            # the straggler detector one giant sample per rank
+            self.step_digest.add(step_dur)
+            trace.record(
+                "step", "train_step", step_m0, step_dur,
+                host_step=self._host_step,
+            )
         if self.worker_ctx is not None:
-            self.worker_ctx.report_step(self._host_step)
+            try:
+                self.worker_ctx.report_step(
+                    self._host_step, digest=self.step_digest
+                )
+            except TypeError:
+                # digest-unaware context (older stubs): plain report
+                self.worker_ctx.report_step(self._host_step)
         if self._retrace_guard is not None:
             # violations from background (speculative-compile) threads
             # can't raise in place; surface them at the step boundary
@@ -1141,10 +1177,39 @@ class ElasticTrainer:
         pending, self._pending_resize = self._pending_resize, None
         info = getattr(self, "_last_build_info", None) or {}
         compile_s = info.get("compile_s")
+        # ONE clock read for every synthetic span below: re-reading the
+        # clock per span would let a later span's back-dated start land
+        # inside an earlier one by the microseconds between the reads
+        # (the job-timeline --check enforces nesting per lane). The
+        # synthetic spans also live on their own "resize" lane so they
+        # can never partially overlap the real thread-lane spans.
+        now_m = time.monotonic()
         if compile_s is None:
-            # jit (kill-switch / AOT-fallback) path
+            # jit (kill-switch / AOT-fallback) path; the AOT path's
+            # compile span came from lower_step, this lazy-jit compile
+            # only becomes measurable here
             jax.block_until_ready(loss)  # graftlint: disable=JG002
             compile_s = time.perf_counter() - build_t0
+            now_m = time.monotonic()  # after the sync, before any span
+            trace.record(
+                "compile", "resize.first_step_compile",
+                now_m - compile_s, compile_s, tid="resize",
+                world=pending["to"], source="resize-jit",
+            )
+        # the rendezvous half was measured by the caller (remesh's
+        # rendezvous_s) — lay it strictly before the transfer+compile
+        # so the local timeline shows the whole downtime bracket
+        # host dict reads, not device syncs  # graftlint: disable=JG002
+        rdzv_s = float(pending.get("rendezvous_s", 0.0) or 0.0)
+        if rdzv_s > 0:
+            before = compile_s + float(  # graftlint: disable=JG002
+                pending.get("state_transfer_s", 0.0) or 0.0
+            )
+            trace.record(
+                "rendezvous", "resize.rendezvous",
+                now_m - before - rdzv_s, rdzv_s, tid="resize",
+                world=pending["to"],
+            )
         event = live_reshard.resize_ledger.record(
             pending["from"], pending["to"],
             rendezvous_s=pending.get("rendezvous_s", 0.0),
@@ -1183,6 +1248,7 @@ class ElasticTrainer:
         mesh: Mesh,
         mesh_config: MeshConfig,
         state: Optional[dict] = None,
+        rendezvous_s: float = 0.0,
     ) -> Optional[dict]:
         """After a membership change: adopt the new mesh; the jitted step is
         rebuilt (recompiled) lazily; accumulation re-derives so the global
@@ -1195,7 +1261,13 @@ class ElasticTrainer:
         with a leaf-wise + host-bridge fallback ladder), skipping the
         checkpoint round-trip entirely. Returns the transferred state,
         or None when live reshard is off / unavailable — the caller
-        then restores via the checkpoint engine exactly as before."""
+        then restores via the checkpoint engine exactly as before.
+
+        ``rendezvous_s``: seconds the caller spent re-seating the world
+        before calling here (the agent/worker measured the
+        re-rendezvous); stamped into the pending resize event so the
+        breakdown report and the trace spine carry the rendezvous half
+        of the downtime bracket instead of a hardcoded zero."""
         old = self.accum_steps
         dp = mesh_config.resolve(mesh.size).data_parallel_size
         denom = self.tc.micro_batch_size * dp
@@ -1246,6 +1318,7 @@ class ElasticTrainer:
         self._pending_resize = {
             "from": old_world,
             "to": mesh.size,
+            "rendezvous_s": max(0.0, float(rendezvous_s)),
             "state_transfer_s": (
                 transfer_info["transfer_s"] if transfer_info else 0.0
             ),

@@ -76,7 +76,7 @@ def main():
         print(json.dumps({"error": f"unknown candidate {cand_name}"}))
         return 1
     name, cfg, micro, seq = cand
-    _tr, _state, _batch, step_s = bench._run_mfu(
+    _tr, _state, _batch, step_s, _ = bench._run_mfu(
         jax, jnp, llama, cfg, micro, seq, steps
     )
     flops = bench._model_flops_per_step(cfg, micro, seq)

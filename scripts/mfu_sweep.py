@@ -73,7 +73,7 @@ def main():
             continue
         t0 = time.time()
         try:
-            _, _, _, step_s = bench._run_mfu(
+            _, _, _, step_s, _ = bench._run_mfu(
                 jax, jnp, llama, cfg, micro, seq, args.steps
             )
             flops = bench._model_flops_per_step(cfg, micro, seq)

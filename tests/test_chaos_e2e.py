@@ -214,6 +214,20 @@ def test_worker_kill_moves_goodput_ledger():
         assert sm._downtime_start == 0.0, "downtime never closed"
         g = sm.goodput()
         assert 0.2 <= g <= 1.0, f"goodput={g}"
+        # lost-time attribution contract: every second of wall time is
+        # accounted (categories sum to elapsed) and the unattributed
+        # residual obeys the same bound the goodput floor implies —
+        # these toy workers report no digests/breakdowns, so the whole
+        # crash downtime lands in `unattributed` (a trainer-based run
+        # attributes it; a sustained run drives the fraction toward 0)
+        attr = sm.attribution()
+        cats = attr["categories"]
+        assert sum(cats.values()) == pytest.approx(
+            attr["elapsed_wall_s"], rel=0.01
+        )
+        assert cats["unattributed"] <= 0.8 * attr["elapsed_wall_s"] + 1.0, (
+            attr
+        )
     finally:
         master.stop()
 

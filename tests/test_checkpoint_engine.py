@@ -68,7 +68,11 @@ def test_async_staging_save_restore(job_env):
     engine.save_to_memory(0, state)  # warmup (shm alloc)
     engine.wait_staging()
     blocking = engine.save_to_memory(7, state)
-    assert blocking < 0.05  # reference capture only
+    # reference capture only; the sync stage of this state is ~1s, and
+    # a mid-suite scheduler hiccup on a loaded 2-core runner has been
+    # seen pushing the snapshot to ~0.052s — bound well above jitter
+    # while staying an order of magnitude under the sync path
+    assert blocking < 0.15
     step, restored = engine.load(target=state)  # joins the stage
     assert step == 7
     np.testing.assert_array_equal(

@@ -67,6 +67,105 @@ def test_breakdown_survives_master_relaunch_via_export_import():
     assert bd["totals"]["state_transfer"] == 0.1
 
 
+def test_attribution_ledger_survives_master_relaunch():
+    """export/import round-trip of the straggler + attribution fields:
+    a relaunched master must not lose the accounting (per-rank
+    productive/input-wait accumulators, checkpoint seconds, flagged
+    stragglers and their lost time)."""
+    import time
+
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    sm = SpeedMonitor()
+    sm.collect_global_step(1, time.time() - 100.0)
+    sm.record_ckpt_blocking(0.75)
+    sm.record_downtime_breakdown(
+        rendezvous_s=1.0, compile_s=2.0, state_transfer_s=3.0,
+        restore_tier="disk",
+    )
+    sm.straggler_detector.windows = 2
+    for _ in range(3):
+        for nid in range(3):
+            slow = nid == 2
+            sm.collect_step_digest(nid, {
+                "count": 10, "mean_s": 0.3 if slow else 0.1,
+                "p50_s": 0.3 if slow else 0.1, "p95_s": 0.35,
+                "max_s": 0.4, "input_wait_s": 0.05,
+            })
+    assert sm.stragglers() == [2]
+    before = sm.attribution(now=time.time())
+
+    sm2 = SpeedMonitor()
+    sm2.import_state(sm.export_state())
+    after = sm2.attribution(now=time.time())
+    # the relaunched monitor reproduces the whole ledger
+    assert sm2.stragglers() == [2]
+    assert sm2.straggler_detector.lost_seconds() == (
+        sm.straggler_detector.lost_seconds()
+    )
+    for cat in ("compile", "rendezvous", "state_transfer", "checkpoint",
+                "input_stall", "straggler_wait"):
+        assert after["categories"][cat] == before["categories"][cat], cat
+    # per-rank digests survive too (the goodput report shows them)
+    assert sm2.straggler_report()["rank_digests"].keys() == {"0", "1", "2"}
+    # restore billed to checkpoint (restore_tier=disk), not transfer
+    assert after["categories"]["checkpoint"] == 0.75 + 3.0
+    assert after["categories"]["state_transfer"] == 0.0
+
+
+def test_attribution_sums_to_elapsed_with_injected_events():
+    """Acceptance: inject a resize (live transfer), a checkpoint
+    restore (breakdown with a checkpoint tier) and an artificial
+    straggler; each lands in its category and the category seconds sum
+    to elapsed wall time (+-1%)."""
+    import time
+
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    sm = SpeedMonitor()
+    t0 = time.time() - 200.0
+    sm.collect_global_step(1, t0)
+    # a live resize: rendezvous + compile + device-to-device transfer
+    sm.record_downtime_breakdown(
+        rendezvous_s=4.0, compile_s=6.0, state_transfer_s=2.0,
+        restore_tier="live",
+    )
+    # a restart whose state came back through the disk checkpoint tier:
+    # its transfer seconds are CHECKPOINT time, not state transfer
+    sm.record_downtime_breakdown(
+        compile_s=1.0, state_transfer_s=5.0, restore_tier="disk",
+    )
+    sm.record_ckpt_blocking(2.0)
+    # an artificial straggler: rank 3 at 3x the fleet p50
+    sm.straggler_detector.windows = 2
+    for _ in range(3):
+        for nid in range(4):
+            slow = nid == 3
+            sm.collect_step_digest(nid, {
+                "count": 20, "mean_s": 0.3 if slow else 0.1,
+                "p50_s": 0.3 if slow else 0.1, "p95_s": 0.32,
+                "max_s": 0.4, "input_wait_s": 0.1,
+            })
+    attr = sm.attribution(now=t0 + 200.0)
+    cats = attr["categories"]
+    assert attr["elapsed_wall_s"] == pytest.approx(200.0, rel=0.01)
+    assert sum(cats.values()) == pytest.approx(
+        attr["elapsed_wall_s"], rel=0.01
+    )
+    # each injected second is attributed to the right category
+    assert cats["rendezvous"] == pytest.approx(4.0)
+    assert cats["compile"] == pytest.approx(7.0)
+    assert cats["state_transfer"] == pytest.approx(2.0)  # live only
+    assert cats["checkpoint"] == pytest.approx(2.0 + 5.0)  # save + restore
+    # straggler: 3 slow windows x 20 steps x (0.3 - 0.1)s excess
+    assert cats["straggler_wait"] == pytest.approx(3 * 20 * 0.2)
+    assert cats["input_stall"] == pytest.approx(0.3)
+    # productive from the digests: the slow rank's 60 steps x 0.3s
+    assert cats["productive"] == pytest.approx(60 * 0.3)
+    assert attr["productive_source"] == "digest"
+    assert attr["stragglers"] == [3]
+
+
 def test_resize_breakdown_report_reaches_speed_monitor():
     """The worker-side ResizeBreakdownReport lands in the master's
     goodput ledger through the servicer dispatch table."""
@@ -93,6 +192,31 @@ def test_resize_breakdown_report_reaches_speed_monitor():
     back = deserialize(wire)
     assert isinstance(back, msg.ResizeBreakdownReport)
     assert back.compile_s == 9.0
+
+
+def test_attribution_scales_overflowing_lost_seconds_into_wall():
+    """Catch-up digest reports can compress many windows into a young
+    job (also: clock skew); the measured lost categories then exceed
+    the elapsed wall. The attribution must scale them down rather than
+    let the category sum overflow elapsed — the report's one hard
+    invariant. (Found by driving a fresh master with batched reports.)"""
+    import time
+
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    sm = SpeedMonitor()
+    t0 = time.time() - 10.0  # a 10s-old job...
+    sm.collect_global_step(1, t0)
+    sm.record_ckpt_blocking(30.0)  # ...reporting 50s of lost time
+    sm.record_downtime_breakdown(rendezvous_s=20.0)
+    attr = sm.attribution(now=t0 + 10.0)
+    cats = attr["categories"]
+    assert sum(cats.values()) == pytest.approx(10.0, rel=0.01)
+    # proportions preserved under the scaling
+    assert cats["checkpoint"] == pytest.approx(6.0)
+    assert cats["rendezvous"] == pytest.approx(4.0)
+    assert cats["productive"] == 0.0
+    assert cats["unattributed"] == 0.0
 
 
 def _agent_cmd(addr, job, node_id):
@@ -180,6 +304,14 @@ def test_goodput_over_95_percent_with_injected_failure(tmp_path):
                 # compile / state transfer), worker-reported via
                 # ResizeBreakdownReport — zeros if no worker reported
                 "downtime_breakdown": sm.downtime_breakdown(),
+                # lost-time attribution: every second of job wall time
+                # decomposed into productive / compile / rendezvous /
+                # state_transfer / checkpoint / input_stall /
+                # straggler_wait / unattributed (categories sum to
+                # elapsed_wall_s) — docs/design/observability.md
+                "attribution": sm.attribution(),
+                # runtime straggler policy state + per-rank digests
+                "stragglers": sm.straggler_report(),
                 "goodput": round(goodput, 4),
                 "steps": steps,
                 "reference_claim": "README.md:46-48 (69% -> 95%+)",

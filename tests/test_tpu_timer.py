@@ -278,6 +278,60 @@ def test_hang_dump_reports_stacks_and_pending(native, tmp_path):
         device.wait(timeout=30)
 
 
+def test_http_get_bounded_timeout_and_retry_with_warning(monkeypatch):
+    """The diagnosis collector's interposer scrapes must survive a
+    wedged interposer: every attempt carries a hard timeout, a
+    transient failure retries once with a warning, and a persistent one
+    raises OSError for the caller's degraded path."""
+    from dlrover_tpu.profiler import tpu_timer
+
+    calls = []
+
+    def flaky_urlopen(url, timeout=None):
+        calls.append((url, timeout))
+        if len(calls) == 1:
+            raise OSError("connection reset")
+
+        class Resp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return b"pong"
+
+        return Resp()
+
+    monkeypatch.setattr(
+        tpu_timer.urllib.request, "urlopen", flaky_urlopen
+    )
+    # first attempt fails, retry succeeds; every attempt was bounded
+    assert tpu_timer._http_get(9999, "/metrics") == "pong"
+    assert len(calls) == 2
+    assert all(t is not None and t > 0 for _, t in calls)
+
+    # persistent failure: retries exhaust, OSError propagates...
+    calls.clear()
+
+    def dead_urlopen(url, timeout=None):
+        calls.append((url, timeout))
+        raise OSError("down")
+
+    monkeypatch.setattr(
+        tpu_timer.urllib.request, "urlopen", dead_urlopen
+    )
+    with pytest.raises(OSError):
+        tpu_timer._http_get(9999, "/metrics")
+    assert len(calls) == 2
+    # ...and the scrape-level callers keep their degraded contracts
+    assert tpu_timer.scrape_metrics(9999) == {}
+    from dlrover_tpu.profiler.hang_dump import HangDumper
+
+    assert "error" in HangDumper._fetch_pending(9999)
+
+
 def test_py_tracer_records_gc_and_spans():
     """Host-side tracing tier (reference py_tracing_manager.cc): GC pauses
     and user spans land in the chrome-trace ring."""
