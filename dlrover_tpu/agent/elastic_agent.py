@@ -76,7 +76,7 @@ class ElasticAgent:
         self._stop_evt = threading.Event()
         self._restart_requested = threading.Event()
         self._relaunch_requested = False
-        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._status_reporter = None
         self._current_world: Optional[CommWorld] = None
         self._ckpt_saver = None  # wired by the flash-checkpoint layer
         # non-numeric values warn once and fall back to the default
@@ -176,6 +176,8 @@ class ElasticAgent:
             return self._invoke_run()
         finally:
             self._stop_evt.set()
+            if self._status_reporter is not None:
+                self._status_reporter.stop()
             self._diagnosis.stop()
             if self._metric_monitor is not None:
                 self._metric_monitor.stop()
@@ -529,19 +531,21 @@ class ElasticAgent:
     # -- heartbeats / signals ----------------------------------------------
 
     def _start_heartbeats(self):
-        def loop():
-            while not self._stop_evt.wait(DefaultValues.SEC_AGENT_HEARTBEAT_INTERVAL):
-                try:
-                    actions = self._client.report_heartbeat()
-                    for action in actions:
-                        self._handle_action(action)
-                except Exception as e:  # master restartable
-                    logger.warning("heartbeat failed: %s", e)
+        """Folded status reports replace the old heartbeat-only loop:
+        heartbeat + host resource usage ride one periodic RPC
+        (agent/reporter.py), and an ``Overloaded`` master widens the
+        cadence instead of being hammered. Diagnosis actions still
+        arrive on the ack exactly as before."""
+        from dlrover_tpu.agent.reporter import StatusReporter
 
-        self._heartbeat_thread = threading.Thread(
-            target=loop, name="agent-heartbeat", daemon=True
+        self._status_reporter = StatusReporter(
+            self._client,
+            interval_s=DefaultValues.SEC_AGENT_HEARTBEAT_INTERVAL,
+            on_actions=lambda actions: [
+                self._handle_action(a) for a in actions
+            ],
         )
-        self._heartbeat_thread.start()
+        self._status_reporter.start()
 
     def _handle_action(self, action):
         cls = getattr(action, "action_cls", "")

@@ -14,6 +14,7 @@ from dlrover_tpu.common import flags
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.constants import NodeEnv, NodeType, RendezvousName
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.rpc import policy as rpc_policy
 from dlrover_tpu.rpc.transport import RpcClient
 
 
@@ -21,8 +22,18 @@ class MasterClient:
     _instance: Optional["MasterClient"] = None
     _instance_lock = threading.Lock()
 
-    def __init__(self, master_addr: str, node_id: int, node_type: str = NodeType.WORKER):
-        self._client = RpcClient(master_addr)
+    def __init__(
+        self,
+        master_addr: str,
+        node_id: int,
+        node_type: str = NodeType.WORKER,
+        client=None,
+    ):
+        # client injection: anything exposing get/report/available/close
+        # over the serde wire — the fleet harness plugs its in-process
+        # loopback here so 1k simulated workers exercise the SAME typed
+        # wrappers production agents use
+        self._client = client or RpcClient(master_addr)
         self.master_addr = master_addr
         self.node_id = node_id
         self.node_type = node_type
@@ -121,6 +132,11 @@ class MasterClient:
         )
 
     def report_heartbeat(self) -> List[msg.DiagnosisAction]:
+        """Legacy heartbeat-only RPC. The agent now sends the folded
+        :meth:`report_worker_status` instead (heartbeat + digest +
+        resource in one message, backpressure-honoring —
+        agent/reporter.py); this wrapper stays for version skew and
+        tests, not for new callers."""
         resp = self._client.report(
             msg.HeartbeatReport(
                 node_type=self.node_type,
@@ -136,6 +152,7 @@ class MasterClient:
         restart_count: int = 0,
         level: str = "error",
         exit_code: int = 1,
+        timestamp: float = 0.0,
     ):
         return self._client.report(
             msg.NodeFailureReport(
@@ -145,7 +162,14 @@ class MasterClient:
                 error_data=error_data,
                 level=level,
                 exit_code=exit_code,
-            )
+                # stamp at send so a retried report (master relaunch
+                # gap) still opens the downtime bracket at the true
+                # failure time — RELAUNCH_TOLERANT backoff gives the
+                # retries ~35s of cumulative sleep to span the gap
+                timestamp=timestamp or time.time(),
+            ),
+            retries=8,
+            policy=rpc_policy.RELAUNCH_TOLERANT,
         )
 
     def report_succeeded(self):
@@ -176,6 +200,36 @@ class MasterClient:
             )
         )
 
+    def report_worker_status(
+        self,
+        step: int = -1,
+        digest: Optional[Dict] = None,
+        cpu_percent: Optional[float] = None,
+        memory_mb: float = 0.0,
+        tpu_duty_cycle: float = 0.0,
+        timestamp: float = 0.0,
+    ) -> msg.WorkerReportResponse:
+        """The folded periodic report: heartbeat + step digest +
+        resource usage in ONE RPC. ``on_overload="raise"`` — a shed
+        periodic report is not retried; the caller honors the
+        backpressure by widening its interval
+        (:class:`~dlrover_tpu.rpc.policy.AdaptiveInterval`)."""
+        return self._client.report(
+            msg.WorkerReport(
+                node_type=self.node_type,
+                node_id=self.node_id,
+                timestamp=timestamp or time.time(),
+                step=step,
+                digest=dict(digest) if digest else {},
+                has_resource=cpu_percent is not None,
+                cpu_percent=cpu_percent or 0.0,
+                memory_mb=memory_mb,
+                tpu_duty_cycle=tpu_duty_cycle,
+            ),
+            retries=1,
+            on_overload="raise",
+        )
+
     def report_node_check_status(self, status: str):
         return self._client.report(
             msg.NodeCheckStatusReport(node_id=self.node_id, status=status)
@@ -198,13 +252,15 @@ class MasterClient:
         )
 
     def get_task(self, dataset_name: str) -> msg.Task:
-        # retries sized to ride out a master relaunch (~20s of backoff):
-        # the data path stalling through the gap is what lets workers
-        # keep training across an operator-relaunched master
+        # RELAUNCH_TOLERANT backoff (~45s of cumulative sleep over 9
+        # attempts): the data path stalling through a master relaunch
+        # gap is what lets workers keep training across an
+        # operator-relaunched master
         return self._client.get(
             msg.TaskRequest(dataset_name=dataset_name, node_id=self.node_id),
             timeout=60,
-            retries=6,
+            retries=9,
+            policy=rpc_policy.RELAUNCH_TOLERANT,
         )
 
     def report_task_result(self, dataset_name: str, task_id: int, success: bool = True):
@@ -215,7 +271,8 @@ class MasterClient:
                 node_id=self.node_id,
                 success=success,
             ),
-            retries=6,
+            retries=9,
+            policy=rpc_policy.RELAUNCH_TOLERANT,
         )
 
     def get_shard_checkpoint(self, dataset_name: str) -> str:
@@ -258,14 +315,28 @@ class MasterClient:
     def sync_finished(self, sync_name: str) -> bool:
         return self._client.get(msg.SyncQuery(sync_name=sync_name)).success
 
-    def barrier(self, sync_name: str, timeout: float = 300, interval: float = 0.2) -> bool:
-        """Join a named barrier and wait for everyone (master decides)."""
+    def barrier(
+        self,
+        sync_name: str,
+        timeout: float = 300,
+        interval: Optional[float] = None,
+    ) -> bool:
+        """Join a named barrier and wait for everyone (master decides).
+
+        Polls on the shared jittered-backoff schedule
+        (:func:`rpc.policy.poll_intervals`) instead of a fixed busy
+        poll: 1k waiters entering a barrier in the same round would
+        otherwise synchronize their polls into a square wave on the
+        master. An explicit ``interval`` pins a fixed cadence (tests)."""
         self.join_sync(sync_name, self.node_id)
         deadline = time.time() + timeout
+        delays = rpc_policy.poll_intervals()
         while time.time() < deadline:
             if self.sync_finished(sync_name):
                 return True
-            time.sleep(interval)
+            time.sleep(
+                interval if interval is not None else next(delays)
+            )
         return False
 
     # -- config / diagnosis -------------------------------------------------

@@ -15,6 +15,7 @@ from typing import Dict, Optional, Tuple
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.constants import RendezvousName
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.rpc import policy as rpc_policy
 
 
 class RendezvousTimeoutError(Exception):
@@ -61,7 +62,7 @@ class MasterRendezvousHandler:
         slice_name: str = "",
         coords: Tuple = (),
         join_timeout: float = 600.0,
-        poll_interval: float = 0.3,
+        poll_interval: Optional[float] = None,
     ):
         self._client = client
         self.rdzv_name = rdzv_name
@@ -71,6 +72,9 @@ class MasterRendezvousHandler:
         self.slice_name = slice_name
         self.coords = coords
         self.join_timeout = join_timeout
+        # None -> the shared jittered growing schedule (rpc/policy.py):
+        # a fleet of waiters polling the incomplete world de-phases
+        # instead of hitting the master in lockstep every 0.3s
         self.poll_interval = poll_interval
 
     def next_rendezvous(self, node_rank_hint: int = -1) -> CommWorld:
@@ -94,6 +98,7 @@ class MasterRendezvousHandler:
             coords=self.coords,
         )
         deadline = time.time() + self.join_timeout
+        delays = rpc_policy.poll_intervals()
         while time.time() < deadline:
             resp = self._client.get_comm_world(self.rdzv_name)
             if (
@@ -115,7 +120,11 @@ class MasterRendezvousHandler:
                     node_rank=world.node_rank,
                 )
                 return world
-            time.sleep(self.poll_interval)
+            time.sleep(
+                self.poll_interval
+                if self.poll_interval is not None
+                else next(delays)
+            )
         raise RendezvousTimeoutError(
             f"rendezvous {self.rdzv_name} (joined round {start_round}) "
             f"not completed within {self.join_timeout}s"

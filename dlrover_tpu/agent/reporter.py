@@ -1,0 +1,113 @@
+"""Agent-side folded status reporter (ROADMAP item 5 backpressure).
+
+The agent used to run one thread per signal: a heartbeat loop and a
+``ResourceMonitor`` loop, each its own RPC — at 1k nodes that is 2k
+periodic streams the master serves for no reason. This reporter folds
+heartbeat + host resource usage into ONE :class:`WorkerReport` per
+period (step digests already ride the trainer's throttled step report),
+and honors the server's explicit ``Overloaded`` reply by widening its
+cadence (AIMD — :class:`~dlrover_tpu.rpc.policy.AdaptiveInterval`)
+instead of retrying into the overload. An unreachable master widens
+too: a relaunch gap with 1k nodes retrying at full cadence is a
+self-inflicted overload on the fresh master.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from dlrover_tpu.agent.monitor import (
+    get_process_cpu_percent,
+    get_tpu_metrics,
+    get_used_memory_mb,
+)
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.rpc.policy import AdaptiveInterval, OverloadedError
+
+
+class StatusReporter:
+    """Periodic folded worker report with backpressure honor.
+
+    ``on_actions`` receives the diagnosis actions the master piggybacks
+    on the ack (same contract as the old heartbeat loop)."""
+
+    def __init__(
+        self,
+        client,
+        interval_s: float = 15.0,
+        max_interval_s: Optional[float] = None,
+        on_actions: Optional[Callable[[List], None]] = None,
+    ):
+        self._client = client
+        # default widening bound of 4x base: the unreachable-master
+        # path has no server-advertised liveness ceiling (nobody is
+        # answering), and an unbounded AIMD walk (16x = 240s on the
+        # default cadence) would carry a healthy agent past aggressive
+        # heartbeat timeouts during a long master outage — the same
+        # eviction-by-politeness bug the chaos harness caught on the
+        # Overloaded path. An unreachable master gains nothing from
+        # widening beyond spam reduction, so the bound is cheap.
+        self._interval = AdaptiveInterval(
+            interval_s,
+            max_interval_s if max_interval_s is not None
+            else interval_s * 4,
+        )
+        self._on_actions = on_actions
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.reports_sent = 0
+        self.reports_shed = 0
+
+    @property
+    def current_interval_s(self) -> float:
+        return self._interval.current_s
+
+    def report_once(self) -> bool:
+        """One folded report; returns False when shed/unreachable (and
+        the cadence has been widened accordingly)."""
+        try:
+            tpu = get_tpu_metrics()
+            resp = self._client.report_worker_status(
+                cpu_percent=get_process_cpu_percent(),
+                memory_mb=get_used_memory_mb(),
+                tpu_duty_cycle=tpu.get("duty_cycle", 0.0),
+            )
+        except OverloadedError as e:
+            self.reports_shed += 1
+            widened = self._interval.widen(e.retry_after_s, e.max_interval_s)
+            logger.warning(
+                "master shed status report (depth=%s); widening interval "
+                "to %.1fs", e.queue_depth, widened,
+            )
+            return False
+        except Exception as e:  # master restartable
+            self._interval.widen()
+            logger.warning("status report failed: %s", e)
+            return False
+        self.reports_sent += 1
+        self._interval.ok()
+        if self._on_actions is not None and getattr(resp, "actions", None):
+            try:
+                self._on_actions(list(resp.actions))
+            except Exception:
+                logger.exception("status-report action handler failed")
+        return True
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="status-reporter", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def _loop(self):
+        # jittered wait: overload-widened reporters must de-phase, not
+        # pound the gate in cohorts (policy.AdaptiveInterval.next_delay_s)
+        while not self._stop_evt.wait(self._interval.next_delay_s()):
+            self.report_once()

@@ -263,6 +263,29 @@ STRAGGLER_RATIO = _define(
     "Straggler policy (master/monitor/straggler.py): a rank is slow "
     "when its windowed step-time p50 exceeds ratio x the fleet median.",
 )
+# -- fleet-scale control plane (rpc/transport.py, master/node/job_manager.py,
+# docs/design/fleet_harness.md)
+
+RPC_INFLIGHT_CAP = _define(
+    "DLROVER_TPU_RPC_INFLIGHT_CAP", 0, "int",
+    "Master RPC admission cap: reports beyond this many in-flight "
+    "requests are shed with an explicit Overloaded reply (gets shed at "
+    "2x). 0 = auto (half the server thread pool). Clamped below the "
+    "server thread count — a cap at/above it could never reject and "
+    "would silently disable shedding.",
+)
+MASTER_METRICS_PORT = _define(
+    "DLROVER_TPU_MASTER_METRICS_PORT", None, "int",
+    "Master /metrics port (goodput, RPC queue depth + shed counters, "
+    "straggler count; 0 = ephemeral port; unset = disabled).",
+)
+EVICT_HYSTERESIS = _define(
+    "DLROVER_TPU_EVICT_HYSTERESIS", 2, "int",
+    "Consecutive heartbeat-monitor sweeps a RUNNING worker must stay "
+    "past the heartbeat timeout before it is evicted (rendezvous slot "
+    "released, straggler/digest state forgotten). >=1; the extra "
+    "sweep(s) absorb clock jumps and one lost report window.",
+)
 STRAGGLER_WINDOWS = _define(
     "DLROVER_TPU_STRAGGLER_WINDOWS", 3, "int",
     "Consecutive slow digest windows before a rank is flagged as a "
@@ -325,6 +348,16 @@ ELASTICJOB_NAME = _define(
 POD_NAMESPACE = _define(
     "POD_NAMESPACE", "default", "str",
     "Kubernetes namespace this pod runs in (downward-API-injected).",
+)
+POD_IP = _define(
+    "POD_IP", "", "str",
+    "This pod's IP (downward-API-injected; master-address fallback "
+    "when the job Service cannot be created).",
+)
+HOSTNAME = _define(
+    "HOSTNAME", "", "str",
+    "Pod hostname (k8s default env; last-resort master-address "
+    "fallback after POD_IP).",
 )
 K8S_INSECURE_TLS = _define(
     "DLROVER_TPU_K8S_INSECURE_TLS", "", "str",

@@ -31,6 +31,31 @@ class SimpleResponse:
     reason: str = ""
 
 
+@message
+class OverloadedResponse:
+    """Explicit backpressure: the server's admission gate shed this
+    request instead of queueing it (rpc/transport.py RequestGate).
+    Clients honor it by widening their report interval (periodic
+    reporters) or sleeping at least ``retry_after_s`` before retrying
+    (one-shot calls). Version-skew note: a PRE-gate client deserializes
+    this fine but then fails its typed field access with an
+    AttributeError OUTSIDE its retry loop — shed load surfaces to the
+    old caller as an application error, not a retry. Upgrade masters
+    LAST (or raise the cap during the rollout) when old agents are in
+    the fleet."""
+
+    retry_after_s: float = 1.0
+    queue_depth: int = 0
+    reason: str = ""
+    # the server's liveness ceiling: widen your cadence, but NEVER past
+    # this, or the heartbeat evictor will declare you dead while you
+    # are politely backing off (found by the fleet chaos harness: naive
+    # AIMD widening under a 10x overload pushed healthy workers past
+    # the eviction timeout). 0 = server didn't say; clients keep their
+    # own bound.
+    max_interval_s: float = 0.0
+
+
 # ---------------------------------------------------------------------------
 # Rendezvous
 # ---------------------------------------------------------------------------
@@ -169,6 +194,11 @@ class NodeFailureReport:
     error_data: str = ""
     level: str = "error"
     exit_code: int = 0
+    # when the failure actually happened (0 = "now" on the master's
+    # clock). Lets a delayed/retried report open the downtime bracket
+    # at the true failure time, and lets the fleet harness drive the
+    # goodput ledger on its virtual clock through the real wire.
+    timestamp: float = 0.0
 
 
 @message
@@ -220,6 +250,35 @@ class ModelInfoReport:
     n_layers: int = 0
     n_heads: int = 0
     remat: bool = True
+
+
+@message
+class WorkerReport:
+    """The folded periodic worker report (ROADMAP item 5 backpressure):
+    heartbeat + step progress/digest + resource usage in ONE RPC where
+    the chatty protocol sent three. Every section is optional —
+    ``step < 0`` means "no step progress to report" (a heartbeat during
+    a stall must NOT close the master's downtime bracket), an empty
+    ``digest`` means no window drained, ``has_resource`` gates the
+    resource fields (0.0 is a legitimate cpu reading)."""
+
+    node_type: str = ""
+    node_id: int = -1
+    timestamp: float = 0.0
+    step: int = -1
+    digest: Dict = field(default_factory=dict)
+    has_resource: bool = False
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    tpu_duty_cycle: float = 0.0
+
+
+@message
+class WorkerReportResponse:
+    """Ack of a folded report: diagnosis actions ride back exactly as
+    on the heartbeat ack."""
+
+    actions: List = field(default_factory=list)
 
 
 @message

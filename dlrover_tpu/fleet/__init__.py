@@ -1,0 +1,22 @@
+"""Fleet-scale chaos harness (docs/design/fleet_harness.md).
+
+A *real* master — real :class:`~dlrover_tpu.master.servicer.MasterServicer`,
+real serde wire format, real rendezvous/diagnosis/monitor stack, real
+admission gate — driven by ~1k lightweight simulated workers and a
+scriptable fault injector, on a virtual clock, on CPU, in CI. The run's
+verdict is the goodput report + lost-time attribution: the paper's
+≥95%-goodput claim made falsifiable.
+
+Entry point: ``python -m dlrover_tpu.fleet run <scenario>``.
+"""
+
+from dlrover_tpu.fleet.scenario import Scenario, FaultEvent, load_scenario
+from dlrover_tpu.fleet.runner import FleetRunner, run_scenario
+
+__all__ = [
+    "Scenario",
+    "FaultEvent",
+    "load_scenario",
+    "FleetRunner",
+    "run_scenario",
+]

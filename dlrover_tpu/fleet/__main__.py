@@ -1,0 +1,98 @@
+"""CLI: ``python -m dlrover_tpu.fleet run <scenario>``.
+
+``run`` executes a built-in scenario (or a ``.json`` schedule), prints
+the goodput verdict, writes ``verdict.json`` + job-timeline trace
+artifacts under ``--out``, and exits nonzero when any ``expect`` gate
+fails — the CI contract. ``list`` shows the built-ins.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _print_verdict(v: dict, as_json: bool):
+    if as_json:
+        print(json.dumps(v, indent=1))
+        return
+    print(f"\n== fleet scenario {v['scenario']} (seed {v['seed']}) ==")
+    print(
+        f"nodes={v['nodes']}  duration={v['duration_vs']:g}vs  "
+        f"real={v['wall_real_s']:.1f}s  rpcs={v['rpc']['calls']}"
+    )
+    print(
+        f"goodput={v['goodput']:.4f}  downtime={v['downtime_vs']:.1f}vs  "
+        f"step={v['global_step']}  relaunches={v['master_relaunches']}"
+    )
+    cats = v["attribution"].get("categories", {})
+    if cats:
+        print("attribution (vs): " + "  ".join(
+            f"{k}={cats[k]:.1f}" for k in sorted(cats) if cats[k] > 0
+        ))
+    print(
+        f"gate: depth_peak={v['gate']['peak_inflight']} "
+        f"served={sum(v['gate']['served'].values())} "
+        f"rejected={sum(v['gate']['rejected'].values())}  "
+        f"rpc max latency={v['rpc']['max_latency_s'] * 1e3:.1f}ms"
+    )
+    if v["stragglers_flagged"]:
+        print(f"stragglers flagged: {v['stragglers_flagged']}")
+    if v["evictions"]:
+        print(
+            f"evictions: {v['evictions']}  reconciled: {v['reconciled']}"
+        )
+    print(f"determinism digest: {v['determinism_digest']}")
+    for name, c in v["checks"].items():
+        mark = "PASS" if c["ok"] else "FAIL"
+        print(f"  [{mark}] {name}: got {c['got']} (want {c['want']})")
+    print(f"verdict: {'OK' if v['ok'] else 'FAILED'}  -> {v['verdict_path']}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m dlrover_tpu.fleet")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    run_p = sub.add_parser("run", help="run a chaos scenario")
+    run_p.add_argument("scenario", help="built-in name or a .json path")
+    run_p.add_argument("--out", default=None, help="artifact directory")
+    run_p.add_argument(
+        "--seed", type=int, default=None, help="override the scenario seed"
+    )
+    run_p.add_argument(
+        "--nodes", type=int, default=None, help="override the fleet size"
+    )
+    run_p.add_argument("--json", action="store_true", dest="as_json")
+    sub.add_parser("list", help="list built-in scenarios")
+    args = parser.parse_args(argv)
+
+    from dlrover_tpu.fleet.scenarios import BUILTIN
+
+    if args.cmd == "list":
+        for name, d in sorted(BUILTIN.items()):
+            exp = d.get("expect", {})
+            gate = (
+                f"goodput>={exp['goodput_min']}"
+                if "goodput_min" in exp else "control-plane gates"
+            )
+            print(
+                f"{name:14s} nodes={d['nodes']:<5d} "
+                f"duration={d['duration_vs']:g}vs  {gate}"
+            )
+        return 0
+
+    from dlrover_tpu.fleet.scenario import load_scenario
+    from dlrover_tpu.fleet.runner import run_scenario
+
+    scenario = load_scenario(args.scenario)
+    if args.seed is not None:
+        scenario.seed = args.seed
+    if args.nodes is not None:
+        scenario.nodes = args.nodes
+    verdict = run_scenario(scenario, out_dir=args.out)
+    _print_verdict(verdict, args.as_json)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

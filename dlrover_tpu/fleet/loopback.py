@@ -1,0 +1,191 @@
+"""In-process wire for the fleet harness.
+
+1k real gRPC channels would measure grpc's threading, not the control
+plane's behavior — and make the run nondeterministic. This loopback
+keeps everything that matters about the wire and drops the sockets:
+every call serializes the request through :mod:`common.serde`, passes
+the admission gate (:class:`~dlrover_tpu.rpc.transport.RequestGate` —
+the same class the real server runs), dispatches into the *real*
+``MasterServicer``, and serializes the response back. A message that
+would not survive the real wire does not survive this one.
+
+Link faults are modeled per worker (:class:`LinkState`): a partitioned
+link raises ``ConnectionError`` (classified ``unavailable``, like a
+dead master address), a slow link stretches the caller's cadence. The
+master itself can be "down" (relaunch gap) via :class:`MasterEndpoint`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.serde import deserialize, serialize
+from dlrover_tpu.rpc.policy import OverloadedError
+from dlrover_tpu.rpc.transport import RequestGate
+
+
+class MasterEndpoint:
+    """The swappable in-process 'address' of the real master: the live
+    servicer plus the shared admission gate. ``set_down()`` during a
+    relaunch makes every call fail like a dead address; ``set_master``
+    points the fleet at the relaunched servicer."""
+
+    def __init__(self, gate: Optional[RequestGate] = None):
+        self.gate = gate or RequestGate()
+        self._lock = threading.Lock()
+        self._servicer = None
+
+    def set_master(self, servicer):
+        with self._lock:
+            self._servicer = servicer
+
+    def set_down(self):
+        with self._lock:
+            self._servicer = None
+
+    @property
+    def up(self) -> bool:
+        with self._lock:
+            return self._servicer is not None
+
+    def servicer(self):
+        with self._lock:
+            return self._servicer
+
+
+class LinkState:
+    """One worker's RPC link: partitioned / slowed by the injector."""
+
+    def __init__(self):
+        self.partitioned = False
+        self.slow_factor = 1.0
+
+
+class RpcStats:
+    """Fleet-wide wire statistics (thread-safe): per-call wall latency
+    (the "no RPC sees unbounded latency" gate reads ``max_s``), send
+    errors and sheds observed client-side."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.errors = 0
+        self.sheds = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def record(self, dur_s: float):
+        with self._lock:
+            self.calls += 1
+            self.total_s += dur_s
+            if dur_s > self.max_s:
+                self.max_s = dur_s
+
+    def record_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def record_shed(self):
+        with self._lock:
+            self.sheds += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "calls": self.calls,
+                "errors": self.errors,
+                "sheds_seen": self.sheds,
+                "mean_latency_s": (
+                    self.total_s / self.calls if self.calls else 0.0
+                ),
+                "max_latency_s": self.max_s,
+            }
+
+
+class LoopbackClient:
+    """Drop-in for :class:`~dlrover_tpu.rpc.transport.RpcClient`
+    (get/report/available/close) over the in-process wire. Retries are
+    immediate — the virtual clock owns time; a sim worker that should
+    back off does so in virtual seconds through its own cadence."""
+
+    def __init__(
+        self,
+        endpoint: MasterEndpoint,
+        link: Optional[LinkState] = None,
+        stats: Optional[RpcStats] = None,
+    ):
+        self._endpoint = endpoint
+        self.link = link or LinkState()
+        self._stats = stats
+
+    def available(self, timeout: float = 5.0) -> bool:
+        return self._endpoint.up and not self.link.partitioned
+
+    def close(self):
+        pass
+
+    def get(
+        self, msg, retries: int = 3, timeout=None, on_overload="retry",
+        policy=None,
+    ):
+        # policy accepted for RpcClient interface parity; retries are
+        # immediate here — the virtual clock owns time
+        return self._call("get", msg, retries, on_overload)
+
+    def report(
+        self, msg, retries: int = 3, timeout=None, on_overload="retry",
+        policy=None,
+    ):
+        return self._call("report", msg, retries, on_overload)
+
+    def _call(self, kind: str, msg, retries: int, on_overload: str):
+        from dlrover_tpu.common import messages as wire_msg
+
+        last: Optional[BaseException] = None
+        for _ in range(max(1, retries)):
+            if self.link.partitioned:
+                if self._stats:
+                    self._stats.record_error()
+                last = ConnectionError("rpc link partitioned")
+                continue
+            servicer = self._endpoint.servicer()
+            if servicer is None:
+                if self._stats:
+                    self._stats.record_error()
+                last = ConnectionError("master unavailable")
+                continue
+            gate = self._endpoint.gate
+            t0 = time.perf_counter()
+            payload = serialize(msg)  # the REAL wire format, both ways
+            if not gate.try_enter(kind):
+                wire = serialize(gate.overload_reply(kind))
+            else:
+                try:
+                    request = deserialize(payload)
+                    resp = (
+                        servicer.get(request, None)
+                        if kind == "get"
+                        else servicer.report(request, None)
+                    )
+                    wire = serialize(resp) if resp is not None else b""
+                finally:
+                    gate.leave(kind)
+            decoded = deserialize(wire)
+            if self._stats:
+                self._stats.record(time.perf_counter() - t0)
+            if isinstance(decoded, wire_msg.OverloadedResponse):
+                if self._stats:
+                    self._stats.record_shed()
+                err = OverloadedError(
+                    decoded.retry_after_s,
+                    decoded.queue_depth,
+                    getattr(decoded, "max_interval_s", 0.0),
+                )
+                if on_overload == "raise":
+                    raise err
+                last = err
+                continue
+            return decoded
+        raise last if last is not None else ConnectionError("loopback failed")

@@ -1,0 +1,627 @@
+"""The fleet scenario runner: real master, virtual clock, injected
+faults, goodput verdict.
+
+Architecture (docs/design/fleet_harness.md):
+
+- **Real master.** A :class:`LocalJobMaster` — the production servicer,
+  rendezvous managers, SpeedMonitor/StragglerDetector, diagnosis
+  manager and durable state backend — built with an injected *virtual*
+  clock, so every goodput bracket, eviction decision and relaunch
+  snapshot is stamped in scenario time and the verdict is deterministic
+  given the scenario seed.
+- **Simulated fleet.** ~1k :class:`SimWorker` state machines speaking
+  the real serde wire through the real servicer via the in-process
+  loopback (one admission gate shared fleet-wide, same class the gRPC
+  server runs).
+- **Tick loop.** Each tick advances the virtual clock, applies due
+  fault events, advances the synchronous-training model (progress only
+  while every live worker is seated in the current round), drives the
+  due workers, runs the master's heartbeat-eviction sweep, and
+  periodically snapshots master state (what a relaunch restores —
+  SIGKILL semantics).
+- **Verdict.** ``goodput`` + the lost-time ``attribution`` (must sum to
+  elapsed), straggler flags, eviction/reconcile events, admission-gate
+  stats and wire latency — checked against the scenario's ``expect``
+  block. Trace artifacts (master downtime spans + fleet fault/stall
+  lanes) dump for ``profiler.analysis job-timeline --check``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common import flags
+from dlrover_tpu.common.log import logger
+from dlrover_tpu.fleet.loopback import MasterEndpoint, RpcStats
+from dlrover_tpu.fleet.scenario import FaultEvent, Scenario
+from dlrover_tpu.fleet.worker import SimWorker
+from dlrover_tpu.rpc.transport import RequestGate
+
+
+class VirtualClock:
+    """The scenario's "now": absolute epoch seconds (so trace artifacts
+    merge like real ranks'), advanced only by the tick loop."""
+
+    def __init__(self, start: Optional[float] = None):
+        self._now = float(start if start is not None else time.time())
+
+    def now(self) -> float:
+        return self._now
+
+    def set(self, t: float):
+        self._now = float(t)
+
+
+class FleetView:
+    """What a worker may know of the job without private master state."""
+
+    def __init__(self):
+        self.global_step = 0
+        self.training_active = False
+
+
+class FleetRunner:
+    def __init__(self, scenario: Scenario, out_dir: Optional[str] = None):
+        self.sc = scenario
+        self.out_dir = out_dir or os.path.join(
+            "/tmp", "dlrover_tpu_fleet", scenario.name
+        )
+        os.makedirs(self.out_dir, exist_ok=True)
+        self.clock = VirtualClock()
+        self._base = self.clock.now()
+        gate = RequestGate(report_cap=scenario.gate_report_cap)
+        # same liveness-ceiling contract the real masters set on their
+        # gate: backpressure never widens a worker past eviction
+        gate.liveness_ceiling_s = scenario.heartbeat_timeout_vs / 3.0
+        self.endpoint = MasterEndpoint(gate)
+        self.stats = RpcStats()
+        self.master = None
+        self.workers: List[SimWorker] = []
+        self.view = FleetView()
+        self._progress = 0.0
+        self._was_active = False
+        self._stall_started_vt: Optional[float] = None
+        self._stall_spans: List[Tuple[float, float, str]] = []
+        self._fault_spans: List[Tuple[float, float, str]] = []
+        self._events: List[str] = []
+        self._evicted_ever: Dict[int, float] = {}
+        self._reconciled: Dict[int, float] = {}
+        self._stragglers_seen: set = set()
+        self._relaunches = 0
+        self._master_gap: Optional[Tuple[float, float]] = None
+        self._archived_master_events: List[Dict] = []
+        self._pool = (
+            ThreadPoolExecutor(max_workers=scenario.parallelism)
+            if scenario.parallelism > 1
+            else None
+        )
+        import random
+
+        self._rng = random.Random(scenario.seed)
+        # resolve the fault schedule up front (deterministic picks)
+        self._schedule: List[Tuple[float, FaultEvent, List[int]]] = []
+        self._step_triggers: List[Tuple[int, FaultEvent, List[int]]] = []
+        for ev in scenario.faults:
+            nodes = ev.resolve_nodes(scenario.nodes, self._rng)
+            if ev.kind == "crash" and ev.at_step >= 0:
+                self._step_triggers.append((ev.at_step, ev, nodes))
+            else:
+                self._schedule.append((ev.at_vs, ev, nodes))
+        self._schedule.sort(key=lambda x: x[0])
+        self._recoveries: List[Tuple[float, str, List[int]]] = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _event(self, vt: float, text: str):
+        line = f"{vt - self._base:9.1f}  {text}"
+        self._events.append(line)
+        logger.info("fleet: %s", line)
+
+    def _boot_master(self):
+        from dlrover_tpu.master.local_master import start_local_master
+
+        master = start_local_master(
+            node_num=self.sc.nodes,
+            min_node_num=self.sc.min_nodes or self.sc.nodes,
+            rdzv_waiting_timeout=5.0,
+            heartbeat_timeout=self.sc.heartbeat_timeout_vs,
+            clock=self.clock.now,
+            eviction_hysteresis=self.sc.eviction_hysteresis,
+        )
+        # the runner drives eviction sweeps on the virtual clock; a
+        # second wall-clock sweeper would add nondeterministic strikes
+        master.job_manager.pause_monitor()
+        return master
+
+    def _save_master_state(self):
+        try:
+            self.master.state_manager.save_speed(
+                self.master.speed_monitor.export_state()
+            )
+        except Exception:
+            logger.exception("fleet: master state save failed")
+
+    # -- fault application ---------------------------------------------
+
+    def _apply_fault(self, vt: float, ev: FaultEvent, nodes: List[int]):
+        off = vt - self._base
+        if ev.kind == "master_relaunch":
+            self._master_down(vt, ev.duration_vs)
+            return
+        self._event(
+            vt, f"fault {ev.kind} nodes={_fmt_nodes(nodes)} "
+            f"dur={ev.duration_vs:g} factor={ev.factor:g}"
+        )
+        self._fault_spans.append(
+            (vt, vt + max(ev.duration_vs, self.sc.tick_vs),
+             f"fault.{ev.kind}")
+        )
+        for nid in nodes:
+            w = self.workers[nid]
+            if ev.kind == "preempt":
+                w.preempt(vt, vt + max(1.0, ev.duration_vs))
+            elif ev.kind == "crash":
+                w.crash(vt, vt + max(1.0, ev.duration_vs))
+            elif ev.kind == "heartbeat_loss":
+                w.go_silent(vt + ev.duration_vs)
+            elif ev.kind == "partition":
+                w.partition(vt + ev.duration_vs)
+            elif ev.kind == "slow_link":
+                w.set_slow_link(ev.factor)
+                self._recoveries.append(
+                    (off + ev.duration_vs, "slow_link", [nid])
+                )
+            elif ev.kind == "straggle":
+                w.set_straggle(ev.factor)
+                self._recoveries.append(
+                    (off + ev.duration_vs, "straggle", [nid])
+                )
+
+    def _apply_recoveries(self, off: float, vt: float):
+        due = [r for r in self._recoveries if r[0] <= off]
+        self._recoveries = [r for r in self._recoveries if r[0] > off]
+        for _, kind, nodes in due:
+            self._event(vt, f"recover {kind} nodes={_fmt_nodes(nodes)}")
+            for nid in nodes:
+                if kind == "slow_link":
+                    self.workers[nid].set_slow_link(1.0)
+                elif kind == "straggle":
+                    self.workers[nid].set_straggle(1.0)
+
+    def _master_down(self, vt: float, gap_vs: float):
+        """SIGKILL semantics: the last periodic snapshot is all the next
+        master gets; the gap is billed as downtime, backdated to that
+        snapshot (the real relaunch path in ``prepare()``)."""
+        self._event(vt, f"master killed (relaunch in {gap_vs:g} vs)")
+        # archive the dying master's downtime spans for the timeline
+        # (its own dump is overwritten by the relaunched master's in
+        # this single-process harness)
+        self._archived_master_events = self.master.speed_monitor.trace_events()
+        self.endpoint.set_down()
+        self.master.stop()
+        # SIGKILL semantics: nothing of the dead master survives except
+        # the last periodic snapshot — no further saves or sweeps
+        self.master = None
+        self._master_gap = (vt, vt + max(1.0, gap_vs))
+        self._relaunches += 1
+
+    def _maybe_master_up(self, vt: float):
+        if self._master_gap is None or vt < self._master_gap[1]:
+            return
+        self._master_gap = None
+        self.master = self._boot_master()
+        self.endpoint.set_master(self.master.servicer)
+        self._event(
+            vt,
+            f"master relaunched (restored step="
+            f"{self.master.speed_monitor.completed_global_step})",
+        )
+
+    # -- training model ------------------------------------------------
+
+    def _update_training(self, vt: float):
+        # synchronous training: the collective advances only when every
+        # live worker is seated in the SAME round and that round's world
+        # covers exactly the live fleet — a seated survivor of a round
+        # whose other members just died is stalled, not stepping
+        alive = [w for w in self.workers if w.alive]
+        active = bool(alive) and all(w.seated for w in alive)
+        if active:
+            rounds = {w.seated_round for w in alive}
+            active = (
+                len(rounds) == 1 and alive[0].world_size == len(alive)
+            )
+        if active and not self._was_active:
+            for w in alive:
+                w.start_stepping()
+            chief = next((w for w in alive if w.is_chief), None)
+            if chief is not None:
+                # the bracket-closing report: the chief reports the step
+                # the moment training resumes (sync_host_step parity)
+                chief.force_report(vt)
+            if self._stall_started_vt is not None:
+                self._stall_spans.append(
+                    (self._stall_started_vt, vt, "training.stall")
+                )
+                self._event(
+                    vt,
+                    f"training resumed after "
+                    f"{vt - self._stall_started_vt:.1f} vs stall",
+                )
+                self._stall_started_vt = None
+            else:
+                self._event(vt, "training started")
+        elif not active and self._was_active:
+            for w in self.workers:
+                w.stop_stepping()
+            self._stall_started_vt = vt
+            self._event(vt, "training stalled (membership change)")
+        self._was_active = active
+        self.view.training_active = active
+        if active:
+            steps = self.sc.tick_vs / self.sc.step_time_s
+            self._progress += steps
+            self.view.global_step = int(self._progress)
+            for w in alive:
+                if w.stepping:
+                    w.accrue_steps(steps)
+
+    # -- tick loop -----------------------------------------------------
+
+    def run(self) -> Dict:
+        sc = self.sc
+        t_real0 = time.time()
+        stack = contextlib.ExitStack()
+        with stack:
+            # pinned runtime environment: durable file state backend for
+            # relaunch continuity, trace spine into the run's out_dir —
+            # an operator's exported values must not leak in
+            stack.enter_context(
+                flags.JOB_NAME.scoped(f"fleet-{sc.name}")
+            )
+            stack.enter_context(flags.STATE_BACKEND.scoped("file"))
+            stack.enter_context(
+                flags.STATE_DIR.scoped(os.path.join(self.out_dir, "state"))
+            )
+            stack.enter_context(flags.TRACE.scoped("1"))
+            stack.enter_context(
+                flags.TRACE_DIR.scoped(os.path.join(self.out_dir, "traces"))
+            )
+            # fresh state dir per run: SIGKILL continuity is within a
+            # run, not across runs
+            import shutil
+
+            shutil.rmtree(
+                os.path.join(self.out_dir, "state"), ignore_errors=True
+            )
+            shutil.rmtree(
+                os.path.join(self.out_dir, "traces"), ignore_errors=True
+            )
+            self.master = self._boot_master()
+            self.endpoint.set_master(self.master.servicer)
+            self.workers = [
+                SimWorker(i, sc, self.endpoint, self.stats)
+                for i in range(sc.nodes)
+            ]
+            self._event(self._base, f"fleet up: {sc.nodes} workers")
+            try:
+                verdict = self._loop(t_real0)
+            finally:
+                if self.master is not None:
+                    self._save_master_state()
+                    self.master.stop()
+                self._dump_fleet_trace()
+                if self._pool is not None:
+                    self._pool.shutdown(wait=False)
+        return verdict
+
+    def _loop(self, t_real0: float) -> Dict:
+        sc = self.sc
+        next_sweep = sc.monitor_sweep_vs
+        next_save = sc.state_save_vs
+        n_ticks = int(sc.duration_vs / sc.tick_vs)
+        schedule = list(self._schedule)
+        for tick in range(n_ticks):
+            off = (tick + 1) * sc.tick_vs
+            vt = self._base + off
+            self.clock.set(vt)
+            while schedule and schedule[0][0] <= off:
+                _, ev, nodes = schedule.pop(0)
+                self._apply_fault(vt, ev, nodes)
+            for at_step, ev, nodes in list(self._step_triggers):
+                if self.view.global_step >= at_step:
+                    self._step_triggers.remove((at_step, ev, nodes))
+                    self._event(vt, f"crash-on-step {at_step}")
+                    self._apply_fault(vt, ev, nodes)
+            self._apply_recoveries(off, vt)
+            self._maybe_master_up(vt)
+            self._update_training(vt)
+            self._tick_workers(vt)
+            if self.master is not None and off >= next_sweep:
+                next_sweep += sc.monitor_sweep_vs
+                evicted = self.master.job_manager.sweep_heartbeats(now=vt)
+                for nid in evicted:
+                    # FIRST eviction only: under sustained overload a
+                    # reconciled worker whose every report is shed can
+                    # be legitimately re-evicted (the gate sheds before
+                    # deserializing, so the master cannot know who it
+                    # silenced) — the hysteresis-latency check measures
+                    # the original silence episode
+                    self._evicted_ever.setdefault(nid, vt)
+                    from dlrover_tpu.common.constants import NodeType
+                    from dlrover_tpu.master.node.job_context import (
+                        get_job_context,
+                    )
+
+                    node = get_job_context().get_node(NodeType.WORKER, nid)
+                    hb_off = (
+                        round(node.heartbeat_time - self._base, 1)
+                        if node is not None else None
+                    )
+                    self._event(
+                        vt, f"master evicted node {nid} (last hb {hb_off})"
+                    )
+                self._track_reconciles(vt)
+                for nid in self.master.speed_monitor.stragglers():
+                    self._stragglers_seen.add(nid)
+            if self.master is not None and off >= next_save:
+                next_save += sc.state_save_vs
+                self._save_master_state()
+        return self._verdict(self._base + n_ticks * sc.tick_vs, t_real0)
+
+    def _tick_workers(self, vt: float):
+        if self._pool is None:
+            for w in self.workers:
+                w.tick(vt, self.view)
+        else:
+            # shuffled issue order: real fleets have no global arrival
+            # order; a fixed id-ordered map would systematically land
+            # the tail of the list on a full admission gate every tick
+            # and starve the same workers into eviction
+            order = list(self.workers)
+            self._rng.shuffle(order)
+            list(self._pool.map(lambda w: w.tick(vt, self.view), order))
+
+    def _track_reconciles(self, vt: float):
+        from dlrover_tpu.common.constants import NodeStatus, NodeType
+        from dlrover_tpu.master.node.job_context import get_job_context
+
+        ctx = get_job_context()
+        for nid in self._evicted_ever:
+            if nid in self._reconciled:
+                continue
+            node = ctx.get_node(NodeType.WORKER, nid)
+            if node is not None and node.status == NodeStatus.RUNNING:
+                self._reconciled[nid] = vt
+                self._event(vt, f"master reconciled node {nid}")
+
+    # -- verdict -------------------------------------------------------
+
+    def _verdict(self, end_vt: float, t_real0: float) -> Dict:
+        sm = self.master.speed_monitor if self.master else None
+        attribution = sm.attribution(now=end_vt) if sm else {}
+        goodput = sm.goodput(now=end_vt) if sm else 0.0
+        downtime = sm.total_downtime(now=end_vt) if sm else 0.0
+        cats = attribution.get("categories", {})
+        cat_sum = sum(cats.values())
+        elapsed = attribution.get("elapsed_wall_s", 0.0)
+        digest = hashlib.sha256()
+        for line in self._events:
+            digest.update(line.encode())
+        digest.update(f"goodput={goodput:.4f}".encode())
+        digest.update(f"downtime={downtime:.1f}".encode())
+        verdict = {
+            "scenario": self.sc.name,
+            "seed": self.sc.seed,
+            "nodes": self.sc.nodes,
+            "duration_vs": self.sc.duration_vs,
+            "wall_real_s": round(time.time() - t_real0, 1),
+            "goodput": round(goodput, 6),
+            "downtime_vs": round(downtime, 3),
+            "global_step": sm.completed_global_step if sm else 0,
+            "attribution": attribution,
+            "attribution_sum_error": (
+                round(abs(cat_sum - elapsed) / elapsed, 6)
+                if elapsed > 0 else 0.0
+            ),
+            "downtime_breakdown": sm.downtime_breakdown() if sm else {},
+            "stragglers_flagged": sorted(self._stragglers_seen),
+            "straggler_report": sm.straggler_report() if sm else {},
+            "evictions": {
+                str(k): round(v - self._base, 1)
+                for k, v in sorted(self._evicted_ever.items())
+            },
+            "reconciled": {
+                str(k): round(v - self._base, 1)
+                for k, v in sorted(self._reconciled.items())
+            },
+            "master_relaunches": self._relaunches,
+            "gate": self.endpoint.gate.stats(),
+            "rpc": self.stats.snapshot(),
+            "worker_reports": {
+                "sent": sum(w.reports_sent for w in self.workers),
+                "failed": sum(w.reports_failed for w in self.workers),
+                "widened_intervals": sum(
+                    1 for w in self.workers if w.interval.widen_events > 0
+                ),
+                "max_interval_s": round(
+                    max(w.interval.current_s for w in self.workers), 2
+                ) if self.workers else 0.0,
+            },
+            "events": self._events,
+            "determinism_digest": digest.hexdigest()[:16],
+        }
+        verdict["checks"] = self._checks(verdict)
+        verdict["ok"] = all(c["ok"] for c in verdict["checks"].values())
+        return verdict
+
+    def _checks(self, v: Dict) -> Dict:
+        exp = self.sc.expect or {}
+        checks: Dict[str, Dict] = {}
+
+        def check(name, ok, got, want):
+            checks[name] = {"ok": bool(ok), "got": got, "want": want}
+
+        tol = float(exp.get("attribution_sum_tol", 0.01))
+        check(
+            "attribution_sums_to_elapsed",
+            v["attribution_sum_error"] <= tol,
+            v["attribution_sum_error"], f"<= {tol}",
+        )
+        if "goodput_min" in exp:
+            check(
+                "goodput", v["goodput"] >= exp["goodput_min"],
+                v["goodput"], f">= {exp['goodput_min']}",
+            )
+        if "max_rpc_latency_s" in exp:
+            check(
+                "rpc_latency_bounded",
+                v["rpc"]["max_latency_s"] <= exp["max_rpc_latency_s"],
+                round(v["rpc"]["max_latency_s"], 4),
+                f"<= {exp['max_rpc_latency_s']}",
+            )
+        if "min_sheds" in exp:
+            total_rej = sum(v["gate"]["rejected"].values())
+            check(
+                "gate_shed_load", total_rej >= exp["min_sheds"],
+                total_rej, f">= {exp['min_sheds']}",
+            )
+        if "min_widened_workers" in exp:
+            check(
+                "overload_honored",
+                v["worker_reports"]["widened_intervals"]
+                >= exp["min_widened_workers"],
+                v["worker_reports"]["widened_intervals"],
+                f">= {exp['min_widened_workers']}",
+            )
+        if "evict_nodes" in exp:
+            want = sorted(int(n) for n in exp["evict_nodes"])
+            got = sorted(int(n) for n in v["evictions"])
+            missing = [n for n in want if n not in got]
+            check(
+                "evicted_silent_workers", not missing, got,
+                f"includes {want}",
+            )
+            # under sustained TOTAL overload the shed-blind evictor can
+            # starve an occasional live worker into eviction (the gate
+            # sheds before it can see who it silenced — known gap,
+            # docs/design/fleet_harness.md); the designed guarantee is
+            # that such evictions are rare and self-heal by
+            # reconciliation, so the verdict bounds them instead of
+            # pretending they cannot happen
+            spurious = [n for n in got if n not in want]
+            cap = int(exp.get("max_spurious_evictions", 0))
+            check(
+                "spurious_evictions_bounded", len(spurious) <= cap,
+                spurious, f"<= {cap} nodes",
+            )
+        if "evict_within_vs" in exp and "evict_nodes" in exp:
+            # eviction latency of the TARGETED silent nodes relative to
+            # the fault that silenced them
+            silence_at = min(
+                ev.at_vs for ev in self.sc.faults
+                if ev.kind in ("heartbeat_loss", "partition")
+            )
+            times = [
+                v["evictions"][str(n)]
+                for n in exp["evict_nodes"]
+                if str(n) in v["evictions"]
+            ]
+            worst = (max(times) - silence_at) if times else float("inf")
+            check(
+                "evicted_within_hysteresis_window",
+                worst <= exp["evict_within_vs"],
+                round(worst, 1), f"<= {exp['evict_within_vs']}",
+            )
+        if exp.get("require_reconcile"):
+            # a worker evicted in the last moments has no time left to
+            # land the reconciling report; only settled evictions gate
+            settled = {
+                n for n, t in v["evictions"].items()
+                if t <= self.sc.duration_vs - 10
+            }
+            missing = sorted(settled - set(v["reconciled"]))
+            check("evicted_workers_reconciled", not missing, missing, [])
+        if "stragglers" in exp:
+            want = sorted(int(n) for n in exp["stragglers"])
+            check(
+                "stragglers_flagged",
+                v["stragglers_flagged"] == want,
+                v["stragglers_flagged"], want,
+            )
+        if "relaunches" in exp:
+            check(
+                "master_relaunches",
+                v["master_relaunches"] == exp["relaunches"],
+                v["master_relaunches"], exp["relaunches"],
+            )
+        if exp.get("master_survives"):
+            served = sum(v["gate"]["served"].values())
+            check(
+                "master_stayed_live",
+                self.master is not None and served > 0
+                and v["global_step"] > 0,
+                {"served": served, "step": v["global_step"]},
+                "served > 0 and step > 0",
+            )
+        return checks
+
+    # -- trace artifacts -----------------------------------------------
+
+    def _dump_fleet_trace(self):
+        """The harness's own job-timeline source: training-stall spans
+        and fault windows, each fault on its own lane so spans nest
+        trivially; plus the pre-relaunch master's archived downtime
+        brackets (its file was overwritten by the relaunched master)."""
+        from dlrover_tpu.observability import trace
+
+        events: List[Dict] = []
+        for s, e, name in self._stall_spans:
+            events.append({
+                "name": name, "cat": "downtime", "ph": "X",
+                "ts": int(s * 1e6), "dur": int(max(0.0, e - s) * 1e6),
+                "pid": 0, "tid": 1, "args": {"kind": "downtime"},
+            })
+        for i, (s, e, name) in enumerate(self._fault_spans):
+            events.append({
+                "name": name, "cat": "fault", "ph": "X",
+                "ts": int(s * 1e6), "dur": int(max(0.0, e - s) * 1e6),
+                "pid": 0, "tid": 100 + i, "args": {"kind": "host"},
+            })
+        for i, ev in enumerate(self._archived_master_events):
+            ev = dict(ev)
+            ev["tid"] = 50  # own lane, clear of the stall lane
+            events.append(ev)
+        try:
+            path = trace.dump_events(events, role="fleet")
+            if path:
+                logger.info("fleet trace dumped to %s", path)
+        except OSError as e:
+            logger.warning("fleet trace dump failed: %s", e)
+
+
+def _fmt_nodes(nodes: List[int]) -> str:
+    if len(nodes) <= 8:
+        return str(nodes)
+    return f"[{nodes[0]}..{nodes[-1]}]x{len(nodes)}"
+
+
+def run_scenario(
+    scenario: Scenario, out_dir: Optional[str] = None
+) -> Dict:
+    """Run one scenario; writes ``verdict.json`` (and trace artifacts)
+    under ``out_dir`` and returns the verdict dict."""
+    runner = FleetRunner(scenario, out_dir=out_dir)
+    verdict = runner.run()
+    path = os.path.join(runner.out_dir, "verdict.json")
+    with open(path, "w") as f:
+        json.dump(verdict, f, indent=1)
+    verdict["verdict_path"] = path
+    verdict["out_dir"] = runner.out_dir
+    return verdict

@@ -1,0 +1,137 @@
+"""Declarative chaos scenarios: what the fleet looks like and what goes
+wrong when (docs/design/fleet_harness.md, "scenario schema").
+
+A scenario is data, not code — checked in (``fleet/scenarios.py``), or
+loaded from a JSON file — so a failure model is reviewable, replayable
+and diffable. All times are *virtual seconds* (``_vs``): the runner
+advances a virtual clock tick by tick, so a 25-virtual-minute job with a
+preemption storm replays in well under a real minute on CPU, and the
+verdict is deterministic given ``seed``.
+
+Fault taxonomy (``FaultEvent.kind``):
+
+- ``preempt`` — nodes report a preemption failure (the agent's SIGTERM
+  grace path), die, and rejoin after ``duration_vs``;
+- ``crash`` — like preempt but a worker-process crash (nonzero exit,
+  restart-in-place); with ``at_step`` set it triggers when the global
+  step crosses that step instead of at ``at_vs``;
+- ``heartbeat_loss`` — nodes go silent without a failure report (hung
+  process / dead host): the master must *evict* them by heartbeat
+  timeout, and reconcile them if they return after ``duration_vs``;
+- ``partition`` — the node's RPC link drops (reports raise): the node
+  keeps trying; master-side it is indistinguishable from heartbeat
+  loss, worker-side the client's backoff path is exercised;
+- ``slow_link`` — the node's link slows by ``factor`` (its report
+  cadence stretches accordingly);
+- ``straggle`` — nodes' per-step wall time inflates by ``factor`` for
+  ``duration_vs`` (their digests must trip the straggler detector, and
+  one recovered window must unflag them);
+- ``master_relaunch`` — the master process "dies" (SIGKILL semantics:
+  whatever the last periodic state snapshot had is what survives) and a
+  fresh master takes over ``duration_vs`` later on the same durable
+  state backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+FAULT_KINDS = (
+    "preempt",
+    "crash",
+    "heartbeat_loss",
+    "partition",
+    "slow_link",
+    "straggle",
+    "master_relaunch",
+)
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    kind: str
+    at_vs: float = 0.0
+    #: explicit node ids; empty + count>0 -> seeded-random pick
+    nodes: List[int] = dataclasses.field(default_factory=list)
+    count: int = 0
+    duration_vs: float = 0.0
+    factor: float = 1.0
+    at_step: int = -1  # crash-on-step trigger (kind "crash")
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {FAULT_KINDS}"
+            )
+
+    def resolve_nodes(self, n_nodes: int, rng) -> List[int]:
+        if self.nodes:
+            return [i for i in self.nodes if 0 <= i < n_nodes]
+        k = min(max(0, self.count), n_nodes)
+        return sorted(rng.sample(range(n_nodes), k))
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str = "scenario"
+    seed: int = 0
+    nodes: int = 100
+    duration_vs: float = 600.0
+    tick_vs: float = 1.0
+    #: base per-step wall seconds (every worker's digest baseline)
+    step_time_s: float = 1.0
+    #: folded WorkerReport cadence (heartbeat + digest + resource)
+    report_interval_vs: float = 15.0
+    #: how often workers poll num_nodes_waiting (membership changes)
+    membership_poll_vs: float = 10.0
+    #: master-side eviction policy, in virtual seconds / sweeps
+    heartbeat_timeout_vs: float = 60.0
+    eviction_hysteresis: int = 2
+    monitor_sweep_vs: float = 5.0
+    #: master durable-state snapshot cadence (what a relaunch restores)
+    state_save_vs: float = 5.0
+    #: rendezvous: min nodes for a round (max is ``nodes``)
+    min_nodes: Optional[int] = None
+    #: admission gate cap for the loopback wire (reports; gets shed at 2x)
+    gate_report_cap: int = 64
+    #: >1 issues worker ticks from a thread pool (overload scenarios —
+    #: exercises servicer concurrency at the cost of strict determinism)
+    parallelism: int = 1
+    faults: List[FaultEvent] = dataclasses.field(default_factory=list)
+    #: verdict gates: the CLI exits nonzero when any fails
+    expect: Dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        self.faults = [
+            f if isinstance(f, FaultEvent) else FaultEvent(**f)
+            for f in self.faults
+        ]
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Scenario":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown scenario keys: {sorted(unknown)}")
+        return cls(**d)
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def load_scenario(name_or_path: str) -> Scenario:
+    """A built-in scenario name (``fleet/scenarios.py``) or a JSON file
+    path with the same schema."""
+    from dlrover_tpu.fleet.scenarios import BUILTIN
+
+    if name_or_path in BUILTIN:
+        return Scenario.from_dict(BUILTIN[name_or_path])
+    if name_or_path.endswith(".json"):
+        with open(name_or_path) as f:
+            return Scenario.from_dict(json.load(f))
+    raise ValueError(
+        f"unknown scenario {name_or_path!r}; built-ins: "
+        f"{sorted(BUILTIN)} (or a .json path)"
+    )

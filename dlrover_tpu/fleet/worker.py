@@ -1,0 +1,310 @@
+"""Lightweight simulated worker: the agent/worker control plane without
+the training math.
+
+Each :class:`SimWorker` speaks through the REAL
+:class:`~dlrover_tpu.agent.master_client.MasterClient` typed wrappers
+(client-injected with the in-process loopback), so every message it
+sends is the production wire format dispatched by the production
+servicer: ``JoinRendezvousRequest`` → ``CommWorldRequest`` polling with
+the round guard, the folded ``WorkerReport`` (heartbeat + step digest +
+resource), ``NodeFailureReport`` on preemption/crash,
+``NumNodesWaitingRequest`` membership polls, ``ResizeBreakdownReport``
+from the chief after a re-rendezvous. It honors ``Overloaded`` replies
+exactly like the real agent reporter: widen the AIMD interval, stash
+the undelivered digest window and fold it into the next report
+(``observability.digest.merge_windows`` — the real retry path).
+
+What it deliberately does NOT do: run steps. Step progress is handed in
+by the runner's training model (synchronous training advances when the
+world is formed, stalls when membership breaks), because the harness is
+testing the control plane, not XLA.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.fleet.loopback import LinkState, LoopbackClient
+from dlrover_tpu.observability.digest import merge_windows
+from dlrover_tpu.rpc.policy import AdaptiveInterval, OverloadedError
+
+JOINING = "joining"
+WAITING = "waiting_world"
+RUNNING = "running"
+DEAD = "dead"
+
+
+class SimWorker:
+    def __init__(self, node_id: int, scenario, endpoint, stats):
+        self.node_id = node_id
+        self.sc = scenario
+        self.rng = random.Random(scenario.seed * 1_000_003 + node_id)
+        self.link = LinkState()
+        self.client = MasterClient(
+            f"loopback://{node_id}",
+            node_id,
+            client=LoopbackClient(endpoint, self.link, stats),
+        )
+        self.state = JOINING
+        self.rank = -1
+        self.is_chief = False
+        self.stepping = False
+        self.seated_round = -1
+        self.world_size = 0
+        self._joined_round = -1
+        self._join_started_vt = 0.0
+        self._next_world_poll = 0.0
+        self._next_member_poll = 0.0
+        # 4x widening bound, matching the real StatusReporter: the
+        # unreachable-master path has no advertised liveness ceiling
+        self.interval = AdaptiveInterval(
+            scenario.report_interval_vs,
+            scenario.report_interval_vs * 4,
+        )
+        # de-phase the fleet: each worker's report phase is seeded-random
+        self._next_report = self.rng.uniform(
+            0.0, scenario.report_interval_vs
+        )
+        # fault state
+        self.revive_at: Optional[float] = None
+        self.silent_until: Optional[float] = None
+        self.straggle_factor = 1.0
+        # digest accumulation (runner-fed while training is active)
+        self._pending_steps = 0.0
+        self._stashed_window: Optional[Dict] = None
+        # verdict counters
+        self.reports_sent = 0
+        self.reports_failed = 0
+        self.evidence: Dict[str, int] = {}
+
+    # -- fault hooks (the injector calls these) ------------------------
+
+    def preempt(self, vt: float, rejoin_at: float):
+        self._report_failure(vt, "preempted: TPU slice reclaimed", 143)
+        self._die(rejoin_at)
+
+    def crash(self, vt: float, rejoin_at: float):
+        self._report_failure(vt, "worker process crashed", 1)
+        self._die(rejoin_at)
+
+    def go_silent(self, until: float):
+        """Heartbeat loss: no failure report, no sends at all."""
+        self.silent_until = until
+
+    def partition(self, until: float):
+        self.link.partitioned = True
+        self.silent_until = None  # keeps *trying*, the link fails
+        self._partition_until = until
+
+    def set_slow_link(self, factor: float):
+        self.link.slow_factor = max(1.0, float(factor))
+
+    def set_straggle(self, factor: float):
+        self.straggle_factor = max(1.0, float(factor))
+
+    def _report_failure(self, vt: float, error: str, exit_code: int):
+        try:
+            self.client.report_failure(
+                error, exit_code=exit_code, timestamp=vt
+            )
+        except Exception:
+            self.reports_failed += 1
+
+    def _die(self, rejoin_at: float):
+        self.state = DEAD
+        self.stepping = False
+        self.rank = -1
+        self.is_chief = False
+        self.seated_round = -1
+        self.world_size = 0
+        self.revive_at = rejoin_at
+        self._pending_steps = 0.0
+        self._stashed_window = None
+
+    # -- training model hooks (the runner calls these) -----------------
+
+    def accrue_steps(self, steps: float):
+        self._pending_steps += steps
+
+    def start_stepping(self):
+        self.stepping = True
+
+    def stop_stepping(self):
+        self.stepping = False
+
+    @property
+    def alive(self) -> bool:
+        return self.state != DEAD
+
+    @property
+    def seated(self) -> bool:
+        return self.state == RUNNING
+
+    def _drain_digest(self) -> Optional[Dict]:
+        count = int(self._pending_steps)
+        if count <= 0:
+            return None
+        self._pending_steps -= count
+        step_s = self.sc.step_time_s * self.straggle_factor
+        return {
+            "count": count,
+            "mean_s": round(step_s, 6),
+            "p50_s": round(step_s, 6),
+            "p95_s": round(step_s * 1.05, 6),
+            "max_s": round(step_s * 1.1, 6),
+            "input_wait_s": round(0.01 * count, 6),
+        }
+
+    # -- the state machine ---------------------------------------------
+
+    def tick(self, vt: float, fleet) -> None:
+        if self.silent_until is not None:
+            if vt < self.silent_until:
+                return
+            self.silent_until = None
+        if getattr(self, "_partition_until", None) is not None:
+            if vt >= self._partition_until:
+                self.link.partitioned = False
+                self._partition_until = None
+        if self.state == DEAD:
+            if self.revive_at is not None and vt >= self.revive_at:
+                self.revive_at = None
+                self.state = JOINING
+            else:
+                return
+        if self.state == JOINING:
+            self._tick_join(vt)
+        elif self.state == WAITING:
+            self._tick_wait_world(vt, fleet)
+        elif self.state == RUNNING:
+            self._tick_running(vt, fleet)
+
+    def _tick_join(self, vt: float):
+        try:
+            self._joined_round = self.client.join_rendezvous(
+                node_rank=self.node_id,
+                local_world_size=1,
+                node_ip=f"10.0.{self.node_id // 256}.{self.node_id % 256}",
+                node_port=8476,
+            )
+        except Exception:
+            return  # master down / link out: rejoin next tick
+        self._join_started_vt = vt
+        self.state = WAITING
+        self._next_world_poll = vt  # poll once in the same tick
+        self._tick_wait_world(vt, fleet=None)
+
+    def _tick_wait_world(self, vt: float, fleet):
+        if vt < self._next_world_poll:
+            return
+        # jittered growing poll: the whole fleet polling an incomplete
+        # world must not arrive in lockstep
+        self._next_world_poll = vt + self.rng.uniform(0.5, 2.0)
+        try:
+            resp = self.client.get_comm_world()
+        except Exception:
+            return
+        if not (resp.completed and resp.world):
+            return
+        if resp.rdzv_round <= self._joined_round:
+            return  # round guard: never act on the stale previous world
+        my_rank = next(
+            (
+                int(r)
+                for r, info in resp.world.items()
+                if info[0] == self.node_id
+            ),
+            -1,
+        )
+        if my_rank < 0:
+            return  # not seated this round; keep waiting for the next
+        self.rank = my_rank
+        self.is_chief = my_rank == 0
+        self.seated_round = resp.rdzv_round
+        self.world_size = len(resp.world)
+        self.state = RUNNING
+        self._next_member_poll = vt + self.rng.uniform(
+            0.0, self.sc.membership_poll_vs
+        )
+        self.evidence["seated_rounds"] = (
+            self.evidence.get("seated_rounds", 0) + 1
+        )
+        if self.is_chief:
+            # the chief attributes this round's rendezvous half of the
+            # downtime (the real trainer's remesh() path does the same)
+            try:
+                self.client.report_resize_breakdown(
+                    rendezvous_s=max(0.0, vt - self._join_started_vt),
+                    compile_s=0.0,
+                )
+            except Exception:
+                pass
+
+    def _tick_running(self, vt: float, fleet):
+        # membership poll: a node waiting to (re)join means the world
+        # must re-form — drop back into the rendezvous
+        if vt >= self._next_member_poll:
+            self._next_member_poll = vt + self.sc.membership_poll_vs * (
+                0.75 + 0.5 * self.rng.random()
+            )
+            try:
+                if self.client.num_nodes_waiting() > 0:
+                    self.stepping = False
+                    self.state = JOINING
+                    self._tick_join(vt)
+                    return
+            except Exception:
+                pass
+        if vt >= self._next_report:
+            self._send_report(vt, fleet)
+
+    def force_report(self, vt: float):
+        """Make the next tick report immediately (the chief's
+        close-the-downtime-bracket report at training resume)."""
+        self._next_report = vt
+
+    def _send_report(self, vt: float, fleet):
+        # digests ride only while actually stepping — a heartbeat sent
+        # during a stall must not close the master's downtime bracket,
+        # and the real trainer's throttled step report does not fire
+        # when no steps run. An undelivered window (master gap /
+        # Overloaded) is stashed and folded into the next report.
+        digest = None
+        if self.stepping:
+            digest = merge_windows(self._stashed_window, self._drain_digest())
+            self._stashed_window = None
+        step = -1
+        if self.is_chief and self.stepping and fleet is not None:
+            step = fleet.global_step
+        shed = False
+        try:
+            self.client.report_worker_status(
+                step=step,
+                digest=digest,
+                cpu_percent=0.5,
+                memory_mb=1024.0,
+                tpu_duty_cycle=0.9,
+                timestamp=vt,
+            )
+        except OverloadedError as e:
+            self.reports_failed += 1
+            self._stashed_window = digest
+            self.interval.widen(e.retry_after_s, e.max_interval_s)
+            shed = True
+        except Exception:
+            self.reports_failed += 1
+            self._stashed_window = digest
+            self.interval.widen()
+            shed = True
+        else:
+            self.reports_sent += 1
+            self.interval.ok()
+        delay = self.interval.next_delay_s(self.rng) * self.link.slow_factor
+        if shed:
+            # full jitter after a shed: spread the retry over
+            # [0.5, 1.5]x the cadence so repeat collisions de-correlate
+            # (plain AIMD keeps colliding cohorts in phase)
+            delay *= 0.5 + self.rng.random()
+        self._next_report = vt + delay

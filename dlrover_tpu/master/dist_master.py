@@ -10,7 +10,6 @@ watcher feeds TPU slice topology into rank sorting.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Optional
@@ -191,7 +190,14 @@ class DistributedJobMaster:
             metric_collector=self.metric_collector,
         )
         self._server = RpcServer(self.servicer, port=port)
+        # backpressure must stay inside the liveness budget: a worker
+        # honoring Overloaded by widening can never be pushed past the
+        # heartbeat-eviction window
+        self._server.gate.liveness_ceiling_s = (
+            self.job_manager._heartbeat_timeout / 3.0
+        )
         self.port = self._server.port
+        self._metrics_server = None
         self._exit_code = 0
         self._exit_reason = ""
         self._stop_requested = threading.Event()
@@ -218,6 +224,11 @@ class DistributedJobMaster:
             snap_ts = float((speed_state or {}).get("snapshot_time", 0.0))
             self.speed_monitor.mark_downtime_start(ts=snap_ts or None)
         self._server.start()
+        from dlrover_tpu.master import metrics as master_metrics
+
+        self._metrics_server = master_metrics.maybe_start(
+            self._server, self.speed_monitor
+        )
         if isinstance(self.scaler, PodScaler):
             self.scaler.set_master_addr(self._resolve_master_addr())
         self.task_manager.start()
@@ -238,7 +249,7 @@ class DistributedJobMaster:
             return self.scaler.create_master_service(self.port)
         except Exception:
             logger.exception("master service creation failed; using pod IP")
-        pod_ip = os.getenv("POD_IP", "") or os.getenv("HOSTNAME", "")
+        pod_ip = flags.POD_IP.get() or flags.HOSTNAME.get()
         return f"{pod_ip}:{self.port}"
 
     def run(self, poll_interval: float = 5.0) -> int:
@@ -312,6 +323,8 @@ class DistributedJobMaster:
         self.scale_plan_watcher.stop()
         self.metric_collector.stop()
         self.diagnosis_manager.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
         self._server.stop(grace=1)
         self._dump_master_trace()
 

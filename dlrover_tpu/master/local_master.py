@@ -37,6 +37,8 @@ class LocalJobMaster:
         heartbeat_timeout: float = 600,
         min_node_num: Optional[int] = None,
         rdzv_waiting_timeout: float = 60,
+        clock=None,
+        eviction_hysteresis: Optional[int] = None,
     ):
         from dlrover_tpu.common import flags
         from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
@@ -52,7 +54,9 @@ class LocalJobMaster:
         self.state_manager = MasterStateManager(
             create_state_backend(flags.JOB_NAME.get())
         )
-        self.speed_monitor = SpeedMonitor()
+        # clock: injectable "now" for the goodput ledger (the fleet
+        # chaos harness drives it virtually; None = wall time)
+        self.speed_monitor = SpeedMonitor(clock=clock)
         self.speed_monitor.set_target_worker_num(node_num)
         self.task_manager = TaskManager(
             speed_monitor=self.speed_monitor,
@@ -62,15 +66,18 @@ class LocalJobMaster:
         self.metric_collector = JobMetricCollector(
             speed_monitor=self.speed_monitor
         )
-        self.job_manager = LocalJobManager(
-            speed_monitor=self.speed_monitor,
-            heartbeat_timeout=heartbeat_timeout,
-            error_monitor=self.error_monitor,
-        )
         self.rdzv_managers = {
             RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
             RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
         }
+        self.job_manager = LocalJobManager(
+            speed_monitor=self.speed_monitor,
+            heartbeat_timeout=heartbeat_timeout,
+            error_monitor=self.error_monitor,
+            rdzv_managers=self.rdzv_managers,
+            eviction_hysteresis=eviction_hysteresis,
+            clock=clock,
+        )
         for mgr in self.rdzv_managers.values():
             mgr.update_rdzv_params(
                 min_nodes=(
@@ -98,7 +105,13 @@ class LocalJobMaster:
             elastic_run_configs=elastic_run_configs,
         )
         self._server = RpcServer(self.servicer, port=port)
+        # Overloaded replies advertise how far a worker may widen its
+        # cadence before the heartbeat evictor declares it dead — the
+        # chaos harness caught naive AIMD widening walking healthy
+        # workers straight into eviction under a 10x overload
+        self._server.gate.liveness_ceiling_s = heartbeat_timeout / 3.0
         self.port = self._server.port
+        self._metrics_server = None
         self._exit_code = 0
         self._exit_reason = ""
 
@@ -115,8 +128,18 @@ class LocalJobMaster:
                 restored,
                 self.speed_monitor.completed_global_step,
             )
-            self.speed_monitor.mark_downtime_start()
+            # the gap while no master was serving is downtime, backdated
+            # to the old master's last ledger snapshot (parity with
+            # DistributedJobMaster.prepare) — on a fresh start with no
+            # prior bracket the relaunch window must not read as free
+            snap_ts = float((speed_state or {}).get("snapshot_time", 0.0))
+            self.speed_monitor.mark_downtime_start(ts=snap_ts or None)
         self._server.start()
+        from dlrover_tpu.master import metrics as master_metrics
+
+        self._metrics_server = master_metrics.maybe_start(
+            self._server, self.speed_monitor
+        )
         self.task_manager.start()
         self.job_manager.start()
         self.metric_collector.start()
@@ -154,6 +177,8 @@ class LocalJobMaster:
         self.metric_collector.stop()
         if self.diagnosis_manager is not None:
             self.diagnosis_manager.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
         self._server.stop(grace=1)
         self._dump_master_trace()
 

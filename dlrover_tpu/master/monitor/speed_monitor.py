@@ -28,7 +28,17 @@ class GlobalStepRecord:
 
 
 class SpeedMonitor:
-    def __init__(self, sample_window: int = DefaultValues.SPEED_SAMPLE_WINDOW):
+    def __init__(
+        self,
+        sample_window: int = DefaultValues.SPEED_SAMPLE_WINDOW,
+        clock=None,
+    ):
+        # injectable clock (defaults to wall time): every internal "now"
+        # reads it, so the fleet harness can drive the whole goodput
+        # ledger — brackets, attribution, relaunch snapshots — on a
+        # virtual clock through the real wire and get a deterministic
+        # verdict
+        self._clock = clock or time.time
         self._lock = threading.Lock()
         self._samples: List[GlobalStepRecord] = []
         self._sample_window = sample_window
@@ -36,7 +46,7 @@ class SpeedMonitor:
         self._global_step = 0
         self._target_worker_num = 0
         self._workers: Set[Tuple[str, int]] = set()
-        self._init_time = time.time()
+        self._init_time = self._clock()
         # goodput ledger
         self._downtime_start: float = 0.0
         self._total_downtime: float = 0.0
@@ -78,7 +88,7 @@ class SpeedMonitor:
     # -- step samples -------------------------------------------------------
 
     def collect_global_step(self, step: int, timestamp: Optional[float] = None):
-        ts = timestamp or time.time()
+        ts = timestamp or self._clock()
         with self._lock:
             if self._start_training_time == 0.0:
                 self._start_training_time = ts
@@ -129,6 +139,17 @@ class SpeedMonitor:
         # forever (detector has its own lock — kept out of ours)
         self.straggler_detector.forget(node_id)
 
+    def evict_worker(self, node_type: str, node_id: int):
+        """Heartbeat eviction: beyond ``remove_running_worker``, drop
+        the rank's last digest window so the straggler report and
+        /metrics stop advertising a dead rank's numbers. Cumulative
+        productive/input-wait seconds stay — that history happened and
+        the attribution must keep accounting for it. A returning worker
+        re-seeds everything with its first fresh digest."""
+        self.remove_running_worker(node_type, node_id)
+        with self._lock:
+            self._digest_last.pop(int(node_id), None)
+
     def all_worker_joined(self) -> bool:
         with self._lock:
             return 0 < self._target_worker_num <= len(self._workers)
@@ -143,12 +164,12 @@ class SpeedMonitor:
     def mark_downtime_start(self, ts: Optional[float] = None):
         with self._lock:
             if self._downtime_start == 0.0:
-                self._downtime_start = ts or time.time()
+                self._downtime_start = ts or self._clock()
 
     def mark_downtime_end(self, ts: Optional[float] = None):
         with self._lock:
             if self._downtime_start > 0.0:
-                end = ts or time.time()
+                end = ts or self._clock()
                 # clamp: downtime_start may come from the OLD master pod's
                 # clock (relaunch backdating); skew must never subtract
                 self._total_downtime += max(0.0, end - self._downtime_start)
@@ -278,7 +299,7 @@ class SpeedMonitor:
         residual; when measured categories overflow the wall —
         clock skew, double-reported windows — productive absorbs the
         overage first)."""
-        now = now or time.time()
+        now = now or self._clock()
         straggler_wait = self.straggler_detector.lost_seconds()
         with self._lock:
             start = self._start_training_time
@@ -350,7 +371,7 @@ class SpeedMonitor:
         with self._lock:
             spans = list(self._downtime_spans)
             if self._downtime_start > 0.0:
-                spans.append((self._downtime_start, time.time()))
+                spans.append((self._downtime_start, self._clock()))
         for s, e in spans:
             events.append({
                 "name": "job.downtime", "cat": "downtime", "ph": "X",
@@ -368,12 +389,12 @@ class SpeedMonitor:
                 return 0.0
             return self._total_downtime / self._downtime_events
 
-    def goodput(self) -> float:
+    def goodput(self, now: Optional[float] = None) -> float:
         """Fraction of wall time (since first step) spent training."""
         with self._lock:
             if self._start_training_time == 0.0:
                 return 0.0
-            now = time.time()
+            now = now or self._clock()
             wall = now - self._start_training_time
             if wall <= 0:
                 return 0.0
@@ -382,11 +403,13 @@ class SpeedMonitor:
                 down += max(0.0, now - self._downtime_start)
             return max(0.0, min(1.0, (wall - down) / wall))
 
-    def total_downtime(self) -> float:
+    def total_downtime(self, now: Optional[float] = None) -> float:
         with self._lock:
             down = self._total_downtime
             if self._downtime_start > 0.0:
-                down += max(0.0, time.time() - self._downtime_start)
+                down += max(
+                    0.0, (now or self._clock()) - self._downtime_start
+                )
             return down
 
     def reset_running_speed(self):
@@ -429,7 +452,7 @@ class SpeedMonitor:
                 "straggler": self.straggler_detector.export_state(),
                 # when the old master dies with no open bracket, the
                 # restore path backdates the relaunch gap to this stamp
-                "snapshot_time": time.time(),
+                "snapshot_time": self._clock(),
             }
 
     def import_state(self, state: Dict):

@@ -30,7 +30,7 @@ from dlrover_tpu.master.node.event_callback import (
     AllReduceNodeHandlingCallback,
     ClusterContext,
 )
-from dlrover_tpu.master.node.job_manager import JobManager
+from dlrover_tpu.master.node.job_manager import HeartbeatEvictor, JobManager
 from dlrover_tpu.master.node.status_flow import get_node_state_flow
 from dlrover_tpu.master.resource.plan import ScalePlan
 from dlrover_tpu.scheduler.job import JobArgs
@@ -77,6 +77,9 @@ class DistributedJobManager(JobManager):
         self._make_replica_manager = make_replica_manager
         self._stop_evt = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
+        # eviction hysteresis state; timeout re-read per sweep (the
+        # override / runtime-tunable context may change it live)
+        self._evictor = HeartbeatEvictor(self._heartbeat_timeout)
         self._start_ts = 0.0
         self._lock = threading.RLock()
         #: set when a node dies unrecoverably → drives early stop
@@ -506,25 +509,71 @@ class DistributedJobManager(JobManager):
         self._scaler.scale(plan)
 
     def _check_heartbeats(self):
-        now = time.time()
+        self.sweep_heartbeats()
+
+    def sweep_heartbeats(self, now: Optional[float] = None) -> List[int]:
+        """One heartbeat-eviction sweep with hysteresis: a worker must
+        stay silent past the timeout for ``hysteresis`` consecutive
+        sweeps before it is declared dead — then its rendezvous slot is
+        released and its straggler/digest state forgotten, so a
+        partitioned node neither stalls a pending round nor skews the
+        fleet median. ``collect_node_heartbeat`` reconciles it cleanly
+        if it returns. Returns the ids evicted this sweep."""
+        now = now if now is not None else time.time()
+        self._evictor.timeout = self._heartbeat_timeout
+        evicted: List[int] = []
         for node in list(self._job_context.workers().values()):
-            if (
-                node.status == NodeStatus.RUNNING
-                and node.heartbeat_time > 0
-                and now - node.heartbeat_time > self._heartbeat_timeout
-            ):
-                logger.warning(
-                    "node %s-%s heartbeat timeout (%.0fs); marking FAILED",
-                    node.type,
-                    node.id,
-                    now - node.heartbeat_time,
-                )
-                dead = Node(node.type, node.id, status=NodeStatus.FAILED)
-                dead.exit_reason = NodeExitReason.UNKNOWN_ERROR
-                node.exit_reason = NodeExitReason.UNKNOWN_ERROR
-                self.handle_node_event(
-                    NodeEvent(NodeEventType.MODIFIED, dead)
-                )
+            if node.status != NodeStatus.RUNNING or node.heartbeat_time <= 0:
+                continue
+            silent = now - node.heartbeat_time
+            if not self._evictor.observe(node.id, silent):
+                continue
+            logger.warning(
+                "node %s-%s heartbeat-silent %.0fs (> %.0fs for %d "
+                "sweeps); evicting",
+                node.type, node.id, silent, self._heartbeat_timeout,
+                self._evictor.hysteresis,
+            )
+            dead = Node(node.type, node.id, status=NodeStatus.FAILED)
+            dead.exit_reason = NodeExitReason.UNKNOWN_ERROR
+            node.exit_reason = NodeExitReason.UNKNOWN_ERROR
+            self.handle_node_event(
+                NodeEvent(NodeEventType.MODIFIED, dead)
+            )
+            # the event callbacks already told the rendezvous managers;
+            # remove_alive_node here is belt-and-braces for a directly
+            # constructed manager with no callbacks wired
+            for mgr in self._rdzv_managers.values():
+                mgr.remove_alive_node(node.id)
+            if self._speed_monitor is not None:
+                self._speed_monitor.evict_worker(node.type, node.id)
+            evicted.append(node.id)
+        return evicted
+
+    def collect_node_heartbeat(self, node_type, node_id, ts):
+        """Reconcile an evicted-but-returned worker before the base
+        heartbeat handling: the partition healed, so the node goes back
+        to RUNNING and re-enters the running-worker set. A node the
+        eviction already RELEASED (relaunch policy launched its
+        replacement) is NOT revived — reviving it would run the old
+        worker alongside its replacement and over-seat the next
+        rendezvous; the platform deletes the released pod."""
+        node = self._job_context.get_node(node_type, node_id)
+        if (
+            node is not None
+            and self._evictor.reconcile(node_id)
+            and node.status == NodeStatus.FAILED
+            and not node.is_released
+        ):
+            logger.info(
+                "node %s-%s returned after heartbeat eviction; reconciling",
+                node_type, node_id,
+            )
+            node.exit_reason = ""
+            node.update_status(NodeStatus.RUNNING)
+            if self._speed_monitor is not None:
+                self._speed_monitor.add_running_worker(node_type, node_id)
+        return super().collect_node_heartbeat(node_type, node_id, ts)
 
     # -- early stop ---------------------------------------------------------
 

@@ -139,6 +139,63 @@ class JobManager(ABC):
         return False, "", ""
 
 
+class HeartbeatEvictor:
+    """Eviction policy with hysteresis, shared by the local and
+    distributed job managers.
+
+    A RUNNING worker silent past ``timeout`` is a *suspect*; only after
+    ``hysteresis`` CONSECUTIVE monitor sweeps over the threshold is it
+    evicted — one lost report window, a GC-of-death pause or a clock
+    jump must not drop a healthy node out of the rendezvous. One
+    in-time heartbeat clears the strikes. ``reconcile`` is the return
+    path: a heartbeat from an evicted id means the partition healed, so
+    the node is revived instead of being treated as a stranger."""
+
+    def __init__(self, timeout: float, hysteresis: Optional[int] = None):
+        from dlrover_tpu.common import flags
+
+        self.timeout = float(timeout)
+        self.hysteresis = max(
+            1,
+            int(hysteresis) if hysteresis is not None
+            else int(flags.EVICT_HYSTERESIS.get()),
+        )
+        self._strikes: Dict[int, int] = {}
+        self._evicted: set = set()
+
+    def observe(self, node_id: int, silent_s: float) -> bool:
+        """Fold one sweep's observation; True = evict now (exactly once
+        per silence episode)."""
+        if silent_s <= self.timeout:
+            self._strikes.pop(node_id, None)
+            return False
+        if node_id in self._evicted:
+            return False
+        strikes = self._strikes.get(node_id, 0) + 1
+        self._strikes[node_id] = strikes
+        if strikes < self.hysteresis:
+            return False
+        self._evicted.add(node_id)
+        return True
+
+    def reconcile(self, node_id: int) -> bool:
+        """A sign of life from the node; True iff it had been evicted
+        (the caller revives it)."""
+        self._strikes.pop(node_id, None)
+        if node_id in self._evicted:
+            self._evicted.discard(node_id)
+            return True
+        return False
+
+    def forget(self, node_id: int):
+        self._strikes.pop(node_id, None)
+        self._evicted.discard(node_id)
+
+    @property
+    def evicted(self) -> set:
+        return set(self._evicted)
+
+
 def _classify_error(error_data: str, exit_code: int) -> str:
     """Map a failure report to a NodeExitReason (drives relaunch policy)."""
     text = (error_data or "").lower()
@@ -169,9 +226,23 @@ class LocalJobManager(JobManager):
         speed_monitor=None,
         heartbeat_timeout: float = DefaultValues.SEC_HEARTBEAT_TIMEOUT,
         error_monitor=None,
+        rdzv_managers=None,
+        eviction_hysteresis: Optional[int] = None,
+        clock=None,
     ):
         super().__init__(job_args, speed_monitor, error_monitor)
         self._heartbeat_timeout = heartbeat_timeout
+        # rendezvous managers, when wired, get a dead node's waiting
+        # slot released at eviction so a pending round stops stalling
+        # on a partitioned worker
+        self._rdzv_managers = rdzv_managers or {}
+        self._evictor = HeartbeatEvictor(
+            heartbeat_timeout, eviction_hysteresis
+        )
+        # injectable "now": registration stamps and eviction sweeps must
+        # share the clock that stamps the heartbeats themselves, or a
+        # virtual-clock harness would evict freshly registered nodes
+        self._clock = clock or time.time
         self._monitor_thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
 
@@ -186,10 +257,18 @@ class LocalJobManager(JobManager):
         self._stopped = True
         self._stop_evt.set()
 
+    def pause_monitor(self):
+        """Stop the wall-clock heartbeat sweep thread without stopping
+        the manager: the fleet harness drives :meth:`sweep_heartbeats`
+        on its own (virtual) clock, and a second sweeper with a
+        different cadence would make eviction strike counts
+        nondeterministic."""
+        self._stop_evt.set()
+
     def add_node(self, node_type: str, node_id: int, **kw) -> Node:
         node = Node(node_type, node_id, **kw)
         node.update_status(NodeStatus.RUNNING)
-        node.update_heartbeat()
+        node.update_heartbeat(self._clock())
         self._job_context.update_node(node)
         if self._speed_monitor is not None:
             self._speed_monitor.add_running_worker(node_type, node_id)
@@ -206,8 +285,20 @@ class LocalJobManager(JobManager):
     ) -> Optional[DiagnosisAction]:
         """A heartbeat from an unknown node re-adopts it: agents only
         report their address once at boot, so a relaunched master learns
-        its surviving workers from their next heartbeat."""
-        self.get_or_register_node(node_type, node_id)
+        its surviving workers from their next heartbeat. A heartbeat
+        from an EVICTED node means the partition healed — revive it
+        (status back to RUNNING, re-counted as a running worker) instead
+        of leaving a live node marked dead."""
+        node = self.get_or_register_node(node_type, node_id)
+        if self._evictor.reconcile(node_id) and node.status == NodeStatus.FAILED:
+            logger.info(
+                "node %s-%s returned after heartbeat eviction; reconciling",
+                node_type, node_id,
+            )
+            node.exit_reason = ""
+            node.update_status(NodeStatus.RUNNING)
+            if self._speed_monitor is not None:
+                self._speed_monitor.add_running_worker(node_type, node_id)
         return super().collect_node_heartbeat(node_type, node_id, ts)
 
     def handle_node_succeeded(self, node_type: str, node_id: int):
@@ -231,23 +322,42 @@ class LocalJobManager(JobManager):
 
     def _monitor_heartbeats(self):
         while not self._stop_evt.wait(DefaultValues.SEC_MONITOR_INTERVAL):
-            now = time.time()
-            for node in self._job_context.workers().values():
-                if (
-                    node.status == NodeStatus.RUNNING
-                    and node.heartbeat_time > 0
-                    and now - node.heartbeat_time > self._heartbeat_timeout
-                ):
-                    logger.warning(
-                        "node %s-%s heartbeat timeout (%.0fs); marking FAILED",
-                        node.type,
-                        node.id,
-                        now - node.heartbeat_time,
-                    )
-                    node.exit_reason = NodeExitReason.UNKNOWN_ERROR
-                    self.handle_node_event(
-                        NodeEvent(
-                            NodeEventType.MODIFIED,
-                            Node(node.type, node.id, status=NodeStatus.FAILED),
-                        )
-                    )
+            self.sweep_heartbeats()
+
+    def sweep_heartbeats(self, now: Optional[float] = None) -> List[int]:
+        """One eviction sweep (the monitor thread's body, public so the
+        fleet harness can drive it on a virtual clock). Returns the
+        node ids evicted this sweep."""
+        now = now if now is not None else self._clock()
+        evicted: List[int] = []
+        for node in list(self._job_context.workers().values()):
+            if node.status != NodeStatus.RUNNING or node.heartbeat_time <= 0:
+                continue
+            silent = now - node.heartbeat_time
+            if self._evictor.observe(node.id, silent):
+                self._evict_node(node, silent)
+                evicted.append(node.id)
+        return evicted
+
+    def _evict_node(self, node: Node, silent_s: float):
+        """Declare a heartbeat-silent worker dead: FAILED status (drops
+        it from the running-worker set), rendezvous slot released so a
+        pending round stops waiting on it, straggler/digest state
+        forgotten so its stale p50 stops skewing the fleet median."""
+        logger.warning(
+            "node %s-%s heartbeat-silent %.0fs (> %.0fs timeout for %d "
+            "sweeps); evicting",
+            node.type, node.id, silent_s, self._heartbeat_timeout,
+            self._evictor.hysteresis,
+        )
+        node.exit_reason = NodeExitReason.UNKNOWN_ERROR
+        self.handle_node_event(
+            NodeEvent(
+                NodeEventType.MODIFIED,
+                Node(node.type, node.id, status=NodeStatus.FAILED),
+            )
+        )
+        for mgr in self._rdzv_managers.values():
+            mgr.remove_alive_node(node.id)
+        if self._speed_monitor is not None:
+            self._speed_monitor.evict_worker(node.type, node.id)

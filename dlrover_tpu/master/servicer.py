@@ -91,6 +91,7 @@ class MasterServicer:
             msg.DiagnosisReportData: self._report_diagnosis_data,
             msg.CheckpointStepReport: self._report_ckpt_step,
             msg.ResizeBreakdownReport: self._report_resize_breakdown,
+            msg.WorkerReport: self._worker_report,
         }
 
     # -- dispatch -----------------------------------------------------------
@@ -256,7 +257,11 @@ class MasterServicer:
         for mgr in self._rdzv_managers.values():
             mgr.remove_alive_node(request.node_id)
         if self._speed_monitor is not None:
-            self._speed_monitor.mark_downtime_start()
+            # a delayed/retried failure report opens the bracket at the
+            # true failure time, not its arrival time
+            self._speed_monitor.mark_downtime_start(
+                ts=request.timestamp or None
+            )
         return msg.SimpleResponse()
 
     def _report_succeeded(self, request: msg.SucceededReport):
@@ -282,27 +287,71 @@ class MasterServicer:
             self._speed_monitor.collect_global_step(
                 request.step, request.timestamp or time.time()
             )
-            self._speed_monitor.mark_downtime_end()
+            self._speed_monitor.mark_downtime_end(
+                ts=request.timestamp or None
+            )
             digest = getattr(request, "digest", None)
             if digest:
-                record = self._speed_monitor.collect_step_digest(
+                self._collect_digest(
                     request.node_id, digest,
-                    ts=request.timestamp or time.time(),
+                    request.timestamp or time.time(),
                 )
-                if record is not None and self._diagnosis_manager is not None:
-                    # a NEWLY flagged straggler enters the diagnosis
-                    # pipeline like any other observation; the resolve
-                    # chain decides whether to act on it
-                    import json as _json
-
-                    self._diagnosis_manager.collect_diagnosis_data(
-                        msg.DiagnosisReportData(
-                            data_cls="StragglerRecordData",
-                            data_content=_json.dumps(record.to_dict()),
-                            node_id=record.node_id,
-                        )
-                    )
         return msg.SimpleResponse()
+
+    def _collect_digest(self, node_id: int, digest: Dict, ts: float):
+        """Fold one rank's step-time digest; a NEWLY flagged straggler
+        enters the diagnosis pipeline like any other observation — the
+        resolve chain decides whether to act on it."""
+        record = self._speed_monitor.collect_step_digest(
+            node_id, digest, ts=ts
+        )
+        if record is not None and self._diagnosis_manager is not None:
+            import json as _json
+
+            self._diagnosis_manager.collect_diagnosis_data(
+                msg.DiagnosisReportData(
+                    data_cls="StragglerRecordData",
+                    data_content=_json.dumps(record.to_dict()),
+                    node_id=record.node_id,
+                )
+            )
+
+    def _worker_report(self, request: msg.WorkerReport):
+        """The folded periodic report (heartbeat + step digest +
+        resource usage in one RPC — ROADMAP item 5's backpressure
+        answer to the per-worker chatty protocol). Heartbeat semantics
+        match ``_report_heartbeat`` exactly (re-adoption after a master
+        relaunch included); the step/digest section only touches the
+        goodput ledger when it carries actual progress, so a heartbeat
+        sent during a stall never closes a downtime bracket."""
+        node_type = request.node_type or NodeType.WORKER
+        ts = request.timestamp or time.time()
+        actions = []
+        if self._job_manager is not None:
+            action = self._job_manager.collect_node_heartbeat(
+                node_type, request.node_id, ts
+            )
+            if action is not None:
+                actions.append(action)
+            if request.has_resource:
+                self._job_manager.update_node_resource_usage(
+                    node_type,
+                    request.node_id,
+                    request.cpu_percent,
+                    request.memory_mb,
+                    tpu_duty_cycle=request.tpu_duty_cycle,
+                )
+        if self._speed_monitor is not None:
+            digest = request.digest or {}
+            if request.step >= 0:
+                self._speed_monitor.collect_global_step(request.step, ts)
+            if request.step >= 0 or int(digest.get("count", 0) or 0) > 0:
+                self._speed_monitor.mark_downtime_end(
+                    ts=request.timestamp or None
+                )
+            if digest:
+                self._collect_digest(request.node_id, digest, ts)
+        return msg.WorkerReportResponse(actions=actions)
 
     def _report_model_info(self, request: msg.ModelInfoReport):
         if self._metric_collector is not None:

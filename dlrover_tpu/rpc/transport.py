@@ -6,19 +6,40 @@ The reference exposes one gRPC service with two generic RPCs ``get`` and
 the protocol evolvable without proto regeneration — but payloads are the safe
 JSON serde from :mod:`dlrover_tpu.common.serde`, and the methods are declared
 as raw-bytes unary RPCs so no generated stubs are needed.
+
+Fleet-scale hardening (ROADMAP item 5, docs/design/fleet_harness.md):
+
+- the server runs every request through a :class:`RequestGate` — a
+  bounded admission counter that *sheds* excess load with an explicit
+  :class:`~dlrover_tpu.common.messages.OverloadedResponse` instead of
+  letting the executor's unbounded queue hide saturation behind
+  unbounded latency.  Reports shed first (they are periodic and
+  resendable); gets shed at a higher watermark (a shed ``get_task``
+  stalls training, a shed heartbeat costs nothing).
+- the client retries through the unified policy in
+  :mod:`dlrover_tpu.rpc.policy`: jittered exponential backoff with a
+  budget, and an error taxonomy distinguishing unavailable vs deadline
+  vs application errors.  ``Overloaded`` replies either retry after the
+  server's hint (default) or raise :class:`OverloadedError` for
+  periodic reporters that honor backpressure by widening their
+  interval.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import random
 import threading
 import time
 from concurrent import futures
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import grpc
 
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.serde import deserialize, serialize
+from dlrover_tpu.rpc import policy as rpc_policy
+from dlrover_tpu.rpc.policy import OverloadedError
 
 SERVICE = "dlrover_tpu.Master"
 GET = f"/{SERVICE}/get"
@@ -49,11 +70,159 @@ class _Handler(grpc.GenericRpcHandler):
         return None
 
 
+class RequestGate:
+    """Bounded admission for the servicer, shared by the real gRPC
+    server and the fleet harness's in-process loopback.
+
+    ``depth`` is the number of requests currently *inside* the
+    servicer.  Admission above ``report_cap`` (or ``get_cap`` for
+    gets) is refused — the caller returns an ``OverloadedResponse``
+    built from :meth:`overload_reply`, a reply that costs microseconds,
+    so saturation turns into explicit, bounded-latency sheds instead of
+    an invisible executor queue.  Counters are cumulative and exported
+    on the master ``/metrics``."""
+
+    def __init__(self, report_cap: int = 16, get_cap: Optional[int] = None):
+        self.report_cap = max(1, int(report_cap))
+        # gets shed later: a shed get stalls the caller's actual work
+        self.get_cap = (
+            max(self.report_cap, int(get_cap))
+            if get_cap is not None
+            else self.report_cap * 2
+        )
+        # the liveness ceiling advertised on Overloaded replies: how far
+        # a client may widen its report cadence before the heartbeat
+        # evictor would declare it dead. The master that owns this gate
+        # sets it from its heartbeat timeout (a safe fraction, so a
+        # widened-but-honoring worker always lands >=2 reports per
+        # timeout window). 0 = don't advertise.
+        self.liveness_ceiling_s = 0.0
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._inflight_reports = 0
+        self._peak = 0
+        self._served: Dict[str, int] = {"get": 0, "report": 0}
+        self._rejected: Dict[str, int] = {"get": 0, "report": 0}
+
+    def try_enter(self, kind: str) -> bool:
+        with self._lock:
+            if kind == "get":
+                # gets compete for the TOTAL budget (they shed last,
+                # at the higher watermark)
+                admitted = self._inflight < self.get_cap
+            else:
+                # reports compete only with OTHER reports: a get-heavy
+                # episode (a 1k-node re-rendezvous polling the world)
+                # must never starve heartbeats/failure reports into
+                # 100% shed — that would walk healthy workers into
+                # eviction while their failure reports are shed too
+                admitted = self._inflight_reports < self.report_cap
+            if not admitted:
+                self._rejected[kind] = self._rejected.get(kind, 0) + 1
+                return False
+            self._inflight += 1
+            if kind != "get":
+                self._inflight_reports += 1
+            if self._inflight > self._peak:
+                self._peak = self._inflight
+            self._served[kind] = self._served.get(kind, 0) + 1
+            return True
+
+    def leave(self, kind: str = "report"):
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+            if kind != "get":
+                self._inflight_reports = max(0, self._inflight_reports - 1)
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @staticmethod
+    def _retry_hint_s(depth: int) -> float:
+        """Shed-reply backoff hint: grows with depth so a deeper
+        overload pushes the fleet further out."""
+        return min(10.0, max(0.5, 0.05 * depth))
+
+    def overload_reply(self, kind: str = "report"):
+        from dlrover_tpu.common import messages as msg
+
+        with self._lock:
+            depth = self._inflight
+        return msg.OverloadedResponse(
+            retry_after_s=self._retry_hint_s(depth),
+            queue_depth=depth,
+            reason=f"{kind} admission cap reached",
+            max_interval_s=self.liveness_ceiling_s,
+        )
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "peak_inflight": self._peak,
+                "report_cap": self.report_cap,
+                "get_cap": self.get_cap,
+                "served": dict(self._served),
+                "rejected": dict(self._rejected),
+            }
+
+    def prometheus_lines(self) -> List[str]:
+        s = self.stats()
+        lines = [
+            "# TYPE dlrover_tpu_master_rpc_inflight gauge",
+            f"dlrover_tpu_master_rpc_inflight {s['inflight']}",
+            f"dlrover_tpu_master_rpc_inflight_peak {s['peak_inflight']}",
+            "# TYPE dlrover_tpu_master_rpc_total counter",
+        ]
+        for kind in sorted(s["served"]):
+            lines.append(
+                f'dlrover_tpu_master_rpc_total{{method="{kind}",'
+                f'outcome="served"}} {s["served"][kind]}'
+            )
+        for kind in sorted(s["rejected"]):
+            lines.append(
+                f'dlrover_tpu_master_rpc_total{{method="{kind}",'
+                f'outcome="rejected"}} {s["rejected"][kind]}'
+            )
+        return lines
+
+
 class RpcServer:
     """Wraps a servicer object exposing ``get(msg)`` / ``report(msg)``."""
 
-    def __init__(self, servicer, port: int = 0, max_workers: int = 32):
+    def __init__(
+        self,
+        servicer,
+        port: int = 0,
+        max_workers: int = 32,
+        gate: Optional[RequestGate] = None,
+    ):
+        from dlrover_tpu.common import flags
+
         self._servicer = servicer
+        if gate is None:
+            # admission caps BELOW the thread count: in-handler depth
+            # can never exceed max_workers, so a cap at or above it
+            # would never reject — the gate would silently vanish and
+            # overload would hide in the executor queue again. Shed
+            # replies also need free threads to stay fast.
+            cap = int(flags.RPC_INFLIGHT_CAP.get()) or max(
+                8, max_workers // 2
+            )
+            ceiling = max(1, max_workers - 8)
+            if cap > ceiling:
+                logger.warning(
+                    "RPC admission cap %d >= server threads %d would "
+                    "disable shedding; clamping to %d",
+                    cap, max_workers, ceiling,
+                )
+                cap = ceiling
+            gate = RequestGate(report_cap=cap, get_cap=min(
+                max_workers - 2, cap * 2
+            ))
+        self.gate = gate
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[
@@ -67,6 +236,8 @@ class RpcServer:
         self.port = self._server.add_insecure_port(f"0.0.0.0:{port}")
 
     def _handle_get(self, request: bytes, context) -> bytes:
+        if not self.gate.try_enter("get"):
+            return serialize(self.gate.overload_reply("get"))
         try:
             msg = deserialize(request)
             resp = self._servicer.get(msg, context)
@@ -74,8 +245,12 @@ class RpcServer:
         except Exception:
             logger.exception("error handling get RPC")
             context.abort(grpc.StatusCode.INTERNAL, "get failed")
+        finally:
+            self.gate.leave("get")
 
     def _handle_report(self, request: bytes, context) -> bytes:
+        if not self.gate.try_enter("report"):
+            return serialize(self.gate.overload_reply("report"))
         try:
             msg = deserialize(request)
             resp = self._servicer.report(msg, context)
@@ -83,6 +258,8 @@ class RpcServer:
         except Exception:
             logger.exception("error handling report RPC")
             context.abort(grpc.StatusCode.INTERNAL, "report failed")
+        finally:
+            self.gate.leave("report")
 
     def start(self):
         self._server.start()
@@ -92,11 +269,21 @@ class RpcServer:
 
 
 class RpcClient:
-    """Client side of the two generic RPCs, with retry."""
+    """Client side of the two generic RPCs, with the unified retry
+    policy (jittered exponential backoff, budget-bounded, error
+    taxonomy — :mod:`dlrover_tpu.rpc.policy`)."""
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        addr: str,
+        timeout: float = 30.0,
+        policy: rpc_policy.BackoffPolicy = rpc_policy.DEFAULT_RPC,
+        rng: Optional[random.Random] = None,
+    ):
         self.addr = addr
         self._timeout = timeout
+        self._policy = policy
+        self._rng = rng
         self._lock = threading.Lock()
         self._channel = None
         self._get = None
@@ -126,29 +313,84 @@ class RpcClient:
         except Exception:
             return False
 
-    def _call(self, stub, msg: Any, retries: int, timeout: Optional[float]):
+    def _call(
+        self,
+        stub,
+        msg: Any,
+        retries: int,
+        timeout: Optional[float],
+        on_overload: str = "retry",
+        policy: Optional[rpc_policy.BackoffPolicy] = None,
+    ):
+        """One logical call. ``retries`` bounds attempts (compat with
+        the old signature); delays come from the policy's jittered,
+        budget-bounded schedule. ``on_overload``: "retry" sleeps at
+        least the server's hint and tries again; "raise" surfaces
+        :class:`OverloadedError` immediately — periodic reporters
+        honor it by widening their cadence, not by retrying."""
         timeout = timeout or self._timeout
-        err = None
-        for i in range(retries):
+        pol = dataclasses.replace(
+            policy or self._policy, max_attempts=max(1, retries)
+        )
+        delays = pol.delays(self._rng)
+        payload = serialize(msg)
+        err: Optional[BaseException] = None
+        while True:
+            hint = 0.0
             try:
-                return deserialize(stub(serialize(msg), timeout=timeout))
-            except grpc.RpcError as e:
-                err = e
-                if e.code() in (
-                    grpc.StatusCode.UNAVAILABLE,
-                    grpc.StatusCode.DEADLINE_EXCEEDED,
-                ):
-                    time.sleep(min(2**i, 8))
-                    continue
+                resp = deserialize(stub(payload, timeout=timeout))
+                if _is_overloaded(resp):
+                    err = OverloadedError(
+                        resp.retry_after_s,
+                        resp.queue_depth,
+                        getattr(resp, "max_interval_s", 0.0),
+                    )
+                    if on_overload == "raise":
+                        raise err
+                    hint = resp.retry_after_s
+                else:
+                    return resp
+            except OverloadedError:
                 raise
-        raise err
+            except grpc.RpcError as e:
+                if rpc_policy.classify(e) not in rpc_policy.RETRYABLE:
+                    raise
+                err = e
+            delay = next(delays, None)
+            if delay is None:
+                raise err
+            time.sleep(max(delay, hint))
 
-    def get(self, msg: Any, retries: int = 3, timeout: Optional[float] = None):
-        return self._call(self._get, msg, retries, timeout)
+    def get(
+        self,
+        msg: Any,
+        retries: int = 3,
+        timeout: Optional[float] = None,
+        on_overload: str = "retry",
+        policy: Optional[rpc_policy.BackoffPolicy] = None,
+    ):
+        return self._call(
+            self._get, msg, retries, timeout, on_overload, policy
+        )
 
-    def report(self, msg: Any, retries: int = 3, timeout: Optional[float] = None):
-        return self._call(self._report, msg, retries, timeout)
+    def report(
+        self,
+        msg: Any,
+        retries: int = 3,
+        timeout: Optional[float] = None,
+        on_overload: str = "retry",
+        policy: Optional[rpc_policy.BackoffPolicy] = None,
+    ):
+        return self._call(
+            self._report, msg, retries, timeout, on_overload, policy
+        )
 
     def close(self):
         if self._channel:
             self._channel.close()
+
+
+def _is_overloaded(resp: Any) -> bool:
+    from dlrover_tpu.common import messages as msg
+
+    return isinstance(resp, msg.OverloadedResponse)

@@ -435,6 +435,11 @@ def test_heartbeat_timeout_marks_failed_and_relaunches():
     run_event(mgr, 0, NodeStatus.RUNNING)
     node = get_job_context().get_node(NodeType.WORKER, 0)
     node.update_heartbeat(time.time() - 10)
+    # hysteresis: one silent sweep is a strike, not an eviction (a
+    # single lost report window must not drop a healthy node)
+    mgr._check_heartbeats()
+    assert node.status == NodeStatus.RUNNING
+    # the second consecutive silent sweep evicts and relaunches
     mgr._check_heartbeats()
     assert node.status == NodeStatus.FAILED
     assert scaler.plans[-1].launch_nodes[0].id == 4
