@@ -32,8 +32,10 @@ class MasterClient:
         # client injection: anything exposing get/report/available/close
         # over the serde wire — the fleet harness plugs its in-process
         # loopback here so 1k simulated workers exercise the SAME typed
-        # wrappers production agents use
-        self._client = client or RpcClient(master_addr)
+        # wrappers production agents use. The node id rides every call
+        # as a cheap header so the master's admission gate knows who it
+        # shed (shed-aware liveness)
+        self._client = client or RpcClient(master_addr, node_id=node_id)
         self.master_addr = master_addr
         self.node_id = node_id
         self.node_type = node_type
@@ -97,6 +99,16 @@ class MasterClient:
     def num_nodes_waiting(self, rdzv_name: str = RendezvousName.TRAINING) -> int:
         resp = self._client.get(msg.NumNodesWaitingRequest(rdzv_name=rdzv_name))
         return resp.waiting_num
+
+    def rendezvous_status(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> Tuple[int, int]:
+        """(waiting_num, latest_round). A worker whose seated round is
+        older than ``latest_round`` is hung in a dead collective (the
+        hang watchdog re-formed the world without it) and must re-join
+        even though nobody is waiting."""
+        resp = self._client.get(msg.NumNodesWaitingRequest(rdzv_name=rdzv_name))
+        return resp.waiting_num, getattr(resp, "latest_round", 0)
 
     def network_ready(self) -> Tuple[bool, str]:
         resp = self._client.get(msg.NetworkReadyRequest())
@@ -173,8 +185,13 @@ class MasterClient:
         )
 
     def report_succeeded(self):
+        # the agent's LAST message — it concludes the job master-side.
+        # RELAUNCH_TOLERANT: finishing during a master relaunch gap
+        # must conclude the job, not crash the agent after a clean run
         return self._client.report(
-            msg.SucceededReport(node_type=self.node_type, node_id=self.node_id)
+            msg.SucceededReport(node_type=self.node_type, node_id=self.node_id),
+            retries=8,
+            policy=rpc_policy.RELAUNCH_TOLERANT,
         )
 
     def report_used_resource(
@@ -263,14 +280,50 @@ class MasterClient:
             policy=rpc_policy.RELAUNCH_TOLERANT,
         )
 
-    def report_task_result(self, dataset_name: str, task_id: int, success: bool = True):
+    def report_task_result(
+        self,
+        dataset_name: str,
+        task_id: int,
+        success: bool = True,
+        lease_epoch: int = -1,
+    ):
         return self._client.report(
             msg.TaskResult(
                 dataset_name=dataset_name,
                 task_id=task_id,
                 node_id=self.node_id,
                 success=success,
+                lease_epoch=lease_epoch,
             ),
+            retries=9,
+            policy=rpc_policy.RELAUNCH_TOLERANT,
+        )
+
+    def lease_shards(
+        self,
+        dataset_name: str,
+        count: int,
+        done_ids: Optional[List[int]] = None,
+        failed_ids: Optional[List[int]] = None,
+        lease_epoch: int = -1,
+    ) -> msg.ShardLeaseResponse:
+        """The batched data plane: ack the finished shards of the
+        previous batch and lease up to ``count`` fresh shards under one
+        per-worker lease in a single RPC (renewed by the folded
+        WorkerReport; expiry re-enqueues at-least-once, the fence dedups
+        — docs/design/data_plane.md). RELAUNCH_TOLERANT like get_task:
+        the data plane stalls through a master relaunch gap instead of
+        failing the epoch."""
+        return self._client.get(
+            msg.ShardLeaseRequest(
+                dataset_name=dataset_name,
+                node_id=self.node_id,
+                count=count,
+                done_task_ids=[int(t) for t in done_ids or ()],
+                failed_task_ids=[int(t) for t in failed_ids or ()],
+                lease_epoch=lease_epoch,
+            ),
+            timeout=60,
             retries=9,
             policy=rpc_policy.RELAUNCH_TOLERANT,
         )

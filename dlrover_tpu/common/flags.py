@@ -292,6 +292,41 @@ STRAGGLER_WINDOWS = _define(
     "straggler (and a StragglerRecord enters the diagnosis pipeline).",
 )
 
+# -- leased data plane + collective-hang watchdog
+# (master/shard/, master/monitor/hang_watchdog.py,
+# docs/design/data_plane.md)
+
+SHARD_LEASE_TTL_S = _define(
+    "DLROVER_TPU_SHARD_LEASE_TTL_S", 120.0, "float",
+    "Seconds a batch shard lease stays valid without renewal; every "
+    "folded WorkerReport renews it (zero extra RPCs), and expiry "
+    "re-enqueues the undone shards at-least-once with the fence "
+    "bumped so the zombie's late reports cannot double-count.",
+)
+SHARD_LEASE_COUNT = _define(
+    "DLROVER_TPU_SHARD_LEASE_COUNT", 16, "int",
+    "Shards per lease_shards batch the worker's ShardingClient "
+    "prefetches (completions of the previous batch ride the same "
+    "RPC). 0 restores the one-task-per-get_task legacy protocol.",
+)
+HANG_WATCHDOG = _define(
+    "DLROVER_TPU_HANG_WATCHDOG", True, "bool",
+    "Master-side collective-hang watchdog "
+    "(master/monitor/hang_watchdog.py): 0 disables the sweep thread. "
+    "A round where every live worker is seated but step reports "
+    "stopped fleet-wide for the window is declared a collective hang: "
+    "downtime bracket opened, attributed to `collective_hang`, and "
+    "the seated cohort re-rendezvoused without its silent members.",
+)
+HANG_WATCHDOG_WINDOW_S = _define(
+    "DLROVER_TPU_HANG_WATCHDOG_WINDOW_S", 300.0, "float",
+    "Fleet-wide no-progress window before a seated round is declared "
+    "a collective hang. Must comfortably exceed the step-report "
+    "cadence and the longest legitimate pause (checkpoint save, "
+    "eval); one slow RANK never trips it (that is the straggler "
+    "detector's job).",
+)
+
 # -- agent/master wiring (NodeEnv names; injected by the agent/launcher)
 
 NODE_ID = _define(
@@ -358,6 +393,21 @@ HOSTNAME = _define(
     "HOSTNAME", "", "str",
     "Pod hostname (k8s default env; last-resort master-address "
     "fallback after POD_IP).",
+)
+KUBERNETES_SERVICE_HOST = _define(
+    "KUBERNETES_SERVICE_HOST", "", "str",
+    "Kubernetes apiserver host (injected into every pod by kubelet; "
+    "the in-cluster REST client's default endpoint).",
+)
+KUBERNETES_SERVICE_PORT = _define(
+    "KUBERNETES_SERVICE_PORT", "443", "str",
+    "Kubernetes apiserver port paired with KUBERNETES_SERVICE_HOST.",
+)
+TPU_LIBRARY_PATH = _define(
+    "TPU_LIBRARY_PATH", "", "str",
+    "Explicit libtpu path (JAX's own resolution variable); the "
+    "profiler interposer reads it to find the real plugin to "
+    "delegate to.",
 )
 K8S_INSECURE_TLS = _define(
     "DLROVER_TPU_K8S_INSECURE_TLS", "", "str",

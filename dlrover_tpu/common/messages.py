@@ -111,6 +111,12 @@ class NumNodesWaitingRequest:
 @message
 class NumNodesWaitingResponse:
     waiting_num: int = 0
+    # the latest completed rendezvous round: a worker seated in an
+    # OLDER round is hung in a dead collective (the hang watchdog
+    # re-formed the world without it) and must re-join even though
+    # nobody is waiting. 0 on a pre-watchdog master — old workers keep
+    # the waiting_num-only behavior (serde drops unknown fields)
+    latest_round: int = 0
 
 
 @message
@@ -276,9 +282,13 @@ class WorkerReport:
 @message
 class WorkerReportResponse:
     """Ack of a folded report: diagnosis actions ride back exactly as
-    on the heartbeat ack."""
+    on the heartbeat ack. ``data_todo`` maps dataset name -> queued
+    shard count, so a worker whose lease polls went idle (empty todo)
+    learns that a death re-enqueued shards WITHOUT polling the data
+    plane — the report it already sends doubles as the wakeup."""
 
     actions: List = field(default_factory=list)
+    data_todo: Dict = field(default_factory=dict)
 
 
 @message
@@ -349,6 +359,47 @@ class TaskResult:
     task_id: int = -1
     node_id: int = -1
     success: bool = True
+    # the lease fence the task was issued under (-1 = legacy per-task
+    # dispatch). A report whose fence no longer matches the master's
+    # issue record is a zombie's late report of a re-issued shard and
+    # is REJECTED — completed_records can never double-count
+    lease_epoch: int = -1
+
+
+@message
+class ShardLeaseRequest:
+    """Batched data-plane RPC (docs/design/data_plane.md): acknowledge
+    the previously leased shards that finished (``done_task_ids`` /
+    ``failed_task_ids``, fenced by ``lease_epoch``) and lease up to
+    ``count`` fresh shards under one per-worker lease in the SAME round
+    trip — steady-state the data plane costs one RPC per batch where
+    the per-task protocol cost two per shard."""
+
+    dataset_name: str = ""
+    node_id: int = -1
+    count: int = 0
+    done_task_ids: List[int] = field(default_factory=list)
+    failed_task_ids: List[int] = field(default_factory=list)
+    lease_epoch: int = -1
+
+
+@message
+class ShardLeaseResponse:
+    """``tasks`` are leased until ``deadline_ts`` under fence
+    ``lease_epoch``; the lease renews on every folded ``WorkerReport``
+    (zero extra steady-state RPCs) and expiry re-enqueues the undone
+    shards at-least-once. ``acked`` lists the done ids that were
+    actually counted (a stale fence acks nothing). ``idle`` = the todo
+    queue is empty but shards are still in flight elsewhere (a death
+    may re-enqueue them — wait for the report-ack data hint);
+    ``exhausted`` = the dataset epoch is truly complete."""
+
+    tasks: List[Task] = field(default_factory=list)
+    lease_epoch: int = -1
+    deadline_ts: float = 0.0
+    acked: List[int] = field(default_factory=list)
+    idle: bool = False
+    exhausted: bool = False
 
 
 @message

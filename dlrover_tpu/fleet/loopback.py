@@ -11,8 +11,11 @@ would not survive the real wire does not survive this one.
 
 Link faults are modeled per worker (:class:`LinkState`): a partitioned
 link raises ``ConnectionError`` (classified ``unavailable``, like a
-dead master address), a slow link stretches the caller's cadence. The
-master itself can be "down" (relaunch gap) via :class:`MasterEndpoint`.
+dead master address); a slow link QUEUES the worker's messages with a
+latency distribution (delayed delivery through the SimWorker outbox —
+a lease renewal or heartbeat genuinely arrives late on the master's
+clock, it is not merely sent less often). The master itself can be
+"down" (relaunch gap) via :class:`MasterEndpoint`.
 """
 
 from __future__ import annotations
@@ -56,17 +59,37 @@ class MasterEndpoint:
 
 
 class LinkState:
-    """One worker's RPC link: partitioned / slowed by the injector."""
+    """One worker's RPC link: partitioned / delayed by the injector.
+
+    ``latency_s``/``jitter_s`` parameterize the delayed-delivery model:
+    a message sent at virtual time T is DELIVERED (dispatched into the
+    servicer) at T + latency ± jitter through the worker's outbox
+    queue. 0 = immediate (the deterministic default)."""
 
     def __init__(self):
         self.partitioned = False
-        self.slow_factor = 1.0
+        self.latency_s = 0.0
+        self.jitter_s = 0.0
+
+    def delay_s(self, rng) -> float:
+        """One message's queued-delivery delay draw."""
+        if self.latency_s <= 0.0:
+            return 0.0
+        jitter = self.jitter_s * (2.0 * rng.random() - 1.0)
+        return max(0.0, self.latency_s + jitter)
 
 
 class RpcStats:
     """Fleet-wide wire statistics (thread-safe): per-call wall latency
-    (the "no RPC sees unbounded latency" gate reads ``max_s``), send
-    errors and sheds observed client-side."""
+    (the "no RPC sees unbounded latency" gate reads ``max_s``), a
+    log-bucketed latency histogram for percentiles (the SpeedMonitor
+    lock-split satellite measures servicer p99 under combined
+    report+lease load), send errors and sheds observed client-side."""
+
+    # ~48 log-spaced buckets, 1 µs .. ~10 s, x1.58 per bucket
+    _EDGE_BASE = 1e-6
+    _EDGE_RATIO = 1.584893  # 10**0.2: 5 buckets per decade
+    _N_BUCKETS = 48
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -75,6 +98,18 @@ class RpcStats:
         self.sheds = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self._hist = [0] * (self._N_BUCKETS + 1)
+
+    def _bucket(self, dur_s: float) -> int:
+        import math
+
+        if dur_s <= self._EDGE_BASE:
+            return 0
+        b = int(
+            math.log(dur_s / self._EDGE_BASE)
+            / math.log(self._EDGE_RATIO)
+        ) + 1
+        return min(self._N_BUCKETS, b)
 
     def record(self, dur_s: float):
         with self._lock:
@@ -82,6 +117,7 @@ class RpcStats:
             self.total_s += dur_s
             if dur_s > self.max_s:
                 self.max_s = dur_s
+            self._hist[self._bucket(dur_s)] += 1
 
     def record_error(self):
         with self._lock:
@@ -91,7 +127,22 @@ class RpcStats:
         with self._lock:
             self.sheds += 1
 
+    def percentile(self, q: float) -> float:
+        """Upper edge of the bucket holding the q-quantile call."""
+        with self._lock:
+            total = sum(self._hist)
+            if total == 0:
+                return 0.0
+            rank = q * (total - 1)
+            acc = 0
+            for i, n in enumerate(self._hist):
+                acc += n
+                if acc > rank:
+                    return self._EDGE_BASE * (self._EDGE_RATIO ** i)
+            return self.max_s
+
     def snapshot(self) -> Dict:
+        p99 = self.percentile(0.99)
         with self._lock:
             return {
                 "calls": self.calls,
@@ -101,6 +152,7 @@ class RpcStats:
                     self.total_s / self.calls if self.calls else 0.0
                 ),
                 "max_latency_s": self.max_s,
+                "p99_latency_s": round(p99, 6),
             }
 
 
@@ -115,10 +167,14 @@ class LoopbackClient:
         endpoint: MasterEndpoint,
         link: Optional[LinkState] = None,
         stats: Optional[RpcStats] = None,
+        node_id: int = -1,
     ):
         self._endpoint = endpoint
         self.link = link or LinkState()
         self._stats = stats
+        # the cheap node-id header (parity with RpcClient's gRPC
+        # metadata): the gate learns who it shed pre-deserialization
+        self._node_id = int(node_id)
 
     def available(self, timeout: float = 5.0) -> bool:
         return self._endpoint.up and not self.link.partitioned
@@ -159,7 +215,7 @@ class LoopbackClient:
             gate = self._endpoint.gate
             t0 = time.perf_counter()
             payload = serialize(msg)  # the REAL wire format, both ways
-            if not gate.try_enter(kind):
+            if not gate.try_enter(kind, self._node_id):
                 wire = serialize(gate.overload_reply(kind))
             else:
                 try:

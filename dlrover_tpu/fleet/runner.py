@@ -93,6 +93,8 @@ class FleetRunner:
         self._evicted_ever: Dict[int, float] = {}
         self._reconciled: Dict[int, float] = {}
         self._stragglers_seen: set = set()
+        self._hang_events: List[Dict] = []
+        self._resumed_after_hang = False
         self._relaunches = 0
         self._master_gap: Optional[Tuple[float, float]] = None
         self._archived_master_events: List[Dict] = []
@@ -133,10 +135,20 @@ class FleetRunner:
             heartbeat_timeout=self.sc.heartbeat_timeout_vs,
             clock=self.clock.now,
             eviction_hysteresis=self.sc.eviction_hysteresis,
+            lease_ttl=self.sc.lease_ttl_vs,
+            hang_window_s=self.sc.hang_window_vs or None,
         )
-        # the runner drives eviction sweeps on the virtual clock; a
-        # second wall-clock sweeper would add nondeterministic strikes
+        # the runner drives every sweep on the virtual clock; second
+        # wall-clock sweepers would add nondeterministic strikes,
+        # expiries and hang declarations
         master.job_manager.pause_monitor()
+        master.task_manager.pause_scan()
+        master.hang_watchdog.pause()
+        # the fleet's wire is the loopback: shed-aware liveness must
+        # consult the gate the workers actually hit, stamped in
+        # virtual time
+        self.endpoint.gate.clock = self.clock.now
+        master.job_manager.attach_gate(self.endpoint.gate)
         return master
 
     def _save_master_state(self):
@@ -173,7 +185,9 @@ class FleetRunner:
             elif ev.kind == "partition":
                 w.partition(vt + ev.duration_vs)
             elif ev.kind == "slow_link":
-                w.set_slow_link(ev.factor)
+                # delayed delivery: factor virtual seconds of one-way
+                # queued latency (±25% jitter), NOT cadence stretching
+                w.set_link_latency(ev.factor, ev.factor / 4.0)
                 self._recoveries.append(
                     (off + ev.duration_vs, "slow_link", [nid])
                 )
@@ -190,7 +204,7 @@ class FleetRunner:
             self._event(vt, f"recover {kind} nodes={_fmt_nodes(nodes)}")
             for nid in nodes:
                 if kind == "slow_link":
-                    self.workers[nid].set_slow_link(1.0)
+                    self.workers[nid].set_link_latency(0.0)
                 elif kind == "straggle":
                     self.workers[nid].set_straggle(1.0)
 
@@ -226,21 +240,28 @@ class FleetRunner:
     # -- training model ------------------------------------------------
 
     def _update_training(self, vt: float):
-        # synchronous training: the collective advances only when every
-        # live worker is seated in the SAME round and that round's world
-        # covers exactly the live fleet — a seated survivor of a round
-        # whose other members just died is stalled, not stepping
-        alive = [w for w in self.workers if w.alive]
-        active = bool(alive) and all(w.seated for w in alive)
-        if active:
-            rounds = {w.seated_round for w in alive}
+        # synchronous training: the CURRENT round's collective advances
+        # only when every member of that round is seated AND healthy —
+        # a member that died, partitioned or hung stalls everyone
+        # (exactly the seated-but-stalled mode PR 9's model masked by
+        # letting partitioned members keep "stepping"). Workers seated
+        # in an OLDER round are hung in a dead collective: they neither
+        # step nor block the re-formed world (they re-join via the
+        # stale-round guard once reachable).
+        seated = [w for w in self.workers if w.seated]
+        members = []
+        active = False
+        if seated:
+            cur = max(w.seated_round for w in seated)
+            members = [w for w in seated if w.seated_round == cur]
             active = (
-                len(rounds) == 1 and alive[0].world_size == len(alive)
+                len(members) == members[0].world_size
+                and all(m.healthy_member for m in members)
             )
         if active and not self._was_active:
-            for w in alive:
+            for w in members:
                 w.start_stepping()
-            chief = next((w for w in alive if w.is_chief), None)
+            chief = next((w for w in members if w.is_chief), None)
             if chief is not None:
                 # the bracket-closing report: the chief reports the step
                 # the moment training resumes (sync_host_step parity)
@@ -255,6 +276,8 @@ class FleetRunner:
                     f"{vt - self._stall_started_vt:.1f} vs stall",
                 )
                 self._stall_started_vt = None
+                if self._hang_events:
+                    self._resumed_after_hang = True
             else:
                 self._event(vt, "training started")
         elif not active and self._was_active:
@@ -268,7 +291,7 @@ class FleetRunner:
             steps = self.sc.tick_vs / self.sc.step_time_s
             self._progress += steps
             self.view.global_step = int(self._progress)
-            for w in alive:
+            for w in members:
                 if w.stepping:
                     w.accrue_steps(steps)
 
@@ -305,6 +328,17 @@ class FleetRunner:
             )
             self.master = self._boot_master()
             self.endpoint.set_master(self.master.servicer)
+            if sc.dataset_size > 0:
+                # the data plane under test: the fleet leases this
+                # dataset through the batched shard-lease protocol (a
+                # relaunched master restores it from the state backend)
+                from dlrover_tpu.common.messages import DatasetShardParams
+
+                self.master.task_manager.new_dataset(DatasetShardParams(
+                    dataset_name=sc.dataset_name,
+                    dataset_size=sc.dataset_size,
+                    shard_size=sc.shard_size,
+                ))
             self.workers = [
                 SimWorker(i, sc, self.endpoint, self.stats)
                 for i in range(sc.nodes)
@@ -343,6 +377,28 @@ class FleetRunner:
             self._maybe_master_up(vt)
             self._update_training(vt)
             self._tick_workers(vt)
+            if self.master is not None:
+                # lease/task deadline sweep (the deadline heap: O(due)
+                # per tick, not a walk of every in-flight shard)
+                self.master.task_manager.sweep_deadlines(now=vt)
+                if self.sc.hang_window_vs > 0:
+                    ev = self.master.hang_watchdog.sweep(now=vt)
+                    if ev is not None:
+                        self._hang_events.append(
+                            {**ev, "off": round(vt - self._base, 1)}
+                        )
+                        self._event(
+                            vt,
+                            f"collective hang declared "
+                            f"(stall {ev['stall_s']:.0f} vs, silent "
+                            f"members {ev['silent'] or 'none'})",
+                        )
+                # drain the coalescing shard-state writer at the tick
+                # boundary: models its sub-ms drain deterministically,
+                # so a SIGKILL between ticks restores exactly the acked
+                # counts the workers observed (the exactly-once gate
+                # across a master relaunch depends on this ordering)
+                self.master.task_manager.flush_state()
             if self.master is not None and off >= next_sweep:
                 next_sweep += sc.monitor_sweep_vs
                 evicted = self.master.job_manager.sweep_heartbeats(now=vt)
@@ -442,6 +498,11 @@ class FleetRunner:
                 for k, v in sorted(self._reconciled.items())
             },
             "master_relaunches": self._relaunches,
+            "hangs": {
+                "events": list(self._hang_events),
+                "recovered": self._resumed_after_hang,
+            },
+            "data_plane": self._data_verdict(),
             "gate": self.endpoint.gate.stats(),
             "rpc": self.stats.snapshot(),
             "worker_reports": {
@@ -460,6 +521,51 @@ class FleetRunner:
         verdict["checks"] = self._checks(verdict)
         verdict["ok"] = all(c["ok"] for c in verdict["checks"].values())
         return verdict
+
+    def _data_verdict(self) -> Dict:
+        """The data plane's ledger: every worker records a shard range
+        into ``acked_ranges`` only when the master's fenced ack
+        confirmed the count. Exactly-once = the sorted ranges tile
+        [0, dataset_size) with no overlap and no gap, AND the master's
+        ``completed_records`` agrees."""
+        sc = self.sc
+        if sc.dataset_size <= 0:
+            return {}
+        ranges = sorted(
+            r for w in self.workers for r in w.acked_ranges
+        )
+        overlaps = gaps = 0
+        pos = 0
+        for s, e in ranges:
+            if s < pos:
+                overlaps += 1
+            elif s > pos:
+                gaps += 1
+            pos = max(pos, e)
+        completed = (
+            self.master.task_manager.completed_records(sc.dataset_name)
+            if self.master is not None else -1
+        )
+        shards = -(-sc.dataset_size // sc.shard_size)  # ceil
+        rpcs = sum(w.data_rpcs for w in self.workers)
+        baseline = 2 * shards  # one get_task + one report per shard
+        return {
+            "dataset_size": sc.dataset_size,
+            "shards": shards,
+            "acked_ranges": len(ranges),
+            "acked_records": pos if not gaps and not overlaps else sum(
+                e - s for s, e in ranges
+            ),
+            "overlaps": overlaps,
+            "gaps": gaps,
+            "master_completed_records": completed,
+            "rpcs": rpcs,
+            "baseline_rpcs": baseline,
+            "rpc_ratio": round(rpcs / baseline, 4) if baseline else 0.0,
+            "workers_exhausted": sum(
+                1 for w in self.workers if w.exhausted
+            ),
+        }
 
     def _checks(self, v: Dict) -> Dict:
         exp = self.sc.expect or {}
@@ -485,6 +591,87 @@ class FleetRunner:
                 v["rpc"]["max_latency_s"] <= exp["max_rpc_latency_s"],
                 round(v["rpc"]["max_latency_s"], 4),
                 f"<= {exp['max_rpc_latency_s']}",
+            )
+        if "max_p99_latency_s" in exp:
+            # the SpeedMonitor lock-split evidence: servicer p99 under
+            # combined report+lease load stays flat at fleet scale
+            check(
+                "rpc_p99_bounded",
+                v["rpc"]["p99_latency_s"] <= exp["max_p99_latency_s"],
+                v["rpc"]["p99_latency_s"],
+                f"<= {exp['max_p99_latency_s']}",
+            )
+        dp = v.get("data_plane") or {}
+        if exp.get("data_exactly_once"):
+            ok = (
+                dp.get("overlaps", 1) == 0
+                and dp.get("gaps", 1) == 0
+                and dp.get("acked_records") == dp.get("dataset_size")
+                and dp.get("master_completed_records")
+                == dp.get("dataset_size")
+            )
+            check(
+                "records_delivered_exactly_once", ok,
+                {k: dp.get(k) for k in (
+                    "acked_records", "overlaps", "gaps",
+                    "master_completed_records",
+                )},
+                f"every record of {dp.get('dataset_size')} counted once",
+            )
+        if "max_data_rpc_ratio" in exp:
+            check(
+                "data_plane_rpc_budget",
+                dp.get("rpc_ratio", 1.0) <= exp["max_data_rpc_ratio"],
+                dp.get("rpc_ratio"),
+                f"<= {exp['max_data_rpc_ratio']} of the per-task baseline",
+            )
+        hangs = v.get("hangs") or {}
+        if "min_hangs" in exp:
+            check(
+                "collective_hang_detected",
+                len(hangs.get("events", [])) >= exp["min_hangs"],
+                len(hangs.get("events", [])), f">= {exp['min_hangs']}",
+            )
+        if "hang_detect_within_vs" in exp:
+            stall_at = min(
+                (ev.at_vs for ev in self.sc.faults
+                 if ev.kind in ("partition", "heartbeat_loss")),
+                default=0.0,
+            )
+            first = (
+                hangs["events"][0]["off"] if hangs.get("events")
+                else float("inf")
+            )
+            check(
+                "hang_detected_within_window",
+                first - stall_at <= exp["hang_detect_within_vs"],
+                round(first - stall_at, 1),
+                f"<= {exp['hang_detect_within_vs']}",
+            )
+        if exp.get("require_hang_recovery"):
+            check(
+                "round_recovered_after_hang",
+                bool(hangs.get("recovered")),
+                hangs.get("recovered"), True,
+            )
+        cats = v["attribution"].get("categories", {})
+        if "min_collective_hang_s" in exp:
+            check(
+                "hang_attributed_not_unattributed",
+                cats.get("collective_hang", 0.0)
+                >= exp["min_collective_hang_s"]
+                and cats.get("unattributed", 0.0)
+                <= cats.get("collective_hang", 0.0),
+                {
+                    "collective_hang": round(
+                        cats.get("collective_hang", 0.0), 1
+                    ),
+                    "unattributed": round(
+                        cats.get("unattributed", 0.0), 1
+                    ),
+                },
+                f"collective_hang >= {exp['min_collective_hang_s']} "
+                f"and >= unattributed",
             )
         if "min_sheds" in exp:
             total_rej = sum(v["gate"]["rejected"].values())

@@ -21,8 +21,11 @@ Fault taxonomy (``FaultEvent.kind``):
 - ``partition`` — the node's RPC link drops (reports raise): the node
   keeps trying; master-side it is indistinguishable from heartbeat
   loss, worker-side the client's backoff path is exercised;
-- ``slow_link`` — the node's link slows by ``factor`` (its report
-  cadence stretches accordingly);
+- ``slow_link`` — delayed delivery: the node's messages are QUEUED and
+  arrive ``factor`` virtual seconds late (± 25% jitter) on the
+  master's clock — a latency distribution, not cadence stretching, so
+  a lease renewal or heartbeat can genuinely arrive after its
+  deadline;
 - ``straggle`` — nodes' per-step wall time inflates by ``factor`` for
   ``duration_vs`` (their digests must trip the straggler detector, and
   one recovered window must unflag them);
@@ -99,6 +102,20 @@ class Scenario:
     #: >1 issues worker ticks from a thread pool (overload scenarios —
     #: exercises servicer concurrency at the cost of strict determinism)
     parallelism: int = 1
+    # -- data plane (0 = off): the fleet leases a dataset through the
+    # batched shard-lease protocol while training
+    dataset_name: str = "fleet-train"
+    dataset_size: int = 0
+    shard_size: int = 100
+    #: shards per lease_shards batch (the worker's prefetch depth)
+    lease_count: int = 16
+    #: lease TTL in virtual seconds (renewed by every WorkerReport)
+    lease_ttl_vs: float = 60.0
+    #: records each worker consumes per training step
+    records_per_step: int = 0
+    #: collective-hang watchdog window in virtual seconds (0 = the
+    #: watchdog is not swept — PR 9 behavior)
+    hang_window_vs: float = 0.0
     faults: List[FaultEvent] = dataclasses.field(default_factory=list)
     #: verdict gates: the CLI exits nonzero when any fails
     expect: Dict = dataclasses.field(default_factory=dict)

@@ -1,4 +1,5 @@
-"""Checked-in chaos scenarios (docs/design/fleet_harness.md).
+"""Checked-in chaos scenarios (docs/design/fleet_harness.md,
+docs/design/data_plane.md).
 
 - ``headline_1k`` — the CI acceptance scenario: a 1000-node fleet over
   30 virtual minutes with a straggler episode, a 40-node preemption
@@ -11,15 +12,36 @@
   explicit ``Overloaded`` replies (never queue unboundedly), workers
   must honor them by widening their cadence, and heartbeat-silent
   workers must be evicted within the hysteresis window and reconciled
-  when they return.
+  when they return. Shed-aware liveness (the node-id header) means the
+  master never evicts a worker it silenced: spurious evictions gate at
+  ZERO, closing PR 9's documented shed-blind gap.
+- ``shard_storm_1k`` — the leased data plane at fleet scale: 1000
+  workers consume a 2M-record dataset through batched shard leases
+  while a preemption storm, a heartbeat-silence episode (eviction +
+  hang-watchdog recovery) and a master relaunch hit mid-epoch. Gates:
+  every record delivered EXACTLY once (the per-worker fenced-ack
+  ledger tiles [0, size) with no gap/overlap and the master's count
+  agrees — at-least-once re-delivery with epoch-fenced dedup), total
+  data-plane RPCs <= 1/10 of the one-task-per-RPC baseline, and
+  servicer p99 latency stays bounded under the combined report+lease
+  load (the SpeedMonitor lock-split evidence).
+- ``seated_hang`` — PR 9's documented worst case: two SEATED workers
+  partition mid-round, stalling the synchronous collective while every
+  heartbeat looks healthy. Gates: the hang watchdog declares within
+  its window, the round re-forms without the silent pair (recovery),
+  the lost time lands in the ``collective_hang`` attribution category
+  (not ``unattributed``), and the attribution still sums to elapsed.
+- ``shard_storm_smoke`` — a 60-node cut of the shard storm for tier-1
+  tests (seconds of real time), same exactly-once + budget gates.
 - ``smoke`` — a 40-node, 4-virtual-minute cut of the headline for
   tier-1 tests (seconds of real time).
 
 Note one modeling rule: membership faults (preempt/crash) must not
-overlap a ``heartbeat_loss``/``partition`` window — a silent worker
-cannot rejoin, and a round that waits for the full fleet would never
-complete. That is a property of real synchronous training too, not a
-harness artifact.
+overlap a ``heartbeat_loss``/``partition`` window in scenarios WITHOUT
+the hang watchdog — a silent worker stalls the seated round (it cannot
+rejoin either), and only the watchdog can re-form the world around it.
+With ``hang_window_vs`` set, that recovery is exactly what the
+scenario exercises.
 """
 
 HEADLINE_FAULTS = [
@@ -98,10 +120,144 @@ BUILTIN = {
             "evict_nodes": [5, 6, 7],
             # silence at 40, timeout 12, 2 sweeps of 3 -> evict by ~58
             "evict_within_vs": 25,
-            # shed-blind liveness under sustained total overload can
-            # starve a few live workers into (self-healing) eviction
-            "max_spurious_evictions": 5,
+            # shed-AWARE liveness (the node-id header): the gate records
+            # who it shed before deserializing, and the sweep treats a
+            # recently-shed node as alive — under sustained total
+            # overload NO live worker may be starved into eviction any
+            # more. PR 9 gated this at <= 5 as a documented gap; the
+            # header closes it.
+            "max_spurious_evictions": 0,
             "require_reconcile": True,
+        },
+    },
+    "shard_storm_1k": {
+        "name": "shard_storm_1k",
+        "seed": 11,
+        "nodes": 1000,
+        "min_nodes": 990,
+        "duration_vs": 460,
+        "step_time_s": 1.0,
+        "report_interval_vs": 15,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 60,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 2,
+        "gate_report_cap": 64,
+        # the data plane: 2M records in 100-record shards, leased 16 at
+        # a time, consumed at 25 records/step/worker
+        "dataset_size": 2_000_000,
+        "shard_size": 100,
+        "lease_count": 16,
+        "lease_ttl_vs": 60,
+        "records_per_step": 25,
+        "hang_window_vs": 45,
+        "faults": [
+            # mid-epoch preemption storm: 30 workers die holding leased
+            # shards (failure report -> immediate requeue)
+            {"kind": "preempt", "at_vs": 100, "count": 30,
+             "duration_vs": 15},
+            # three workers go heartbeat-silent holding leases: the
+            # hang watchdog re-forms the round without them, the
+            # evictor declares them dead (HeartbeatEvictor ->
+            # remove_node_tasks), and their zombie completions after
+            # return are fenced off
+            {"kind": "heartbeat_loss", "at_vs": 200, "nodes": [3, 4, 5],
+             "duration_vs": 100},
+            # the master is SIGKILLed mid-epoch with leases open and
+            # relaunched from the durable dataset state
+            {"kind": "master_relaunch", "at_vs": 330, "duration_vs": 10},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.60,
+            "max_rpc_latency_s": 1.0,
+            # the SpeedMonitor lock-split evidence: p99 flat at 1k nodes
+            # under combined report+lease load
+            "max_p99_latency_s": 0.25,
+            "data_exactly_once": True,
+            "max_data_rpc_ratio": 0.1,
+            "evict_nodes": [3, 4, 5],
+            "max_spurious_evictions": 0,
+            "relaunches": 1,
+            "master_survives": True,
+        },
+    },
+    "shard_storm_smoke": {
+        "name": "shard_storm_smoke",
+        "seed": 12,
+        "nodes": 60,
+        "min_nodes": 58,
+        "duration_vs": 260,
+        "step_time_s": 1.0,
+        "report_interval_vs": 15,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 60,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 2,
+        "gate_report_cap": 32,
+        "dataset_size": 60_000,
+        "shard_size": 100,
+        "lease_count": 8,
+        "lease_ttl_vs": 60,
+        "records_per_step": 25,
+        "hang_window_vs": 45,
+        "faults": [
+            {"kind": "preempt", "at_vs": 60, "count": 4,
+             "duration_vs": 15},
+            {"kind": "heartbeat_loss", "at_vs": 120, "nodes": [2],
+             "duration_vs": 80},
+            {"kind": "master_relaunch", "at_vs": 210, "duration_vs": 10},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "max_rpc_latency_s": 2.0,
+            "data_exactly_once": True,
+            # the batching win scales with shards-per-worker: at 10
+            # shards/worker the floor is ~2 lease RPCs + a flush per
+            # worker (~0.2x); the 1k acceptance scenario carries the
+            # real <= 0.1 gate at 20 shards/worker
+            "max_data_rpc_ratio": 0.3,
+            "evict_nodes": [2],
+            "max_spurious_evictions": 0,
+            "relaunches": 1,
+            "master_survives": True,
+        },
+    },
+    "seated_hang": {
+        "name": "seated_hang",
+        "seed": 21,
+        "nodes": 100,
+        "min_nodes": 98,
+        "duration_vs": 300,
+        "step_time_s": 1.0,
+        "report_interval_vs": 10,
+        "membership_poll_vs": 8,
+        # high heartbeat timeout: the point is that the EVICTOR never
+        # fires here — heartbeats from the reachable 98 look perfectly
+        # healthy, and the partitioned pair heals before any timeout;
+        # only the watchdog can see the seated round stopped
+        "heartbeat_timeout_vs": 200,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 5,
+        "gate_report_cap": 32,
+        "hang_window_vs": 30,
+        "faults": [
+            # two SEATED workers partition mid-round: the synchronous
+            # collective stalls fleet-wide while everyone stays alive
+            {"kind": "partition", "at_vs": 100, "nodes": [10, 55],
+             "duration_vs": 150},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.70,
+            "max_rpc_latency_s": 2.0,
+            "min_hangs": 1,
+            # partition at 100, window 30, sweep 1/vs -> declared ~131
+            "hang_detect_within_vs": 40,
+            "require_hang_recovery": True,
+            # the stall is billed to collective_hang, not unattributed
+            "min_collective_hang_s": 20,
+            "master_survives": True,
         },
     },
     "smoke": {

@@ -1,5 +1,5 @@
-"""Lightweight simulated worker: the agent/worker control plane without
-the training math.
+"""Lightweight simulated worker: the agent/worker control AND data
+plane without the training math.
 
 Each :class:`SimWorker` speaks through the REAL
 :class:`~dlrover_tpu.agent.master_client.MasterClient` typed wrappers
@@ -7,23 +7,47 @@ Each :class:`SimWorker` speaks through the REAL
 sends is the production wire format dispatched by the production
 servicer: ``JoinRendezvousRequest`` → ``CommWorldRequest`` polling with
 the round guard, the folded ``WorkerReport`` (heartbeat + step digest +
-resource), ``NodeFailureReport`` on preemption/crash,
-``NumNodesWaitingRequest`` membership polls, ``ResizeBreakdownReport``
-from the chief after a re-rendezvous. It honors ``Overloaded`` replies
-exactly like the real agent reporter: widen the AIMD interval, stash
-the undelivered digest window and fold it into the next report
-(``observability.digest.merge_windows`` — the real retry path).
+resource), batched ``ShardLeaseRequest`` data-plane calls,
+``NodeFailureReport`` on preemption/crash, membership polls,
+``ResizeBreakdownReport`` from the chief after a re-rendezvous. It
+honors ``Overloaded`` replies exactly like the real agent reporter:
+widen the AIMD interval, stash the undelivered digest window and fold
+it into the next report.
 
-What it deliberately does NOT do: run steps. Step progress is handed in
-by the runner's training model (synchronous training advances when the
-world is formed, stalls when membership breaks), because the harness is
+Two state machines:
+
+- **Control plane** — join/wait/run, as in PR 9, plus the stale-round
+  guard: a worker seated in an older round than the master's latest
+  (the hang watchdog re-formed the world without it) re-joins even
+  though nobody is waiting.
+- **Data plane** — while stepping, the worker consumes records from
+  its leased shard queue; when the queue runs low it leases the next
+  batch (completions of the previous batch ride the same RPC); when
+  the master's todo runs dry it goes IDLE and wakes on the
+  ``WorkerReport`` ack's ``data_todo`` hint instead of polling — so a
+  mid-epoch death elsewhere re-engages exactly the workers needed,
+  not a thundering herd. Ranges are recorded into ``acked_ranges``
+  only when the master's ack confirms the fence — the harness's
+  exactly-once ledger.
+
+Delayed delivery: messages on a link with latency go through the
+worker's OUTBOX — queued (deliver_at, send) pairs the tick loop drains
+when due — so a lease renewal or heartbeat genuinely ARRIVES late on
+the master's virtual clock (the PR 9 loopback could only stretch send
+cadence). A worker that dies drops its outbox (in-flight connections
+reset with the process).
+
+What it deliberately does NOT do: run steps. Step progress is handed
+in by the runner's training model (synchronous training advances when
+the current round's members are all healthy), because the harness is
 testing the control plane, not XLA.
 """
 
 from __future__ import annotations
 
+import heapq
 import random
-from typing import Dict, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.fleet.loopback import LinkState, LoopbackClient
@@ -45,7 +69,9 @@ class SimWorker:
         self.client = MasterClient(
             f"loopback://{node_id}",
             node_id,
-            client=LoopbackClient(endpoint, self.link, stats),
+            client=LoopbackClient(
+                endpoint, self.link, stats, node_id=node_id
+            ),
         )
         self.state = JOINING
         self.rank = -1
@@ -74,6 +100,24 @@ class SimWorker:
         # digest accumulation (runner-fed while training is active)
         self._pending_steps = 0.0
         self._stashed_window: Optional[Dict] = None
+        # delayed-delivery outbox: (deliver_at, seq, send_fn)
+        self._outbox: List[Tuple[float, int, Callable[[], None]]] = []
+        self._outbox_seq = 0
+        # -- data plane ------------------------------------------------
+        self.shard_q: List = []  # leased Tasks not yet fully consumed
+        self._cur_remaining = 0  # records left in shard_q[0]
+        self._consume_credit = 0.0
+        self.lease_epoch = -1
+        self._done_pending: List[int] = []
+        self._unacked: Dict[int, Tuple[int, int]] = {}  # id -> range
+        self._lease_inflight = False
+        self._data_idle = False  # todo dry; wake on report-ack hint
+        self.exhausted = False
+        #: the exactly-once ledger: ranges whose completion the master
+        #: ACKED under a live fence (survives this worker's death — the
+        #: count happened)
+        self.acked_ranges: List[Tuple[int, int]] = []
+        self.data_rpcs = 0
         # verdict counters
         self.reports_sent = 0
         self.reports_failed = 0
@@ -98,8 +142,12 @@ class SimWorker:
         self.silent_until = None  # keeps *trying*, the link fails
         self._partition_until = until
 
-    def set_slow_link(self, factor: float):
-        self.link.slow_factor = max(1.0, float(factor))
+    def set_link_latency(self, latency_s: float, jitter_s: float = 0.0):
+        """Queued delayed delivery (not cadence stretching): messages
+        sent from now on arrive ``latency_s`` (± jitter) virtual
+        seconds later."""
+        self.link.latency_s = max(0.0, float(latency_s))
+        self.link.jitter_s = max(0.0, float(jitter_s))
 
     def set_straggle(self, factor: float):
         self.straggle_factor = max(1.0, float(factor))
@@ -122,11 +170,27 @@ class SimWorker:
         self.revive_at = rejoin_at
         self._pending_steps = 0.0
         self._stashed_window = None
+        # connections reset with the process: queued messages are lost
+        self._outbox = []
+        # un-acked consumed work dies with the worker — the master's
+        # lease expiry / failure-report requeue re-delivers it
+        # (at-least-once); acked_ranges stay: those counts happened
+        self.shard_q = []
+        self._cur_remaining = 0
+        self._consume_credit = 0.0
+        self.lease_epoch = -1
+        self._done_pending = []
+        self._unacked = {}
+        self._lease_inflight = False
+        self._data_idle = False
+        self.exhausted = False
 
     # -- training model hooks (the runner calls these) -----------------
 
     def accrue_steps(self, steps: float):
         self._pending_steps += steps
+        if self.sc.records_per_step > 0:
+            self._consume_credit += steps * self.sc.records_per_step
 
     def start_stepping(self):
         self.stepping = True
@@ -142,6 +206,19 @@ class SimWorker:
     def seated(self) -> bool:
         return self.state == RUNNING
 
+    @property
+    def healthy_member(self) -> bool:
+        """Can this worker actually run its half of a collective right
+        now? A partitioned or hung (silent) member stalls the whole
+        synchronous round — PR 9's model let seated-but-partitioned
+        workers keep 'stepping', which is exactly the masked hang this
+        PR's watchdog exists for."""
+        return (
+            self.state == RUNNING
+            and not self.link.partitioned
+            and self.silent_until is None
+        )
+
     def _drain_digest(self) -> Optional[Dict]:
         count = int(self._pending_steps)
         if count <= 0:
@@ -156,6 +233,24 @@ class SimWorker:
             "max_s": round(step_s * 1.1, 6),
             "input_wait_s": round(0.01 * count, 6),
         }
+
+    # -- delayed delivery ----------------------------------------------
+
+    def _dispatch(self, vt: float, fn: Callable[[], None]):
+        """Run ``fn`` (a real wire send) now, or queue it on the outbox
+        when the link has latency — the message then ARRIVES when the
+        tick loop drains it, late on the master's clock."""
+        delay = self.link.delay_s(self.rng)
+        if delay <= 0.0:
+            fn()
+            return
+        self._outbox_seq += 1
+        heapq.heappush(self._outbox, (vt + delay, self._outbox_seq, fn))
+
+    def _drain_outbox(self, vt: float):
+        while self._outbox and self._outbox[0][0] <= vt:
+            _, _, fn = heapq.heappop(self._outbox)
+            fn()
 
     # -- the state machine ---------------------------------------------
 
@@ -174,6 +269,7 @@ class SimWorker:
                 self.state = JOINING
             else:
                 return
+        self._drain_outbox(vt)
         if self.state == JOINING:
             self._tick_join(vt)
         elif self.state == WAITING:
@@ -206,7 +302,20 @@ class SimWorker:
             resp = self.client.get_comm_world()
         except Exception:
             return
+        if resp.rdzv_round < self._joined_round:
+            # the master's round went BACKWARD: it relaunched and our
+            # join died with its memory — re-join the fresh master (a
+            # relaunch that races a re-rendezvous would otherwise
+            # strand the whole fleet in waiting_world forever)
+            self.state = JOINING
+            self._tick_join(vt)
+            return
         if not (resp.completed and resp.world):
+            if vt - self._join_started_vt > 30.0:
+                # join-timeout parity with the real agent: a join eaten
+                # by a shed/relaunch window must not wait forever
+                self.state = JOINING
+                self._tick_join(vt)
             return
         if resp.rdzv_round <= self._joined_round:
             return  # round guard: never act on the stale previous world
@@ -244,13 +353,17 @@ class SimWorker:
 
     def _tick_running(self, vt: float, fleet):
         # membership poll: a node waiting to (re)join means the world
-        # must re-form — drop back into the rendezvous
+        # must re-form — drop back into the rendezvous. A LATEST round
+        # newer than the seated one means this worker is hung in a dead
+        # collective (the hang watchdog re-formed the world without
+        # it): re-join too, even though nobody is waiting.
         if vt >= self._next_member_poll:
             self._next_member_poll = vt + self.sc.membership_poll_vs * (
                 0.75 + 0.5 * self.rng.random()
             )
             try:
-                if self.client.num_nodes_waiting() > 0:
+                waiting, latest = self.client.rendezvous_status()
+                if waiting > 0 or latest > self.seated_round:
                     self.stepping = False
                     self.state = JOINING
                     self._tick_join(vt)
@@ -259,6 +372,7 @@ class SimWorker:
                 pass
         if vt >= self._next_report:
             self._send_report(vt, fleet)
+        self._tick_data(vt)
 
     def force_report(self, vt: float):
         """Make the next tick report immediately (the chief's
@@ -278,9 +392,16 @@ class SimWorker:
         step = -1
         if self.is_chief and self.stepping and fleet is not None:
             step = fleet.global_step
+        # cadence is decided at SEND time; a delayed link shifts when
+        # the report ARRIVES, not how often it is sent (queued
+        # delivery, not cadence stretching)
+        self._next_report = vt + self.interval.next_delay_s(self.rng)
+        self._dispatch(vt, lambda: self._do_report(vt, step, digest))
+
+    def _do_report(self, vt: float, step: int, digest: Optional[Dict]):
         shed = False
         try:
-            self.client.report_worker_status(
+            resp = self.client.report_worker_status(
                 step=step,
                 digest=digest,
                 cpu_percent=0.5,
@@ -290,21 +411,134 @@ class SimWorker:
             )
         except OverloadedError as e:
             self.reports_failed += 1
-            self._stashed_window = digest
+            self._stashed_window = merge_windows(
+                self._stashed_window, digest
+            )
             self.interval.widen(e.retry_after_s, e.max_interval_s)
             shed = True
         except Exception:
             self.reports_failed += 1
-            self._stashed_window = digest
+            self._stashed_window = merge_windows(
+                self._stashed_window, digest
+            )
             self.interval.widen()
             shed = True
         else:
             self.reports_sent += 1
             self.interval.ok()
-        delay = self.interval.next_delay_s(self.rng) * self.link.slow_factor
+            # the data-available hint: a death elsewhere re-enqueued
+            # shards — wake the data plane WITHOUT a poll storm
+            # (probabilistic: roughly as many workers wake as there
+            # are shards to hand out)
+            if self._data_idle and not self.exhausted:
+                todo = int(
+                    (getattr(resp, "data_todo", None) or {}).get(
+                        self.sc.dataset_name, 0
+                    )
+                )
+                if todo > 0:
+                    p = min(1.0, 4.0 * todo / max(1, self.sc.nodes))
+                    if self.rng.random() < p:
+                        self._data_idle = False
         if shed:
             # full jitter after a shed: spread the retry over
             # [0.5, 1.5]x the cadence so repeat collisions de-correlate
             # (plain AIMD keeps colliding cohorts in phase)
-            delay *= 0.5 + self.rng.random()
-        self._next_report = vt + delay
+            delay = self.interval.next_delay_s(self.rng)
+            self._next_report = vt + delay * (0.5 + self.rng.random())
+
+    # -- the data plane ------------------------------------------------
+
+    def _shards_left(self) -> int:
+        return len(self.shard_q)
+
+    def _tick_data(self, vt: float):
+        if self.sc.dataset_size <= 0:
+            return
+        self._consume(vt)
+        if self._lease_inflight or self.exhausted:
+            return
+        # completions flush even while data-IDLE: a worker that drained
+        # the todo queue still owes the master its finished shards —
+        # stranding them would leave the epoch permanently un-counted
+        # (doing never empties, nobody re-issues, exactly-once fails)
+        flush = bool(self._done_pending) and (
+            not self.shard_q or len(self._done_pending)
+            >= self.sc.lease_count
+        )
+        if flush:
+            self._lease_inflight = True
+            self._dispatch(vt, lambda: self._do_lease(0))
+            return
+        if self._data_idle:
+            return  # refills wait for the report-ack data hint
+        low_water = max(1, self.sc.lease_count // 2)
+        if self.stepping and self._shards_left() <= low_water:
+            self._lease_inflight = True
+            self._dispatch(
+                vt, lambda: self._do_lease(self.sc.lease_count)
+            )
+
+    def _consume(self, vt: float):
+        """Feed consumption credit through the leased shard queue;
+        finished shards move to the done batch (acked on the next
+        lease call)."""
+        credit = int(self._consume_credit)
+        if credit <= 0 or not self.shard_q:
+            return
+        while credit > 0 and self.shard_q:
+            task = self.shard_q[0]
+            if self._cur_remaining <= 0:
+                self._cur_remaining = task.shard_end - task.shard_start
+            eaten = min(credit, self._cur_remaining)
+            self._cur_remaining -= eaten
+            credit -= eaten
+            self._consume_credit -= eaten
+            if self._cur_remaining <= 0:
+                self.shard_q.pop(0)
+                self._done_pending.append(task.task_id)
+                self._unacked[task.task_id] = (
+                    task.shard_start, task.shard_end
+                )
+
+    def _do_lease(self, count: int):
+        """One batched data-plane RPC (runs at DELIVERY time when the
+        link has latency — a renewal-starved lease may have expired in
+        between, which is exactly the at-least-once path under test)."""
+        done, self._done_pending = self._done_pending, []
+        try:
+            resp = self.client.lease_shards(
+                self.sc.dataset_name,
+                count,
+                done_ids=done,
+                lease_epoch=self.lease_epoch,
+            )
+        except Exception:
+            self.reports_failed += 1
+            self._done_pending = done + self._done_pending
+            self._lease_inflight = False
+            return
+        self.data_rpcs += 1
+        self._lease_inflight = False
+        acked = set(resp.acked)
+        for tid in done:
+            rng = self._unacked.pop(tid, None)
+            if rng is None:
+                continue
+            if tid in acked:
+                # the master counted it — the exactly-once ledger entry
+                self.acked_ranges.append(rng)
+            # not acked = the fence moved (this lease expired and the
+            # shard was re-issued): drop it — the new holder's
+            # completion is the one that counts
+        if resp.lease_epoch >= 0:
+            self.lease_epoch = resp.lease_epoch
+        if resp.tasks:
+            self.shard_q.extend(resp.tasks)
+        elif count > 0:
+            if resp.exhausted and not self._done_pending:
+                self.exhausted = True
+            else:
+                # todo dry but shards still in flight elsewhere: go
+                # idle and wake on the report-ack data_todo hint
+                self._data_idle = True

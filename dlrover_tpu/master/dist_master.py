@@ -196,6 +196,17 @@ class DistributedJobMaster:
         self._server.gate.liveness_ceiling_s = (
             self.job_manager._heartbeat_timeout / 3.0
         )
+        # shed-aware liveness: the heartbeat sweep consults the gate's
+        # shed ledger — the master never evicts a worker it silenced
+        self.job_manager.attach_gate(self._server.gate)
+        from dlrover_tpu.master.monitor.hang_watchdog import HangWatchdog
+
+        self.hang_watchdog = HangWatchdog(
+            speed_monitor=self.speed_monitor,
+            rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
+            job_context=get_job_context(),
+            task_manager=self.task_manager,
+        )
         self.port = self._server.port
         self._metrics_server = None
         self._exit_code = 0
@@ -236,6 +247,8 @@ class DistributedJobMaster:
         self.scale_plan_watcher.start()
         self.metric_collector.start()
         self.diagnosis_manager.start_observing()
+        if flags.HANG_WATCHDOG.get():
+            self.hang_watchdog.start()
         logger.info(
             "distributed master for job %s serving on port %s",
             self.job_args.job_name,
@@ -319,6 +332,7 @@ class DistributedJobMaster:
 
     def stop(self):
         self.task_manager.stop()
+        self.hang_watchdog.stop()
         self.job_manager.stop()
         self.scale_plan_watcher.stop()
         self.metric_collector.stop()

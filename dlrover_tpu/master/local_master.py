@@ -39,6 +39,8 @@ class LocalJobMaster:
         rdzv_waiting_timeout: float = 60,
         clock=None,
         eviction_hysteresis: Optional[int] = None,
+        lease_ttl: Optional[float] = None,
+        hang_window_s: Optional[float] = None,
     ):
         from dlrover_tpu.common import flags
         from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
@@ -61,14 +63,20 @@ class LocalJobMaster:
         self.task_manager = TaskManager(
             speed_monitor=self.speed_monitor,
             state_manager=self.state_manager,
+            clock=clock,
+            lease_ttl=lease_ttl,
         )
         self.error_monitor = ErrorMonitor()
         self.metric_collector = JobMetricCollector(
             speed_monitor=self.speed_monitor
         )
         self.rdzv_managers = {
-            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
-            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(
+                clock=clock
+            ),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(
+                clock=clock
+            ),
         }
         self.job_manager = LocalJobManager(
             speed_monitor=self.speed_monitor,
@@ -110,6 +118,23 @@ class LocalJobMaster:
         # chaos harness caught naive AIMD widening walking healthy
         # workers straight into eviction under a 10x overload
         self._server.gate.liveness_ceiling_s = heartbeat_timeout / 3.0
+        # shed-aware liveness: the gate records WHICH node it shed (the
+        # cheap pre-deserialization node-id header), and the evictor
+        # treats a recently-shed node as alive — an overloaded master
+        # must never evict workers it itself silenced
+        self.job_manager.attach_gate(self._server.gate)
+        # eviction re-enqueues the dead node's leased shards
+        self.job_manager.attach_task_manager(self.task_manager)
+        from dlrover_tpu.master.monitor.hang_watchdog import HangWatchdog
+
+        self.hang_watchdog = HangWatchdog(
+            speed_monitor=self.speed_monitor,
+            rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
+            job_context=get_job_context(),
+            task_manager=self.task_manager,
+            window_s=hang_window_s,
+            clock=clock,
+        )
         self.port = self._server.port
         self._metrics_server = None
         self._exit_code = 0
@@ -144,6 +169,10 @@ class LocalJobMaster:
         self.job_manager.start()
         self.metric_collector.start()
         self.diagnosis_manager.start_observing()
+        from dlrover_tpu.common import flags as _flags
+
+        if _flags.HANG_WATCHDOG.get():
+            self.hang_watchdog.start()
         logger.info("local master serving on port %s", self.port)
 
     def run(self, poll_interval: float = 1.0) -> int:
@@ -173,6 +202,7 @@ class LocalJobMaster:
 
     def stop(self):
         self.task_manager.stop()
+        self.hang_watchdog.stop()
         self.job_manager.stop()
         self.metric_collector.stop()
         if self.diagnosis_manager is not None:

@@ -27,6 +27,121 @@ class GlobalStepRecord:
     timestamp: float
 
 
+class _StripedRankLedger:
+    """Per-rank accumulators sharded by rank-id stripe (ROADMAP item 5:
+    one lock + dicts used to serve the whole fleet — 1k concurrent
+    ``WorkerReport`` handlers folding digests serialized on the
+    SpeedMonitor's single lock, so servicer latency degraded with fleet
+    size). A digest fold now touches only its rank's stripe; fleet-wide
+    aggregations (attribution maxes, the goodput report) walk the
+    stripes sequentially — they run once per report/sweep, not once per
+    RPC."""
+
+    STRIPES = 16
+
+    def __init__(self):
+        self._locks = [threading.Lock() for _ in range(self.STRIPES)]
+        self._stripes = [
+            {
+                "digest": {},        # node -> last window
+                "productive": {},    # node -> cumulative seconds
+                "input_wait": {},    # node -> cumulative seconds
+                "ckpt_blocking": {},  # node -> cumulative seconds
+            }
+            for _ in range(self.STRIPES)
+        ]
+
+    def _slot(self, node: int):
+        i = int(node) % self.STRIPES
+        return self._locks[i], self._stripes[i]
+
+    def fold_digest(
+        self, node: int, digest: Dict, productive_add: float,
+        input_wait_add: float,
+    ):
+        lock, s = self._slot(node)
+        with lock:
+            s["digest"][node] = dict(digest)
+            s["productive"][node] = (
+                s["productive"].get(node, 0.0) + productive_add
+            )
+            s["input_wait"][node] = (
+                s["input_wait"].get(node, 0.0) + input_wait_add
+            )
+
+    def add_ckpt_blocking(self, node: int, seconds: float):
+        lock, s = self._slot(node)
+        with lock:
+            s["ckpt_blocking"][node] = (
+                s["ckpt_blocking"].get(node, 0.0) + seconds
+            )
+
+    def pop_digest(self, node: int):
+        lock, s = self._slot(node)
+        with lock:
+            s["digest"].pop(int(node), None)
+
+    def digests(self) -> Dict[int, Dict]:
+        out: Dict[int, Dict] = {}
+        for lock, s in zip(self._locks, self._stripes):
+            with lock:
+                out.update({k: dict(v) for k, v in s["digest"].items()})
+        return out
+
+    def _max(self, key: str) -> Optional[float]:
+        best: Optional[float] = None
+        for lock, s in zip(self._locks, self._stripes):
+            with lock:
+                for v in s[key].values():
+                    if best is None or v > best:
+                        best = v
+        return best
+
+    def max_productive(self) -> Optional[float]:
+        return self._max("productive")
+
+    def max_input_wait(self) -> float:
+        return self._max("input_wait") or 0.0
+
+    def max_ckpt_blocking(self) -> float:
+        return self._max("ckpt_blocking") or 0.0
+
+    def export(self) -> Dict[str, Dict]:
+        out = {"digest": {}, "productive": {}, "input_wait": {},
+               "ckpt_blocking": {}}
+        for lock, s in zip(self._locks, self._stripes):
+            with lock:
+                for key in out:
+                    out[key].update(s[key])
+        return out
+
+    def import_(
+        self,
+        digest: Dict[int, Dict],
+        productive: Dict[int, float],
+        input_wait: Dict[int, float],
+        ckpt_blocking: Dict[int, float],
+    ):
+        for lock, s in zip(self._locks, self._stripes):
+            with lock:
+                for key in ("digest", "productive", "input_wait",
+                            "ckpt_blocking"):
+                    s[key].clear()
+        for node, v in digest.items():
+            lock, s = self._slot(node)
+            with lock:
+                s["digest"][node] = dict(v)
+        for key, src in (
+            ("productive", productive),
+            ("input_wait", input_wait),
+            ("ckpt_blocking", ckpt_blocking),
+        ):
+            for node, v in src.items():
+                lock, s = self._slot(node)
+                with lock:
+                    s[key][node] = float(v)
+
+
 class SpeedMonitor:
     def __init__(
         self,
@@ -70,16 +185,21 @@ class SpeedMonitor:
         # per-rank step-time digests ride the (throttled) step RPC
         # (observability/digest.py): productive seconds fold from them,
         # the straggler detector reads their p50s, and input-stall
-        # seconds ride along from the worker trace spine.
-        self._digest_last: Dict[int, Dict] = {}
-        self._productive_s: Dict[int, float] = {}
-        self._input_wait_s: Dict[int, float] = {}
+        # seconds ride along from the worker trace spine. Striped by
+        # rank id so report handlers don't serialize on this lock.
+        self._ranks = _StripedRankLedger()
         # checkpoint seconds: save blocking (CheckpointStepReport) plus
         # the state_transfer half of any resize whose restore_tier says
         # the state came back through the checkpoint ladder (the live
         # device-to-device moves stay in state_transfer)
-        self._ckpt_blocking_s: Dict[int, float] = {}
         self._ckpt_restore_s: float = 0.0
+        # collective-hang ledger (master/monitor/hang_watchdog.py): a
+        # seated-but-stalled round's seconds land here, not in
+        # `unattributed`. _last_progress_ts is the watchdog's stall
+        # signal: the newest step report or step-carrying digest.
+        self._hang_s: float = 0.0
+        self._last_progress_ts: float = 0.0
+        self._progress_lock = threading.Lock()
         self.straggler_detector = StragglerDetector()
         # master-side span buffer for the job timeline: closed downtime
         # brackets as (start, end) epoch pairs (bounded)
@@ -98,6 +218,19 @@ class SpeedMonitor:
             self._samples.append(GlobalStepRecord(step, ts))
             if len(self._samples) > self._sample_window:
                 self._samples.pop(0)
+        self._note_progress(ts)
+
+    def _note_progress(self, ts: float):
+        with self._progress_lock:
+            if ts > self._last_progress_ts:
+                self._last_progress_ts = ts
+
+    def last_progress_ts(self) -> float:
+        """Epoch seconds of the newest fleet progress signal (a step
+        report or a step-carrying digest; heartbeats never count) — the
+        hang watchdog's stall clock. 0 = training never started."""
+        with self._progress_lock:
+            return self._last_progress_ts
 
     @property
     def completed_global_step(self) -> int:
@@ -147,8 +280,7 @@ class SpeedMonitor:
         the attribution must keep accounting for it. A returning worker
         re-seeds everything with its first fresh digest."""
         self.remove_running_worker(node_type, node_id)
-        with self._lock:
-            self._digest_last.pop(int(node_id), None)
+        self._ranks.pop_digest(int(node_id))
 
     def all_worker_joined(self) -> bool:
         with self._lock:
@@ -249,14 +381,16 @@ class SpeedMonitor:
         if count <= 0:
             return None
         node = int(node_id)
-        with self._lock:
-            self._digest_last[node] = dict(digest)
-            self._productive_s[node] = (
-                self._productive_s.get(node, 0.0) + count * max(0.0, mean_s)
-            )
-            self._input_wait_s[node] = self._input_wait_s.get(node, 0.0) + max(
-                0.0, float(digest.get("input_wait_s", 0.0) or 0.0)
-            )
+        # stripe fold only — no SpeedMonitor-wide lock on the report
+        # hot path (the shard_storm_1k harness measures servicer p99
+        # under combined report+lease load at 1k nodes)
+        self._ranks.fold_digest(
+            node,
+            digest,
+            count * max(0.0, mean_s),
+            max(0.0, float(digest.get("input_wait_s", 0.0) or 0.0)),
+        )
+        self._note_progress(ts or self._clock())
         # detector has its own lock; keep it out of ours
         return self.straggler_detector.observe(
             node, p50_s, count=count, ts=ts
@@ -269,12 +403,17 @@ class SpeedMonitor:
         every process reports the same job-wide pause, so the
         attribution reads the max across ranks (one save = one pause),
         never the sum (which would overcount world_size times)."""
+        self._ranks.add_ckpt_blocking(
+            int(node_id), max(0.0, float(seconds))
+        )
+
+    def record_hang(self, seconds: float):
+        """Collective-hang seconds (hang watchdog): a round where every
+        live worker was seated but step reports stopped fleet-wide —
+        lost time with its own attribution category, so a stalled
+        collective reads as `collective_hang`, not `unattributed`."""
         with self._lock:
-            node = int(node_id)
-            self._ckpt_blocking_s[node] = (
-                self._ckpt_blocking_s.get(node, 0.0)
-                + max(0.0, float(seconds))
-            )
+            self._hang_s += max(0.0, float(seconds))
 
     def stragglers(self) -> List[int]:
         return self.straggler_detector.stragglers()
@@ -283,10 +422,9 @@ class SpeedMonitor:
         """Detector snapshot + the last digest per rank (goodput report
         and /metrics consumers)."""
         snap = self.straggler_detector.snapshot()
-        with self._lock:
-            snap["rank_digests"] = {
-                str(k): dict(v) for k, v in self._digest_last.items()
-            }
+        snap["rank_digests"] = {
+            str(k): dict(v) for k, v in self._ranks.digests().items()
+        }
         return snap
 
     # -- lost-time attribution --------------------------------------------
@@ -301,6 +439,9 @@ class SpeedMonitor:
         overage first)."""
         now = now or self._clock()
         straggler_wait = self.straggler_detector.lost_seconds()
+        rank_productive = self._ranks.max_productive()
+        rank_input_wait = self._ranks.max_input_wait()
+        rank_ckpt_blocking = self._ranks.max_ckpt_blocking()
         with self._lock:
             start = self._start_training_time
             wall = max(0.0, now - start) if start > 0.0 else 0.0
@@ -310,14 +451,10 @@ class SpeedMonitor:
                 "compile": bt["compile"],
                 "rendezvous": bt["rendezvous"],
                 "state_transfer": bt["state_transfer"] - ckpt_restore,
-                "checkpoint": (
-                    max(self._ckpt_blocking_s.values(), default=0.0)
-                    + ckpt_restore
-                ),
-                "input_stall": max(
-                    self._input_wait_s.values(), default=0.0
-                ),
+                "checkpoint": rank_ckpt_blocking + ckpt_restore,
+                "input_stall": rank_input_wait,
                 "straggler_wait": straggler_wait,
+                "collective_hang": self._hang_s,
             }
             lost_sum = sum(lost.values())
             if lost_sum > wall:
@@ -330,7 +467,7 @@ class SpeedMonitor:
                 lost = {k: v * scale for k, v in lost.items()}
                 lost_sum = sum(lost.values())
             budget = max(0.0, wall - lost_sum)
-            productive = max(self._productive_s.values(), default=None)
+            productive = rank_productive
             if productive is None:
                 # no digest-reporting workers (version skew / toy
                 # scripts): productive is the wall minus downtime and
@@ -422,6 +559,7 @@ class SpeedMonitor:
         """Durable ledger snapshot: global step, training-start epoch and
         downtime totals survive a master relaunch, so goodput keeps its
         true denominator instead of restarting from the relaunch time."""
+        ranks = self._ranks.export()
         with self._lock:
             return {
                 "global_step": self._global_step,
@@ -437,18 +575,20 @@ class SpeedMonitor:
                 # accumulators, checkpoint seconds and the straggler
                 # detector — master relaunch must not lose accounting
                 "productive_s": {
-                    str(k): v for k, v in self._productive_s.items()
+                    str(k): v for k, v in ranks["productive"].items()
                 },
                 "input_wait_s": {
-                    str(k): v for k, v in self._input_wait_s.items()
+                    str(k): v for k, v in ranks["input_wait"].items()
                 },
                 "digest_last": {
-                    str(k): dict(v) for k, v in self._digest_last.items()
+                    str(k): dict(v) for k, v in ranks["digest"].items()
                 },
                 "ckpt_blocking_s": {
-                    str(k): v for k, v in self._ckpt_blocking_s.items()
+                    str(k): v for k, v in ranks["ckpt_blocking"].items()
                 },
                 "ckpt_restore_s": self._ckpt_restore_s,
+                "hang_s": self._hang_s,
+                "last_progress_ts": self._last_progress_ts,
                 "straggler": self.straggler_detector.export_state(),
                 # when the old master dies with no open bracket, the
                 # restore path backdates the relaunch gap to this stamp
@@ -481,24 +621,28 @@ class SpeedMonitor:
             self._last_restore_tier = str(
                 state.get("last_restore_tier", "")
             )
-            self._productive_s = {
-                int(k): float(v)
-                for k, v in (state.get("productive_s") or {}).items()
-            }
-            self._input_wait_s = {
-                int(k): float(v)
-                for k, v in (state.get("input_wait_s") or {}).items()
-            }
-            self._digest_last = {
+            self._ckpt_restore_s = float(state.get("ckpt_restore_s", 0.0))
+            self._hang_s = float(state.get("hang_s", 0.0))
+        raw_blocking = state.get("ckpt_blocking_s") or {}
+        if not isinstance(raw_blocking, dict):
+            # pre-per-rank snapshot: one untagged total
+            raw_blocking = {-1: float(raw_blocking)}
+        self._ranks.import_(
+            digest={
                 int(k): dict(v)
                 for k, v in (state.get("digest_last") or {}).items()
-            }
-            raw_blocking = state.get("ckpt_blocking_s") or {}
-            if isinstance(raw_blocking, dict):
-                self._ckpt_blocking_s = {
-                    int(k): float(v) for k, v in raw_blocking.items()
-                }
-            else:  # pre-per-rank snapshot: one untagged total
-                self._ckpt_blocking_s = {-1: float(raw_blocking)}
-            self._ckpt_restore_s = float(state.get("ckpt_restore_s", 0.0))
+            },
+            productive={
+                int(k): float(v)
+                for k, v in (state.get("productive_s") or {}).items()
+            },
+            input_wait={
+                int(k): float(v)
+                for k, v in (state.get("input_wait_s") or {}).items()
+            },
+            ckpt_blocking={
+                int(k): float(v) for k, v in raw_blocking.items()
+            },
+        )
+        self._note_progress(float(state.get("last_progress_ts", 0.0)))
         self.straggler_detector.import_state(state.get("straggler") or {})

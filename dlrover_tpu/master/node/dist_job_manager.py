@@ -526,6 +526,14 @@ class DistributedJobManager(JobManager):
             if node.status != NodeStatus.RUNNING or node.heartbeat_time <= 0:
                 continue
             silent = now - node.heartbeat_time
+            if silent > self._heartbeat_timeout and self._shed_recently(
+                node.id, self._heartbeat_timeout, now
+            ):
+                # shed-aware liveness: the admission gate refused this
+                # node's report inside the window — it is alive and the
+                # master silenced it; clear strikes, never evict it
+                self._evictor.observe(node.id, 0.0)
+                continue
             if not self._evictor.observe(node.id, silent):
                 continue
             logger.warning(

@@ -36,6 +36,32 @@ class JobManager(ABC):
         self._error_monitor = error_monitor
         self._job_context = get_job_context()
         self._stopped = False
+        # shed-aware liveness (docs/design/fleet_harness.md, closed
+        # gap): the RPC admission gate records which node each shed
+        # request came from (cheap pre-deserialization header), so the
+        # heartbeat sweep can tell "silent" from "silenced by my own
+        # backpressure"
+        self._gate = None
+        # eviction must also re-enqueue the dead node's data shards
+        self._task_manager = None
+
+    def attach_gate(self, gate) -> None:
+        self._gate = gate
+
+    def attach_task_manager(self, task_manager) -> None:
+        self._task_manager = task_manager
+
+    def _shed_recently(self, node_id: int, window_s: float, now: float) -> bool:
+        """True when the admission gate shed a request from this node
+        within the window: the node IS alive and talking — the master
+        just refused to listen. Evicting it would punish the victim of
+        the master's own overload."""
+        if self._gate is None:
+            return False
+        try:
+            return self._gate.recently_shed(node_id, window_s, now=now)
+        except AttributeError:  # pre-header gate object
+            return False
 
     @abstractmethod
     def start(self):
@@ -334,6 +360,14 @@ class LocalJobManager(JobManager):
             if node.status != NodeStatus.RUNNING or node.heartbeat_time <= 0:
                 continue
             silent = now - node.heartbeat_time
+            if silent > self._heartbeat_timeout and self._shed_recently(
+                node.id, self._heartbeat_timeout, now
+            ):
+                # the gate shed this node's report inside the timeout
+                # window: it is alive, the master silenced it — clear
+                # its strikes instead of walking it toward eviction
+                self._evictor.observe(node.id, 0.0)
+                continue
             if self._evictor.observe(node.id, silent):
                 self._evict_node(node, silent)
                 evicted.append(node.id)
@@ -361,3 +395,9 @@ class LocalJobManager(JobManager):
             mgr.remove_alive_node(node.id)
         if self._speed_monitor is not None:
             self._speed_monitor.evict_worker(node.type, node.id)
+        if self._task_manager is not None:
+            # the evicted node's leased shards go back in the queue
+            # now (at-least-once); the fence bump keeps its zombie
+            # reports from double-counting (HeartbeatEvictor ->
+            # remove_node_tasks — the data-plane half of eviction)
+            self._task_manager.remove_node_tasks(node.id)

@@ -50,9 +50,14 @@ class RendezvousParameters:
 
 
 class RendezvousManager(ABC):
-    def __init__(self, name: str):
+    def __init__(self, name: str, clock=None):
         self.name = name
         self._lock = Lock()
+        # injectable "now": the waiting-timeout completion path and the
+        # join stamps must share the clock that drives the job (the
+        # fleet harness forms rounds in virtual time; wall time there
+        # would stretch a 5-vs last-call window into minutes)
+        self._clock = clock or time.time
         self._params = RendezvousParameters(1, 1)
         self._alive_nodes: set = set()
         self._waiting_nodes: Dict[int, NodeTopologyMeta] = {}
@@ -63,6 +68,10 @@ class RendezvousManager(ABC):
         self._start_rdzv_ts: float = 0.0
         self._node_unit = 1
         self._topology_sorter = TpuTopologySorter()
+        # the hang watchdog's re-form signal: while set, workers polling
+        # num_nodes_waiting see a virtual waiter and drop back into the
+        # rendezvous; the next completed round clears it
+        self._force_reform = False
 
     def update_rdzv_params(
         self, min_nodes: int, max_nodes: int, node_unit: int,
@@ -99,19 +108,53 @@ class RendezvousManager(ABC):
 
     def join_rendezvous(self, node_id: int, node_rank: int, meta: NodeTopologyMeta) -> int:
         with self._lock:
-            meta.join_time = time.time()
+            meta.join_time = self._clock()
             if not self._waiting_nodes:
                 self._start_rdzv_ts = meta.join_time
             # re-join replaces the stale entry
             self._waiting_nodes[node_rank] = meta
-            self._lastcall_time = time.time()
+            self._lastcall_time = meta.join_time
             self._alive_nodes.add(node_id)
         return self._rdzv_round
 
     def num_nodes_waiting(self) -> int:
-        """Agents poll this; >0 during training means a membership change."""
+        """Agents poll this; >0 during training means a membership
+        change. While a re-form is requested (collective-hang recovery)
+        and nobody has re-joined yet, a VIRTUAL waiter is reported so
+        the seated-but-stalled cohort drops back into the rendezvous —
+        the same signal path a real joiner uses."""
         with self._lock:
-            return len(self._waiting_nodes)
+            n = len(self._waiting_nodes)
+            if n == 0 and self._force_reform:
+                return 1
+            return n
+
+    def request_re_rendezvous(self, exclude=()) -> None:
+        """Collective-hang recovery (master/monitor/hang_watchdog.py):
+        drop the silent members of the seated round from the alive set
+        and raise the re-form signal. Reachable members see a waiter on
+        their next membership poll and re-join; the next completed
+        round (without the excluded nodes) clears the signal. An
+        excluded node that heals simply joins again."""
+        with self._lock:
+            for node_id in exclude:
+                self._alive_nodes.discard(node_id)
+                stale = [
+                    rank for rank, m in self._waiting_nodes.items()
+                    if m.node_id == node_id
+                ]
+                for rank in stale:
+                    del self._waiting_nodes[rank]
+            self._force_reform = True
+        logger.warning(
+            "%s rdzv: re-rendezvous requested (excluding %s)",
+            self.name, sorted(exclude) if exclude else "nobody",
+        )
+
+    def latest_world_ids(self) -> List[int]:
+        """Node ids of the latest completed round's world."""
+        with self._lock:
+            return list(self._latest_rdzv_nodes)
 
     def _effective_world_size(self, n: int) -> int:
         """Round down to a multiple of node_unit (reference :118-156)."""
@@ -137,7 +180,7 @@ class RendezvousManager(ABC):
                 )
 
                 timeout = get_master_config().rdzv_waiting_timeout
-            since_last = time.time() - self._lastcall_time
+            since_last = self._clock() - self._lastcall_time
             if since_last >= timeout and self._effective_world_size(waiting) > 0:
                 completed = True
         if completed:
@@ -159,7 +202,8 @@ class RendezvousManager(ABC):
         }
         self._latest_rdzv_nodes = sorted(kept_ids)
         self._rdzv_round += 1
-        elapsed = time.time() - self._start_rdzv_ts if self._start_rdzv_ts else 0.0
+        self._force_reform = False  # the re-formed world answers the hang
+        elapsed = self._clock() - self._start_rdzv_ts if self._start_rdzv_ts else 0.0
         logger.info(
             "%s rendezvous round %s completed: %s nodes in %.1fs; world=%s",
             self.name,
@@ -184,8 +228,8 @@ class RendezvousManager(ABC):
 
 
 class ElasticTrainingRendezvousManager(RendezvousManager):
-    def __init__(self):
-        super().__init__(RendezvousName.TRAINING)
+    def __init__(self, clock=None):
+        super().__init__(RendezvousName.TRAINING, clock=clock)
 
     def get_comm_world(
         self, node_id: int
@@ -212,8 +256,8 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     rounds is a straggler.
     """
 
-    def __init__(self):
-        super().__init__(RendezvousName.NETWORK_CHECK)
+    def __init__(self, clock=None):
+        super().__init__(RendezvousName.NETWORK_CHECK, clock=clock)
         self._node_status: Dict[int, Dict[int, bool]] = {}  # round -> id -> ok
         self._node_times: Dict[int, Dict[int, float]] = {}  # round -> id -> sec
         self._check_round = 0

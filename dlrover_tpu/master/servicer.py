@@ -53,6 +53,7 @@ class MasterServicer:
 
         self._get_handlers = {
             msg.TaskRequest: self._get_task,
+            msg.ShardLeaseRequest: self._lease_shards,
             msg.ShardCheckpointRequest: self._get_shard_checkpoint,
             msg.DatasetEpochRequest: self._get_dataset_epoch,
             msg.JoinRendezvousRequest: self._join_rendezvous,
@@ -123,9 +124,35 @@ class MasterServicer:
 
     def _report_task_result(self, request: msg.TaskResult):
         ok = self._task_manager.report_dataset_task(
-            request.dataset_name, request.task_id, request.success
+            request.dataset_name,
+            request.task_id,
+            request.success,
+            lease_epoch=getattr(request, "lease_epoch", -1),
         )
         return msg.SimpleResponse(success=ok)
+
+    def _lease_shards(self, request: msg.ShardLeaseRequest):
+        """The batched data plane (docs/design/data_plane.md): one call
+        acks the previous batch's completions under the presented fence
+        and leases up to ``count`` fresh shards under the node's lease.
+        Classified as a *get* so it sheds at the higher watermark — a
+        shed lease stalls training, a shed heartbeat costs nothing."""
+        grant = self._task_manager.lease_shards(
+            request.node_id,
+            request.dataset_name,
+            request.count,
+            done_ids=request.done_task_ids,
+            failed_ids=request.failed_task_ids,
+            lease_epoch=request.lease_epoch,
+        )
+        return msg.ShardLeaseResponse(
+            tasks=grant.tasks,
+            lease_epoch=grant.lease_epoch,
+            deadline_ts=grant.deadline,
+            acked=grant.acked,
+            idle=grant.idle,
+            exhausted=grant.exhausted,
+        )
 
     def _get_shard_checkpoint(self, request: msg.ShardCheckpointRequest):
         ckpt = self._task_manager.checkpoint_dataset(request.dataset_name)
@@ -185,7 +212,12 @@ class MasterServicer:
 
     def _num_nodes_waiting(self, request: msg.NumNodesWaitingRequest):
         mgr = self._rdzv_managers[request.rdzv_name or RendezvousName.TRAINING]
-        return msg.NumNodesWaitingResponse(waiting_num=mgr.num_nodes_waiting())
+        return msg.NumNodesWaitingResponse(
+            waiting_num=mgr.num_nodes_waiting(),
+            # workers seated in an OLDER round than this are hung in a
+            # dead collective (post-watchdog re-form) and must re-join
+            latest_round=mgr.get_rdzv_round(),
+        )
 
     def _network_ready(self, request: msg.NetworkReadyRequest):
         mgr = self._rdzv_managers[RendezvousName.NETWORK_CHECK]
@@ -351,7 +383,19 @@ class MasterServicer:
                 )
             if digest:
                 self._collect_digest(request.node_id, digest, ts)
-        return msg.WorkerReportResponse(actions=actions)
+        data_todo: Dict = {}
+        if self._task_manager is not None:
+            # data-plane liveness rides the report: every heartbeat
+            # renews the node's shard leases (zero extra RPCs), and the
+            # ack carries the queued-shard hint so idle workers learn a
+            # death re-enqueued shards without polling. Renewal uses
+            # the MASTER's clock (not the wire timestamp): deadlines
+            # and expiry sweeps are stamped master-side, and a worker
+            # whose clock lags by more than the TTL could otherwise
+            # never extend its lease despite healthy reporting
+            self._task_manager.renew_node_leases(request.node_id)
+            data_todo = self._task_manager.todo_counts()
+        return msg.WorkerReportResponse(actions=actions, data_todo=data_todo)
 
     def _report_model_info(self, request: msg.ModelInfoReport):
         if self._metric_collector is not None:

@@ -1,8 +1,11 @@
 """Dataset registry + task dispatch (parity: master/shard/task_manager.py).
 
 Holds one :class:`BatchDatasetManager` per registered dataset, hands shards
-("tasks") to workers, re-dispatches tasks of dead/timed-out workers, and
-exposes dataset checkpoint/restore for job-level resume.
+("tasks") to workers — one at a time via the legacy ``get_task`` path or
+batched under per-worker leases via :meth:`lease_shards`
+(docs/design/data_plane.md) — re-dispatches tasks of dead/timed-out
+workers from a deadline heap, and exposes dataset checkpoint/restore for
+job-level resume.
 """
 
 from __future__ import annotations
@@ -18,6 +21,7 @@ from dlrover_tpu.common.messages import DatasetShardParams, Task
 from dlrover_tpu.master.shard.dataset_manager import (
     BatchDatasetManager,
     DatasetShardCheckpoint,
+    LeaseGrant,
     StreamingDatasetManager,
 )
 from dlrover_tpu.master.shard.dataset_splitter import new_dataset_splitter
@@ -29,6 +33,8 @@ class TaskManager:
         worker_restart_timeout: float = 0.0,
         speed_monitor=None,
         state_manager=None,
+        clock=None,
+        lease_ttl: Optional[float] = None,
     ):
         self._datasets: Dict[str, BatchDatasetManager] = {}
         self._params: Dict[str, DatasetShardParams] = {}
@@ -39,7 +45,14 @@ class TaskManager:
         #: None = in-memory only (local master)
         self._state_manager = state_manager
         self._task_timeout = DefaultValues.TASK_TIMEOUT_SECS
+        #: injectable "now" shared with the dataset managers' lease
+        #: deadlines (the fleet harness drives sweeps on a virtual clock)
+        self._clock = clock or time.time
+        self._lease_ttl = lease_ttl
         self._stop = threading.Event()
+        #: scan-only stop: the harness pauses the wall-clock sweep thread
+        #: and drives :meth:`sweep_deadlines` on its own clock
+        self._scan_stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # persistence runs on a coalescing writer thread: every dispatch/
         # report marks its dataset dirty and the writer drains immediately
@@ -74,7 +87,13 @@ class TaskManager:
             if params.storage_type == "streaming"
             else BatchDatasetManager
         )
-        self._datasets[params.dataset_name] = manager_cls(task_type, splitter)
+        self._datasets[params.dataset_name] = manager_cls(
+            task_type,
+            splitter,
+            clock=self._clock,
+            task_timeout=self._task_timeout,
+            lease_ttl=self._lease_ttl,
+        )
         self._params[params.dataset_name] = params
         logger.info(
             "registered dataset %s: size=%s shard=%s epochs=%s",
@@ -113,8 +132,14 @@ class TaskManager:
             return
         import dataclasses
 
-        while self._dirty:
-            name = self._dirty.pop()
+        while True:
+            try:
+                # set.pop races with a concurrent drain (writer thread
+                # vs an explicit flush); losing the race means the
+                # other drainer owns that dataset's write
+                name = self._dirty.pop()
+            except KeyError:
+                break
             ds = self._datasets.get(name)
             params = self._params.get(name)
             if ds is None or params is None:
@@ -127,8 +152,9 @@ class TaskManager:
 
     def restore_from_state(self) -> int:
         """Master relaunch: rebuild every persisted dataset with its shard
-        queues, keeping live workers' in-flight tasks as doing. Returns
-        the number of datasets restored."""
+        queues, keeping live workers' in-flight tasks as doing (original
+        ids AND lease fences, so batched late reports complete
+        exactly-once). Returns the number of datasets restored."""
         if self._state_manager is None:
             return 0
         restored = 0
@@ -147,9 +173,10 @@ class TaskManager:
                 restored += 1
                 logger.info(
                     "restored dataset %s from master state: epoch=%s "
-                    "todo=%s doing=%s completed_records=%s",
+                    "todo=%s doing=%s leases=%s completed_records=%s",
                     name, ckpt.epoch, len(ckpt.todo), len(ckpt.doing_meta)
-                    or len(ckpt.doing), ckpt.completed_records,
+                    or len(ckpt.doing), len(ckpt.leases),
+                    ckpt.completed_records,
                 )
             except Exception:
                 logger.exception("dataset %s state restore failed", name)
@@ -167,11 +194,57 @@ class TaskManager:
             self._persist(dataset_name)
         return task
 
-    def report_dataset_task(self, dataset_name: str, task_id: int, success: bool):
+    def lease_shards(
+        self,
+        node_id: int,
+        dataset_name: str,
+        count: int,
+        done_ids: Optional[List[int]] = None,
+        failed_ids: Optional[List[int]] = None,
+        lease_epoch: int = -1,
+    ) -> LeaseGrant:
+        """The batched data plane: ack the previous batch's completions
+        (fenced) and lease up to ``count`` fresh shards in one call."""
+        ds = self._datasets.get(dataset_name)
+        if ds is None:
+            return LeaseGrant()
+        grant = ds.lease_shards(
+            node_id, count, done_ids, failed_ids, lease_epoch
+        )
+        if grant.changed:
+            self._persist(dataset_name)
+        return grant
+
+    def renew_node_leases(self, node_id: int, now: Optional[float] = None):
+        """Folded-WorkerReport hook: one heartbeat renews every dataset
+        lease the node holds — data-plane liveness costs zero extra
+        RPCs. Renewals are not persisted (a relaunch re-grants one TTL
+        anyway)."""
+        for ds in list(self._datasets.values()):
+            ds.renew_lease(node_id, now=now)
+
+    def todo_counts(self) -> Dict[str, int]:
+        """dataset -> queued-shard count; rides the WorkerReport ack as
+        the idle workers' data-available wakeup hint."""
+        return {
+            name: n
+            for name, ds in list(self._datasets.items())
+            if (n := ds.todo_count()) > 0
+        }
+
+    def report_dataset_task(
+        self,
+        dataset_name: str,
+        task_id: int,
+        success: bool,
+        lease_epoch: int = -1,
+    ):
         ds = self._datasets.get(dataset_name)
         if ds is None:
             return False
-        known, _ = ds.report_task_status(task_id, success)
+        known, _ = ds.report_task_status(
+            task_id, success, lease_epoch=lease_epoch
+        )
         if known:
             self._persist(dataset_name)
         return known
@@ -211,28 +284,66 @@ class TaskManager:
         ds.restore_checkpoint(ckpt)
         return True
 
-    # -- background timeout scan ------------------------------------------
+    # -- background deadline sweep ----------------------------------------
 
     def start(self):
         if self._thread is None:
+            self._scan_stop.clear()
             self._thread = threading.Thread(
-                target=self._scan_loop, name="task-timeout-scan", daemon=True
+                target=self._scan_loop, name="task-deadline-scan", daemon=True
             )
             self._thread.start()
 
+    def pause_scan(self):
+        """Stop the wall-clock sweep thread without stopping the
+        manager: the fleet harness drives :meth:`sweep_deadlines` on
+        its own virtual clock."""
+        self._scan_stop.set()
+
     def stop(self):
         self._stop.set()
+        self._scan_stop.set()
         self._dirty_evt.set()
         self.flush_state()
 
-    def _scan_loop(self):
-        while not self._stop.wait(30):
-            for name, ds in list(self._datasets.items()):
-                stale = ds.reset_timeout_tasks(self._task_timeout)
-                if stale:
+    def next_deadline(self) -> Optional[float]:
+        deadlines = [
+            d for ds in list(self._datasets.values())
+            if (d := ds.next_deadline()) is not None
+        ]
+        return min(deadlines) if deadlines else None
+
+    def sweep_deadlines(self, now: Optional[float] = None) -> int:
+        """One deadline sweep over every dataset's heap: expire due
+        leases (requeue their undone shards at-least-once) and due
+        legacy task timeouts. O(due · log n) — a 1M-shard dataset with
+        nothing due costs one heap peek, not a full walk. Returns the
+        number of shards requeued."""
+        requeued = 0
+        for name, ds in list(self._datasets.items()):
+            events = ds.expire_due(now=now)
+            if events:
+                for kind, key, n in events:
+                    requeued += n
                     logger.warning(
-                        "dataset %s: reassigned timed-out tasks %s",
-                        ds.dataset_name,
-                        stale,
+                        "dataset %s: %s %s expired; requeued %s shard(s)",
+                        name, kind,
+                        f"of node {key}" if kind == "lease" else key, n,
                     )
-                    self._persist(name)
+                self._persist(name)
+        return requeued
+
+    def _scan_loop(self):
+        """Deadline-heap-driven sweep: sleeps until the earliest lease
+        or task deadline (bounded to [0.2, 30] s so new datasets and
+        clock adjustments are picked up) instead of the old fixed
+        30-second full-dataset walk."""
+        while not self._scan_stop.is_set():
+            nxt = self.next_deadline()
+            if nxt is None:
+                wait = 30.0
+            else:
+                wait = min(30.0, max(0.2, nxt - self._clock()))
+            if self._scan_stop.wait(wait):
+                break
+            self.sweep_deadlines()

@@ -50,7 +50,9 @@ def build_native(force: bool = False) -> str:
 
 def find_libtpu() -> str:
     """Locate the real libtpu the interposer should delegate to."""
-    explicit = os.getenv("TPU_LIBRARY_PATH", "")
+    from dlrover_tpu.common import flags
+
+    explicit = flags.TPU_LIBRARY_PATH.get()
     if explicit and "dlrover_tpu_timer" not in explicit:
         return explicit
     try:

@@ -44,6 +44,9 @@ from dlrover_tpu.rpc.policy import OverloadedError
 SERVICE = "dlrover_tpu.Master"
 GET = f"/{SERVICE}/get"
 REPORT = f"/{SERVICE}/report"
+#: the cheap node-id header: lets the admission gate record WHICH node
+#: it shed before paying any deserialization (shed-aware liveness)
+NODE_ID_HEADER = "dlrover-node-id"
 
 _identity = lambda b: b  # noqa: E731
 
@@ -97,14 +100,25 @@ class RequestGate:
         # widened-but-honoring worker always lands >=2 reports per
         # timeout window). 0 = don't advertise.
         self.liveness_ceiling_s = 0.0
+        # clock for the shed-recency ledger (injectable: the fleet
+        # harness stamps sheds in virtual time)
+        self.clock = time.time
         self._lock = threading.Lock()
         self._inflight = 0
         self._inflight_reports = 0
         self._peak = 0
         self._served: Dict[str, int] = {"get": 0, "report": 0}
         self._rejected: Dict[str, int] = {"get": 0, "report": 0}
+        # shed-aware liveness: node_id -> last shed timestamp. The
+        # node id arrives as a cheap header (gRPC metadata / loopback
+        # arg) so it is known BEFORE deserialization — the whole point
+        # of shedding is not paying the parse, and the heartbeat
+        # evictor still must not evict workers the master itself
+        # silenced. Bounded; pruned oldest-first past the cap.
+        self._shed_nodes: Dict[int, float] = {}
+        self._shed_cap = 8192
 
-    def try_enter(self, kind: str) -> bool:
+    def try_enter(self, kind: str, node_id: int = -1) -> bool:
         with self._lock:
             if kind == "get":
                 # gets compete for the TOTAL budget (they shed last,
@@ -119,6 +133,13 @@ class RequestGate:
                 admitted = self._inflight_reports < self.report_cap
             if not admitted:
                 self._rejected[kind] = self._rejected.get(kind, 0) + 1
+                if node_id >= 0:
+                    self._shed_nodes[node_id] = self.clock()
+                    if len(self._shed_nodes) > self._shed_cap:
+                        oldest = min(
+                            self._shed_nodes, key=self._shed_nodes.get
+                        )
+                        del self._shed_nodes[oldest]
                 return False
             self._inflight += 1
             if kind != "get":
@@ -127,6 +148,19 @@ class RequestGate:
                 self._peak = self._inflight
             self._served[kind] = self._served.get(kind, 0) + 1
             return True
+
+    def recently_shed(
+        self, node_id: int, window_s: float, now: Optional[float] = None
+    ) -> bool:
+        """Did the gate shed a request from this node within the
+        window? The heartbeat evictor treats such a node as alive: it
+        was talking, the master refused to listen."""
+        with self._lock:
+            ts = self._shed_nodes.get(int(node_id))
+        if ts is None:
+            return False
+        now = self.clock() if now is None else now
+        return now - ts <= window_s
 
     def leave(self, kind: str = "report"):
         with self._lock:
@@ -235,8 +269,22 @@ class RpcServer:
         )
         self.port = self._server.add_insecure_port(f"0.0.0.0:{port}")
 
+    @staticmethod
+    def _peer_node_id(context) -> int:
+        """The cheap node-id header (gRPC metadata): read BEFORE the
+        payload deserializes so a shed still records WHO it silenced.
+        -1 = absent (pre-header client) — shed-blind for that caller,
+        exactly the old behavior."""
+        try:
+            for key, value in context.invocation_metadata() or ():
+                if key == NODE_ID_HEADER:
+                    return int(value)
+        except (TypeError, ValueError, AttributeError):
+            pass
+        return -1
+
     def _handle_get(self, request: bytes, context) -> bytes:
-        if not self.gate.try_enter("get"):
+        if not self.gate.try_enter("get", self._peer_node_id(context)):
             return serialize(self.gate.overload_reply("get"))
         try:
             msg = deserialize(request)
@@ -249,7 +297,7 @@ class RpcServer:
             self.gate.leave("get")
 
     def _handle_report(self, request: bytes, context) -> bytes:
-        if not self.gate.try_enter("report"):
+        if not self.gate.try_enter("report", self._peer_node_id(context)):
             return serialize(self.gate.overload_reply("report"))
         try:
             msg = deserialize(request)
@@ -279,11 +327,18 @@ class RpcClient:
         timeout: float = 30.0,
         policy: rpc_policy.BackoffPolicy = rpc_policy.DEFAULT_RPC,
         rng: Optional[random.Random] = None,
+        node_id: int = -1,
     ):
         self.addr = addr
         self._timeout = timeout
         self._policy = policy
         self._rng = rng
+        # the cheap node-id header rides every call's metadata so the
+        # server's admission gate knows who it shed without touching
+        # the payload (-1 = anonymous caller, e.g. master-to-master)
+        self._metadata = (
+            ((NODE_ID_HEADER, str(int(node_id))),) if node_id >= 0 else None
+        )
         self._lock = threading.Lock()
         self._channel = None
         self._get = None
@@ -297,6 +352,20 @@ class RpcClient:
                 ("grpc.max_send_message_length", 256 * 1024 * 1024),
                 ("grpc.max_receive_message_length", 256 * 1024 * 1024),
                 ("grpc.enable_retries", 1),
+                # a master relaunch is a DESIGNED-FOR event: gRPC's
+                # default reconnect backoff grows toward 120s, so a
+                # channel that watched the old master die can keep
+                # replaying "connection refused" long after the new
+                # master is serving — defeating the RELAUNCH_TOLERANT
+                # retry budget at the application layer. Bound the
+                # re-dial so a relaunched address is probed within
+                # seconds (found by the SIGKILL-the-master e2e: the
+                # agent's succeeded report burned all its retries
+                # inside the channel's backoff window while the master
+                # was up and reachable).
+                ("grpc.initial_reconnect_backoff_ms", 500),
+                ("grpc.min_reconnect_backoff_ms", 500),
+                ("grpc.max_reconnect_backoff_ms", 3000),
             ],
         )
         self._get = self._channel.unary_unary(
@@ -313,9 +382,29 @@ class RpcClient:
         except Exception:
             return False
 
+    def _reconnect(self):
+        """Tear down and re-dial the channel. A long-lived channel that
+        watched its master die can wedge in a state no reconnect
+        backoff escapes (observed in the SIGKILL-the-master e2e:
+        subchannel fds kept failing with 'FD Shutdown' for 60+ s while
+        a FRESH channel from a new process connected instantly). The
+        relaunch-tolerance story therefore includes rebuilding the
+        channel after consecutive unavailable failures — the client
+        half of master-relaunch survival."""
+        with self._lock:
+            try:
+                self._channel.close()
+            except Exception:
+                pass
+            self._connect()
+
+    def _stub(self, kind: str):
+        with self._lock:
+            return self._get if kind == "get" else self._report
+
     def _call(
         self,
-        stub,
+        kind: str,
         msg: Any,
         retries: int,
         timeout: Optional[float],
@@ -327,7 +416,9 @@ class RpcClient:
         budget-bounded schedule. ``on_overload``: "retry" sleeps at
         least the server's hint and tries again; "raise" surfaces
         :class:`OverloadedError` immediately — periodic reporters
-        honor it by widening their cadence, not by retrying."""
+        honor it by widening their cadence, not by retrying. The stub
+        re-resolves every attempt so a mid-call channel rebuild takes
+        effect immediately."""
         timeout = timeout or self._timeout
         pol = dataclasses.replace(
             policy or self._policy, max_attempts=max(1, retries)
@@ -335,10 +426,15 @@ class RpcClient:
         delays = pol.delays(self._rng)
         payload = serialize(msg)
         err: Optional[BaseException] = None
+        unavailable_streak = 0
         while True:
             hint = 0.0
             try:
-                resp = deserialize(stub(payload, timeout=timeout))
+                resp = deserialize(
+                    self._stub(kind)(
+                        payload, timeout=timeout, metadata=self._metadata
+                    )
+                )
                 if _is_overloaded(resp):
                     err = OverloadedError(
                         resp.retry_after_s,
@@ -356,6 +452,15 @@ class RpcClient:
                 if rpc_policy.classify(e) not in rpc_policy.RETRYABLE:
                     raise
                 err = e
+                if rpc_policy.classify(e) == "unavailable":
+                    unavailable_streak += 1
+                    if unavailable_streak >= 2:
+                        logger.warning(
+                            "master %s unavailable %d attempts in a "
+                            "row; rebuilding the channel",
+                            self.addr, unavailable_streak,
+                        )
+                        self._reconnect()
             delay = next(delays, None)
             if delay is None:
                 raise err
@@ -370,7 +475,7 @@ class RpcClient:
         policy: Optional[rpc_policy.BackoffPolicy] = None,
     ):
         return self._call(
-            self._get, msg, retries, timeout, on_overload, policy
+            "get", msg, retries, timeout, on_overload, policy
         )
 
     def report(
@@ -382,7 +487,7 @@ class RpcClient:
         policy: Optional[rpc_policy.BackoffPolicy] = None,
     ):
         return self._call(
-            self._report, msg, retries, timeout, on_overload, policy
+            "report", msg, retries, timeout, on_overload, policy
         )
 
     def close(self):

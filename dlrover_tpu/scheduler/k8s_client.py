@@ -49,8 +49,10 @@ class ApiServerTransport:
         ca_file: str = "",
         timeout: float = 30.0,
     ):
-        host = host or os.getenv("KUBERNETES_SERVICE_HOST", "")
-        port = os.getenv("KUBERNETES_SERVICE_PORT", "443")
+        from dlrover_tpu.common import flags
+
+        host = host or flags.KUBERNETES_SERVICE_HOST.get()
+        port = flags.KUBERNETES_SERVICE_PORT.get()
         self.base_url = host if "://" in host else f"https://{host}:{port}"
         self._timeout = timeout
         token_file = os.path.join(SA_DIR, "token")

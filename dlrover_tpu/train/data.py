@@ -12,6 +12,7 @@ collective schedules identical across the world.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
@@ -35,16 +36,47 @@ def _broadcast_tuple(values: Tuple[int, ...], is_source: bool) -> Tuple[int, ...
 
 
 class ShardingClient:
-    """Lockstep-safe dynamic shard consumption for SPMD workers."""
+    """Lockstep-safe dynamic shard consumption for SPMD workers.
 
-    def __init__(self, dataset_name: str, master_client=None):
+    The chief's master traffic runs the batched lease protocol by
+    default (docs/design/data_plane.md): ``lease_shards`` prefetches
+    ``lease_count`` shards under one per-worker lease per RPC and the
+    SAME call acks the previous batch's completions, so the data plane
+    costs ~1/(2·lease_count) of the per-task ``get_task``+``report``
+    protocol at fleet scale. The lease renews via the agent's folded
+    WorkerReport (zero extra steady-state RPCs); if this worker dies,
+    lease expiry re-enqueues its undone shards at-least-once and the
+    fence keeps its zombie reports from double-counting.
+    ``lease_count=0`` (or an old master that does not know the RPC)
+    falls back to the legacy one-task-per-RPC path."""
+
+    def __init__(
+        self,
+        dataset_name: str,
+        master_client=None,
+        lease_count: Optional[int] = None,
+        idle_poll_s: Optional[float] = None,
+    ):
         import jax
+
+        from dlrover_tpu.common import flags
 
         self.dataset_name = dataset_name
         self._client = master_client
         self._is_chief = jax.process_index() == 0
         self._current_task: Optional[Task] = None
         self._lock = threading.Lock()
+        self._lease_count = int(
+            lease_count if lease_count is not None
+            else flags.SHARD_LEASE_COUNT.get()
+        )
+        self._lease_supported = True
+        self._lease_epoch = -1
+        self._prefetched: List[Task] = []
+        self._done_ids: List[int] = []
+        #: fixed cadence for the idle (todo-drained, shards in flight
+        #: elsewhere) poll; None = the shared jittered growing schedule
+        self._idle_poll_s = idle_poll_s
 
     def register_dataset(
         self,
@@ -66,15 +98,95 @@ class ShardingClient:
                 )
             )
 
+    # -- leased prefetch (chief only) ---------------------------------------
+
+    def _lease(self, count: int, failed_ids=()) -> Optional[object]:
+        """One lease RPC: pending completions + up to ``count`` fresh
+        shards. Returns None when the master predates the protocol
+        (the caller falls back to per-task dispatch)."""
+        from dlrover_tpu.common.messages import ShardLeaseResponse
+
+        done, self._done_ids = self._done_ids, []
+        try:
+            resp = self._client.lease_shards(
+                self.dataset_name,
+                count,
+                done_ids=done,
+                failed_ids=list(failed_ids),
+                lease_epoch=self._lease_epoch,
+            )
+        except Exception:
+            # the RPC (and its whole retry budget) failed: the
+            # completions are NOT lost — they ride the next call.
+            # Dropping them would leave the shards in the master's
+            # doing set until lease expiry and force an avoidable
+            # re-delivery of up to a full batch.
+            self._done_ids = done + self._done_ids
+            raise
+        if not isinstance(resp, ShardLeaseResponse):
+            # version skew: an old master answers the unknown message
+            # with a SimpleResponse — switch to the legacy protocol and
+            # re-report the completions through it
+            logger.warning(
+                "master does not support lease_shards; falling back to "
+                "per-task shard dispatch"
+            )
+            self._lease_supported = False
+            for tid in done:
+                self._client.report_task_result(self.dataset_name, tid, True)
+            for tid in failed_ids:
+                self._client.report_task_result(self.dataset_name, tid, False)
+            return None
+        # done ids the master did NOT ack were fenced off (this lease
+        # expired and the shards were re-issued): drop them — the new
+        # holder's completion is the one that counts
+        if resp.lease_epoch >= 0:
+            self._lease_epoch = resp.lease_epoch
+        return resp
+
+    def _fetch_leased(self) -> Task:
+        """Pop the next prefetched shard, leasing the next batch when
+        the queue runs dry. An IDLE grant (todo drained but shards
+        still in flight on other workers) is NOT end-of-data: a death
+        elsewhere will re-enqueue them, and ending the epoch here
+        would silently lose those records — the chief polls (jittered,
+        growing) until the master says ``exhausted``. Each poll also
+        flushes any pending completions, so the final batch's acks
+        never strand."""
+        if self._prefetched:
+            return self._prefetched.pop(0)
+        delays = None
+        while True:
+            resp = self._lease(self._lease_count)
+            if resp is None:
+                return self._client.get_task(self.dataset_name)
+            self._prefetched.extend(resp.tasks)
+            if self._prefetched:
+                return self._prefetched.pop(0)
+            if resp.exhausted and not self._done_ids:
+                return Task()  # epoch truly complete, everything acked
+            if resp.exhausted:
+                continue  # one more call flushes the final completions
+            # idle: wait for a re-enqueue (or completion) elsewhere
+            if self._idle_poll_s is not None:
+                time.sleep(self._idle_poll_s)
+            else:
+                if delays is None:
+                    from dlrover_tpu.rpc import policy as rpc_policy
+
+                    delays = rpc_policy.poll_intervals()
+                time.sleep(next(delays))
+
     def fetch_task(self) -> Optional[Task]:
         """Chief fetches; everyone receives the same task (or None at end)."""
         task_tuple: Tuple[int, ...]
         if self._is_chief:
-            task = (
-                self._client.get_task(self.dataset_name)
-                if self._client is not None
-                else Task()
-            )
+            if self._client is None:
+                task = Task()
+            elif self._lease_count > 0 and self._lease_supported:
+                task = self._fetch_leased()
+            else:
+                task = self._client.get_task(self.dataset_name)
             task_tuple = (
                 task.task_id,
                 task.shard_start,
@@ -103,9 +215,18 @@ class ShardingClient:
             and self._client is not None
             and self._current_task is not None
         ):
-            self._client.report_task_result(
-                self.dataset_name, self._current_task.task_id, success
-            )
+            if self._lease_count > 0 and self._lease_supported:
+                if success:
+                    # completions batch up and ride the NEXT lease call
+                    self._done_ids.append(self._current_task.task_id)
+                else:
+                    # failures flush immediately so the master requeues
+                    # the shard for someone else without waiting a TTL
+                    self._lease(0, failed_ids=[self._current_task.task_id])
+            else:
+                self._client.report_task_result(
+                    self.dataset_name, self._current_task.task_id, success
+                )
         self._current_task = None
 
     def iter_tasks(self) -> Iterator[Task]:
@@ -120,6 +241,9 @@ class ShardingClient:
 
     def checkpoint_shards(self) -> str:
         if self._is_chief and self._client is not None:
+            if self._done_ids and self._lease_supported:
+                # the shard checkpoint must reflect everything consumed
+                self._lease(0)
             return self._client.get_shard_checkpoint(self.dataset_name)
         return ""
 

@@ -262,20 +262,27 @@ def test_master_sigkill_resumes_shards_exactly_once(tmp_path):
         "DLROVER_TPU_JOB_NAME": job,
     }
 
-    def spawn_master():
+    def spawn_master(tag):
+        # stdout to a file (not a PIPE): a failing run leaves the
+        # master's own log readable next to the agent logs
+        log = tmp_path / f"master-{tag}.log"
         p = subprocess.Popen(
             [sys.executable, MASTER, str(port), "1"],
-            env=_env(state_env), stdout=subprocess.PIPE,
+            env=_env(state_env), stdout=open(log, "w"),
             stderr=subprocess.STDOUT, text=True,
         )
-        for _ in range(50):  # log lines precede the READY marker
-            line = p.stdout.readline()
-            if "READY" in line or not line:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            text = log.read_text() if log.exists() else ""
+            if "READY" in text or p.poll() is not None:
                 break
-        assert "READY" in line, line
+            time.sleep(0.1)
+        ready = [l for l in log.read_text().splitlines() if "READY" in l]
+        assert ready, log.read_text()[-2000:]
+        assert f"port={port}" in ready[0], ready[0]
         return p
 
-    m1 = spawn_master()
+    m1 = spawn_master("m1")
 
     agent = subprocess.Popen(
         _agent_cmd(f"127.0.0.1:{port}", job, 0, nnodes="1:1", script=SHARDS),
@@ -300,7 +307,7 @@ def test_master_sigkill_resumes_shards_exactly_once(tmp_path):
         os.kill(m1.pid, signal.SIGKILL)
         m1.wait(timeout=30)
         time.sleep(1.0)  # real relaunch gap; client retries bridge it
-        m2 = spawn_master()
+        m2 = spawn_master("m2")
 
         out, _ = agent.communicate(timeout=300)
         logs = _agent_logs(job, 0)
@@ -320,7 +327,8 @@ def test_master_sigkill_resumes_shards_exactly_once(tmp_path):
 
         # the relaunched master concludes the job and its ledger carried
         # across: global step from before the kill, downtime recorded
-        mout, _ = m2.communicate(timeout=120)
+        m2.wait(timeout=120)
+        mout = (tmp_path / "master-m2.log").read_text()
         m = re.search(
             r"MASTER_EXIT global_step=(\d+) downtime=([\d.]+) "
             r"goodput=([\d.]+)", mout,

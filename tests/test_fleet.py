@@ -31,7 +31,10 @@ def test_scenario_schema_rejects_unknowns_and_bad_kinds():
 
 
 def test_builtin_scenarios_load():
-    for name in ("headline_1k", "overload_10x", "smoke"):
+    for name in (
+        "headline_1k", "overload_10x", "smoke",
+        "shard_storm_1k", "shard_storm_smoke", "seated_hang",
+    ):
         sc = load_scenario(name)
         assert sc.nodes > 0 and sc.duration_vs > 0
 
@@ -93,6 +96,84 @@ def test_overload_scenario_backpressure_and_eviction(tmp_path):
     # the scenario's checks gate both
     assert {"5", "6", "7"} <= set(v["evictions"])
     assert {"5", "6", "7"} <= set(v["reconciled"])
+
+
+def test_shard_storm_smoke_exactly_once_and_rpc_budget(tmp_path):
+    """The leased data plane under chaos at smoke scale: a preemption
+    storm, a heartbeat-silence episode (hang-watchdog re-form +
+    eviction + fenced zombie) and a master relaunch with leases open —
+    every record counted exactly once, data-plane RPCs bounded."""
+    v = _run("shard_storm_smoke", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    dp = v["data_plane"]
+    # the exactly-once ledger: fenced acks tile [0, size) and the
+    # master's count agrees, across at-least-once re-deliveries
+    assert dp["acked_records"] == dp["dataset_size"] == 60_000
+    assert dp["overlaps"] == 0 and dp["gaps"] == 0
+    assert dp["master_completed_records"] == 60_000
+    # batching: well under the 2-RPCs-per-shard baseline
+    assert dp["rpc_ratio"] < 0.3
+    # the silence episode was recovered by the WATCHDOG (round
+    # re-formed) and the evictor (node declared dead), with zero
+    # spurious evictions (shed-aware liveness)
+    assert len(v["hangs"]["events"]) >= 1
+    assert v["hangs"]["recovered"]
+    assert set(v["evictions"]) == {"2"}
+    assert v["master_relaunches"] == 1
+    # the hang seconds are attributed, and the invariant holds
+    cats = v["attribution"]["categories"]
+    assert cats["collective_hang"] > 0
+    assert sum(cats.values()) == pytest.approx(
+        v["attribution"]["elapsed_wall_s"], rel=0.01
+    )
+
+
+def test_shard_storm_smoke_deterministic(tmp_path):
+    v1 = _run("shard_storm_smoke", tmp_path / "a")
+    v2 = _run("shard_storm_smoke", tmp_path / "b")
+    assert v1["determinism_digest"] == v2["determinism_digest"]
+    assert v1["data_plane"] == v2["data_plane"]
+
+
+def test_seated_hang_detected_recovered_attributed(tmp_path):
+    """PR 9's documented worst case, closed: two SEATED workers
+    partition mid-round; the collective stalls while every heartbeat
+    looks fine; the watchdog declares within its window, the round
+    re-forms without the pair, and the stall is billed to
+    collective_hang (not unattributed)."""
+    v = _run("seated_hang", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    events = v["hangs"]["events"]
+    assert len(events) >= 1
+    assert events[0]["silent"] == [10, 55]
+    # declared within (partition at 100) + window 30 + sweep slack;
+    # the stall clock starts at the chief's LAST report before the
+    # partition, so detection can lead partition+window by up to one
+    # report interval (10 vs)
+    assert 100 + 30 - 10 <= events[0]["off"] <= 140
+    assert v["hangs"]["recovered"]
+    cats = v["attribution"]["categories"]
+    assert cats["collective_hang"] >= 20
+    assert cats["unattributed"] <= cats["collective_hang"]
+    assert sum(cats.values()) == pytest.approx(
+        v["attribution"]["elapsed_wall_s"], rel=0.01
+    )
+    # nobody was evicted: the watchdog, not the evictor, owned this
+    assert v["evictions"] == {}
+    assert v["goodput"] >= 0.7
+
+
+@pytest.mark.slow
+def test_shard_storm_1k_scenario(tmp_path):
+    """The data-plane acceptance scenario (ISSUE 11): 1000 workers
+    lease a 2M-record dataset through storm + silence + relaunch —
+    exactly-once, <= 1/10 RPC baseline, bounded p99. Run explicitly by
+    the fleet-chaos CI step (also via
+    ``python -m dlrover_tpu.fleet run shard_storm_1k``)."""
+    v = _run("shard_storm_1k", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    assert v["data_plane"]["rpc_ratio"] <= 0.1
+    assert v["data_plane"]["master_completed_records"] == 2_000_000
 
 
 @pytest.mark.slow

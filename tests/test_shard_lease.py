@@ -1,0 +1,768 @@
+"""Leased, batched, failure-recovering data plane + collective-hang
+watchdog (docs/design/data_plane.md): lease issue/renew/expire through
+the deadline heap, epoch-fenced dedup (at-least-once delivery,
+exactly-once counting), checkpoint-riding lease state across a master
+relaunch, the servicer wire, the worker-side ShardingClient prefetch,
+shed-aware liveness, and the hang watchdog's declaration rule."""
+
+import json
+import time
+
+import pytest
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.messages import DatasetShardParams
+from dlrover_tpu.common.serde import deserialize, serialize
+from dlrover_tpu.master.shard.dataset_manager import DatasetShardCheckpoint
+from dlrover_tpu.master.shard.task_manager import TaskManager
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _tm(clock, ttl=30.0, size=1000, shard=100, name="ds"):
+    tm = TaskManager(clock=clock, lease_ttl=ttl)
+    tm.new_dataset(DatasetShardParams(
+        dataset_name=name, dataset_size=size, shard_size=shard
+    ))
+    return tm
+
+
+# -- lease grant / piggybacked completion -----------------------------------
+
+
+def test_lease_batch_and_piggybacked_completion():
+    clock = Clock()
+    tm = _tm(clock)
+    g = tm.lease_shards(0, "ds", 4)
+    assert len(g.tasks) == 4
+    assert g.lease_epoch == 1 and g.deadline == clock.t + 30.0
+    # completions of the previous batch + the next lease in ONE call
+    g2 = tm.lease_shards(
+        0, "ds", 2, done_ids=[t.task_id for t in g.tasks[:2]],
+        lease_epoch=g.lease_epoch,
+    )
+    assert g2.acked == [g.tasks[0].task_id, g.tasks[1].task_id]
+    assert len(g2.tasks) == 2
+    assert g2.lease_epoch == g.lease_epoch  # same lease, same fence
+    assert tm.completed_records("ds") == 200
+
+
+def test_lease_renewal_rides_heartbeat_and_expiry_requeues():
+    clock = Clock()
+    tm = _tm(clock)
+    g = tm.lease_shards(7, "ds", 4)
+    # renewal (the WorkerReport path) pushes the deadline out
+    clock.t += 25.0
+    tm.renew_node_leases(7)
+    clock.t += 10.0  # past the ORIGINAL deadline, inside the renewed one
+    assert tm.sweep_deadlines() == 0
+    # no renewal: expiry re-enqueues the undone shards at-least-once
+    clock.t += 35.0
+    assert tm.sweep_deadlines() == 4
+    g2 = tm.lease_shards(8, "ds", 10)
+    spans = {(t.shard_start, t.shard_end) for t in g2.tasks}
+    assert {(t.shard_start, t.shard_end) for t in g.tasks} <= spans
+
+
+def test_renewal_is_progress_capped_not_liveness_forever():
+    """A heartbeating worker whose data pipeline is wedged must still
+    lose its shards on the progress timeout: renewals never extend the
+    deadline past progress_at + task_timeout (the legacy per-task
+    guarantee), while a worker that keeps completing renews freely."""
+    clock = Clock()
+    tm = _tm(clock, ttl=30.0)
+    tm._datasets["ds"].task_timeout = 100.0
+    g = tm.lease_shards(0, "ds", 4)
+    # wedged-but-heartbeating: renew every 20 vs, complete nothing —
+    # renewals keep it alive only up to progress (t=1000) + 100
+    for _ in range(4):
+        clock.t += 20.0  # through t = 1080
+        tm.renew_node_leases(0)
+        assert tm.sweep_deadlines() == 0
+    clock.t += 21.0  # t = 1101 > the progress cap
+    tm.renew_node_leases(0)  # the heartbeat cannot save it any more
+    assert tm.sweep_deadlines() == 4
+    # a worker that keeps completing renews freely past that horizon
+    g2 = tm.lease_shards(1, "ds", 4)
+    for i in range(4):
+        clock.t += 20.0
+        tm.lease_shards(
+            1, "ds", 0, done_ids=[g2.tasks[i].task_id],
+            lease_epoch=g2.lease_epoch,
+        )
+        tm.renew_node_leases(1)
+        assert tm.sweep_deadlines() == 0
+    assert tm.completed_records("ds") == 400
+
+
+def test_fence_rejects_zombie_report_no_double_count():
+    """The epoch-fence invariant: a zombie's late completion of a
+    re-issued shard acks nothing and never double-counts."""
+    clock = Clock()
+    tm = _tm(clock)
+    g = tm.lease_shards(0, "ds", 2)
+    clock.t += 100.0  # lease expires
+    tm.sweep_deadlines()
+    g2 = tm.lease_shards(1, "ds", 2)  # re-issued under a new fence
+    assert g2.lease_epoch > g.lease_epoch
+    # the zombie wakes up and reports its old batch
+    stale = tm.lease_shards(
+        0, "ds", 0, done_ids=[t.task_id for t in g.tasks],
+        lease_epoch=g.lease_epoch,
+    )
+    assert stale.acked == []
+    assert tm.completed_records("ds") == 0
+    # the live holder's completion is the one that counts — once
+    ok = tm.lease_shards(
+        1, "ds", 0, done_ids=[t.task_id for t in g2.tasks],
+        lease_epoch=g2.lease_epoch,
+    )
+    assert len(ok.acked) == 2
+    assert tm.completed_records("ds") == 200
+
+
+def test_legacy_report_path_fences_against_leased_tasks():
+    clock = Clock()
+    tm = _tm(clock)
+    g = tm.lease_shards(0, "ds", 1)
+    # a legacy (fence-less) report cannot complete a leased task...
+    assert not tm.report_dataset_task("ds", g.tasks[0].task_id, True)
+    # ...but the fenced path can
+    assert tm.report_dataset_task(
+        "ds", g.tasks[0].task_id, True, lease_epoch=g.lease_epoch
+    )
+    # and legacy get_task issues still complete through the legacy path
+    t = tm.get_dataset_task(3, "ds")
+    assert tm.report_dataset_task("ds", t.task_id, True)
+
+
+def test_failed_shards_requeue_front_and_refence():
+    clock = Clock()
+    tm = _tm(clock, size=200, shard=100)
+    g = tm.lease_shards(0, "ds", 2)
+    tm.lease_shards(
+        0, "ds", 0, failed_ids=[g.tasks[0].task_id],
+        lease_epoch=g.lease_epoch,
+    )
+    g2 = tm.lease_shards(1, "ds", 1)
+    assert (g2.tasks[0].shard_start, g2.tasks[0].shard_end) == (
+        g.tasks[0].shard_start, g.tasks[0].shard_end
+    )
+    assert g2.lease_epoch != g.lease_epoch
+
+
+def test_eviction_drops_lease_and_requeues():
+    clock = Clock()
+    tm = _tm(clock)
+    g = tm.lease_shards(5, "ds", 3)
+    tm.remove_node_tasks(5)  # HeartbeatEvictor -> remove_node_tasks
+    g2 = tm.lease_shards(6, "ds", 10)
+    spans = {(t.shard_start, t.shard_end) for t in g2.tasks}
+    assert {(t.shard_start, t.shard_end) for t in g.tasks} <= spans
+    # the evicted node's zombie report is fenced off
+    stale = tm.lease_shards(
+        5, "ds", 0, done_ids=[g.tasks[0].task_id], lease_epoch=g.lease_epoch
+    )
+    assert stale.acked == [] and tm.completed_records("ds") == 0
+
+
+def test_idle_vs_exhausted_and_todo_hint():
+    clock = Clock()
+    tm = _tm(clock, size=200, shard=100)
+    g = tm.lease_shards(0, "ds", 10)
+    assert len(g.tasks) == 2
+    # todo empty, shards in flight: idle, not exhausted
+    g2 = tm.lease_shards(1, "ds", 10)
+    assert g2.idle and not g2.exhausted and not g2.tasks
+    assert tm.todo_counts() == {}
+    # a death re-enqueues -> the hint reappears
+    tm.remove_node_tasks(0)
+    assert tm.todo_counts() == {"ds": 2}
+    # drain to completion
+    g3 = tm.lease_shards(1, "ds", 10)
+    done = tm.lease_shards(
+        1, "ds", 1, done_ids=[t.task_id for t in g3.tasks],
+        lease_epoch=g3.lease_epoch,
+    )
+    assert done.exhausted and tm.completed_records("ds") == 200
+    assert tm.finished()
+
+
+# -- deadline heap ----------------------------------------------------------
+
+
+def test_deadline_heap_expires_only_due_entries():
+    clock = Clock()
+    tm = _tm(clock, size=1000, shard=100)
+    tm.lease_shards(0, "ds", 2)
+    clock.t += 10.0
+    tm.lease_shards(1, "ds", 2)  # later deadline
+    clock.t += 21.0  # node 0's lease (t+30) due; node 1's (t+40) not
+    assert tm.sweep_deadlines() == 2
+    ds = tm._datasets["ds"]
+    assert 1 in ds._leases and 0 not in ds._leases
+    assert tm.next_deadline() is not None
+
+
+def test_legacy_task_timeout_via_heap():
+    clock = Clock()
+    tm = _tm(clock, size=200, shard=100)
+    tm._datasets["ds"].task_timeout = 50.0
+    t = tm.get_dataset_task(0, "ds")
+    assert not t.empty
+    clock.t += 49.0
+    assert tm.sweep_deadlines() == 0
+    clock.t += 2.0
+    assert tm.sweep_deadlines() == 1
+    t2 = tm.get_dataset_task(1, "ds")
+    assert (t2.shard_start, t2.shard_end) == (t.shard_start, t.shard_end)
+
+
+# -- master relaunch with open leases ---------------------------------------
+
+
+def test_master_relaunch_restores_open_leases_exactly_once(tmp_path):
+    """Satellite: kill the master mid-epoch with leases open; the
+    restored todo/doing queues, lease fences AND the exactly-once count
+    survive (restore_from_state's keep_doing=True path)."""
+    from dlrover_tpu.common import flags
+    from dlrover_tpu.master.state_store import (
+        MasterStateManager,
+        create_state_backend,
+    )
+
+    clock = Clock()
+    with flags.STATE_BACKEND.scoped("file"), flags.STATE_DIR.scoped(
+        str(tmp_path)
+    ):
+        sm1 = MasterStateManager(create_state_backend("lease-job"))
+        tm = TaskManager(clock=clock, lease_ttl=30.0, state_manager=sm1)
+        tm.new_dataset(DatasetShardParams(
+            dataset_name="ds", dataset_size=600, shard_size=100
+        ))
+        g = tm.lease_shards(0, "ds", 4)
+        tm.lease_shards(
+            0, "ds", 0, done_ids=[g.tasks[0].task_id],
+            lease_epoch=g.lease_epoch,
+        )
+        tm.flush_state()  # the coalescing writer's drain
+        # SIGKILL: nothing of tm survives but the state backend
+        sm2 = MasterStateManager(create_state_backend("lease-job"))
+        tm2 = TaskManager(clock=clock, lease_ttl=30.0, state_manager=sm2)
+        assert tm2.restore_from_state() == 1
+        assert tm2.completed_records("ds") == 100
+        ds2 = tm2._datasets["ds"]
+        assert len(ds2._doing) == 3 and 0 in ds2._leases
+        # the live worker's late batched report completes exactly-once
+        ok = tm2.lease_shards(
+            0, "ds", 0,
+            done_ids=[t.task_id for t in g.tasks[1:]],
+            lease_epoch=g.lease_epoch,
+        )
+        assert len(ok.acked) == 3
+        assert tm2.completed_records("ds") == 400
+        # a SECOND replay of the same report is deduped (the doing
+        # entries are gone)
+        again = tm2.lease_shards(
+            0, "ds", 0,
+            done_ids=[t.task_id for t in g.tasks[1:]],
+            lease_epoch=g.lease_epoch,
+        )
+        assert again.acked == [] and tm2.completed_records("ds") == 400
+        # restored leases get a renewal grace, then expire normally
+        clock.t += 31.0
+        tm2.sweep_deadlines()
+        remaining = []
+        while True:
+            gg = tm2.lease_shards(9, "ds", 10)
+            if not gg.tasks:
+                break
+            remaining.extend(gg.tasks)
+            tm2.lease_shards(
+                9, "ds", 0, done_ids=[t.task_id for t in gg.tasks],
+                lease_epoch=gg.lease_epoch,
+            )
+        assert tm2.completed_records("ds") == 600
+
+
+# -- wire + servicer --------------------------------------------------------
+
+
+def test_lease_rpc_over_the_serde_wire():
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    tm = TaskManager(lease_ttl=30.0)
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="ds", dataset_size=400, shard_size=100
+    ))
+    servicer = MasterServicer(task_manager=tm)
+    req = msg.ShardLeaseRequest(dataset_name="ds", node_id=2, count=3)
+    resp = deserialize(serialize(
+        servicer.get(deserialize(serialize(req)), None)
+    ))
+    assert isinstance(resp, msg.ShardLeaseResponse)
+    assert len(resp.tasks) == 3 and isinstance(resp.tasks[0], msg.Task)
+    assert resp.lease_epoch == 1 and resp.deadline_ts > 0
+    # completions + the exhaustion flags ride back
+    req2 = msg.ShardLeaseRequest(
+        dataset_name="ds", node_id=2, count=3,
+        done_task_ids=[t.task_id for t in resp.tasks],
+        lease_epoch=resp.lease_epoch,
+    )
+    resp2 = deserialize(serialize(
+        servicer.get(deserialize(serialize(req2)), None)
+    ))
+    assert len(resp2.acked) == 3 and len(resp2.tasks) == 1
+    assert tm.completed_records("ds") == 300
+
+
+def test_worker_report_renews_lease_and_carries_todo_hint():
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    clock = Clock()
+    tm = TaskManager(clock=clock, lease_ttl=30.0)
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="ds", dataset_size=300, shard_size=100
+    ))
+    servicer = MasterServicer(task_manager=tm)
+    g = tm.lease_shards(4, "ds", 1)
+    clock.t += 25.0
+    resp = servicer.report(msg.WorkerReport(node_id=4, timestamp=clock.t))
+    # hint: two shards still queued
+    assert resp.data_todo == {"ds": 2}
+    # the report renewed the lease: original deadline passed, no expiry
+    clock.t += 10.0
+    assert tm.sweep_deadlines() == 0
+    clock.t += 31.0
+    assert tm.sweep_deadlines() == 1
+
+
+def test_report_task_result_carries_fence_on_wire():
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    tm = TaskManager(lease_ttl=30.0)
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="ds", dataset_size=100, shard_size=100
+    ))
+    servicer = MasterServicer(task_manager=tm)
+    g = tm.lease_shards(0, "ds", 1)
+    bad = servicer.report(deserialize(serialize(msg.TaskResult(
+        dataset_name="ds", task_id=g.tasks[0].task_id, node_id=0,
+        success=True, lease_epoch=99,
+    ))))
+    assert not bad.success
+    good = servicer.report(deserialize(serialize(msg.TaskResult(
+        dataset_name="ds", task_id=g.tasks[0].task_id, node_id=0,
+        success=True, lease_epoch=g.lease_epoch,
+    ))))
+    assert good.success and tm.completed_records("ds") == 100
+
+
+# -- streaming datasets lease too -------------------------------------------
+
+
+def test_streaming_lease_checkpoint_round_trip():
+    clock = Clock()
+    tm = TaskManager(clock=clock, lease_ttl=30.0)
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="stream", shard_size=10, storage_type="streaming",
+        partition_offsets={"p0": 0},
+    ))
+    g = tm.lease_shards(0, "stream", 2)
+    assert len(g.tasks) == 2 and g.tasks[0].partition == "p0"
+    ck = tm.checkpoint_dataset("stream")
+    assert len(ck.leases) == 1 and ck.doing_meta[0][5] == g.lease_epoch
+    tm2 = TaskManager(clock=clock, lease_ttl=30.0)
+    tm2.new_dataset(DatasetShardParams(
+        dataset_name="stream", shard_size=10, storage_type="streaming",
+        partition_offsets={"p0": 0},
+    ))
+    tm2._datasets["stream"].restore_checkpoint(ck, keep_doing=True)
+    ok = tm2.lease_shards(
+        0, "stream", 0, done_ids=[g.tasks[0].task_id],
+        lease_epoch=g.lease_epoch,
+    )
+    assert len(ok.acked) == 1 and tm2.completed_records("stream") == 10
+
+
+def test_legacy_checkpoint_without_lease_fields_still_restores():
+    """Version skew: a pre-lease master's checkpoint (5-element
+    doing_meta, no leases key) restores with fence -1."""
+    payload = json.dumps({
+        "dataset_name": "ds", "todo": [[100, 200]], "doing": [],
+        "epoch": 1, "completed_records": 100,
+        "doing_meta": [[0, 3, "", 0, 100]], "task_id_seq": 2,
+    })
+    ck = DatasetShardCheckpoint.from_json(payload)
+    tm = TaskManager(lease_ttl=30.0)
+    tm.new_dataset(DatasetShardParams(
+        dataset_name="ds", dataset_size=200, shard_size=100
+    ))
+    tm._datasets["ds"].restore_checkpoint(ck, keep_doing=True)
+    # the legacy in-flight task completes through the legacy path
+    assert tm.report_dataset_task("ds", 0, True)
+    assert tm.completed_records("ds") == 200
+
+
+# -- worker-side ShardingClient lease prefetch ------------------------------
+
+
+class _FakeMasterClient:
+    """MasterClient facade over a real TaskManager (chief-side only)."""
+
+    def __init__(self, tm, node_id=0, lease_supported=True):
+        self._tm = tm
+        self._nid = node_id
+        self._lease_supported = lease_supported
+        self.lease_calls = 0
+        self.get_calls = 0
+        self.report_calls = 0
+
+    def lease_shards(self, name, count, done_ids=None, failed_ids=None,
+                     lease_epoch=-1):
+        self.lease_calls += 1
+        if not self._lease_supported:
+            return msg.SimpleResponse(success=False, reason="unknown message")
+        g = self._tm.lease_shards(
+            self._nid, name, count, done_ids=done_ids,
+            failed_ids=failed_ids, lease_epoch=lease_epoch,
+        )
+        return msg.ShardLeaseResponse(
+            tasks=g.tasks, lease_epoch=g.lease_epoch,
+            deadline_ts=g.deadline, acked=g.acked, idle=g.idle,
+            exhausted=g.exhausted,
+        )
+
+    def get_task(self, name):
+        self.get_calls += 1
+        return self._tm.get_dataset_task(self._nid, name)
+
+    def report_task_result(self, name, task_id, success=True,
+                           lease_epoch=-1):
+        self.report_calls += 1
+        return self._tm.report_dataset_task(
+            name, task_id, success, lease_epoch=lease_epoch
+        )
+
+    def report_dataset_shard_params(self, params):
+        self._tm.new_dataset(params)
+
+    def get_shard_checkpoint(self, name):
+        ck = self._tm.checkpoint_dataset(name)
+        return ck.to_json() if ck else ""
+
+
+def test_sharding_client_leased_prefetch_counts_every_record_once():
+    from dlrover_tpu.train.data import ShardingClient
+
+    tm = _tm(Clock(), size=1000, shard=100)
+    fake = _FakeMasterClient(tm)
+    client = ShardingClient("ds", master_client=fake, lease_count=4)
+    seen = []
+    for task in client.iter_tasks():
+        seen.append((task.shard_start, task.shard_end))
+    assert len(seen) == len(set(seen)) == 10
+    assert tm.completed_records("ds") == 1000
+    assert tm.finished()
+    # the RPC economics: 10 shards moved in ~4 lease calls (batch of
+    # 4, completions piggybacked), not 10 gets + 10 reports
+    assert fake.get_calls == 0 and fake.report_calls == 0
+    assert fake.lease_calls <= 5
+
+
+def test_sharding_client_idle_is_not_end_of_data():
+    """Todo drained while another worker holds shards is IDLE, not
+    end-of-data: the chief polls until the master says exhausted, and
+    picks up shards a death re-enqueued — the epoch never silently
+    loses the dead worker's records."""
+    import threading
+
+    from dlrover_tpu.train.data import ShardingClient
+
+    tm = _tm(Clock(), size=400, shard=100)
+    other = tm.lease_shards(9, "ds", 2)  # another worker holds 2 shards
+    fake = _FakeMasterClient(tm)
+    client = ShardingClient(
+        "ds", master_client=fake, lease_count=4, idle_poll_s=0.01
+    )
+
+    def die_later():
+        import time as _t
+
+        _t.sleep(0.08)
+        tm.remove_node_tasks(9)  # worker 9 dies: its shards re-enqueue
+
+    t = threading.Thread(target=die_later)
+    t.start()
+    spans = [(task.shard_start, task.shard_end) for task in client.iter_tasks()]
+    t.join()
+    # the chief consumed its own 2 shards AND the re-enqueued 2
+    assert sorted(spans) == [(0, 100), (100, 200), (200, 300), (300, 400)]
+    assert tm.completed_records("ds") == 400
+    assert tm.finished()
+
+
+def test_sharding_client_keeps_done_ids_when_lease_rpc_fails():
+    """A lease RPC that exhausts its retries must not strand the
+    batched completions: they ride the next successful call."""
+    from dlrover_tpu.train.data import ShardingClient
+
+    tm = _tm(Clock(), size=200, shard=100)
+
+    class Flaky(_FakeMasterClient):
+        def __init__(self, tm):
+            super().__init__(tm)
+            self.fail_next = False
+
+        def lease_shards(self, *a, **kw):
+            if self.fail_next:
+                self.fail_next = False
+                raise ConnectionError("master relaunching")
+            return super().lease_shards(*a, **kw)
+
+    fake = Flaky(tm)
+    client = ShardingClient("ds", master_client=fake, lease_count=2,
+                            idle_poll_s=0.01)
+    t1 = client.fetch_task()
+    client.report_task_done(True)  # buffered
+    t2 = client.fetch_task()
+    client.report_task_done(True)
+    fake.fail_next = True
+    with pytest.raises(ConnectionError):
+        client.fetch_task()
+    # the failed call restored the done ids; the retry acks them
+    assert client.fetch_task() is None
+    assert tm.completed_records("ds") == 200
+    assert tm.finished()
+    _ = (t1, t2)
+
+
+def test_sharding_client_falls_back_to_legacy_on_old_master():
+    from dlrover_tpu.train.data import ShardingClient
+
+    tm = _tm(Clock(), size=300, shard=100)
+    fake = _FakeMasterClient(tm, lease_supported=False)
+    client = ShardingClient("ds", master_client=fake, lease_count=4)
+    seen = [(t.shard_start, t.shard_end) for t in client.iter_tasks()]
+    assert len(seen) == 3
+    assert tm.completed_records("ds") == 300
+    assert fake.get_calls >= 3  # the per-task path carried the traffic
+
+
+def test_sharding_client_failure_flushes_immediately():
+    from dlrover_tpu.train.data import ShardingClient
+
+    tm = _tm(Clock(), size=200, shard=100)
+    fake = _FakeMasterClient(tm)
+    client = ShardingClient("ds", master_client=fake, lease_count=2)
+    t = client.fetch_task()
+    client.report_task_done(success=False)  # requeued NOW, re-fenced
+    spans = set()
+    for task in client.iter_tasks():
+        spans.add((task.shard_start, task.shard_end))
+    assert (t.shard_start, t.shard_end) in spans
+    assert tm.completed_records("ds") == 200
+
+
+# -- shed-aware liveness ----------------------------------------------------
+
+
+def test_gate_records_shed_node_and_recency():
+    from dlrover_tpu.rpc.transport import RequestGate
+
+    gate = RequestGate(report_cap=1)
+    now = [1000.0]
+    gate.clock = lambda: now[0]
+    assert gate.try_enter("report", node_id=1)
+    assert not gate.try_enter("report", node_id=2)  # shed, recorded
+    gate.leave("report")
+    assert gate.recently_shed(2, 60.0)
+    assert not gate.recently_shed(1, 60.0)  # admitted, never shed
+    now[0] += 61.0
+    assert not gate.recently_shed(2, 60.0)  # aged out
+
+
+def test_evictor_never_evicts_a_node_the_gate_silenced():
+    """Shed-aware liveness end to end: a worker whose every report the
+    gate sheds looks heartbeat-silent, but must NOT be evicted — the
+    master silenced it. A truly silent worker (no attempts at all)
+    still is."""
+    from dlrover_tpu.master.local_master import start_local_master
+    from dlrover_tpu.rpc.transport import RequestGate
+
+    t0 = time.time()
+    master = start_local_master(
+        node_num=2, heartbeat_timeout=10, eviction_hysteresis=1
+    )
+    master.job_manager.pause_monitor()
+    master.hang_watchdog.pause()
+    try:
+        servicer = master.servicer
+        for nid in (0, 1):
+            servicer.report(msg.WorkerReport(node_id=nid, timestamp=t0))
+        gate = RequestGate(report_cap=1)
+        master.job_manager.attach_gate(gate)
+        # node 0 keeps TRYING but every report is shed; node 1 is silent
+        with gate._lock:
+            gate._shed_nodes[0] = t0 + 14
+        assert master.job_manager.sweep_heartbeats(now=t0 + 15) == [1]
+        # the shed node survives as long as it keeps getting shed
+        with gate._lock:
+            gate._shed_nodes[0] = t0 + 28
+        assert master.job_manager.sweep_heartbeats(now=t0 + 30) == []
+        # once it stops trying past the window, normal eviction applies
+        assert master.job_manager.sweep_heartbeats(now=t0 + 45) == [0]
+    finally:
+        master.stop()
+
+
+# -- hang watchdog ----------------------------------------------------------
+
+
+def _hang_rig(n=4, window=30.0):
+    from dlrover_tpu.master.monitor.hang_watchdog import HangWatchdog
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.rendezvous.manager import (
+        ElasticTrainingRendezvousManager,
+    )
+
+    clock = Clock()
+    sm = SpeedMonitor(clock=clock)
+    rdzv = ElasticTrainingRendezvousManager(clock=clock)
+    rdzv.update_rdzv_params(
+        min_nodes=n - 2, max_nodes=n, waiting_timeout=5.0, node_unit=1
+    )
+    wd = HangWatchdog(
+        speed_monitor=sm, rdzv_manager=rdzv, window_s=window, clock=clock
+    )
+    # seat the round + register the fleet as running workers
+    from dlrover_tpu.master.rendezvous.net_topology import NodeTopologyMeta
+
+    for nid in range(n):
+        rdzv.join_rendezvous(nid, nid, NodeTopologyMeta(
+            node_id=nid, node_rank=nid, process_num=1,
+            node_ip=f"10.0.0.{nid}", node_port=1,
+        ))
+        sm.add_running_worker("worker", nid)
+    rdzv._lock.acquire()
+    try:
+        rdzv._check_rdzv_completed()
+    finally:
+        rdzv._lock.release()
+    assert len(rdzv.latest_world_ids()) == n
+    # the first sweep latches the freshly formed round: a new world
+    # gets a FULL window from formation before it can be declared hung
+    assert wd.sweep() is None
+    return clock, sm, rdzv, wd
+
+
+def test_watchdog_round_guard_after_relaunch_stale_stamp():
+    """A relaunched master restores the PRE-crash progress stamp; the
+    re-formed round must get a full window from formation before any
+    declaration — the relaunch gap is downtime, not a collective hang,
+    and the healthy fleet must not be forced back into JOINING."""
+    clock, sm, rdzv, wd = _hang_rig(window=30.0)
+    # simulate the restored ledger: progress stamp far in the past
+    sm.collect_global_step(10, clock.t - 500.0)
+    clock.t += 5
+    assert wd.sweep() is None  # guarded by the formation stamp
+    clock.t += 20
+    assert wd.sweep() is None  # still inside the formation window
+    assert sm.attribution()["categories"]["collective_hang"] == 0.0
+    # but a genuine post-formation stall still declares
+    clock.t += 10  # 35 since formation, no progress since
+    assert wd.sweep() is not None
+
+
+def test_watchdog_declares_only_seated_fleetwide_stalls():
+    clock, sm, rdzv, wd = _hang_rig()
+    sm.collect_global_step(10, clock.t)
+    # progress fresh: no hang
+    clock.t += 10
+    assert wd.sweep() is None
+    # stalled past the window with everyone seated: DECLARED
+    clock.t += 25
+    ev = wd.sweep()
+    assert ev is not None and ev["world"] == 4
+    assert sm.attribution()["categories"]["collective_hang"] > 0
+    # the re-form signal is up: a virtual waiter appears
+    assert rdzv.num_nodes_waiting() == 1
+    # one declaration per episode (within the window)
+    assert wd.sweep() is None
+
+
+def test_watchdog_ignores_membership_changes_and_stragglers():
+    clock, sm, rdzv, wd = _hang_rig()
+    sm.collect_global_step(10, clock.t)
+    # one rank keeps folding step digests: fleet progress continues —
+    # that is the straggler detector's territory, not a hang
+    clock.t += 40
+    sm.collect_step_digest(
+        1, {"count": 3, "mean_s": 2.0, "p50_s": 2.0}, ts=clock.t
+    )
+    assert wd.sweep() is None
+    # a stalled fleet with a LIVE != WORLD mismatch (eviction in
+    # flight) is a membership change, not a hang
+    clock.t += 40
+    sm.remove_running_worker("worker", 3)
+    assert wd.sweep() is None
+
+
+def test_watchdog_excludes_silent_members_and_bills_hang():
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+    from dlrover_tpu.common.node import Node
+
+    clock, sm, rdzv, wd = _hang_rig()
+    JobContext.reset_singleton()
+    ctx = get_job_context()
+    wd._job_context = ctx
+    t0 = clock.t
+    for nid in range(4):
+        node = Node(NodeType.WORKER, nid)
+        node.update_heartbeat(t0)
+        ctx.update_node(node)
+    sm.collect_global_step(10, t0)
+    clock.t += 35
+    # nodes 0,1 kept heartbeating; 2,3 went dark with the stall
+    for nid in (0, 1):
+        ctx.get_node(NodeType.WORKER, nid).update_heartbeat(clock.t - 5)
+    ev = wd.sweep()
+    assert ev is not None and ev["silent"] == [2, 3]
+    assert 2 not in rdzv._alive_nodes and 3 not in rdzv._alive_nodes
+    cats = sm.attribution()["categories"]
+    assert cats["collective_hang"] == pytest.approx(35, abs=1)
+    # hang seconds survive a master relaunch
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+
+    sm2 = SpeedMonitor(clock=clock)
+    sm2.import_state(sm.export_state())
+    assert sm2.attribution()["categories"]["collective_hang"] == (
+        pytest.approx(35, abs=1)
+    )
+    assert sm2.last_progress_ts() == t0
+
+
+def test_watchdog_refires_and_keeps_billing_until_recovery():
+    clock, sm, rdzv, wd = _hang_rig(window=30.0)
+    sm.collect_global_step(10, clock.t)
+    clock.t += 31
+    assert wd.sweep() is not None
+    clock.t += 15
+    assert wd.sweep() is None  # recovery window
+    clock.t += 16
+    ev = wd.sweep()
+    assert ev is not None and ev["refire"]
+    # total billed = the whole stall, no double count
+    cats = sm.attribution()["categories"]
+    assert cats["collective_hang"] == pytest.approx(62, abs=1)
+    # progress re-arms
+    sm.collect_global_step(11, clock.t)
+    clock.t += 5
+    assert wd.sweep() is None
