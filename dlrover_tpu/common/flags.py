@@ -326,6 +326,15 @@ HANG_WATCHDOG_WINDOW_S = _define(
     "eval); one slow RANK never trips it (that is the straggler "
     "detector's job).",
 )
+LOCK_TRACKER = _define(
+    "DLROVER_TPU_LOCK_TRACKER", False, "bool",
+    "Runtime lock-discipline tracker (lint/lock_tracker.py): wraps the "
+    "hot-path master locks and raises with BOTH acquisition stacks on "
+    "any acquisition that contradicts the checked-in "
+    "lint/lock_order.json acquisition graph. Off by default (zero "
+    "overhead); the fleet harness arms it programmatically for the "
+    "schedule-perturbation scenarios.",
+)
 
 # -- agent/master wiring (NodeEnv names; injected by the agent/launcher)
 

@@ -36,9 +36,19 @@ class MasterEndpoint:
     points the fleet at the relaunched servicer."""
 
     def __init__(self, gate: Optional[RequestGate] = None):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self.gate = gate or RequestGate()
-        self._lock = threading.Lock()
+        self._lock = maybe_track(
+            threading.Lock(), "fleet.loopback.MasterEndpoint._lock"
+        )
         self._servicer = None
+        #: schedule-perturbation hook (docs/design/racecheck.md): when
+        #: set, called as ``perturb(point, kind)`` immediately before
+        #: ("pre") and after ("post") every servicer dispatch — the
+        #: runner's SchedulePerturber fires master sweeps there, in the
+        #: middle of a logical RPC, which the tick loop never does
+        self.perturb = None
 
     def set_master(self, servicer):
         with self._lock:
@@ -92,7 +102,11 @@ class RpcStats:
     _N_BUCKETS = 48
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        self._lock = maybe_track(
+            threading.Lock(), "fleet.loopback.RpcStats._lock"
+        )
         self.calls = 0
         self.errors = 0
         self.sheds = 0
@@ -219,6 +233,9 @@ class LoopbackClient:
                 wire = serialize(gate.overload_reply(kind))
             else:
                 try:
+                    perturb = self._endpoint.perturb
+                    if perturb is not None:
+                        perturb("pre", kind)
                     request = deserialize(payload)
                     resp = (
                         servicer.get(request, None)
@@ -226,6 +243,8 @@ class LoopbackClient:
                         else servicer.report(request, None)
                     )
                     wire = serialize(resp) if resp is not None else b""
+                    if perturb is not None:
+                        perturb("post", kind)
                 finally:
                     gate.leave(kind)
             decoded = deserialize(wire)

@@ -66,13 +66,123 @@ class FleetView:
         self.training_active = False
 
 
+class SchedulePerturber:
+    """Adversarial schedule exploration (docs/design/racecheck.md).
+
+    The tick loop runs every master sweep at tick boundaries, when no
+    RPC is mid-flight — so the loopback proves the control plane's
+    *logic*, never its interleavings. This hook runs on the loopback's
+    pre/post-dispatch points and, with seeded probability, fires one of
+    the master's background operations (the deadline sweep, the hang
+    watchdog, the heartbeat evictor, the shard-state writer drain, the
+    training-status probe) right there — in the middle of a logical
+    RPC, on the virtual clock, with the LockTracker armed. Any lock
+    acquisition the perturbed schedule makes in an order inconsistent
+    with the global graph raises with both stacks and fails the
+    verdict. Deterministic given the scenario seed (parallelism=1).
+
+    ``ops`` is a plain list of (name, thunk) so a regression test can
+    append a known-bad shape and prove the explorer + tracker catch it.
+    """
+
+    def __init__(self, runner: "FleetRunner", seed: int, prob: float):
+        import random
+
+        self._runner = runner
+        self._rng = random.Random(seed ^ 0x5EED)
+        self.prob = float(prob)
+        self.fired: Dict[str, int] = {}
+        self.errors: List[str] = []
+        self._inside = False
+        self.ops: List[Tuple[str, object]] = [
+            ("deadline_sweep", self._deadline_sweep),
+            ("hang_watchdog", self._hang_watchdog),
+            ("heartbeat_evictor", self._evictor),
+            ("writer_drain", self._writer_drain),
+            ("finished_probe", self._finished_probe),
+        ]
+
+    # -- the injectable master ops -------------------------------------
+
+    def _deadline_sweep(self, vt: float):
+        self._runner.master.task_manager.sweep_deadlines(now=vt)
+
+    def _hang_watchdog(self, vt: float):
+        if self._runner.sc.hang_window_vs > 0:
+            ev = self._runner.master.hang_watchdog.sweep(now=vt)
+            if ev is not None:
+                self._runner.note_hang(vt, ev)
+
+    def _evictor(self, vt: float):
+        evicted = self._runner.master.job_manager.sweep_heartbeats(now=vt)
+        self._runner.note_evicted(vt, evicted)
+
+    def _writer_drain(self, vt: float):
+        self._runner.master.task_manager.flush_state()
+
+    def _finished_probe(self, vt: float):
+        # the TrainingStatusRequest path: TaskManager lock, then every
+        # dataset's lock — the acquisition chain worth perturbing
+        self._runner.master.task_manager.finished()
+
+    # -- the loopback hook ---------------------------------------------
+
+    def __call__(self, point: str, kind: str):
+        if self._inside or self._runner.master is None:
+            return
+        if self._rng.random() >= self.prob:
+            return
+        name, op = self.ops[self._rng.randrange(len(self.ops))]
+        self._inside = True  # an op's own RPCs must not recurse
+        try:
+            op(self._runner.clock.now())
+            self.fired[name] = self.fired.get(name, 0) + 1
+        except Exception as e:
+            # a LockOrderViolation lands in tracker.violations too; the
+            # perturber records the op so the verdict can attribute it
+            self.errors.append(f"{name}@{point}/{kind}: {e}")
+            self.fired[name] = self.fired.get(name, 0) + 1
+        finally:
+            self._inside = False
+
+    def stats(self) -> Dict:
+        return {
+            "prob": self.prob,
+            "fired": dict(sorted(self.fired.items())),
+            "total": sum(self.fired.values()),
+            "errors": list(self.errors[:16]),
+        }
+
+
 class FleetRunner:
     def __init__(self, scenario: Scenario, out_dir: Optional[str] = None):
         self.sc = scenario
+        if scenario.perturb_schedule and scenario.parallelism > 1:
+            # the perturber's seeded rng, recursion guard and fired
+            # counters are single-threaded by design; a thread-pool
+            # tick loop would silently break seed-determinism.
+            # Validated before ANY side effect (tracker arming below)
+            raise ValueError(
+                "perturb_schedule requires parallelism=1 "
+                f"(scenario has parallelism={scenario.parallelism})"
+            )
         self.out_dir = out_dir or os.path.join(
             "/tmp", "dlrover_tpu_fleet", scenario.name
         )
         os.makedirs(self.out_dir, exist_ok=True)
+        #: armed BEFORE anything below constructs a lock: the gate,
+        #: endpoint and stats locks are born here in __init__, and a
+        #: tracker installed later would miss them (maybe_track returns
+        #: the raw lock). run() disarms on exit.
+        self.tracker = None
+        if scenario.lock_tracker:
+            from dlrover_tpu.lint import lock_tracker as _lt
+
+            self.tracker = _lt.LockTracker.from_lock_order()
+            # record-only: a violation must land in the verdict, not
+            # die inside a servicer handler's catch-all
+            self.tracker.raise_on_violation = False
+            _lt.install_tracker(self.tracker)
         self.clock = VirtualClock()
         self._base = self.clock.now()
         gate = RequestGate(report_cap=scenario.gate_report_cap)
@@ -103,6 +213,14 @@ class FleetRunner:
             if scenario.parallelism > 1
             else None
         )
+        #: mid-RPC schedule perturber (racecheck)
+        self.perturber = (
+            SchedulePerturber(self, scenario.seed, scenario.perturb_prob)
+            if scenario.perturb_schedule
+            else None
+        )
+        if self.perturber is not None:
+            self.endpoint.perturb = self.perturber
         import random
 
         self._rng = random.Random(scenario.seed)
@@ -301,6 +419,10 @@ class FleetRunner:
         sc = self.sc
         t_real0 = time.time()
         stack = contextlib.ExitStack()
+        if self.tracker is not None:
+            from dlrover_tpu.lint import lock_tracker as _lt
+
+            stack.callback(_lt.install_tracker, None)
         with stack:
             # pinned runtime environment: durable file state backend for
             # relaunch continuity, trace spine into the run's out_dir —
@@ -384,15 +506,7 @@ class FleetRunner:
                 if self.sc.hang_window_vs > 0:
                     ev = self.master.hang_watchdog.sweep(now=vt)
                     if ev is not None:
-                        self._hang_events.append(
-                            {**ev, "off": round(vt - self._base, 1)}
-                        )
-                        self._event(
-                            vt,
-                            f"collective hang declared "
-                            f"(stall {ev['stall_s']:.0f} vs, silent "
-                            f"members {ev['silent'] or 'none'})",
-                        )
+                        self.note_hang(vt, ev)
                 # drain the coalescing shard-state writer at the tick
                 # boundary: models its sub-ms drain deterministically,
                 # so a SIGKILL between ticks restores exactly the acked
@@ -402,27 +516,7 @@ class FleetRunner:
             if self.master is not None and off >= next_sweep:
                 next_sweep += sc.monitor_sweep_vs
                 evicted = self.master.job_manager.sweep_heartbeats(now=vt)
-                for nid in evicted:
-                    # FIRST eviction only: under sustained overload a
-                    # reconciled worker whose every report is shed can
-                    # be legitimately re-evicted (the gate sheds before
-                    # deserializing, so the master cannot know who it
-                    # silenced) — the hysteresis-latency check measures
-                    # the original silence episode
-                    self._evicted_ever.setdefault(nid, vt)
-                    from dlrover_tpu.common.constants import NodeType
-                    from dlrover_tpu.master.node.job_context import (
-                        get_job_context,
-                    )
-
-                    node = get_job_context().get_node(NodeType.WORKER, nid)
-                    hb_off = (
-                        round(node.heartbeat_time - self._base, 1)
-                        if node is not None else None
-                    )
-                    self._event(
-                        vt, f"master evicted node {nid} (last hb {hb_off})"
-                    )
+                self.note_evicted(vt, evicted)
                 self._track_reconciles(vt)
                 for nid in self.master.speed_monitor.stragglers():
                     self._stragglers_seen.add(nid)
@@ -443,6 +537,37 @@ class FleetRunner:
             order = list(self.workers)
             self._rng.shuffle(order)
             list(self._pool.map(lambda w: w.tick(vt, self.view), order))
+
+    def note_hang(self, vt: float, ev: Dict):
+        """Record one hang-watchdog declaration (tick loop or a
+        perturbed mid-RPC sweep — same bookkeeping either way)."""
+        self._hang_events.append({**ev, "off": round(vt - self._base, 1)})
+        self._event(
+            vt,
+            f"collective hang declared (stall {ev['stall_s']:.0f} vs, "
+            f"silent members {ev['silent'] or 'none'})",
+        )
+
+    def note_evicted(self, vt: float, evicted):
+        for nid in evicted:
+            # FIRST eviction only: under sustained overload a
+            # reconciled worker whose every report is shed can be
+            # legitimately re-evicted (the gate sheds before
+            # deserializing, so the master cannot know who it
+            # silenced) — the hysteresis-latency check measures the
+            # original silence episode
+            self._evicted_ever.setdefault(nid, vt)
+            from dlrover_tpu.common.constants import NodeType
+            from dlrover_tpu.master.node.job_context import get_job_context
+
+            node = get_job_context().get_node(NodeType.WORKER, nid)
+            hb_off = (
+                round(node.heartbeat_time - self._base, 1)
+                if node is not None else None
+            )
+            self._event(
+                vt, f"master evicted node {nid} (last hb {hb_off})"
+            )
 
     def _track_reconciles(self, vt: float):
         from dlrover_tpu.common.constants import NodeStatus, NodeType
@@ -503,6 +628,10 @@ class FleetRunner:
                 "recovered": self._resumed_after_hang,
             },
             "data_plane": self._data_verdict(),
+            "lock_tracker": self._tracker_verdict(),
+            "schedule_perturbation": (
+                self.perturber.stats() if self.perturber else {}
+            ),
             "gate": self.endpoint.gate.stats(),
             "rpc": self.stats.snapshot(),
             "worker_reports": {
@@ -565,6 +694,17 @@ class FleetRunner:
             "workers_exhausted": sum(
                 1 for w in self.workers if w.exhausted
             ),
+        }
+
+    def _tracker_verdict(self) -> Dict:
+        if self.tracker is None:
+            return {}
+        snap = self.tracker.snapshot()
+        return {
+            "armed": True,
+            "acquisitions": snap["acquisitions"],
+            "observed_edges": len(snap["observed_edges"]),
+            "violations": snap["violations"],
         }
 
     def _checks(self, v: Dict) -> Dict:
@@ -747,6 +887,35 @@ class FleetRunner:
                 "master_relaunches",
                 v["master_relaunches"] == exp["relaunches"],
                 v["master_relaunches"], exp["relaunches"],
+            )
+        lt = v.get("lock_tracker") or {}
+        if lt.get("armed"):
+            # the tracker-clean gate: a perturbed schedule that takes
+            # any lock against the global order fails the scenario,
+            # with the offending pair named in the verdict
+            check(
+                "lock_discipline_clean",
+                not lt["violations"] and lt["acquisitions"] > 0,
+                {"violations": lt["violations"],
+                 "acquisitions": lt["acquisitions"]},
+                "0 violations over >0 tracked acquisitions",
+            )
+        sp = v.get("schedule_perturbation") or {}
+        if sp:
+            # every perturbed op must have RUN clean: an op that raised
+            # still counts toward `fired`, so without this gate a
+            # crashing mid-RPC sweep would pass CI invisibly
+            check(
+                "perturbed_ops_clean", not sp.get("errors"),
+                sp.get("errors"), "no perturbed op raised",
+            )
+        if "min_perturbations" in exp:
+            # the explorer actually explored: sweeps fired mid-RPC, not
+            # just at tick boundaries
+            check(
+                "schedule_explored",
+                sp.get("total", 0) >= exp["min_perturbations"],
+                sp.get("total", 0), f">= {exp['min_perturbations']}",
             )
         if exp.get("master_survives"):
             served = sum(v["gate"]["served"].values())

@@ -116,6 +116,17 @@ class Scenario:
     #: collective-hang watchdog window in virtual seconds (0 = the
     #: watchdog is not swept — PR 9 behavior)
     hang_window_vs: float = 0.0
+    # -- adversarial schedule exploration (docs/design/racecheck.md):
+    # drive the master's sweeps (deadline sweep, hang watchdog,
+    # heartbeat evictor, shard-state writer drain, training-status
+    # probe) at seeded-random points MID-RPC instead of only at tick
+    # boundaries — interleavings the tick loop alone never exercises
+    perturb_schedule: bool = False
+    #: per-injection-point fire probability (two points per served RPC)
+    perturb_prob: float = 0.02
+    #: arm the runtime LockTracker (lint/lock_tracker.py) around the
+    #: whole run; the verdict then gates on zero lock-order violations
+    lock_tracker: bool = False
     faults: List[FaultEvent] = dataclasses.field(default_factory=list)
     #: verdict gates: the CLI exits nonzero when any fails
     expect: Dict = dataclasses.field(default_factory=dict)

@@ -35,6 +35,17 @@ docs/design/data_plane.md).
   tests (seconds of real time), same exactly-once + budget gates.
 - ``smoke`` — a 40-node, 4-virtual-minute cut of the headline for
   tier-1 tests (seconds of real time).
+- ``perturbed_smoke`` — the racecheck schedule explorer
+  (docs/design/racecheck.md): a 30-node fleet with the data plane on,
+  the LockTracker armed, and the master's sweeps (deadline sweep, hang
+  watchdog, heartbeat evictor, shard-state writer drain,
+  training-status probe) fired at seeded-random points MID-RPC through
+  the loopback's perturbation hook — interleavings the tick loop never
+  exercises. Gates: zero lock-order violations over a nonempty set of
+  tracked acquisitions, the explorer actually fired, exactly-once
+  still holds and the attribution still sums — the perturbed schedule
+  must be indistinguishable from the tick-aligned one in every
+  verdict-visible way.
 
 Note one modeling rule: membership faults (preempt/crash) must not
 overlap a ``heartbeat_loss``/``partition`` window in scenarios WITHOUT
@@ -257,6 +268,45 @@ BUILTIN = {
             "require_hang_recovery": True,
             # the stall is billed to collective_hang, not unattributed
             "min_collective_hang_s": 20,
+            "master_survives": True,
+        },
+    },
+    "perturbed_smoke": {
+        "name": "perturbed_smoke",
+        "seed": 31,
+        "nodes": 30,
+        "min_nodes": 28,
+        "duration_vs": 240,
+        "step_time_s": 1.0,
+        "report_interval_vs": 10,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 60,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 5,
+        "gate_report_cap": 32,
+        # the data plane ON so the perturbed deadline sweep / writer
+        # drain / finished probe have real lease + dataset locks to
+        # contend over
+        "dataset_size": 30_000,
+        "shard_size": 100,
+        "lease_count": 8,
+        "lease_ttl_vs": 60,
+        "records_per_step": 25,
+        "hang_window_vs": 45,
+        "perturb_schedule": True,
+        "perturb_prob": 0.02,
+        "lock_tracker": True,
+        "faults": [
+            # membership churn mid-epoch so the perturbed evictor and
+            # deadline sweeps run against real lease re-enqueues
+            {"kind": "preempt", "at_vs": 80, "count": 3,
+             "duration_vs": 15},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "max_rpc_latency_s": 2.0,
+            "data_exactly_once": True,
+            "min_perturbations": 20,
             "master_survives": True,
         },
     },

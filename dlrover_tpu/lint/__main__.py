@@ -1,19 +1,24 @@
-"""graftlint + shardcheck CLI.
+"""graftlint + shardcheck + racecheck CLI.
 
     python -m dlrover_tpu.lint [options] paths...       # AST rules
     python -m dlrover_tpu.lint --hlo dp4 [--hlo ...]    # IR rules
+    python -m dlrover_tpu.lint --race [paths...]        # concurrency
 
-Exit codes: 0 clean (against the baseline / contracts), 1 new
-violations, unparsable files, or missing contracts, 2 usage error.
-``--fix-baseline`` rewrites the AST baseline; ``--fix-contracts``
-regenerates the SC001 collective-census contracts for the given mesh
-specs (both: use after deliberate grandfathering, never to silence a
-new violation you should fix).
+Exit codes: 0 clean (against the baseline / contracts / lock-order
+graph), 1 new violations, unparsable files, missing contracts, or
+lock-graph drift, 2 usage error. ``--fix-baseline`` rewrites the AST
+baseline; ``--fix-contracts`` regenerates the SC001 collective-census
+contracts for the given mesh specs; ``--fix-lock-order`` /
+``--fix-race-baseline`` re-record the RC001 acquisition graph and the
+racecheck baseline (all: use after deliberate grandfathering or a
+reviewed edge, never to silence a new violation you should fix).
 
 The ``--hlo`` path lowers the pinned contract model (see
 lint/contract_model.py) on virtual CPU devices — no TPU, no live
 training process — and runs the SC rules over the lowered StableHLO +
-optimized HLO text.
+optimized HLO text. The ``--race`` path is a whole-repo analysis
+(cross-file lock identity), so it takes the package root, not single
+files (see lint/racecheck.py).
 """
 
 from __future__ import annotations
@@ -85,14 +90,64 @@ def main(argv=None) -> int:
         help="SC001: allowed fractional byte growth per collective cell "
         f"(default {shardcheck.DEFAULT_BYTE_TOLERANCE})",
     )
+    p.add_argument(
+        "--race",
+        action="store_true",
+        help="concurrency mode: whole-repo lock-order + guarded-by "
+        "analysis (RC rules) against the checked-in lock_order.json "
+        "and racecheck baseline",
+    )
+    p.add_argument(
+        "--lock-order",
+        default=None,
+        help="RC001 acquisition-graph file (default: the checked-in "
+        "dlrover_tpu/lint/lock_order.json)",
+    )
+    p.add_argument(
+        "--race-baseline",
+        default=None,
+        help="racecheck baseline file (default: the checked-in "
+        "dlrover_tpu/lint/racecheck_baseline.json)",
+    )
+    p.add_argument(
+        "--fix-lock-order",
+        action="store_true",
+        help="re-record the RC001 acquisition graph from the current "
+        "tree (use for a reviewed, intentional new edge)",
+    )
+    p.add_argument(
+        "--fix-race-baseline",
+        action="store_true",
+        help="rewrite the racecheck baseline to the current finding set",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
+        from dlrover_tpu.lint import racecheck
+
         for rid, name, doc in rule_catalog():
             print(f"{rid}  {name:28s} {doc}")
         for rid, name, doc in shardcheck.SC_RULES:
             print(f"{rid}  {name:28s} {doc}")
+        for rid, name, doc in racecheck.RC_RULES:
+            print(f"{rid}  {name:28s} {doc}")
         return 0
+    if args.race:
+        if args.hlo or args.fix_baseline or args.no_baseline or args.rule:
+            print(
+                "error: --race (concurrency mode) cannot be combined "
+                "with --hlo, --fix-baseline, --no-baseline or --rule — "
+                "run them as separate invocations",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_race(args)
+    if args.fix_lock_order or args.fix_race_baseline:
+        print(
+            "error: --fix-lock-order / --fix-race-baseline need --race",
+            file=sys.stderr,
+        )
+        return 2
     if args.hlo:
         if args.paths or args.fix_baseline or args.no_baseline or args.rule:
             print(
@@ -152,6 +207,63 @@ def main(argv=None) -> int:
         result = engine.run(args.paths, baseline_path=args.baseline,
                             rules=rules)
     engine.report(result)
+    return 1 if result.failed else 0
+
+
+def _run_race(args) -> int:
+    """Concurrency mode: whole-repo RC rules + lock-order graph diff."""
+    from dlrover_tpu.lint import racecheck
+
+    paths = args.paths or ["dlrover_tpu"]
+    result = racecheck.run(
+        paths,
+        lock_order_path=args.lock_order,
+        baseline_path=args.race_baseline,
+        fix_lock_order=args.fix_lock_order,
+        fix_baseline=args.fix_race_baseline,
+    )
+    cycles = [v for v in result.violations if v.rule == "RC001"]
+    if args.fix_lock_order:
+        if cycles:
+            # nothing was written: a cyclic graph must never seed the
+            # tracker or pass the diff gate
+            print(
+                "racecheck: lock order NOT rewritten — the current "
+                "tree has a lock-order cycle; fix it first:",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"racecheck: lock order "
+                f"{args.lock_order or racecheck.DEFAULT_LOCK_ORDER} "
+                f"rewritten ({len(result.edges)} edge(s) over "
+                f"{len(result.model.locks)} lock(s))"
+            )
+    if args.fix_race_baseline:
+        if cycles:
+            print(
+                "racecheck: baseline NOT rewritten — a deadlock cycle "
+                "is never baselinable; fix it first:",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"racecheck: baseline "
+                f"{args.race_baseline or racecheck.DEFAULT_RACE_BASELINE} "
+                f"rewritten with "
+                f"{len([v for v in result.violations if v.rule != 'RC001'])}"
+                " finding(s)"
+            )
+        for e in result.errors:
+            print(f"ERROR {e}", file=sys.stderr)
+        for v in cycles:
+            print(v.format(), file=sys.stderr)
+        return 1 if result.errors or cycles else 0
+    if args.fix_lock_order and cycles:
+        for v in cycles:
+            print(v.format(), file=sys.stderr)
+        return 1
+    racecheck.report(result)
     return 1 if result.failed else 0
 
 

@@ -230,7 +230,11 @@ def load_baseline(path: str) -> Dict[str, dict]:
     return dict(data["violations"])
 
 
-def write_baseline(path: str, violations: Sequence[Violation]) -> dict:
+def write_baseline(
+    path: str,
+    violations: Sequence[Violation],
+    regen_hint: str = "--fix-baseline",
+) -> dict:
     entries: Dict[str, dict] = {}
     for v in violations:
         fp = v.fingerprint()
@@ -246,10 +250,10 @@ def write_baseline(path: str, violations: Sequence[Violation]) -> dict:
         e["count"] += 1
     data = {
         "comment": (
-            "graftlint baseline: grandfathered violations. Entries key on "
+            "grandfathered violations. Entries key on "
             "(rule, path, line TEXT) so line drift never un-baselines a "
             "site. Regenerate with: python -m dlrover_tpu.lint "
-            "--fix-baseline dlrover_tpu/"
+            f"{regen_hint} dlrover_tpu/"
         ),
         "version": 1,
         "violations": {k: entries[k] for k in sorted(entries)},

@@ -1,0 +1,270 @@
+"""Runtime lock-discipline tracker: racecheck's dynamic companion.
+
+The static layer (:mod:`dlrover_tpu.lint.racecheck`) proves the
+*lexical* acquisition graph is acyclic and checked in; this module
+enforces it on the *executed* schedule. Tracked locks are plain
+``threading`` locks wrapped in a :class:`TrackedLock` proxy; every
+acquisition consults the per-thread held stack and the global order
+graph (checked-in edges from ``lint/lock_order.json`` plus edges
+observed this run). An acquisition that would close a cycle — lock B
+taken while holding A when the graph already knows a path B ⇝ A —
+raises :class:`LockOrderViolation` carrying BOTH stacks: where A was
+acquired and where B is being acquired, which is exactly the pair a
+deadlock post-mortem never has.
+
+Wiring: hot-path modules construct their locks through
+:func:`maybe_track`. With the tracker disarmed (the default —
+``DLROVER_TPU_LOCK_TRACKER`` unset and no programmatic
+:func:`install_tracker`), ``maybe_track`` returns the raw lock: zero
+indirection, zero overhead, production behavior unchanged. The fleet
+harness arms a tracker programmatically before booting the master and
+gates its verdict on ``tracker.violations`` staying empty, so the
+schedule-perturbation scenarios turn "the loopback proves logic, not
+threading" into a falsifiable exit code.
+
+Overhead when armed: one dict lookup + held-stack append per
+acquisition, plus a ``traceback.extract_stack`` per acquisition (the
+expensive part, ~10µs) — acceptable for the harness and for a
+flagged-on canary master, not for the data-plane hot loop. Limits: the
+tracker sees lock *ids* (type-level, striped stripes share one id), so
+a same-id different-instance ordering (stripe i then stripe j) is
+permitted by design; and it detects *inversions*, not missed guards —
+that is RC002/JG006's job.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquisition inconsistent with the global lock order. Carries the
+    acquisition stacks of both ends of the inversion."""
+
+    def __init__(
+        self,
+        holding: str,
+        acquiring: str,
+        holding_stack: str,
+        acquiring_stack: str,
+        known_path: List[str],
+    ):
+        self.holding = holding
+        self.acquiring = acquiring
+        self.holding_stack = holding_stack
+        self.acquiring_stack = acquiring_stack
+        self.known_path = list(known_path)
+        super().__init__(
+            f"lock-order inversion: acquiring {acquiring} while holding "
+            f"{holding}, but the order graph already has "
+            f"{' -> '.join(known_path)} — two threads on these paths "
+            "deadlock.\n"
+            f"--- stack holding {holding} ---\n{holding_stack}"
+            f"--- stack acquiring {acquiring} ---\n{acquiring_stack}"
+        )
+
+
+class LockTracker:
+    """The global order graph + per-thread held stacks.
+
+    ``order`` seeds the graph with the checked-in edges (held ->
+    acquired); edges observed at runtime are unioned in, so a schedule
+    that explores A->B in one thread and B->A in another trips the
+    check whichever side runs second — no true preemption race needed.
+    """
+
+    def __init__(
+        self, order: Optional[Dict[str, Set[str]]] = None,
+        raise_on_violation: bool = True,
+    ):
+        self._graph: Dict[str, Set[str]] = {
+            k: set(v) for k, v in (order or {}).items()
+        }
+        self._lock = threading.Lock()  # guards _graph/violations/counts
+        self._held = threading.local()
+        self.raise_on_violation = raise_on_violation
+        self.violations: List[LockOrderViolation] = []
+        self.acquisitions = 0
+        self.observed_edges: Set[Tuple[str, str]] = set()
+        #: inverting pairs already reported: in record-only mode a hot
+        #: inversion repeats every RPC — one violation (with its two
+        #: stacks) per pair, not thousands, and no repeat BFS. The bad
+        #: edge is deliberately NOT added to the graph: that would make
+        #: the LEGITIMATE order read as cycle-closing too.
+        self._known_bad: Set[Tuple[str, str]] = set()
+
+    # -- construction helpers ------------------------------------------
+
+    @classmethod
+    def from_lock_order(cls, path: Optional[str] = None) -> "LockTracker":
+        """Seed from the checked-in ``lint/lock_order.json``."""
+        from dlrover_tpu.lint.racecheck import (
+            DEFAULT_LOCK_ORDER,
+            load_lock_order,
+        )
+
+        data = load_lock_order(path or DEFAULT_LOCK_ORDER)
+        order: Dict[str, Set[str]] = {}
+        for e in (data or {}).get("edges", []):
+            order.setdefault(e["held"], set()).add(e["acquired"])
+        return cls(order)
+
+    def wrap(self, lock, name: str) -> "TrackedLock":
+        return TrackedLock(lock, name, self)
+
+    # -- the held-stack bookkeeping ------------------------------------
+
+    def _stack(self) -> List[Tuple[str, str]]:
+        if not hasattr(self._held, "stack"):
+            self._held.stack = []
+        return self._held.stack
+
+    def _reachable(self, src: str, dst: str) -> Optional[List[str]]:
+        """A path src ⇝ dst in the graph, or None. Called under
+        self._lock."""
+        if src == dst:
+            return [src]
+        seen = {src}
+        frontier = [[src]]
+        while frontier:
+            path = frontier.pop()
+            for nxt in self._graph.get(path[-1], ()):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(path + [nxt])
+        return None
+
+    def note_acquire(self, name: str) -> None:
+        stack_txt = "".join(traceback.format_stack(limit=12)[:-2])
+        held = self._stack()
+        violation: Optional[LockOrderViolation] = None
+        with self._lock:
+            self.acquisitions += 1
+            for held_name, held_stack in held:
+                if held_name == name:
+                    continue  # striped same-id / RLock re-entry
+                edge = (held_name, name)
+                if edge in self.observed_edges or edge in self._known_bad:
+                    continue
+                # would held -> name close a cycle? i.e. does the graph
+                # already know name ⇝ held?
+                back = self._reachable(name, held_name)
+                if back is not None:
+                    self._known_bad.add(edge)
+                    violation = LockOrderViolation(
+                        held_name, name, held_stack, stack_txt,
+                        back + [name],
+                    )
+                    self.violations.append(violation)
+                    break
+                self.observed_edges.add(edge)
+                self._graph.setdefault(held_name, set()).add(name)
+        if violation is not None and self.raise_on_violation:
+            # raising means the caller never acquires: keep the held
+            # stack truthful by not recording the acquisition
+            raise violation
+        held.append((name, stack_txt))
+
+    def note_release(self, name: str) -> None:
+        held = self._stack()
+        # release in any order: pop the NEWEST entry of this name (an
+        # out-of-LIFO release is legal threading, just unusual)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name:
+                del held[i]
+                return
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "acquisitions": self.acquisitions,
+                "observed_edges": sorted(self.observed_edges),
+                "violations": [
+                    {"holding": v.holding, "acquiring": v.acquiring,
+                     "path": v.known_path}
+                    for v in self.violations
+                ],
+            }
+
+
+class TrackedLock:
+    """Order-checking proxy over a ``threading`` lock. Supports the
+    surface the repo's locks actually use: context manager,
+    ``acquire(blocking, timeout)`` / ``release`` / ``locked``."""
+
+    def __init__(self, lock, name: str, tracker: LockTracker):
+        self._lock = lock
+        self.name = name
+        self._tracker = tracker
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # order-check BEFORE blocking: the whole point is to raise where
+        # the would-be deadlock would otherwise hang
+        self._tracker.note_acquire(self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if not ok:
+            self._tracker.note_release(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        self._tracker.note_release(self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"TrackedLock({self.name!r})"
+
+
+# ---------------------------------------------------------------------------
+# process-wide arming
+# ---------------------------------------------------------------------------
+
+_armed: Optional[LockTracker] = None
+_armed_lock = threading.Lock()
+
+
+def install_tracker(tracker: Optional[LockTracker]) -> None:
+    """Arm (or, with None, disarm) the process-wide tracker. Only locks
+    constructed AFTER arming are tracked — the fleet harness arms
+    before booting the master, so every master lock is covered."""
+    global _armed
+    with _armed_lock:
+        _armed = tracker
+
+
+def current_tracker() -> Optional[LockTracker]:
+    global _armed
+    if _armed is not None:
+        return _armed
+    from dlrover_tpu.common import flags
+
+    if not flags.LOCK_TRACKER.get():
+        return None
+    with _armed_lock:
+        if _armed is None:
+            # flag-armed default: seeded from the checked-in graph
+            _armed = LockTracker.from_lock_order()
+        return _armed
+
+
+def maybe_track(lock, name: str):
+    """Hot-path lock constructor hook: the raw lock when disarmed (the
+    default — zero overhead), a :class:`TrackedLock` when armed."""
+    tracker = current_tracker()
+    if tracker is None:
+        return lock
+    return tracker.wrap(lock, name)

@@ -1,0 +1,899 @@
+"""racecheck: whole-repo static concurrency analysis (RC001–RC003).
+
+graftlint checks the AST per file and shardcheck checks the lowered IR;
+this is the third machine-checked invariant layer — the master's
+*thread/lock structure*. 28 modules hold ``threading`` locks today and
+every shipped concurrency bug so far (the JG006 origin bugs, the AIMD
+phase-lock, the shard-state writer drains) was found dynamically, after
+the fact. racecheck makes the lock discipline a checked artifact:
+
+- **lock identity** is ``(module, class, attribute)`` — every
+  assignment whose value constructs ``threading.Lock`` / ``RLock`` /
+  ``Condition`` (directly, through a wrapper call like
+  ``maybe_track(threading.Lock(), ...)``, or in a list comprehension of
+  stripes) names a lock. Identity is type-level, not instance-level:
+  two instances of one class share the id, which is exactly the
+  granularity a lock-ORDER discipline is stated at.
+- **RC001 lock-order-cycle**: "acquires B while holding A" edges come
+  from ``with``-statement nesting plus two same-module call-graph hops
+  (the JG002 technique: ``self.f()`` resolves within the class, bare
+  ``f()`` to module functions). Any cycle in the global acquisition
+  graph is a potential deadlock. The acyclic graph is checked in as
+  ``lint/lock_order.json`` and diffed: a NEW edge — even an acyclic
+  one — fails until ``--fix-lock-order`` re-records it, so the edge
+  shows up in review as a one-line JSON diff and a cycle-closing edge
+  is vetoed before it ships. The same file arms the runtime
+  :class:`~dlrover_tpu.lint.lock_tracker.LockTracker`.
+- **RC002 guarded-by inference**: an attribute written under lock L at
+  two or more sites but written lock-free elsewhere (outside
+  ``__init__``, which runs before the object is published) is a
+  finding — the whole-repo upgrade of JG006's thread-target heuristic.
+  Sites inside thread-target functions are JG006's and are skipped
+  here, so one defect never double-reports (graftlint.md, "division of
+  labor").
+- **RC003 blocking-call-under-lock**: ``sleep``, thread ``join``, file
+  or socket IO, subprocess and RPC sends lexically inside a
+  ``with <lock>:`` block of a hot-path master module (gate, servicer,
+  SpeedMonitor stripes, task-manager heap, rendezvous, the loopback
+  wire). A blocked holder of a hot lock stalls every RPC handler
+  behind it — the exact shape the RequestGate exists to prevent.
+
+Suppression reuses the graftlint comment syntax (``# graftlint:
+disable=RC002 <why>``), and the baseline machinery is shared with
+:mod:`dlrover_tpu.lint.engine` (fingerprints on rule + path + line
+text), in ``lint/racecheck_baseline.json``. CLI:
+``python -m dlrover_tpu.lint --race`` (exit 0 clean / 1 findings or
+graph drift / 2 usage).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.lint.engine import (
+    Severity,
+    SourceFile,
+    Violation,
+    iter_py_files,
+)
+
+#: checked-in acquisition graph (regenerate with --fix-lock-order)
+DEFAULT_LOCK_ORDER = os.path.join(
+    os.path.dirname(__file__), "lock_order.json"
+)
+#: grandfathered racecheck findings (regenerate with --fix-race-baseline)
+DEFAULT_RACE_BASELINE = os.path.join(
+    os.path.dirname(__file__), "racecheck_baseline.json"
+)
+
+LOCK_MAKERS = {"Lock", "RLock", "Condition"}
+
+#: RC003 applies where a blocked lock holder stalls RPC handlers: the
+#: master's request path and the harness wire that stands in for it
+HOT_PATH_SUFFIXES = (
+    "rpc/transport.py",
+    "master/servicer.py",
+    "master/monitor/speed_monitor.py",
+    "master/monitor/hang_watchdog.py",
+    "master/shard/task_manager.py",
+    "master/shard/dataset_manager.py",
+    "master/rendezvous/kv_store.py",
+    "master/rendezvous/sync_service.py",
+    "master/node/job_context.py",
+    "fleet/loopback.py",
+)
+
+RC_RULES = (
+    ("RC001", "lock-order-cycle",
+     "cycle in the global lock-acquisition graph (potential deadlock)"),
+    ("RC002", "unguarded-attr-write",
+     "attribute guarded by a lock at 2+ sites but written lock-free "
+     "elsewhere"),
+    ("RC003", "blocking-call-under-lock",
+     "sleep/join/IO/RPC while holding a hot-path master lock"),
+)
+
+
+# ---------------------------------------------------------------------------
+# the repo lock model
+# ---------------------------------------------------------------------------
+
+
+def _module_name(rel_path: str) -> str:
+    """dlrover_tpu/master/shard/task_manager.py -> master.shard.task_manager
+    (the leading package segment is dropped: ids must survive a repo
+    rename and read short in reports)."""
+    p = rel_path.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x]
+    if parts and parts[0] in ("dlrover_tpu", "."):
+        parts = parts[1:]
+    return ".".join(parts)
+
+
+def _makes_lock(value: ast.AST) -> Optional[str]:
+    """'Lock'/'RLock'/'Condition' when this expression constructs one,
+    looking THROUGH wrapper calls (``maybe_track(threading.Lock())``)
+    and comprehensions (striped lock lists)."""
+    from dlrover_tpu.lint.rules import dotted_name
+
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee in LOCK_MAKERS:
+                return callee
+    return None
+
+
+@dataclasses.dataclass
+class LockDef:
+    lock_id: str  # module.Class.attr | module.name
+    kind: str  # Lock | RLock | Condition
+    path: str
+    line: int
+    striped: bool = False  # a list/dict of locks (subscripted use)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method with its lock-relevant facts."""
+
+    module: str
+    cls: str  # "" for module-level functions
+    name: str
+    node: ast.AST
+    src: SourceFile
+    #: lock ids this function acquires directly (any `with` in the body)
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    #: (callee_class_or_empty, callee_name) same-module calls
+    calls: Set[Tuple[str, str]] = dataclasses.field(default_factory=set)
+
+
+class RepoModel:
+    """Everything RC rules need, built in one pass over the files."""
+
+    def __init__(self):
+        self.locks: Dict[str, LockDef] = {}
+        self.funcs: Dict[Tuple[str, str, str], FuncInfo] = {}
+        self.sources: Dict[str, SourceFile] = {}
+        self.errors: List[str] = []
+        #: method name -> [(module, class)] across the whole tree:
+        #: ``recv.method()`` on a non-self receiver resolves only when
+        #: exactly one class defines the method (unique-name
+        #: resolution — under-approximates, never invents an edge)
+        self.method_index: Dict[str, List[Tuple[str, str]]] = {}
+        #: (module, class) -> same-module base-class names (virtual
+        #: dispatch: ``self.m()`` in a base can run a subclass override)
+        self.class_bases: Dict[Tuple[str, str], Set[str]] = {}
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def build(cls, paths: Sequence[str]) -> "RepoModel":
+        model = cls()
+        for full, display in iter_py_files(paths):
+            try:
+                with open(full, encoding="utf-8") as f:
+                    text = f.read()
+                src = SourceFile(full, text, rel_path=display)
+            except (OSError, SyntaxError, ValueError) as e:
+                model.errors.append(f"{display}: unparsable: {e}")
+                continue
+            model.sources[src.rel_path] = src
+            model._scan_file(src)
+        model._resolve_acquires()
+        return model
+
+    def _scan_file(self, src: SourceFile):
+        from dlrover_tpu.lint.rules import dotted_name
+
+        module = _module_name(src.rel_path)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                self.class_bases[(module, node.name)] = {
+                    dotted_name(b).rsplit(".", 1)[-1] for b in node.bases
+                }
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls_name = self._enclosing_class(node)
+                if self._enclosing_func(node) is not None:
+                    continue  # nested defs are not call targets here
+                info = FuncInfo(module, cls_name, node.name, node, src)
+                self._scan_func(info)
+                self.funcs[(module, cls_name, node.name)] = info
+                if cls_name:
+                    self.method_index.setdefault(node.name, []).append(
+                        (module, cls_name)
+                    )
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                self._scan_lock_assign(src, module, node)
+
+    @staticmethod
+    def _enclosing_class(node: ast.AST, through_funcs: bool = False) -> str:
+        """Nearest ClassDef name. ``through_funcs`` looks past enclosing
+        functions (the ``self._lock = ...`` inside ``__init__`` case);
+        without it a def inside a function reads as module-level."""
+        from dlrover_tpu.lint.rules import ancestors
+
+        for a in ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a.name
+            if not through_funcs and isinstance(
+                a, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                return ""
+        return ""
+
+    @staticmethod
+    def _enclosing_func(node: ast.AST):
+        from dlrover_tpu.lint.rules import enclosing_function
+
+        return enclosing_function(node)
+
+    def _scan_lock_assign(self, src: SourceFile, module: str, node):
+        value = node.value if node.value is not None else None
+        if value is None:
+            return
+        kind = _makes_lock(value)
+        if kind is None:
+            return
+        striped = isinstance(value, (ast.ListComp, ast.List, ast.DictComp))
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            lock_id = None
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                cls_name = self._enclosing_class(node, through_funcs=True)
+                if cls_name:
+                    lock_id = f"{module}.{cls_name}.{t.attr}"
+            elif isinstance(t, ast.Name):
+                if self._enclosing_func(node) is not None:
+                    continue  # a local lock: no stable identity
+                cls_name = self._enclosing_class(node)
+                owner = f"{module}.{cls_name}" if cls_name else module
+                lock_id = f"{owner}.{t.id}"
+            if lock_id and lock_id not in self.locks:
+                self.locks[lock_id] = LockDef(
+                    lock_id, kind, src.rel_path,
+                    getattr(node, "lineno", 1), striped,
+                )
+
+    @staticmethod
+    def _call_target(info: FuncInfo, node: ast.Call) -> Optional[Tuple[str, str]]:
+        """(resolution, name): ``(cls, m)`` for ``self.m()``, ``("", f)``
+        for bare ``f()``, ``("*", m)`` for a method on any other
+        receiver (subscripts included) — resolved later by unique
+        method name across the tree."""
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                return (info.cls, node.func.attr)
+            return ("*", node.func.attr)
+        if isinstance(node.func, ast.Name):
+            return ("", node.func.id)
+        return None
+
+    def _scan_func(self, info: FuncInfo):
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call):
+                call = self._call_target(info, node)
+                if call is not None:
+                    info.calls.add(call)
+
+    def _resolve_acquires(self):
+        for info in self.funcs.values():
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lid = self.resolve_lock(info, item.context_expr)
+                        if lid:
+                            info.acquires.add(lid)
+
+    # -- lock-expression resolution --------------------------------------
+
+    def resolve_lock(self, info: FuncInfo, expr: ast.AST) -> Optional[str]:
+        """Lock id for a ``with``-item expression, or None when it is
+        not a known lock (locals, non-lock context managers)."""
+        # strip subscripts: self._locks[i] -> self._locks (striped)
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and info.cls
+        ):
+            # the declaring class owns the id: walk same-module bases so
+            # a subclass's `with self._lock:` maps to the inherited lock
+            cls = info.cls
+            seen = set()
+            while cls and cls not in seen:
+                seen.add(cls)
+                lid = f"{info.module}.{cls}.{expr.attr}"
+                if lid in self.locks:
+                    return lid
+                bases = self.class_bases.get((info.module, cls), set())
+                cls = next(iter(sorted(bases)), "")
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            # ClassName._class_lock style (singleton guards)
+            lid = f"{info.module}.{expr.value.id}.{expr.attr}"
+            return lid if lid in self.locks else None
+        if isinstance(expr, ast.Name):
+            lid = f"{info.module}.{expr.id}"
+            return lid if lid in self.locks else None
+        return None
+
+    def _subclasses(self, module: str, cls: str) -> Set[str]:
+        """``cls`` plus its same-module (transitive) subclasses."""
+        out = {cls}
+        changed = True
+        while changed:
+            changed = False
+            for (m, c), bases in self.class_bases.items():
+                if m == module and c not in out and bases & out:
+                    out.add(c)
+                    changed = True
+        return out
+
+    def callees(self, info: FuncInfo, call: Tuple[str, str]) -> List[FuncInfo]:
+        """Possible targets of one call: the module function for bare
+        names, the virtual-dispatch set (class + same-module
+        subclasses defining the method) for ``self.m()``, the
+        unique-name owner for any other receiver."""
+        cls_name, name = call
+        if cls_name == "*":
+            owners = self.method_index.get(name, [])
+            if len(owners) != 1:
+                return []  # ambiguous or unknown: no edge invented
+            module, cls = owners[0]
+            g = self.funcs.get((module, cls, name))
+            return [g] if g else []
+        if cls_name:
+            out = []
+            for c in self._subclasses(info.module, cls_name):
+                g = self.funcs.get((info.module, c, name))
+                if g is not None:
+                    out.append(g)
+            return out
+        g = self.funcs.get((info.module, "", name))
+        return [g] if g else []
+
+    def callee(self, info: FuncInfo, call: Tuple[str, str]):
+        targets = self.callees(info, call)
+        return targets[0] if len(targets) == 1 else None
+
+    def reachable_acquires(self, info: FuncInfo, hops: int = 2) -> Set[str]:
+        """Locks acquired by ``info`` or by resolvable callees within
+        ``hops`` call-graph hops (the JG002 technique)."""
+        out: Set[str] = set(info.acquires)
+        frontier = [info]
+        for _ in range(hops):
+            nxt = []
+            for f in frontier:
+                for call in f.calls:
+                    for g in self.callees(f, call):
+                        if g is not info:
+                            out |= g.acquires
+                            nxt.append(g)
+            frontier = nxt
+        return out
+
+
+# ---------------------------------------------------------------------------
+# RC001 — lock-order cycles + the checked-in graph
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    held: str
+    acquired: str
+    path: str
+    line: int
+    via: str  # "nested" | "call:<func>"
+
+    def key(self) -> Tuple[str, str]:
+        return (self.held, self.acquired)
+
+
+def extract_edges(model: RepoModel) -> List[Edge]:
+    """Every "acquires ``acquired`` while holding ``held``" edge in the
+    repo: ``with`` nesting first, then calls made inside a ``with``
+    block resolved two same-module hops deep."""
+    from dlrover_tpu.lint.rules import ancestors
+
+    edges: Dict[Tuple[str, str, str], Edge] = {}
+
+    def add(held, acquired, src, node, via):
+        if held == acquired:
+            # same-id re-entry: legal for RLock stripes and striped
+            # lists (different instances); a true self-deadlock on one
+            # Lock instance is the runtime tracker's to catch
+            return
+        e = Edge(held, acquired, src.rel_path,
+                 getattr(node, "lineno", 1), via)
+        edges.setdefault((held, acquired, via), e)
+
+    for info in model.funcs.values():
+        for node in ast.walk(info.node):
+            held = []
+            for a in ancestors(node):
+                if a is info.node:
+                    break
+                if isinstance(a, ast.With):
+                    for item in a.items:
+                        lid = model.resolve_lock(info, item.context_expr)
+                        if lid:
+                            held.append(lid)
+            if not held:
+                continue
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = model.resolve_lock(info, item.context_expr)
+                    if lid:
+                        for h in held:
+                            add(h, lid, info.src, node, "nested")
+            elif isinstance(node, ast.Call):
+                call = RepoModel._call_target(info, node)
+                if call is None:
+                    continue
+                for g in model.callees(info, call):
+                    for lid in model.reachable_acquires(g, hops=1):
+                        for h in held:
+                            add(h, lid, info.src, node, f"call:{call[1]}")
+    return sorted(edges.values(), key=lambda e: (e.held, e.acquired, e.via))
+
+
+def find_cycles(edges: Iterable[Edge]) -> List[List[str]]:
+    """Elementary cycles in the acquisition graph (DFS with a path
+    stack; the graph is tiny). Each cycle is the lock-id path with the
+    start repeated at the end."""
+    graph: Dict[str, Set[str]] = {}
+    for e in edges:
+        graph.setdefault(e.held, set()).add(e.acquired)
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], on_path: Set[str]):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cyc = path + [start]
+                # canonical form: rotate so the smallest id leads
+                body = cyc[:-1]
+                i = body.index(min(body))
+                canon = tuple(body[i:] + body[:i])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(cyc)
+            elif nxt not in on_path and nxt > start:
+                # nodes < start were exhausted as starts already
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def load_lock_order(path: str) -> Optional[Dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return None
+    if not isinstance(data, dict) or "edges" not in data:
+        raise ValueError(f"{path}: not a racecheck lock-order file")
+    return data
+
+
+def write_lock_order(
+    path: str, model: RepoModel, edges: Sequence[Edge]
+) -> Dict:
+    data = {
+        "comment": (
+            "racecheck RC001 acquisition graph: every 'acquires B while "
+            "holding A' edge in the tree, by (module, class, attribute) "
+            "lock identity. CI diffs this file, so a new edge — even an "
+            "acyclic one — lands as a reviewable one-line diff, and the "
+            "runtime LockTracker raises on any acquisition that "
+            "contradicts it. Regenerate with: python -m dlrover_tpu.lint "
+            "--race --fix-lock-order dlrover_tpu/"
+        ),
+        "version": 1,
+        "locks": {
+            lid: {"kind": d.kind, "path": d.path, "line": d.line,
+                  "striped": d.striped}
+            for lid, d in sorted(model.locks.items())
+        },
+        "edges": [
+            {"held": e.held, "acquired": e.acquired, "via": e.via,
+             "site": f"{e.path}:{e.line}"}
+            for e in edges
+        ],
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# the three rules
+# ---------------------------------------------------------------------------
+
+
+def _violation(
+    src: SourceFile, rule: str, node_or_line, message: str
+) -> Optional[Violation]:
+    line = (
+        node_or_line
+        if isinstance(node_or_line, int)
+        else getattr(node_or_line, "lineno", 1)
+    )
+    if src.suppressed(rule, line):
+        return None
+    return Violation(
+        rule=rule,
+        path=src.rel_path,
+        line=line,
+        col=0,
+        message=message,
+        snippet=src.snippet_at(line),
+        severity=Severity.ERROR,
+    )
+
+
+def check_rc001(
+    model: RepoModel,
+    edges: Sequence[Edge],
+    checked_in: Optional[Dict],
+) -> Tuple[List[Violation], List[str]]:
+    """(violations, graph-drift messages). Cycles are violations at a
+    participating edge's site; drift (edges added/removed vs the
+    checked-in graph) is reported separately — it fails the run but is
+    fixed by --fix-lock-order, not by a suppression."""
+    violations: List[Violation] = []
+    by_key: Dict[Tuple[str, str], Edge] = {}
+    for e in edges:
+        by_key.setdefault(e.key(), e)
+    for cyc in find_cycles(edges):
+        first = by_key.get((cyc[0], cyc[1]))
+        src = model.sources.get(first.path) if first else None
+        msg = (
+            "lock-order cycle (potential deadlock): "
+            + " -> ".join(cyc)
+            + ". Two threads taking these locks in program order can "
+            "each hold one and wait on the other. Restructure so every "
+            "path acquires them in one global order (or drop to a "
+            "single lock)."
+        )
+        if src is not None:
+            v = _violation(src, "RC001", first.line, msg)
+            if v is not None:
+                violations.append(v)
+        else:
+            violations.append(Violation(
+                "RC001", first.path if first else "<graph>",
+                first.line if first else 1, 0, msg, "",
+            ))
+    drift: List[str] = []
+    if checked_in is None:
+        drift.append(
+            "no checked-in lock_order.json — the RC001 diff gate has "
+            "nothing to diff against; generate it with "
+            "--race --fix-lock-order"
+        )
+    else:
+        want = {(d["held"], d["acquired"]) for d in checked_in["edges"]}
+        got = {e.key() for e in edges}
+        for held, acquired in sorted(got - want):
+            e = by_key[(held, acquired)]
+            drift.append(
+                f"{e.path}:{e.line}: RC001 new acquisition edge "
+                f"{held} -> {acquired} (via {e.via}) is not in the "
+                "checked-in lock_order.json — if the order is "
+                "intentional and acyclic, record it with "
+                "--fix-lock-order so the diff is reviewed"
+            )
+        for held, acquired in sorted(want - got):
+            drift.append(
+                f"lock_order.json: stale edge {held} -> {acquired} no "
+                "longer exists in the tree — run --fix-lock-order to "
+                "shrink the graph"
+            )
+    return violations, drift
+
+
+def _lexically_locked(model: RepoModel, info: FuncInfo, node) -> bool:
+    """Is ``node`` inside a ``with <lock>:`` block of ``info``? Resolved
+    lock ids count, and so do lock-ish names JG006-style (a lock passed
+    in as an argument still guards)."""
+    from dlrover_tpu.lint.rules import ancestors, dotted_name
+
+    for a in ancestors(node):
+        if a is info.node:
+            break
+        if isinstance(a, ast.With):
+            for item in a.items:
+                d = dotted_name(item.context_expr)
+                if (
+                    model.resolve_lock(info, item.context_expr)
+                    or "lock" in d.lower()
+                    or "cond" in d.lower()
+                ):
+                    return True
+    return False
+
+
+def lock_context_only(model: RepoModel) -> Set[Tuple[str, str, str]]:
+    """Functions that only ever run with a lock held: every resolved
+    call site is lexically inside a locked region, or inside another
+    lock-context-only function (fixed point — the ``get_task`` →
+    ``_refill_locked`` → ``_create_tasks_from_shards`` chain). Writes
+    in them are guarded *via the caller*, which a purely lexical rule
+    would misreport."""
+    # call sites: target key -> [(caller key, lexically locked)]
+    callsites: Dict[Tuple[str, str, str], List[Tuple[Tuple, bool]]] = {}
+    for key, info in model.funcs.items():
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            call = RepoModel._call_target(info, node)
+            if call is None:
+                continue
+            locked = _lexically_locked(model, info, node)
+            for g in model.callees(info, call):
+                gkey = (g.module, g.cls, g.name)
+                if gkey != key:
+                    callsites.setdefault(gkey, []).append((key, locked))
+    only: Set[Tuple[str, str, str]] = set()
+    for _ in range(3):  # bounded fixed point (call chains are shallow)
+        nxt = {
+            key
+            for key, sites in callsites.items()
+            if sites
+            and all(locked or caller in only for caller, locked in sites)
+        }
+        if nxt == only:
+            break
+        only = nxt
+    return only
+
+
+def check_rc002(model: RepoModel) -> List[Violation]:
+    """Guarded-by inference per (module, class, attribute): 2+ write
+    sites under a lock and any lock-free write site elsewhere (outside
+    ``__init__``/``__new__``, outside thread-target functions — JG006's
+    beat — and outside functions only ever called with a lock held)
+    flags the lock-free sites."""
+    from dlrover_tpu.lint.rules import UnguardedSharedMutationRule
+
+    guarded_via_caller = lock_context_only(model)
+    # write sites: (module, cls, attr) -> list of (guarded, src, node)
+    sites: Dict[Tuple[str, str, str], List] = {}
+    jg006 = UnguardedSharedMutationRule()
+    thread_fns: Set[int] = set()
+    for src in model.sources.values():
+        thread_fns |= {id(fn) for fn in jg006._thread_targets(src)}
+    for key, info in model.funcs.items():
+        if info.name in ("__init__", "__new__") or not info.cls:
+            continue
+        in_thread_target = id(info.node) in thread_fns
+        for node in ast.walk(info.node):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                guarded = (
+                    _lexically_locked(model, info, node)
+                    or key in guarded_via_caller
+                )
+                sites.setdefault(
+                    (info.module, info.cls, t.attr), []
+                ).append((guarded, info.src, node, in_thread_target))
+    out: List[Violation] = []
+    for (module, cls_name, attr), entries in sorted(
+        sites.items(), key=lambda kv: str(kv[0])
+    ):
+        n_guarded = sum(1 for g, *_ in entries if g)
+        if n_guarded < 2:
+            continue
+        for guarded, src, node, in_thread_target in entries:
+            if guarded or in_thread_target:
+                continue  # thread-target sites are JG006's report
+            v = _violation(
+                src, "RC002", node,
+                f"self.{attr} is written under a lock at {n_guarded} "
+                f"site(s) in {cls_name} but lock-free here: either this "
+                "write races the guarded ones, or the attribute is not "
+                "actually shared — guard it, or suppress with why the "
+                "lock-free write is safe (single-threaded phase, "
+                "pre-publication, etc.).",
+            )
+            if v is not None:
+                out.append(v)
+    return out
+
+
+#: RC003's blocking-call set: calls that park the thread while every
+#: other handler queues behind the held lock
+RC003_CALLEES = {
+    "time.sleep", "sleep", "os.system", "os.replace", "open",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "socket.create_connection", "urlopen",
+}
+RC003_METHODS = {"join", "sleep", "recv", "send", "sendall", "connect",
+                 "fsync", "flush"}
+#: RPC-send methods on client-ish receivers (a lock held across a
+#: network round trip is the worst case)
+RC003_RPC_METHODS = {"get", "report"}
+RC003_RPC_RECEIVERS = ("client", "stub", "channel")
+
+
+def check_rc003(model: RepoModel) -> List[Violation]:
+    from dlrover_tpu.lint.rules import ancestors, dotted_name
+
+    out: List[Violation] = []
+    for info in model.funcs.values():
+        if not info.src.rel_path.replace(os.sep, "/").endswith(
+            HOT_PATH_SUFFIXES
+        ):
+            continue
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            held = None
+            for a in ancestors(node):
+                if a is info.node:
+                    break
+                if isinstance(a, ast.With):
+                    for item in a.items:
+                        lid = model.resolve_lock(info, item.context_expr)
+                        if lid:
+                            held = lid
+            if held is None:
+                continue
+            d = dotted_name(node.func)
+            hit = None
+            if d in RC003_CALLEES:
+                hit = d
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                recv = dotted_name(node.func.value).rsplit(".", 1)[-1]
+                if attr in RC003_METHODS:
+                    hit = f".{attr}()"
+                elif attr in RC003_RPC_METHODS and any(
+                    r in recv.lower() for r in RC003_RPC_RECEIVERS
+                ):
+                    hit = f"{recv}.{attr}() [RPC]"
+            if hit is None:
+                continue
+            v = _violation(
+                info.src, "RC003", node,
+                f"blocking call {hit} while holding hot-path lock "
+                f"{held}: every RPC handler needing that lock parks "
+                "behind this call. Move the blocking work outside the "
+                "critical section (snapshot under the lock, block "
+                "after), or suppress with why the hold is bounded.",
+            )
+            if v is not None:
+                out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# one-call entry (CLI and the tier-1 test share it)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RaceResult:
+    violations: List[Violation]
+    fresh: List[Violation]
+    stale_fingerprints: List[str]
+    drift: List[str]
+    errors: List[str]
+    edges: List[Edge]
+    model: RepoModel
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.fresh or self.drift or self.errors)
+
+
+def run(
+    paths: Sequence[str],
+    lock_order_path: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    fix_lock_order: bool = False,
+    fix_baseline: bool = False,
+) -> RaceResult:
+    from dlrover_tpu.lint import engine
+
+    lock_order_path = lock_order_path or DEFAULT_LOCK_ORDER
+    baseline_path = baseline_path or DEFAULT_RACE_BASELINE
+    model = RepoModel.build(paths)
+    edges = extract_edges(model)
+    # a cyclic graph is never a recordable artifact: --fix-lock-order
+    # must not seed the runtime tracker with a deadlock, and
+    # --fix-race-baseline must not grandfather one — refuse BEFORE any
+    # write, so an ignored exit-1 fix run cannot bless the cycle
+    cyclic = bool(find_cycles(edges))
+    if fix_lock_order and not cyclic:
+        write_lock_order(lock_order_path, model, edges)
+    checked_in = load_lock_order(lock_order_path)
+    v1, drift = check_rc001(model, edges, checked_in)
+    violations = sorted(
+        v1 + check_rc002(model) + check_rc003(model),
+        key=lambda v: (v.path, v.line, v.rule),
+    )
+    if fix_baseline:
+        if not cyclic:
+            engine.write_baseline(
+                baseline_path,
+                # RC001 never enters the baseline even cycle-free:
+                # order problems are fixed or recorded in the graph,
+                # not grandfathered
+                [v for v in violations if v.rule != "RC001"],
+                regen_hint="--race --fix-race-baseline",
+            )
+        return RaceResult(
+            violations, [], [], drift, model.errors, edges, model
+        )
+    baseline = engine.load_baseline(baseline_path)
+    fresh, stale = engine.apply_baseline(violations, baseline)
+    return RaceResult(
+        violations, fresh, stale, drift, model.errors, edges, model
+    )
+
+
+def report(result: RaceResult, out=None) -> None:
+    import sys
+
+    out = out or sys.stdout
+    for v in result.fresh:
+        print(v.format(), file=out)
+    for d in result.drift:
+        print(d, file=out)
+    for e in result.errors:
+        print(f"ERROR {e}", file=out)
+    if result.stale_fingerprints:
+        print(
+            f"note: {len(result.stale_fingerprints)} racecheck baseline "
+            "entr"
+            f"{'y is' if len(result.stale_fingerprints) == 1 else 'ies are'}"
+            " stale — run --race --fix-race-baseline to shrink it",
+            file=out,
+        )
+    n_base = len(result.violations) - len(result.fresh)
+    print(
+        f"racecheck: {len(result.fresh)} new, {n_base} baselined, "
+        f"{len(result.drift)} graph drift(s), {len(result.errors)} "
+        f"errors over {len(result.model.locks)} lock(s), "
+        f"{len(result.edges)} edge(s)",
+        file=out,
+    )

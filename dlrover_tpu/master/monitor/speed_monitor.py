@@ -40,7 +40,18 @@ class _StripedRankLedger:
     STRIPES = 16
 
     def __init__(self):
-        self._locks = [threading.Lock() for _ in range(self.STRIPES)]
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        # every stripe carries the same tracked id: stripe-to-stripe
+        # nesting is legal by the striping contract (never nested), and
+        # the type-level lock identity matches lock_order.json
+        self._locks = [
+            maybe_track(
+                threading.Lock(),
+                "master.monitor.speed_monitor._StripedRankLedger._locks",
+            )
+            for _ in range(self.STRIPES)
+        ]
         self._stripes = [
             {
                 "digest": {},        # node -> last window
@@ -154,7 +165,12 @@ class SpeedMonitor:
         # virtual clock through the real wire and get a deterministic
         # verdict
         self._clock = clock or time.time
-        self._lock = threading.Lock()
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.monitor.speed_monitor.SpeedMonitor._lock",
+        )
         self._samples: List[GlobalStepRecord] = []
         self._sample_window = sample_window
         self._start_training_time: float = 0.0
@@ -199,7 +215,10 @@ class SpeedMonitor:
         # signal: the newest step report or step-carrying digest.
         self._hang_s: float = 0.0
         self._last_progress_ts: float = 0.0
-        self._progress_lock = threading.Lock()
+        self._progress_lock = maybe_track(
+            threading.Lock(),
+            "master.monitor.speed_monitor.SpeedMonitor._progress_lock",
+        )
         self.straggler_detector = StragglerDetector()
         # master-side span buffer for the job timeline: closed downtime
         # brackets as (start, end) epoch pairs (bounded)

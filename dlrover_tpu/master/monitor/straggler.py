@@ -62,7 +62,12 @@ class StragglerDetector:
             int(windows) if windows is not None
             else max(1, int(flags.STRAGGLER_WINDOWS.get()))
         )
-        self._lock = threading.Lock()
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.monitor.straggler.StragglerDetector._lock",
+        )
         self._latest_p50: Dict[int, float] = {}
         self._strikes: Dict[int, int] = {}
         self._flagged: Dict[int, StragglerRecord] = {}

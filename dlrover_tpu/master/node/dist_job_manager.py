@@ -81,7 +81,12 @@ class DistributedJobManager(JobManager):
         # override / runtime-tunable context may change it live)
         self._evictor = HeartbeatEvictor(self._heartbeat_timeout)
         self._start_ts = 0.0
-        self._lock = threading.RLock()
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        self._lock = maybe_track(
+            threading.RLock(),
+            "master.node.dist_job_manager.DistributedJobManager._lock",
+        )
         #: set when a node dies unrecoverably → drives early stop
         self._unrecoverable: Tuple[str, str] = ("", "")
         #: pluggable observers (reference event_callback.py:1-348); the

@@ -19,8 +19,13 @@ class DiagnosisActionQueue:
     """Per-instance queues of pending diagnosis actions with expiry."""
 
     def __init__(self):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self._actions: Dict[int, List[DiagnosisAction]] = {}
-        self._lock = threading.Lock()
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.node.job_context.DiagnosisActionQueue._lock",
+        )
 
     def add_action(self, action: DiagnosisAction):
         with self._lock:
@@ -60,8 +65,13 @@ class JobContext:
     _instance_lock = threading.Lock()
 
     def __init__(self):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self._nodes: Dict[str, Dict[int, Node]] = {}
-        self._lock = threading.RLock()
+        self._lock = maybe_track(
+            threading.RLock(),
+            "master.node.job_context.JobContext._lock",
+        )
         self._action_queue = DiagnosisActionQueue()
         self._failed_locating: set = set()
         self.job_stage: str = ""

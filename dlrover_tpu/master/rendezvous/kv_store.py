@@ -14,8 +14,13 @@ from typing import Dict, List
 
 class KVStoreService:
     def __init__(self):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self._store: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.rendezvous.kv_store.KVStoreService._lock",
+        )
 
     def set(self, key: str, value: bytes):
         with self._lock:

@@ -52,7 +52,11 @@ class RendezvousParameters:
 class RendezvousManager(ABC):
     def __init__(self, name: str, clock=None):
         self.name = name
-        self._lock = Lock()
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        self._lock = maybe_track(
+            Lock(), "master.rendezvous.manager.RendezvousManager._lock"
+        )
         # injectable "now": the waiting-timeout completion path and the
         # join stamps must share the clock that drives the job (the
         # fleet harness forms rounds in virtual time; wall time there

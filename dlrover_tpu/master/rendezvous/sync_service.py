@@ -8,9 +8,14 @@ from typing import Dict, Set
 
 class SyncService:
     def __init__(self, job_context=None):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self._sync_objs: Dict[str, Set[int]] = {}
         self._finished: Set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.rendezvous.sync_service.SyncService._lock",
+        )
         self._job_context = job_context
 
     def _required_ranks(self) -> Set[int]:

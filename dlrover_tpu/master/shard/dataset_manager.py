@@ -208,7 +208,12 @@ class BatchDatasetManager:
         self._deadlines: List[Tuple[float, int, int]] = []
         self._task_id_seq = 0
         self._completed_records = 0
-        self._lock = threading.Lock()
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.shard.dataset_manager.BatchDatasetManager._lock",
+        )
 
     @property
     def dataset_name(self) -> str:

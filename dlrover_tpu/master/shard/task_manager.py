@@ -36,9 +36,14 @@ class TaskManager:
         clock=None,
         lease_ttl: Optional[float] = None,
     ):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self._datasets: Dict[str, BatchDatasetManager] = {}
         self._params: Dict[str, DatasetShardParams] = {}
-        self._lock = threading.Lock()
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.shard.task_manager.TaskManager._lock",
+        )
         self._worker_restart_timeout = worker_restart_timeout
         self._speed_monitor = speed_monitor
         #: durable write-through target (master relaunch continuity);

@@ -61,8 +61,13 @@ class MemoryStateBackend(MasterStateBackend):
     master — fine for LocalJobMaster and tests."""
 
     def __init__(self):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self._data: Dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.state_store.MemoryStateBackend._lock",
+        )
 
     def get(self, key: str) -> Optional[str]:
         with self._lock:
@@ -114,8 +119,13 @@ class FileStateBackend(MasterStateBackend):
     same key from interleaving."""
 
     def __init__(self, root: str):
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
         self._root = root
-        self._lock = threading.Lock()
+        self._lock = maybe_track(
+            threading.Lock(),
+            "master.state_store.FileStateBackend._lock",
+        )
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:

@@ -103,7 +103,11 @@ class RequestGate:
         # clock for the shed-recency ledger (injectable: the fleet
         # harness stamps sheds in virtual time)
         self.clock = time.time
-        self._lock = threading.Lock()
+        from dlrover_tpu.lint.lock_tracker import maybe_track
+
+        self._lock = maybe_track(
+            threading.Lock(), "rpc.transport.RequestGate._lock"
+        )
         self._inflight = 0
         self._inflight_reports = 0
         self._peak = 0

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# racecheck, from anywhere in the repo: whole-repo lock-order +
+# guarded-by analysis against the checked-in acquisition graph
+# (dlrover_tpu/lint/lock_order.json) and baseline. Exit 1 on any new
+# finding, cycle, or graph drift — same gate as tier-1 and CI.
+#
+#   scripts/racecheck.sh                   # check
+#   scripts/racecheck.sh --fix-lock-order  # record a REVIEWED new edge
+set -euo pipefail
+cd "$(dirname "$0")/.."   # sites embed repo-relative paths
+exec python -m dlrover_tpu.lint --race "$@" dlrover_tpu/

@@ -34,6 +34,7 @@ def test_builtin_scenarios_load():
     for name in (
         "headline_1k", "overload_10x", "smoke",
         "shard_storm_1k", "shard_storm_smoke", "seated_hang",
+        "perturbed_smoke",
     ):
         sc = load_scenario(name)
         assert sc.nodes > 0 and sc.duration_vs > 0
