@@ -359,6 +359,118 @@ def _zero1_hbm_compare_legs(jax, llama) -> dict:
     return out
 
 
+def _bench_multislice(jax, jnp, llama) -> dict:
+    """Multislice leg: the hierarchical DCN-aware gradient reduction
+    (ops/hier_collectives.py) vs the flat collective, on VIRTUAL slices
+    — the full CPU/TPU device world built slice-major as 2 slices
+    (``build_mesh(n_slices=2)``), so the strategy, the per-link SC001
+    census and the comm ledger's ici/dcn split all exercise for real
+    with no multislice hardware. Per leg: a few timed steps, the
+    per-link census (``dcn_bytes`` from the modeled slow-link
+    accounting, lint/shardcheck.py) and the analytic ledger's
+    bytes/step per link class; the contract test pins the hier leg's
+    ledger DCN bytes at 1/dp_in of the flat leg's.
+
+    The legs are decided by the TrainConfig knob alone — an exported
+    ``DLROVER_TPU_HIER_COLLECTIVES`` would otherwise override both legs
+    to the same program (same reasoning as the zero-1 compare)."""
+    from dlrover_tpu.common import flags
+
+    with flags.HIER_COLLECTIVES.scoped(None), flags.ZERO1.scoped(None):
+        return _bench_multislice_legs(jax, jnp, llama)
+
+
+def _bench_multislice_legs(jax, jnp, llama) -> dict:
+    import numpy as np
+
+    from dlrover_tpu.lint import shardcheck
+    from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+    from dlrover_tpu.profiler.comm import comm_ledger
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    world = len(jax.devices())
+    n_slices = 2
+    if world < 4 or world % n_slices:
+        return {"skipped": f"needs >= 4 devices in {n_slices} even "
+                           f"slices (have {world})"}
+    cfg = llama.LlamaConfig.tiny()
+    specs = llama.param_specs(cfg)
+    mc = MeshConfig(dp=-1).resolve(world)
+    mesh = build_mesh(mc, devices=jax.devices()[:world],
+                      n_slices=n_slices)
+    seq, micro, steps = 64, 2, 3
+    out = {"world": world, "n_slices": n_slices, "model": "llama_tiny",
+           "seq": seq, "micro_batch": micro}
+    losses = {}
+    for leg in ("flat", "hier"):
+        tc = TrainConfig(
+            global_batch_size=micro * mc.data_parallel_size,
+            micro_batch_size=micro, warmup_steps=0, total_steps=100,
+            hier_collectives=(leg == "hier"),
+        )
+        tr = ElasticTrainer(
+            None, specs, mesh, mc, tc,
+            loss_factory=lambda m: (lambda p, t: llama.loss_fn(p, t, cfg, m)),
+            n_slices=n_slices,
+        )
+        params = jax.device_put(
+            llama.init_params(cfg, jax.random.key(0)),
+            named_shardings(mesh, specs),
+        )
+        state = tr.init_state(params)
+        a, b = tr.step_batch_shape
+        leg_losses = []
+        for i in range(steps + 1):
+            batch = np.asarray(jax.random.randint(
+                jax.random.key(1000 + i), (a, b, seq), 0, cfg.vocab_size
+            ))
+            if i == 1:  # step 0 is the compile
+                t0 = time.perf_counter()
+            state, loss = tr.step(state, batch)
+            if i > 0:
+                leg_losses.append(float(loss))
+        jax.block_until_ready(loss)
+        step_s = (time.perf_counter() - t0) / steps
+        losses[leg] = leg_losses
+        leg_out = {
+            "mode": tr._hier_mode(mesh),
+            "step_time_s": round(step_s, 4),
+            # analytic per-link bytes/step (profiler/comm.py): what
+            # /metrics' dlrover_tpu_comm_bytes_total{link=...} exports
+            "ledger_link_bytes": comm_ledger.link_bytes(),
+        }
+        try:
+            program = tr.step_ir()
+            census = shardcheck.collective_census(
+                program.hlo, program.coords()
+            )
+            leg_out["census_dcn_bytes"] = \
+                shardcheck.census_dcn_bytes(census)
+            leg_out["census_dp_cells"] = {
+                k: c for k, c in sorted(census.items())
+                if k.split("|")[1] == "dp"
+            }
+            leg_out["contract_spec"] = tr._contract_spec(mesh)
+        except Exception as e:
+            leg_out["census_error"] = str(e)[:200]
+        out[leg] = leg_out
+        _release(jax, state, params)
+        del tr, state, params
+    if losses.get("flat") and losses.get("hier"):
+        # the fast path is the same math: per-step loss parity between
+        # the flat and hierarchical reductions
+        out["max_loss_delta"] = max(
+            abs(a - b) for a, b in zip(losses["flat"], losses["hier"])
+        )
+    flat_dcn = out.get("flat", {}).get(
+        "ledger_link_bytes", {}).get("dcn", 0)
+    hier_dcn = out.get("hier", {}).get(
+        "ledger_link_bytes", {}).get("dcn", 0)
+    if flat_dcn:
+        out["dcn_bytes_ratio"] = round(hier_dcn / flat_dcn, 4)
+    return out
+
+
 def _bench_ckpt_dedup(jax, jnp, llama) -> dict:
     """Replica-deduplicated persist + tiered restore legs of the ckpt
     phase (checkpoint/ownership.py, docs/design/checkpoint_tiers.md).
@@ -489,7 +601,7 @@ LAST_TPU_RESULT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
 )
 
-KNOWN_PHASES = ("mfu", "ckpt", "interposer", "resize")
+KNOWN_PHASES = ("mfu", "ckpt", "interposer", "resize", "multislice")
 
 
 def _requested_phases() -> set:
@@ -1167,6 +1279,19 @@ def main():
         detail["resize"] = rz
         if "error" not in rz:
             detail["phases_done"].append("resize")
+
+    # ---- multislice leg: hierarchical vs flat DCN collectives ----------
+    # (ops/hier_collectives.py) on 2 VIRTUAL slices over the full
+    # device world — per-link census + step time into the trajectory,
+    # so the slow-link bytes claim is a measured number every round.
+    if "multislice" in phases:
+        try:
+            ms = _bench_multislice(jax, jnp, llama)
+        except Exception as e:  # keep the already-persisted headline
+            ms = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+        detail["multislice"] = ms
+        if "error" not in ms and "skipped" not in ms:
+            detail["phases_done"].append("multislice")
 
     # ---- goodput self-accounting: where did the bench's wall time go? --
     # The same category vocabulary as the master's attribution

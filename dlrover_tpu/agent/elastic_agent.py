@@ -375,8 +375,7 @@ class ElasticAgent:
     # -- workers ------------------------------------------------------------
 
     def _worker_env(self, world: CommWorld, local_rank: int) -> Dict[str, str]:
-        env = dict(os.environ)
-        env.update(self._config.env)
+        env = flags.child_env(self._config.env)
         if self._config.ckpt_replica:
             env["DLROVER_TPU_CKPT_REPLICA"] = "1"
         if self._config.compile_cache_dir:

@@ -207,13 +207,19 @@ class MasterClient:
             )
         )
 
-    def report_global_step(self, step: int, digest: Optional[Dict] = None):
+    def report_global_step(
+        self,
+        step: int,
+        digest: Optional[Dict] = None,
+        comm_links: Optional[Dict] = None,
+    ):
         return self._client.report(
             msg.GlobalStepReport(
                 node_id=self.node_id,
                 step=step,
                 timestamp=time.time(),
                 digest=dict(digest) if digest else {},
+                comm_links=dict(comm_links) if comm_links else {},
             )
         )
 

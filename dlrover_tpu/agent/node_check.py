@@ -49,8 +49,9 @@ def _run_check_round(
     group_members = sorted(world.members)
     my_index = group_members.index(world.node_rank)
     out_file = tempfile.mktemp(prefix="dlrover_tpu_check_")
-    env = dict(os.environ)
-    env.update(
+    from dlrover_tpu.common import flags
+
+    env = flags.child_env(
         {
             "DLROVER_TPU_NODE_ID": str(config.node_id),
             "DLROVER_TPU_CHECK_OUT": out_file,

@@ -96,6 +96,19 @@ class EnvFlag:
                 os.environ[self.name] = prev
 
 
+def child_env(overrides: Optional[Dict[str, Any]] = None) -> Dict[str, str]:
+    """The sanctioned environment clone for spawning child processes
+    (worker launch, node-check workloads): the parent's environment —
+    which :meth:`EnvFlag.propagate` writes sanctioned flag values into
+    — plus per-child overrides, stringified. This is the subprocess
+    face of the ``propagate()`` path: call sites build their child env
+    here instead of cloning ``os.environ`` raw (graftlint JG003)."""
+    env = dict(os.environ)
+    if overrides:
+        env.update({k: str(v) for k, v in overrides.items()})
+    return env
+
+
 _REGISTRY: Dict[str, EnvFlag] = {}
 
 
@@ -219,6 +232,16 @@ ZERO1 = _define(
     " overrides the TrainConfig.zero1 knob in BOTH directions — 0 "
     "forces the replicated update, any other non-empty value forces "
     "zero-1 on; empty defers to the config. Read at step-build time.",
+)
+HIER_COLLECTIVES = _define(
+    "DLROVER_TPU_HIER_COLLECTIVES", "", "str",
+    "Hierarchical DCN-aware collectives on multislice meshes "
+    "(ops/hier_collectives.py): overrides the "
+    "TrainConfig.hier_collectives knob in BOTH directions — 0 forces "
+    "the flat (one collective over the full dp axis) path, any other "
+    "non-empty value forces the ICI-first hierarchy on; empty defers "
+    "to the config. Read at step-build time; no-op on single-slice "
+    "meshes.",
 )
 RETRACE_GUARD = _define(
     "DLROVER_TPU_RETRACE_GUARD", 0, "int",

@@ -241,6 +241,12 @@ class GlobalStepReport:
     # extra RPCs. Empty = a pre-digest worker (serde drops unknown
     # fields both ways, so version skew is harmless).
     digest: Dict = field(default_factory=dict)
+    # per-link-class analytic comm bytes/step ({"ici": N, "dcn": M},
+    # profiler/comm.py CommLedger.link_bytes): rides the same throttled
+    # report so the master's goodput report — and through it the
+    # brain/tuner — sees how loaded the slow inter-slice link is.
+    # Empty = single-link world or a pre-link worker (skew-safe).
+    comm_links: Dict = field(default_factory=dict)
 
 
 @message

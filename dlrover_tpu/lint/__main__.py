@@ -68,9 +68,10 @@ def main(argv=None) -> int:
         default=None,
         metavar="MESHSPEC",
         help="IR mode: lower the contract model for this mesh spec "
-        "(e.g. dp4, dp2xfsdp2, sp2xdp2, or a zero-1 variant like "
-        "dp4+zero1; repeatable) and run the SC rules over the lowered "
-        "program",
+        "(e.g. dp4, dp2xfsdp2, sp2xdp2, a zero-1 variant like "
+        "dp4+zero1, or a multislice hierarchical variant like "
+        "dp4+2slice / dp4+2slice+zero1; repeatable) and run the SC "
+        "rules over the lowered program",
     )
     p.add_argument(
         "--contracts",
@@ -274,11 +275,14 @@ def _run_hlo(args) -> int:
     specs = []
     for raw in args.hlo:
         try:
-            axis_sizes, zero1 = shardcheck.parse_contract_spec(raw)
+            axis_sizes, zero1, n_slices = \
+                shardcheck.parse_contract_spec(raw)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
-        specs.append(shardcheck.contract_spec_of(axis_sizes, zero1))
+        specs.append(
+            shardcheck.contract_spec_of(axis_sizes, zero1, n_slices)
+        )
 
     # every spec shares one jax process: size the virtual CPU device
     # pool to the largest world before anything touches jax

@@ -59,13 +59,19 @@ def ensure_cpu_devices(n: int) -> None:
         )
 
 
-def build_contract_trainer(axis_sizes: Dict[str, int], zero1: bool = False):
+def build_contract_trainer(
+    axis_sizes: Dict[str, int], zero1: bool = False, n_slices: int = 1
+):
     """(trainer, state, batch) for the pinned contract model on the
     mesh ``axis_sizes`` describes, placed on CPU host devices.
     ``zero1`` builds the weight-update-sharded variant of the step via
-    the TrainConfig knob; callers that must not let an exported
-    ``DLROVER_TPU_ZERO1`` override it wrap the build in
-    ``flags.ZERO1.scoped(None)`` (``build_program`` does)."""
+    the TrainConfig knob; ``n_slices > 1`` builds the mesh slice-major
+    (virtual slices on CPU) and hands the trainer the slice count, so
+    the hierarchical-collectives strategy and the per-link census see
+    the multislice topology. Callers that must not let exported
+    ``DLROVER_TPU_ZERO1`` / ``DLROVER_TPU_HIER_COLLECTIVES`` overrides
+    leak in wrap the build in ``flags.*.scoped(None)``
+    (``build_program`` does)."""
     import jax
     import numpy as np
 
@@ -88,7 +94,9 @@ def build_contract_trainer(axis_sizes: Dict[str, int], zero1: bool = False):
         sp=axis_sizes.get("sp", 1),
         tp=axis_sizes.get("tp", 1),
     ).resolve(world)
-    mesh = build_mesh(mc, devices=jax.devices()[:world])
+    mesh = build_mesh(
+        mc, devices=jax.devices()[:world], n_slices=n_slices
+    )
     specs = llama.param_specs(cfg)
     tc = TrainConfig(
         global_batch_size=GLOBAL_BATCH,
@@ -102,6 +110,7 @@ def build_contract_trainer(axis_sizes: Dict[str, int], zero1: bool = False):
         loss_factory=lambda m: (
             lambda p, t: llama.loss_fn(p, t, cfg, m)
         ),
+        n_slices=n_slices,
     )
     trainer.shardcheck_hints = {
         "seq_len": SEQ_LEN, "vocab": cfg.vocab_size,
@@ -120,23 +129,31 @@ def build_contract_trainer(axis_sizes: Dict[str, int], zero1: bool = False):
 def build_program(
     spec: str, pinned: bool = True
 ) -> Tuple["shardcheck.StepProgram", object]:
-    """Lower the contract model for ``spec`` (e.g. ``"dp2xfsdp2"`` or
-    the zero-1 variant ``"dp4+zero1"``) and return
+    """Lower the contract model for ``spec`` (e.g. ``"dp2xfsdp2"``,
+    the zero-1 variant ``"dp4+zero1"``, or a multislice hierarchical
+    variant like ``"dp4+2slice"``) and return
     ``(StepProgram, trainer)``."""
+    import contextlib
+
     from dlrover_tpu.common import flags
 
-    axis_sizes, zero1 = shardcheck.parse_contract_spec(spec)
+    axis_sizes, zero1, n_slices = shardcheck.parse_contract_spec(spec)
     world = 1
     for s in axis_sizes.values():
         world *= s
     ensure_cpu_devices(world)
-    with flags.ZERO1.scoped(None):
-        # the spec decides the variant; an exported DLROVER_TPU_ZERO1
-        # would otherwise override the knob at init_state/lower time
-        # and build (or --fix-contracts: RECORD) the wrong program
-        trainer, _, _ = build_contract_trainer(axis_sizes, zero1=zero1)
+    with contextlib.ExitStack() as stack:
+        # the spec decides the variant; exported DLROVER_TPU_ZERO1 /
+        # DLROVER_TPU_HIER_COLLECTIVES would otherwise override the
+        # knobs at init_state/lower time and build (or --fix-contracts:
+        # RECORD) the wrong program
+        stack.enter_context(flags.ZERO1.scoped(None))
+        stack.enter_context(flags.HIER_COLLECTIVES.scoped(None))
+        trainer, _, _ = build_contract_trainer(
+            axis_sizes, zero1=zero1, n_slices=n_slices
+        )
         program = trainer.step_ir(pinned=pinned)
     program.label = "hlo:" + shardcheck.contract_spec_of(
-        axis_sizes, zero1
+        axis_sizes, zero1, n_slices
     )
     return program, trainer

@@ -100,21 +100,43 @@ def mesh_spec_of(axis_sizes: Dict[str, int]) -> str:
 #: ``dp4.json``)
 ZERO1_SUFFIX = "+zero1"
 
+#: contract-spec suffix pattern for the multislice hierarchical program
+#: variant: ``dp4+2slice`` is the dp4 mesh over 2 slices running the
+#: ICI-first hierarchical gradient reduction (ops/hier_collectives.py)
+#: — its census carries the per-link (ici/dcn) byte split. Canonical
+#: suffix order: mesh, ``+Nslice``, ``+zero1``.
+_SLICE_SUFFIX_RE = re.compile(r"\+([0-9]+)slice$")
 
-def contract_spec_of(axis_sizes: Dict[str, int], zero1: bool = False) -> str:
+
+def contract_spec_of(
+    axis_sizes: Dict[str, int], zero1: bool = False, n_slices: int = 1
+) -> str:
     """Canonical CONTRACT key for a program: the mesh spec, suffixed
-    with ``+zero1`` when the step was built with weight-update sharding
-    — ``contract_spec_of({"dp": 4}, True)`` → ``"dp4+zero1"``."""
-    return mesh_spec_of(axis_sizes) + (ZERO1_SUFFIX if zero1 else "")
+    with ``+Nslice`` for the hierarchical multislice program variant
+    and ``+zero1`` for weight-update sharding —
+    ``contract_spec_of({"dp": 4}, True, 2)`` → ``"dp4+2slice+zero1"``.
+    A multislice mesh running the FLAT path keys the plain spec (its
+    program is the single-slice one)."""
+    spec = mesh_spec_of(axis_sizes)
+    if n_slices > 1:
+        spec += f"+{n_slices}slice"
+    return spec + (ZERO1_SUFFIX if zero1 else "")
 
 
-def parse_contract_spec(spec: str) -> Tuple[Dict[str, int], bool]:
-    """``"dp4+zero1"`` → ``({"dp": 4}, True)``; plain mesh specs parse
-    with ``zero1=False``."""
+def parse_contract_spec(spec: str) -> Tuple[Dict[str, int], bool, int]:
+    """``"dp4+2slice+zero1"`` → ``({"dp": 4}, True, 2)``; plain mesh
+    specs parse with ``zero1=False, n_slices=1``."""
     zero1 = spec.endswith(ZERO1_SUFFIX)
     if zero1:
         spec = spec[: -len(ZERO1_SUFFIX)]
-    return parse_mesh_spec(spec), zero1
+    n_slices = 1
+    m = _SLICE_SUFFIX_RE.search(spec)
+    if m:
+        n_slices = int(m.group(1))
+        if n_slices < 1:
+            raise ValueError(f"bad slice count in contract spec {spec!r}")
+        spec = spec[: m.start()]
+    return parse_mesh_spec(spec), zero1, n_slices
 
 
 def parse_mesh_spec(spec: str) -> Dict[str, int]:
@@ -351,15 +373,70 @@ class MeshCoords:
     assignment in ``mesh.devices.flat`` order, so a member decodes
     directly as a flat index into the mesh shape. (Mapping through
     hardware ids would invert the attribution on any mesh whose device
-    order is permuted — every real TPU torus mesh.)"""
+    order is permuted — every real TPU torus mesh.)
 
-    def __init__(self, axis_sizes: Dict[str, int]):
+    ``n_slices > 1`` adds LINK-CLASS attribution: the multislice
+    layout is slice-major over the outermost (dp) axis
+    (``parallel/mesh.py _build_multislice_mesh``), so a device-
+    assignment position's slice is simply ``position // per_slice`` —
+    and a replica group whose members span more than one slice is a
+    collective that crosses DCN."""
+
+    def __init__(self, axis_sizes: Dict[str, int], n_slices: int = 1):
         self.axis_sizes = dict(axis_sizes)
         self.axes = list(axis_sizes)
         n = 1
         for s in axis_sizes.values():
             n *= s
         self.num_devices = n
+        self.n_slices = max(1, int(n_slices))
+        if self.n_slices > 1 and n % self.n_slices:
+            # a world that doesn't tile into slices cannot be slice-
+            # attributed; fail soft to single-slice (everything "ici")
+            # rather than mis-labeling — the mesh builder would have
+            # rejected this topology anyway
+            self.n_slices = 1
+        self._per_slice = (
+            n // self.n_slices if self.n_slices > 1 else n
+        )
+
+    def slice_of(self, position: int) -> int:
+        """Slice of a device-assignment position (slice-major layout)."""
+        if self._per_slice <= 0:
+            return 0
+        return position // self._per_slice
+
+    def slices_spanned(self, members: Sequence[int]) -> int:
+        """Distinct slices a replica group's members live on."""
+        if self.n_slices <= 1:
+            return 1
+        return len({self.slice_of(m) for m in members}) or 1
+
+    def link_of_groups(self, groups: Sequence[Sequence[int]]) -> Tuple[
+        str, int
+    ]:
+        """``("ici"|"dcn", max slices spanned by any group)``. Empty
+        groups (= every device participates) span all slices."""
+        if self.n_slices <= 1:
+            return "ici", 1
+        if not groups:
+            return "dcn", self.n_slices
+        spanned = max(self.slices_spanned(g) for g in groups)
+        return ("dcn" if spanned > 1 else "ici"), spanned
+
+    def link_of_pairs(self, pairs: Sequence[Tuple[int, int]]) -> Tuple[
+        str, int
+    ]:
+        """collective-permute link class: any pair crossing a slice
+        boundary makes the op ride DCN."""
+        if self.n_slices <= 1:
+            return "ici", 1
+        spanned = 1
+        for s, t in pairs:
+            if s != t and self.slice_of(s) != self.slice_of(t):
+                spanned = 2
+                break
+        return ("dcn" if spanned > 1 else "ici"), spanned
 
     def coords(self, position: int) -> Optional[Tuple[int, ...]]:
         if not 0 <= position < self.num_devices:
@@ -431,6 +508,13 @@ class CollectiveOp:
     bytes: int  # per-device contribution (see parse_collectives)
     axes: str  # mesh-axis label ("fsdp", "dp+fsdp", "tp", ...)
     line: int  # 1-indexed line in the HLO text
+    #: link class: "dcn" when any replica group spans >1 slice of a
+    #: multislice device assignment, else "ici" (single-slice meshes
+    #: are all-ici by construction)
+    link: str = "ici"
+    #: modeled per-device bytes this op moves ACROSS the slice
+    #: boundary (0 for ici ops) — see parse_collectives
+    dcn_bytes: int = 0
 
 
 _COLLECTIVE_RE = re.compile(
@@ -472,7 +556,31 @@ def parse_collectives(
     result. Counting the gathered result would overstate an all-gather
     by the axis size against every other op — and make the
     allreduce→reduce-scatter+all-gather rewrite (zero-1) read as MORE
-    communication when it moves strictly less per link."""
+    communication when it moves strictly less per link.
+
+    On a multislice assignment (``coords.n_slices > 1``) each op also
+    carries its LINK class and modeled per-device DCN bytes — what the
+    op moves across the slice boundary. The contribution unit cannot
+    express this (a flat reduce-scatter over dp and the hierarchical
+    DCN leg scatter the same result shape while moving very different
+    bytes over the slow link), so the DCN model follows the op's
+    *operand*, the analytic-formula approach the comm ledger already
+    takes for bandwidth: with ``s`` = slices the group spans and
+    ``frac = 1 - 1/s`` (the share of a uniformly-partitioned payload
+    that is remote),
+
+    - all-reduce / all-to-all: operand == result → ``result × frac``;
+    - reduce-scatter: operand = result × participants → that × frac
+      (the un-scattered input is what rides the ring past the cut);
+    - all-gather: every remote shard crosses once → gathered result ×
+      frac;
+    - collective-permute: the full payload crosses iff the pair does.
+
+    A model, not a packet count — its value is that flat and
+    hierarchical variants of the same reduction are scored by the same
+    rule, so the 2slice contracts can assert the hierarchy's DCN bytes
+    are ~1/dp_in of the flat path's and veto a regression that moves
+    bytes back onto the slow link."""
     out: List[CollectiveOp] = []
     for lineno, line in enumerate(hlo_text.splitlines(), start=1):
         if "-done" in line:
@@ -482,21 +590,34 @@ def parse_collectives(
             continue
         kind = m.group(1)
         shape = _result_shape(line, m.start(1), m.group(2) is not None)
-        nbytes = sum(shape_bytes(s) for s in shape.split("+"))
+        raw_bytes = sum(shape_bytes(s) for s in shape.split("+"))
+        nbytes = raw_bytes
         if kind == "collective-permute":
             pairs = parse_source_target_pairs(
                 _attr(line, "source_target_pairs")
             )
             axes = coords.attribute_pairs(pairs)
+            link, spanned = coords.link_of_pairs(pairs)
+            participants = 1
         else:
             groups = parse_replica_groups(_attr(line, "replica_groups"))
             axes = coords.attribute_groups(groups)
+            link, spanned = coords.link_of_groups(groups)
+            participants = (
+                len(groups[0]) if groups and groups[0]
+                else max(coords.num_devices, 1)
+            )
             if kind == "all-gather":
-                participants = (
-                    len(groups[0]) if groups and groups[0]
-                    else max(coords.num_devices, 1)
-                )
                 nbytes //= max(participants, 1)
+        dcn_bytes = 0
+        if link == "dcn":
+            frac = 1.0 - 1.0 / max(spanned, 2)
+            if kind == "collective-permute":
+                dcn_bytes = raw_bytes
+            elif kind == "reduce-scatter":
+                dcn_bytes = int(raw_bytes * participants * frac)
+            else:
+                dcn_bytes = int(raw_bytes * frac)
         out.append(
             CollectiveOp(
                 kind=kind,
@@ -504,6 +625,8 @@ def parse_collectives(
                 bytes=nbytes,
                 axes=axes,
                 line=lineno,
+                link=link,
+                dcn_bytes=dcn_bytes,
             )
         )
     return out
@@ -540,14 +663,30 @@ def collective_census(
     SC001 fingerprint. Bytes are per-device contributions (see
     ``parse_collectives``) summed over static ops (a scan body counts
     once: the fingerprint tracks the *program*, not the per-step issue
-    count — accum lives in the comm ledger, not here)."""
+    count — accum lives in the comm ledger, not here).
+
+    On a multislice assignment every cell additionally carries
+    ``dcn_bytes`` — the modeled bytes its ops move across the slice
+    boundary (0 for cells whose ops all stay on ICI). Cell KEYS are
+    link-free on purpose: the flat and hierarchical programs label the
+    same logical reduction ``…|dp`` on every topology, so their
+    censuses stay comparable and only the link split differs."""
+    multislice = coords.n_slices > 1
     census: Dict[str, Dict[str, int]] = {}
     for op in parse_collectives(hlo_text, coords):
         key = f"{op.kind}|{op.axes}"
         cell = census.setdefault(key, {"count": 0, "bytes": 0})
+        if multislice:
+            cell.setdefault("dcn_bytes", 0)
+            cell["dcn_bytes"] += op.dcn_bytes
         cell["count"] += 1
         cell["bytes"] += op.bytes
     return census
+
+
+def census_dcn_bytes(census: Dict[str, Dict[str, int]]) -> int:
+    """Total modeled DCN bytes of a (multislice) census."""
+    return sum(c.get("dcn_bytes", 0) for c in census.values())
 
 
 # ---------------------------------------------------------------------------
@@ -676,9 +815,14 @@ class StepProgram:
     #: SC002 replicated-optimizer-moment check (a moment the sharding
     #: rule left replicated across dp>1 defeats the feature's point)
     zero1: bool = False
+    #: slices the device assignment spans (slice-major layout): >1
+    #: arms the per-link (ici/dcn) census attribution — set for ANY
+    #: multislice program, flat or hierarchical, so the census always
+    #: shows what the slow link carries
+    n_slices: int = 1
 
     def coords(self) -> MeshCoords:
-        return MeshCoords(self.axis_sizes)
+        return MeshCoords(self.axis_sizes, n_slices=self.n_slices)
 
     @property
     def data_axis_product(self) -> int:
@@ -781,6 +925,27 @@ def check_census_against_contract(
                     snippet=key,
                 )
             )
+        if contract.get("n_slices", 1) > 1:
+            # the slow-link veto: a cell whose modeled DCN bytes grew
+            # beyond tolerance moved traffic onto the inter-slice link
+            # — the exact regression the hierarchical strategy exists
+            # to prevent (a contract without slice info records no
+            # dcn_bytes and skips this arm)
+            ref_dcn = ref.get("dcn_bytes", 0)
+            got_dcn = got.get("dcn_bytes", 0)
+            if got_dcn > ref_dcn * (1.0 + byte_tolerance) and \
+                    got_dcn > ref_dcn:
+                out.append(
+                    program.violation(
+                        "SC001",
+                        f"collective {key} DCN bytes grew {ref_dcn} -> "
+                        f"{got_dcn}: the program moves more traffic "
+                        "across the slice boundary than the contract "
+                        "records — the slow link now carries what ICI "
+                        "used to.",
+                        snippet=key,
+                    )
+                )
     return out
 
 
@@ -802,6 +967,11 @@ def census_improvements(
             notes.append(
                 f"{key}: {want[key]['count']}/{want[key]['bytes']}B -> "
                 f"{got['count']}/{got['bytes']}B"
+            )
+        elif got.get("dcn_bytes", 0) < want[key].get("dcn_bytes", 0):
+            notes.append(
+                f"{key}: dcn {want[key]['dcn_bytes']}B -> "
+                f"{got['dcn_bytes']}B (less on the slow link)"
             )
     return notes
 
@@ -1153,6 +1323,11 @@ def write_contract(
         "config_hash": program.config_hash,
         "census": {k: census[k] for k in sorted(census)},
     }
+    if program.n_slices > 1:
+        # arms the per-cell dcn_bytes diff (the slow-link veto) and
+        # records what the census unit means for this contract
+        data["n_slices"] = program.n_slices
+        data["dcn_bytes_total"] = census_dcn_bytes(census)
     if extra:
         data.update(extra)
     path = contract_path(contracts_dir, mesh_spec)
@@ -1170,7 +1345,8 @@ def write_contract(
 
 SC_RULES: List[Tuple[str, str, str]] = [
     ("SC001", "collective-census",
-     "Collectives per mesh axis diffed against a checked-in contract."),
+     "Collectives per mesh axis (and, on multislice assignments, per "
+     "ici/dcn link class) diffed against a checked-in contract."),
     ("SC002", "replicated-large-tensor",
      "A big sharding-constrained tensor left fully replicated across "
      "the data axes; under zero-1, also an optimizer moment still "

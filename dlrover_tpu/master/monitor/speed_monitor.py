@@ -220,6 +220,13 @@ class SpeedMonitor:
             "master.monitor.speed_monitor.SpeedMonitor._progress_lock",
         )
         self.straggler_detector = StragglerDetector()
+        # per-link-class comm bytes/step, per rank (last report wins:
+        # every rank of one program reports the same analytic split —
+        # GlobalStepReport.comm_links, profiler/comm.py). The goodput
+        # report's ici/dcn section reads the max across ranks, so the
+        # brain/tuner has a real slow-link signal instead of step-time
+        # guesswork.
+        self._comm_links: Dict[int, Dict[str, int]] = {}
         # master-side span buffer for the job timeline: closed downtime
         # brackets as (start, end) epoch pairs (bounded)
         self._downtime_spans: List[Tuple[float, float]] = []
@@ -300,6 +307,7 @@ class SpeedMonitor:
         re-seeds everything with its first fresh digest."""
         self.remove_running_worker(node_type, node_id)
         self._ranks.pop_digest(int(node_id))
+        self.evict_comm_links(node_id)
 
     def all_worker_joined(self) -> bool:
         with self._lock:
@@ -414,6 +422,46 @@ class SpeedMonitor:
         return self.straggler_detector.observe(
             node, p50_s, count=count, ts=ts
         )
+
+    def record_comm_links(self, node_id: int, links: Dict):
+        """One rank's per-link analytic comm bytes/step
+        (``{"ici": N, "dcn": M}`` — GlobalStepReport.comm_links). Last
+        report wins per rank; bad payloads are dropped, not raised (the
+        report hot path must never fail on a malformed split)."""
+        if not links:
+            return
+        clean: Dict[str, int] = {}
+        try:
+            for k, v in dict(links).items():
+                clean[str(k)] = int(v)
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            self._comm_links[int(node_id)] = clean
+
+    def evict_comm_links(self, node_id: int):
+        with self._lock:
+            self._comm_links.pop(int(node_id), None)
+
+    def comm_link_report(self) -> Dict:
+        """The goodput report's ici/dcn section: per-link bytes/step
+        (max across ranks — every rank of one program reports the same
+        analytic split; max is robust to a straggling stale report),
+        the dcn share of all comm, and how many ranks reported."""
+        with self._lock:
+            per_rank = {k: dict(v) for k, v in self._comm_links.items()}
+        links: Dict[str, int] = {}
+        for row in per_rank.values():
+            for link, b in row.items():
+                links[link] = max(links.get(link, 0), int(b))
+        total = sum(links.values())
+        return {
+            "per_step_bytes": links,
+            "dcn_share": (
+                round(links.get("dcn", 0) / total, 4) if total else 0.0
+            ),
+            "ranks_reporting": len(per_rank),
+        }
 
     def record_ckpt_blocking(self, seconds: float, node_id: int = -1):
         """Training seconds a checkpoint save blocked the step loop for
@@ -607,6 +655,9 @@ class SpeedMonitor:
                 },
                 "ckpt_restore_s": self._ckpt_restore_s,
                 "hang_s": self._hang_s,
+                "comm_links": {
+                    str(k): dict(v) for k, v in self._comm_links.items()
+                },
                 "last_progress_ts": self._last_progress_ts,
                 "straggler": self.straggler_detector.export_state(),
                 # when the old master dies with no open bracket, the
@@ -642,6 +693,10 @@ class SpeedMonitor:
             )
             self._ckpt_restore_s = float(state.get("ckpt_restore_s", 0.0))
             self._hang_s = float(state.get("hang_s", 0.0))
+            self._comm_links = {
+                int(k): {str(a): int(b) for a, b in dict(v).items()}
+                for k, v in (state.get("comm_links") or {}).items()
+            }
         raw_blocking = state.get("ckpt_blocking_s") or {}
         if not isinstance(raw_blocking, dict):
             # pre-per-rank snapshot: one untagged total

@@ -16,10 +16,11 @@ Parity: reference ``master/elastic_training/rdzv_manager.py`` (796 LoC):
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from abc import ABC, abstractmethod
 from threading import Lock
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 from dlrover_tpu.common.constants import (
     DefaultValues,
@@ -49,6 +50,35 @@ class RendezvousParameters:
         self.join_timeout = join_timeout
 
 
+@dataclasses.dataclass(frozen=True)
+class _WorldSnapshot:
+    """Immutable published view of one rendezvous manager's state — the
+    world-poll fast path (ROADMAP item 5: join/world-poll storms used
+    to take the manager lock AND copy the full world dict on EVERY
+    poll; at 1k nodes that is ~3k lock acquisitions and full-world
+    copies per second for a world that changes a few times an hour).
+
+    Copy-on-change: every MUTATION rebuilds the snapshot under the
+    lock (``_publish_locked``) and publishes it with one atomic
+    reference store; polls read the current reference with NO lock and
+    NO copy. Consumers must treat ``rdzv_nodes`` as read-only — the
+    dict is shared by every concurrent poll (the servicer serializes
+    it; nothing mutates seated metas between completions, which build
+    a fresh dict)."""
+
+    version: int = 0
+    round: int = 0
+    rdzv_nodes: Dict[int, "NodeTopologyMeta"] = dataclasses.field(
+        default_factory=dict
+    )
+    rdzv_ids: FrozenSet[int] = frozenset()
+    waiting_ids: FrozenSet[int] = frozenset()
+    num_waiting: int = 0
+    force_reform: bool = False
+    coordinator: str = ""
+    latest_world: Tuple[int, ...] = ()
+
+
 class RendezvousManager(ABC):
     def __init__(self, name: str, clock=None):
         self.name = name
@@ -76,6 +106,34 @@ class RendezvousManager(ABC):
         # num_nodes_waiting see a virtual waiter and drop back into the
         # rendezvous; the next completed round clears it
         self._force_reform = False
+        # the poll fast path: an immutable snapshot rebuilt on every
+        # MUTATION (copy-on-change) and read lock-free by the storms of
+        # get_comm_world / num_nodes_waiting polls. The reference store
+        # is atomic in CPython; readers grab one coherent version.
+        self._snapshot = _WorldSnapshot()
+
+    def _publish_locked(self):
+        """Rebuild the published snapshot. Caller holds the lock."""
+        s = self._snapshot
+        self._snapshot = _WorldSnapshot(
+            version=s.version + 1,
+            round=self._rdzv_round,
+            rdzv_nodes=dict(self._rdzv_nodes),
+            rdzv_ids=frozenset(
+                m.node_id for m in self._rdzv_nodes.values()
+            ),
+            waiting_ids=frozenset(
+                m.node_id for m in self._waiting_nodes.values()
+            ),
+            num_waiting=len(self._waiting_nodes),
+            force_reform=self._force_reform,
+            coordinator=self.coordinator_addr(),
+            latest_world=tuple(self._latest_rdzv_nodes),
+        )
+
+    def world_snapshot(self) -> _WorldSnapshot:
+        """The current published view (lock-free; tests and metrics)."""
+        return self._snapshot
 
     def update_rdzv_params(
         self, min_nodes: int, max_nodes: int, node_unit: int,
@@ -104,6 +162,7 @@ class RendezvousManager(ABC):
                     break
             if removed is not None:
                 del self._waiting_nodes[removed]
+                self._publish_locked()
                 logger.info(
                     "%s rdzv: removed dead node %s from waiting list",
                     self.name,
@@ -119,6 +178,7 @@ class RendezvousManager(ABC):
             self._waiting_nodes[node_rank] = meta
             self._lastcall_time = meta.join_time
             self._alive_nodes.add(node_id)
+            self._publish_locked()
         return self._rdzv_round
 
     def num_nodes_waiting(self) -> int:
@@ -126,12 +186,15 @@ class RendezvousManager(ABC):
         change. While a re-form is requested (collective-hang recovery)
         and nobody has re-joined yet, a VIRTUAL waiter is reported so
         the seated-but-stalled cohort drops back into the rendezvous —
-        the same signal path a real joiner uses."""
-        with self._lock:
-            n = len(self._waiting_nodes)
-            if n == 0 and self._force_reform:
-                return 1
-            return n
+        the same signal path a real joiner uses.
+
+        Served from the immutable snapshot — the highest-rate poll in
+        the protocol (every agent, every poll interval) costs one
+        reference read, no lock."""
+        s = self._snapshot
+        if s.num_waiting == 0 and s.force_reform:
+            return 1
+        return s.num_waiting
 
     def request_re_rendezvous(self, exclude=()) -> None:
         """Collective-hang recovery (master/monitor/hang_watchdog.py):
@@ -150,15 +213,16 @@ class RendezvousManager(ABC):
                 for rank in stale:
                     del self._waiting_nodes[rank]
             self._force_reform = True
+            self._publish_locked()
         logger.warning(
             "%s rdzv: re-rendezvous requested (excluding %s)",
             self.name, sorted(exclude) if exclude else "nobody",
         )
 
     def latest_world_ids(self) -> List[int]:
-        """Node ids of the latest completed round's world."""
-        with self._lock:
-            return list(self._latest_rdzv_nodes)
+        """Node ids of the latest completed round's world (lock-free:
+        served from the published snapshot)."""
+        return list(self._snapshot.latest_world)
 
     def _effective_world_size(self, n: int) -> int:
         """Round down to a multiple of node_unit (reference :118-156)."""
@@ -207,6 +271,7 @@ class RendezvousManager(ABC):
         self._latest_rdzv_nodes = sorted(kept_ids)
         self._rdzv_round += 1
         self._force_reform = False  # the re-formed world answers the hang
+        self._publish_locked()
         elapsed = self._clock() - self._start_rdzv_ts if self._start_rdzv_ts else 0.0
         logger.info(
             "%s rendezvous round %s completed: %s nodes in %.1fs; world=%s",
@@ -238,17 +303,22 @@ class ElasticTrainingRendezvousManager(RendezvousManager):
     def get_comm_world(
         self, node_id: int
     ) -> Tuple[int, int, Dict[int, NodeTopologyMeta], str]:
-        """Returns (round, group, world, coordinator). world empty = not ready."""
-        with self._lock:
-            if node_id is not None and any(
-                m.node_id == node_id for m in self._waiting_nodes.values()
-            ):
+        """Returns (round, group, world, coordinator). world empty = not ready.
+
+        Served from the immutable snapshot: a seated node's poll — the
+        steady-state storm at fleet scale — reads one reference and
+        returns the SHARED world dict (read-only by contract), taking
+        no lock and copying nothing. Only a node that is actually
+        WAITING takes the lock, to drive round completion — the
+        mutation path, where the lock belongs."""
+        snap = self._snapshot
+        if node_id is not None and node_id in snap.waiting_ids:
+            with self._lock:
                 self._check_rdzv_completed()
-            if node_id is not None and any(
-                m.node_id == node_id for m in self._rdzv_nodes.values()
-            ):
-                return self._rdzv_round, 0, dict(self._rdzv_nodes), self.coordinator_addr()
-            return self._rdzv_round, 0, {}, ""
+            snap = self._snapshot  # re-read: completion republishes
+        if node_id is not None and node_id in snap.rdzv_ids:
+            return snap.round, 0, snap.rdzv_nodes, snap.coordinator
+        return snap.round, 0, {}, ""
 
 
 class NetworkCheckRendezvousManager(RendezvousManager):

@@ -328,6 +328,13 @@ class MasterServicer:
                     request.node_id, digest,
                     request.timestamp or time.time(),
                 )
+            comm_links = getattr(request, "comm_links", None)
+            if comm_links:
+                # per-link comm split (profiler/comm.py): feeds the
+                # goodput report's ici/dcn section
+                self._speed_monitor.record_comm_links(
+                    request.node_id, comm_links
+                )
         return msg.SimpleResponse()
 
     def _collect_digest(self, node_id: int, digest: Dict, ts: float):

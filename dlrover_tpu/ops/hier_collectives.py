@@ -1,0 +1,313 @@
+"""Hierarchical DCN-aware collectives for multislice meshes.
+
+The multislice mesh (``parallel/mesh.py``) guarantees that only the
+``dp`` axis spans DCN — and then the gradient reduction runs as ONE
+flat collective over the full dp axis, so every hop of the ring treats
+the slow inter-slice link like ICI and the DCN cut carries the whole
+gradient. FlexLink (arXiv:2510.15882, PAPERS.md) shows hierarchy- and
+link-aware collective scheduling recovering double-digit bandwidth on
+exactly this topology shape. This module is that strategy, TPU-native:
+
+1. decompose the cross-slice ``dp`` axis into ``(slice, dp_in)`` —
+   legal because the multislice layout is **slice-major** over dp
+   (``_build_multislice_mesh``: dp index ``d`` lives on slice
+   ``d // dp_in``), so reshaping the mesh's dp dimension into
+   ``(n_slices, dp_in)`` preserves every device's position;
+2. run the gradient reduction as **ICI reduce-scatter within each
+   slice** (over ``dp_in``) → **DCN exchange of only the slice-local
+   1/dp_in shard** (over ``slice``) → ICI all-gather to rebuild the
+   full reduced gradient;
+3. composed with zero-1 (``train/zero1.py``): in scatter mode the DCN
+   leg is itself a reduce-scatter, so the DCN cut carries only the
+   owned moment shard and the trailing all-gather is the existing
+   param gather — no extra pass.
+
+Like zero-1's scatter strategy, the engines here run the loss+backward
+inside a **full-manual** ``shard_map`` — so they need the factory form
+of the loss (``loss_factory(None)`` is the single-device local loss)
+and a mesh where every non-dp axis is trivial. The shard_map binds a
+*derived* mesh (:func:`hier_mesh`) over the SAME devices in the SAME
+flat order, with dp split into the two named axes; base-mesh
+``NamedSharding``s on the jit boundary and derived-mesh out_specs
+describe identical placements, so GSPMD inserts no resharding between
+them (pinned by tests/test_hier_collectives.py on the lowered HLO).
+
+Zero-1 composition needs one local permutation: scattering first over
+``dp_in`` then over ``slice`` would leave the dim sharded in
+``(dp_in, slice)`` order, while the zero-1 layout (``P(..., "dp")``,
+slice-major) is ``(slice, dp_in)``. The engine pre-permutes the
+scatter dim — ``(n_slices, dp_in, rest) → (dp_in, n_slices, rest)`` —
+so the two chained reduce-scatters land each rank exactly on its
+zero-1 shard, bitwise contiguous (tests pin parity vs the flat
+``psum_scatter``).
+
+Strategy selection (:func:`mode_for`) is per-mesh, driven by
+``TrainConfig.hier_collectives`` with the ``DLROVER_TPU_HIER_COLLECTIVES``
+typed flag overriding in both directions; the flat path is the
+kill-switch fallback and stays byte-identical to before.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from dlrover_tpu.common import flags
+from dlrover_tpu.common.log import logger
+
+PyTree = Any
+
+#: derived-mesh axis names the hier engines introduce. "slice" is the
+#: DCN axis (outermost), "dp_in" the within-slice ICI remainder of dp.
+SLICE_AXIS = "slice"
+DP_IN_AXIS = "dp_in"
+
+__all__ = [
+    "SLICE_AXIS",
+    "DP_IN_AXIS",
+    "enabled",
+    "mode_for",
+    "hier_mesh",
+    "split_spec",
+    "hier_value_and_grad",
+]
+
+
+def enabled(train_config) -> bool:
+    """Effective hier-collectives setting: the
+    ``DLROVER_TPU_HIER_COLLECTIVES`` env flag when set (``0`` = off,
+    anything else = on), else the ``TrainConfig.hier_collectives``
+    knob."""
+    flag = flags.HIER_COLLECTIVES
+    if flag.present():
+        return flag.get() != "0"
+    return bool(getattr(train_config, "hier_collectives", True))
+
+
+def mode_for(
+    mesh,
+    n_slices: int,
+    train_config,
+    has_factory: bool,
+    zero1_mode: str = "off",
+    enabled_override: Optional[bool] = None,
+) -> str:
+    """``"flat"`` | ``"hier"`` for this build.
+
+    ``hier`` needs: >1 slice; a dp axis that actually decomposes
+    (``dp % n_slices == 0`` with a non-trivial within-slice remainder —
+    when ``dp_in == 1`` the dp axis IS the DCN axis and there is
+    nothing to reduce on ICI first); every non-dp axis trivial and the
+    factory form of the loss (the engines go full-manual, same
+    constraint as zero-1's scatter strategy); and a zero-1 mode the
+    manual engine composes with (``off`` or ``scatter`` — ``gspmd``
+    zero-1 only arises on mixed meshes, which already fail the
+    trivial-axes test, or without a factory).
+
+    ``enabled_override`` mirrors ``zero1.mode_for``'s: the trainer pins
+    the flag read once per build so a concurrent ``scoped`` window can
+    never flip the answer between cache key and program build."""
+    on = (
+        enabled(train_config)
+        if enabled_override is None else enabled_override
+    )
+    if not on or n_slices <= 1:
+        return "flat"
+    shape = dict(mesh.shape)
+    dp = shape.get("dp", 1)
+    if dp % n_slices or dp // n_slices <= 1:
+        return "flat"
+    if not has_factory:
+        return "flat"
+    if any(s > 1 for a, s in shape.items() if a != "dp"):
+        # the body is single-device model code; a non-trivial model
+        # axis would need its own manual handling (future work —
+        # docs/design/hier_collectives.md "limits")
+        return "flat"
+    if zero1_mode == "gspmd":
+        return "flat"
+    if SLICE_AXIS in shape or DP_IN_AXIS in shape:
+        logger.warning(
+            "hier collectives: mesh already has a %r/%r axis; flat path",
+            SLICE_AXIS, DP_IN_AXIS,
+        )
+        return "flat"
+    return "hier"
+
+
+def hier_mesh(mesh, n_slices: int):
+    """The derived mesh: same devices, same flat order, with the dp
+    axis split into ``(slice, dp_in)``. Because the multislice layout
+    is slice-major over dp, this is a pure C-order reshape — a value
+    sharded over ``dp`` on the base mesh is *identically placed* when
+    sharded over ``("slice", "dp_in")`` here."""
+    from jax.sharding import Mesh
+
+    shape = dict(mesh.shape)
+    dp = shape.get("dp", 1)
+    if dp % n_slices:
+        raise ValueError(
+            f"dp={dp} not divisible by n_slices={n_slices}"
+        )
+    dp_in = dp // n_slices
+    names, dims = [], []
+    for ax in mesh.axis_names:
+        if ax == "dp":
+            names += [SLICE_AXIS, DP_IN_AXIS]
+            dims += [n_slices, dp_in]
+        else:
+            names.append(ax)
+            dims.append(shape[ax])
+    return Mesh(mesh.devices.reshape(tuple(dims)), tuple(names))
+
+
+def split_spec(spec):
+    """Translate a base-mesh PartitionSpec for the derived mesh:
+    every ``"dp"`` entry becomes the ``("slice", "dp_in")`` pair in
+    place (order preserved inside tuple entries — slice-major, the
+    same placement)."""
+    from jax.sharding import PartitionSpec as P
+
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        new = []
+        for a in axes:
+            if a == "dp":
+                new += [SLICE_AXIS, DP_IN_AXIS]
+            else:
+                new.append(a)
+        out.append(tuple(new) if len(new) > 1 else new[0])
+    return P(*out)
+
+
+def _first_divisible_dim(shape, k: int) -> Optional[int]:
+    """Leading dim whose extent divides by ``k`` (for picking the ICI
+    reduce-scatter dim of a replicated-output leaf)."""
+    for dim, extent in enumerate(shape):
+        if extent > 0 and extent % k == 0:
+            return dim
+    return None
+
+
+def hier_value_and_grad(
+    local_loss, mesh, n_slices: int, p_specs, params,
+    zero1_scatter: bool = False,
+):
+    """The hierarchical grad engine: a full-manual ``shard_map`` over
+    :func:`hier_mesh` whose body runs the *local* loss+backward and
+    reduces each grad leaf ICI-first. Returns ``fn(params, micro) ->
+    (loss, grads)`` with ``loss`` the global-mean scalar.
+
+    ``zero1_scatter=False`` (replicated weight update): each grad leaf
+    comes back FULL and replicated over dp — reduce-scatter over
+    ``dp_in`` (ICI), psum over ``slice`` (DCN carries the 1/dp_in
+    shard), all-gather over ``dp_in`` (ICI). Leaves with no
+    dp_in-divisible dim fall back to a flat psum over both axes (DCN
+    carries the whole leaf — scalars and tiny odd shapes only).
+
+    ``zero1_scatter=True``: grads land directly in the zero-1 layout
+    (``zero1.partition_spec``) — reduce-scatter over ``dp_in`` (ICI)
+    then reduce-scatter over ``slice`` (the DCN cut carries only the
+    slice-local 1/dp_in shard and emits the owned 1/dp moment shard);
+    the trailing all-gather is the step's existing param gather. The
+    scatter dim is pre-permuted ``(slice, dp_in) → (dp_in, slice)`` so
+    the chained scatters land each rank on its slice-major zero-1
+    shard (see module docstring). Non-divisible leaves take the
+    replicated hierarchical reduce, exactly like zero-1's flat psum
+    fallback.
+
+    ``params`` may be live arrays, tracers or avatars: only ``.shape``
+    is read.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.ops.shard_map_compat import shard_map
+    from dlrover_tpu.parallel.sharding import batch_spec
+    from dlrover_tpu.train import zero1
+
+    hmesh = hier_mesh(mesh, n_slices)
+    axis_sizes = dict(mesh.shape)
+    dp = axis_sizes["dp"]
+    dp_in = dp // n_slices
+    inv_dp = 1.0 / dp
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+
+    if zero1_scatter:
+        dims = jax.tree.map(
+            lambda s, leaf: zero1.scatter_dim(s, leaf.shape, axis_sizes),
+            p_specs, params, is_leaf=is_spec,
+        )
+        out_grad_specs = jax.tree.map(
+            lambda s, leaf: split_spec(
+                zero1.partition_spec(s, leaf.shape, axis_sizes) or s
+            ),
+            p_specs, params, is_leaf=is_spec,
+        )
+    else:
+        dims = jax.tree.map(lambda s: None, p_specs, is_leaf=is_spec)
+        out_grad_specs = jax.tree.map(split_spec, p_specs, is_leaf=is_spec)
+
+    def reduce_replicated(leaf):
+        """full grad, replicated over dp: RS(ici) → psum(dcn) → AG(ici)."""
+        d = _first_divisible_dim(leaf.shape, dp_in)
+        if d is None:
+            # scalars / odd tiny shapes: flat psum (whole leaf on DCN)
+            return lax.psum(leaf, (DP_IN_AXIS, SLICE_AXIS)) * inv_dp
+        part = lax.psum_scatter(
+            leaf, DP_IN_AXIS, scatter_dimension=d, tiled=True
+        )
+        part = lax.psum(part, SLICE_AXIS)
+        return lax.all_gather(
+            part, DP_IN_AXIS, axis=d, tiled=True
+        ) * inv_dp
+
+    def reduce_scattered(d, leaf):
+        """zero-1 shard, slice-major: permute → RS(ici) → RS(dcn)."""
+        shp = leaf.shape
+        gg = leaf.reshape(
+            shp[:d] + (n_slices, dp_in, shp[d] // dp) + shp[d + 1:]
+        )
+        gg = jnp.swapaxes(gg, d, d + 1).reshape(shp)
+        part = lax.psum_scatter(
+            gg, DP_IN_AXIS, scatter_dimension=d, tiled=True
+        )
+        return lax.psum_scatter(
+            part, SLICE_AXIS, scatter_dimension=d, tiled=True
+        ) * inv_dp
+
+    def body(p, micro):
+        loss, g = jax.value_and_grad(local_loss)(p, micro)
+
+        def reduce_leaf(dim, leaf):
+            if zero1_scatter and dim is not None:
+                return reduce_scattered(dim, leaf)
+            return reduce_replicated(leaf)
+
+        g = jax.tree.map(
+            reduce_leaf, dims, g,
+            is_leaf=lambda x: x is None or isinstance(x, int),
+        )
+        # global batch mean = mean of equal-sized local means (scalar:
+        # the DCN half of this psum moves 4 bytes)
+        return lax.psum(loss, (DP_IN_AXIS, SLICE_AXIS)) * inv_dp, g
+
+    split_p_specs = jax.tree.map(split_spec, p_specs, is_leaf=is_spec)
+
+    def fn(p, micro):
+        micro_specs = jax.tree.map(
+            lambda _: split_spec(batch_spec()), micro
+        )
+        return shard_map(
+            body, mesh=hmesh,
+            in_specs=(split_p_specs, micro_specs),
+            out_specs=(P(), out_grad_specs),
+            check_vma=False,
+        )(p, micro)
+
+    return fn

@@ -197,8 +197,23 @@ def _build_multislice_mesh(
 
 
 def mesh_slice_of(mesh: Mesh, n_slices: int, dp_index: int) -> int:
-    """Which slice a given dp-axis index lives on (slice-major layout)."""
-    per = mesh.shape[DP] // n_slices
+    """Which slice a given dp-axis index lives on (slice-major layout).
+
+    Fails loudly on a topology the layout cannot mean: ``n_slices < 1``
+    or a dp axis that doesn't tile into whole slices (callers used to
+    get a silent ``// 0`` crash or — worse — a wrong slice id from the
+    floored quotient), and a dp index outside the axis."""
+    if n_slices < 1:
+        raise ValueError(f"n_slices={n_slices} must be >= 1")
+    dp = mesh.shape[DP]
+    if dp % n_slices:
+        raise ValueError(
+            f"dp={dp} does not tile into n_slices={n_slices} whole "
+            "slices (the slice-major layout requires dp % n_slices == 0)"
+        )
+    if not 0 <= dp_index < dp:
+        raise ValueError(f"dp_index={dp_index} outside dp axis of {dp}")
+    per = dp // n_slices
     return dp_index // per
 
 

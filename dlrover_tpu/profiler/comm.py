@@ -54,7 +54,13 @@ class CollectiveEvent:
     ``nbytes`` is the PER-SHARD payload of one issue; ``count`` is how
     many times the site executes per unit of ``per``: ``"step"`` (one
     optimizer step) or ``"loss_call"`` (one microbatch loss evaluation —
-    scaled by the trainer's gradient-accumulation factor at export)."""
+    scaled by the trainer's gradient-accumulation factor at export).
+
+    ``link``: explicit link class ("ici" | "dcn") for sites that know
+    better than the per-axis map — the hierarchical dp reduction
+    (ops/hier_collectives.py) runs BOTH link classes over the same
+    axis, so its legs self-classify. Empty = derive from the axis via
+    ``set_links`` (the flat-path behavior, unchanged)."""
 
     name: str      # site label, e.g. "ring_attention.kv_hop"
     kind: str      # ppermute | all_to_all | psum | all_gather | ...
@@ -62,6 +68,7 @@ class CollectiveEvent:
     nbytes: int
     count: int = 1
     per: str = "step"  # "step" | "loss_call"
+    link: str = ""     # "" = derive from axis
 
     def bytes_per_step(self, accum_steps: int = 1) -> int:
         scale = accum_steps if self.per == "loss_call" else 1
@@ -84,12 +91,40 @@ class CommLedger:
         self._accum_steps = 1  # trainer-set loss_call -> step multiplier
 
     def record(self, name: str, kind: str, axis: str, nbytes: int,
-               count: int = 1, per: str = "step"):
+               count: int = 1, per: str = "step", link: str = ""):
         ev = CollectiveEvent(name, kind, str(axis), int(nbytes),
-                             int(count), per)
-        key = (ev.name, ev.kind, ev.axis, ev.nbytes, ev.count, ev.per)
+                             int(count), per, str(link))
+        key = (ev.name, ev.kind, ev.axis, ev.nbytes, ev.count, ev.per,
+               ev.link)
         with self._lock:
             self._events[key] = ev
+
+    def _link_of(self, ev: CollectiveEvent, links: Dict[str, str]) -> str:
+        return ev.link or links.get(ev.axis, "ici")
+
+    def _link_totals(
+        self, events, links: Dict[str, str], accum: int
+    ) -> Dict[str, int]:
+        """The one per-link aggregation: link_bytes() and the
+        /metrics ``dlrover_tpu_comm_bytes_total`` rows must never
+        diverge (the goodput report's comm_links is documented to
+        carry the same split the endpoint exports)."""
+        out: Dict[str, int] = {}
+        for ev in events:
+            link = self._link_of(ev, links)
+            out[link] = out.get(link, 0) + ev.bytes_per_step(accum)
+        return out
+
+    def link_bytes(self) -> Dict[str, int]:
+        """Per-link-class bytes/step: ``{"ici": N, "dcn": M}`` (absent
+        class = 0 bytes on it). The per-step analogue of the census's
+        link split, from the analytic inventory — the signal the
+        brain/tuner reads to trade mesh layout against the slow link."""
+        with self._lock:
+            events = list(self._events.values())
+            links = dict(self._links)
+            accum = self._accum_steps
+        return self._link_totals(events, links, accum)
 
     def set_accum_steps(self, n: int):
         with self._lock:
@@ -120,7 +155,7 @@ class CommLedger:
             links = dict(self._links)
             accum = self._accum_steps
         for ev in events:
-            link = links.get(ev.axis, "ici")
+            link = self._link_of(ev, links)
             row = out.setdefault(ev.axis, {
                 "link": link, "bytes_per_step": 0, "est_seconds": 0.0,
                 "collectives": [],
@@ -144,6 +179,7 @@ class CommLedger:
         lines = [
             "# TYPE dlrover_tpu_comm_bytes_per_step gauge",
             "# TYPE dlrover_tpu_comm_est_seconds_per_step gauge",
+            "# TYPE dlrover_tpu_comm_bytes_total gauge",
             "# TYPE dlrover_tpu_axis_bandwidth_gbps gauge",
         ]
         with self._lock:
@@ -152,7 +188,7 @@ class CommLedger:
             links = dict(self._links)
             accum = self._accum_steps
         for ev in sorted(events, key=lambda e: (e.axis, e.name)):
-            link = links.get(ev.axis, "ici")
+            link = self._link_of(ev, links)
             label = (
                 f'collective="{ev.name}",kind="{ev.kind}",'
                 f'axis="{ev.axis}",link="{link}"'
@@ -168,6 +204,15 @@ class CommLedger:
                     f"dlrover_tpu_comm_est_seconds_per_step{{{label}}} "
                     f"{est:.9f}"
                 )
+        # per-link-class rollup: total analytic bytes/step per ici|dcn
+        # (the fleet-level "is the slow link loaded" signal — the
+        # goodput report carries the same split via GlobalStepReport)
+        per_link = self._link_totals(events, links, accum)
+        for link in sorted(per_link):
+            lines.append(
+                f'dlrover_tpu_comm_bytes_total{{link="{link}"}} '
+                f"{per_link[link]}"
+            )
         for axis, gbps in sorted(bw.items()):
             link = links.get(axis, "ici")
             lines.append(
@@ -182,9 +227,9 @@ comm_ledger = CommLedger()
 
 
 def record_collective(name: str, kind: str, axis: str, nbytes: int,
-                      count: int = 1, per: str = "step"):
+                      count: int = 1, per: str = "step", link: str = ""):
     """Module-level convenience used by call sites at trace time."""
-    comm_ledger.record(name, kind, axis, nbytes, count, per)
+    comm_ledger.record(name, kind, axis, nbytes, count, per, link)
 
 
 @contextlib.contextmanager

@@ -74,6 +74,12 @@ class WorkerContext:
         # input-wait seconds already shipped with earlier digests (the
         # spine counter is cumulative; reports carry the delta)
         self._input_wait_mark = 0.0
+        # whether this worker has ever shipped a comm_links split with
+        # a dcn row: after a resize REMOVES the slow link (slice loss →
+        # single-slice world) one more report must replace the master's
+        # stale dcn row, or the goodput report advertises slow-link
+        # load that no longer exists
+        self._sent_comm_links = False
         # drained-but-unsent digest window (failed report): merged into
         # the next report so the master's ledger never loses it
         self._unreported_digest = None
@@ -191,8 +197,37 @@ class WorkerContext:
 
             payload = merge_windows(self._unreported_digest, payload)
             self._unreported_digest = None
+        # per-link comm bytes (profiler/comm.py): the analytic ici/dcn
+        # split of this worker's program, riding the same throttled RPC
+        # — only attached when a slow link exists (a dcn row), so
+        # single-slice jobs add nothing to the wire. One FINAL split is
+        # sent after a resize removes the slow link, replacing the
+        # master's now-stale dcn row (record_comm_links is
+        # last-report-wins per rank).
+        comm_links = None
         try:
-            self.client.report_global_step(step, digest=payload)
+            from dlrover_tpu.profiler.comm import comm_ledger
+
+            links = comm_ledger.link_bytes()
+            if links.get("dcn"):
+                comm_links = links
+                self._sent_comm_links = True
+            elif self._sent_comm_links:
+                # the {"ici": 0} floor keeps the clearing report
+                # truthy through serde (an empty dict would be
+                # indistinguishable from "no split attached")
+                comm_links = links or {"ici": 0}
+                self._sent_comm_links = False
+        except Exception:
+            comm_links = None
+        try:
+            try:
+                self.client.report_global_step(
+                    step, digest=payload, comm_links=comm_links
+                )
+            except TypeError:
+                # link-unaware client (older stubs): plain report
+                self.client.report_global_step(step, digest=payload)
             self._last_reported_step = step
             self._last_report_ts = now
         except Exception as e:

@@ -39,6 +39,7 @@ from dlrover_tpu.common.log import logger
 from dlrover_tpu.lint import retrace_guard
 from dlrover_tpu.observability import trace
 from dlrover_tpu.observability.digest import StepTimeDigest
+from dlrover_tpu.ops import hier_collectives
 from dlrover_tpu.parallel.mesh import MeshConfig
 from dlrover_tpu.parallel.sharding import batch_spec
 from dlrover_tpu.train import live_reshard, warm_compile, zero1
@@ -86,6 +87,13 @@ class TrainConfig:
     # the params. The DLROVER_TPU_ZERO1 env flag overrides this knob in
     # both directions. No-op on meshes without a dp axis > 1.
     zero1: bool = False
+    # Hierarchical DCN-aware gradient reduction on multislice meshes
+    # (ops/hier_collectives.py): ICI reduce-scatter within each slice,
+    # DCN exchange of only the slice-local 1/dp_in shard, ICI
+    # all-gather. The DLROVER_TPU_HIER_COLLECTIVES env flag overrides
+    # this knob in both directions; the flat path is the fallback.
+    # No-op on single-slice meshes (the trainer's n_slices).
+    hier_collectives: bool = True
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -128,6 +136,7 @@ class ElasticTrainer:
         train_config: TrainConfig,
         worker_ctx=None,
         loss_factory: Optional[Callable[[Optional[Mesh]], Callable]] = None,
+        n_slices: int = 1,
     ):
         """``loss_fn`` may close over the live mesh (sharding
         constraints); that pins the step to one mesh forever. Passing
@@ -135,7 +144,13 @@ class ElasticTrainer:
         re-derive the loss for any mesh — which is what makes
         cross-world AOT compilation (``lower_step`` for a world that is
         not live) and true in-process ``remesh()`` possible. With only
-        ``loss_fn``, speculative neighbor compilation stays off."""
+        ``loss_fn``, speculative neighbor compilation stays off.
+
+        ``n_slices``: distinct TPU slices the mesh spans (the agent
+        injects it as ``DLROVER_TPU_NUM_SLICES`` — ``WorkerEnv.
+        num_slices``). >1 arms the hierarchical DCN-aware gradient
+        reduction (ops/hier_collectives.py) and the per-link comm
+        inventory; 1 (the default) is byte-identical to before."""
         self.loss_factory = loss_factory
         if loss_fn is None:
             if loss_factory is None:
@@ -146,6 +161,7 @@ class ElasticTrainer:
         self.mesh = mesh
         self.mesh_config = mesh_config
         self.tc = train_config
+        self.n_slices = max(1, int(n_slices))
         self.optimizer = make_optimizer(train_config)
         self.worker_ctx = worker_ctx
         self._step_fn = None
@@ -204,16 +220,18 @@ class ElasticTrainer:
     # ---- zero-1 weight-update sharding (train/zero1.py) ----------------
     @contextlib.contextmanager
     def _zero1_pin(self):
-        """Pin the effective zero-1 decision for the calling thread.
+        """Pin the effective zero-1 AND hier-collectives decisions for
+        the calling thread.
 
-        The ``DLROVER_TPU_ZERO1`` env flag is read live at build time
-        (flips take effect at the next build — the documented resize/
-        restore-boundary semantics). But ONE build reads it several
-        times (cache key, avatars, contract lookup, the step body), and
-        another thread's ``flags.ZERO1.scoped`` window (bench A/B legs,
-        contract lowering) can flip the env between those reads — a
-        cache key that says scatter over a replicated program, cached
-        forever. Pinning makes every ``_zero1_mode`` call within the
+        The ``DLROVER_TPU_ZERO1`` / ``DLROVER_TPU_HIER_COLLECTIVES``
+        env flags are read live at build time (flips take effect at the
+        next build — the documented resize/restore-boundary semantics).
+        But ONE build reads them several times (cache key, avatars,
+        contract lookup, the step body), and another thread's
+        ``flags.*.scoped`` window (bench A/B legs, contract lowering)
+        can flip the env between those reads — a cache key that says
+        scatter over a replicated program, cached forever. Pinning
+        makes every ``_zero1_mode`` / ``_hier_mode`` call within the
         ``with`` block (on this thread) see one consistent answer.
         Re-entrant: an outer pin wins."""
         tls = self._zero1_tls
@@ -221,10 +239,12 @@ class ElasticTrainer:
             yield
             return
         tls.enabled = zero1.enabled(self.tc)
+        tls.hier_enabled = hier_collectives.enabled(self.tc)
         try:
             yield
         finally:
             tls.enabled = None
+            tls.hier_enabled = None
 
     def _zero1_mode(self, mesh: Mesh) -> str:
         """``"off"`` | ``"scatter"`` | ``"gspmd"`` — how the weight
@@ -233,6 +253,40 @@ class ElasticTrainer:
         return zero1.mode_for(
             mesh, self.tc, self.loss_factory is not None,
             enabled_override=getattr(self._zero1_tls, "enabled", None),
+        )
+
+    def _slices_for(self, mesh: Mesh) -> int:
+        """Slice count of ``mesh``: the live mesh carries the trainer's
+        ``n_slices``; a warm-compile TARGET mesh (speculative neighbor,
+        cross-world lowering) derives it from the invariant that slices
+        are atomic resize units — devices per slice stay constant, so a
+        neighbor world's slice count is ``size / per_slice``. Worlds
+        that don't tile into whole slices are treated single-slice
+        (they could only run flat anyway)."""
+        return self._slices_for_size(mesh.size)
+
+    def _slices_for_size(self, size: int) -> int:
+        if self.n_slices <= 1:
+            return 1
+        if size == self.mesh.size:
+            return self.n_slices
+        per = self.mesh.size // self.n_slices
+        if per > 0 and size % per == 0:
+            return max(1, size // per)
+        return 1
+
+    def _hier_mode(self, mesh: Mesh) -> str:
+        """``"flat"`` | ``"hier"`` — how the dp gradient reduction is
+        scheduled over the slice topology (ops/hier_collectives.py).
+        Inside a ``_zero1_pin`` block the flag read is the pinned
+        snapshot, same as zero-1's."""
+        return hier_collectives.mode_for(
+            mesh, self._slices_for(mesh), self.tc,
+            self.loss_factory is not None,
+            zero1_mode=self._zero1_mode(mesh),
+            enabled_override=getattr(
+                self._zero1_tls, "hier_enabled", None
+            ),
         )
 
     def _state_avatar_for(self, mesh: Mesh) -> Optional[PyTree]:
@@ -386,13 +440,20 @@ class ElasticTrainer:
         reference derives NCCL bus bandwidth from algorithm formulas
         rather than observed packets (xpu_timer parse_params.cc).
         ``params`` may be live arrays or their avatars (remesh path)."""
-        from dlrover_tpu.profiler.comm import comm_ledger, record_collective
+        from dlrover_tpu.profiler.comm import (
+            axis_links,
+            comm_ledger,
+            record_collective,
+        )
 
         # a new trainer means a new program inventory: drop rows from any
         # previous mesh/config so /metrics never mixes dead and live
         # configurations (elastic resize, bench candidate sweeps)
         comm_ledger.clear()
         comm_ledger.set_accum_steps(self.accum_steps)
+        # per-link classification: on a multislice mesh the dp axis is
+        # the one DCN axis; hier-mode events below override per leg
+        comm_ledger.set_links(axis_links(self.mesh, self.n_slices))
         shape = dict(self.mesh.shape)
         param_bytes = sum(
             l.size * np.dtype(l.dtype).itemsize
@@ -425,7 +486,42 @@ class ElasticTrainer:
             # step; the census-diff test (tests/test_zero1.py) pins
             # this inventory against the lowered IR.
             grad_payload = param_bytes // max(fsdp, 1)
-            if mode == "scatter":
+            hier = self._hier_mode(self.mesh) == "hier"
+            dp_in = dp // self.n_slices if hier else dp
+            if hier and mode == "scatter":
+                # hierarchical zero-1 (ops/hier_collectives.py): ICI
+                # reduce-scatter within the slice, then a DCN
+                # reduce-scatter whose cut carries only the slice-local
+                # 1/dp_in shard and emits the owned 1/dp moment shard
+                record_collective(
+                    "dp.grad_reduce_scatter_ici", "reduce_scatter",
+                    "dp", nbytes=grad_payload // dp_in, count=1,
+                    per="loss_call", link="ici",
+                )
+                record_collective(
+                    "dp.grad_reduce_scatter_dcn", "reduce_scatter",
+                    "dp", nbytes=grad_payload // dp, count=1,
+                    per="loss_call", link="dcn",
+                )
+            elif hier:
+                # hierarchical replicated: RS (ici) → psum of the
+                # 1/dp_in shard (the only DCN leg) → all-gather (ici)
+                record_collective(
+                    "dp.grad_reduce_scatter_ici", "reduce_scatter",
+                    "dp", nbytes=grad_payload // dp_in, count=1,
+                    per="loss_call", link="ici",
+                )
+                record_collective(
+                    "dp.grad_allreduce_dcn", "psum", "dp",
+                    nbytes=grad_payload // dp_in, count=1,
+                    per="loss_call", link="dcn",
+                )
+                record_collective(
+                    "dp.grad_all_gather_ici", "all_gather", "dp",
+                    nbytes=grad_payload // dp_in, count=1,
+                    per="loss_call", link="ici",
+                )
+            elif mode == "scatter":
                 # explicit psum_scatter straight into the zero-1 layout
                 # (train/zero1.py sharded_value_and_grad)
                 record_collective(
@@ -479,6 +575,7 @@ class ElasticTrainer:
             else self.loss_fn
         )
         z1_mode = self._zero1_mode(mesh)
+        hier = self._hier_mode(mesh) == "hier"
         if z1_mode != "off" and self._params_avatar is None:
             # zero-1 derives its per-leaf layout from the param shapes;
             # a step built before any state exists (init_state and
@@ -509,7 +606,18 @@ class ElasticTrainer:
                 ),
                 self.p_specs, self._params_avatar, is_leaf=is_spec,
             )
-        if z1_mode == "scatter":
+        hier_grad_fn = None
+        if z1_mode == "scatter" and hier:
+            # multislice pure-dp: the dp reduction is the two-stage
+            # hierarchy — ICI reduce-scatter within the slice, then a
+            # DCN reduce-scatter of only the slice-local shard straight
+            # into the zero-1 layout (the dp4+2slice+zero1 contract
+            # pins the link split)
+            z1_grad_fn = hier_collectives.hier_value_and_grad(
+                self.loss_factory(None), mesh, self._slices_for(mesh),
+                self.p_specs, self._params_avatar, zero1_scatter=True,
+            )
+        elif z1_mode == "scatter":
             # pure-dp mesh: the loss+grad runs full-manual and the dp
             # reduction is an explicit psum_scatter straight into the
             # zero-1 layout — a REAL reduce-scatter in the lowered HLO
@@ -518,12 +626,21 @@ class ElasticTrainer:
                 self.loss_factory(None), mesh, self.p_specs,
                 self._params_avatar,
             )
+        elif hier:
+            # multislice, replicated weight update: same full-manual
+            # engine, grads come back FULL — the DCN cut carries the
+            # 1/dp_in shard instead of the whole gradient
+            hier_grad_fn = hier_collectives.hier_value_and_grad(
+                self.loss_factory(None), mesh, self._slices_for(mesh),
+                self.p_specs, None, zero1_scatter=False,
+            )
 
         def step(state, batch):
             # batch: any pytree whose leaves lead with (accum, micro*dp):
             # token arrays for the LM families, (images, labels) for CV
             grad_of = (
                 z1_grad_fn if z1_grad_fn is not None
+                else hier_grad_fn if hier_grad_fn is not None
                 else jax.value_and_grad(loss_fn)
             )
             if accum == 1:
@@ -645,6 +762,12 @@ class ElasticTrainer:
             # miss its own checked-in plain contract (a spurious
             # config_hash-mismatch failure, a veto under strict mode)
             parts.append("zero1=1")
+        if self._hier_mode(mesh) == "hier":
+            # same asymmetry: the hierarchical step is a genuinely
+            # different program (its own +Nslice contract); flat-path
+            # hashes — including flat-on-a-multislice-mesh, the
+            # kill-switch fallback — stay what they always were
+            parts.append(f"hier={self._slices_for(mesh)}")
         for av in jax.tree.leaves(self._state_avatar):
             parts.append(f"{av.shape}/{av.dtype}")
         return warm_compile.signature_hash(parts)
@@ -670,6 +793,9 @@ class ElasticTrainer:
             # scatter and gspmd lower different programs, and a flag
             # flip between builds must never warm-hit a stale executable
             f"zero1={self._zero1_mode(mesh)}",
+            # flat and hier lower different programs too — and the SAME
+            # device set re-seated as a different slice count must miss
+            f"hier={self._hier_mode(mesh)}x{self._slices_for(mesh)}",
         ]
         for av in jax.tree.leaves(self._state_avatar_for(mesh)):
             parts.append(f"{av.spec}")
@@ -797,10 +923,9 @@ class ElasticTrainer:
                     hints["seq_len"] = int(av.shape[2])
                     break
         z1 = self._zero1_mode(mesh) != "off"
+        hier = self._hier_mode(mesh) == "hier"
         return shardcheck.StepProgram(
-            label="hlo:" + shardcheck.contract_spec_of(
-                dict(mesh.shape), z1
-            ),
+            label="hlo:" + self._contract_spec(mesh),
             stablehlo=lowered.as_text(),
             hlo=compiled.as_text(),
             axis_sizes=dict(mesh.shape),
@@ -809,6 +934,29 @@ class ElasticTrainer:
             world=mesh.size,
             config_hash=config_hash,
             zero1=z1,
+            # slice topology for the per-link (ici/dcn) census
+            # attribution — passed whenever the mesh is multislice, so
+            # even a flat (kill-switch) program's census shows what the
+            # slow link carries
+            n_slices=self._slices_for(mesh),
+        )
+
+    def _contract_spec(self, mesh: Mesh) -> str:
+        """The SC001 contract key for the program this trainer builds
+        on ``mesh``: the mesh spec, ``+Nslice`` when the hierarchical
+        strategy is active (a different program with its own census),
+        ``+zero1`` when weight-update sharding is on. A multislice mesh
+        running the FLAT path keys the plain spec — its census is the
+        single-slice program's."""
+        from dlrover_tpu.lint import shardcheck
+
+        return shardcheck.contract_spec_of(
+            dict(mesh.shape),
+            zero1=self._zero1_mode(mesh) != "off",
+            n_slices=(
+                self._slices_for(mesh)
+                if self._hier_mode(mesh) == "hier" else 1
+            ),
         )
 
     def _maybe_shardcheck(
@@ -831,10 +979,7 @@ class ElasticTrainer:
                 or shardcheck.DEFAULT_CONTRACTS_DIR
             )
             contract = shardcheck.load_contract(
-                contracts_dir,
-                shardcheck.contract_spec_of(
-                    dict(mesh.shape), self._zero1_mode(mesh) != "off"
-                ),
+                contracts_dir, self._contract_spec(mesh)
             )
             if (
                 contract is not None
@@ -958,6 +1103,7 @@ class ElasticTrainer:
                 devices_per_node=jax.local_device_count(),
                 global_batch_size=self.tc.global_batch_size,
                 micro_batch_size=self.tc.micro_batch_size,
+                n_slices=self.n_slices,
             )
         except Exception:
             return
@@ -969,7 +1115,15 @@ class ElasticTrainer:
             from dlrover_tpu.parallel.mesh import remesh as remesh_config
 
             cfg = remesh_config(self.mesh_config, w).resolve(w)
-            mesh = build_mesh(cfg, devices=jax.devices()[:w])
+            # multislice: a neighbor world is a whole number of slices
+            # (neighbor_worlds guarantees it) — build it slice-major so
+            # the speculated executable IS the post-slice-loss program
+            # (the hierarchical strategy and the ici/dcn layout both
+            # key on it)
+            slices = self._slices_for_size(w)
+            mesh = build_mesh(
+                cfg, devices=jax.devices()[:w], n_slices=slices
+            )
             _, info = self.lower_step(mesh, cfg, source="speculative")
             # no log once shutdown began: the interpreter may have
             # closed the log streams under this daemon thread
@@ -1249,6 +1403,7 @@ class ElasticTrainer:
         mesh_config: MeshConfig,
         state: Optional[dict] = None,
         rendezvous_s: float = 0.0,
+        n_slices: Optional[int] = None,
     ) -> Optional[dict]:
         """After a membership change: adopt the new mesh; the jitted step is
         rebuilt (recompiled) lazily; accumulation re-derives so the global
@@ -1267,7 +1422,12 @@ class ElasticTrainer:
         before calling here (the agent/worker measured the
         re-rendezvous); stamped into the pending resize event so the
         breakdown report and the trace spine carry the rendezvous half
-        of the downtime bracket instead of a hardcoded zero."""
+        of the downtime bracket instead of a hardcoded zero.
+
+        ``n_slices``: the new world's slice count (a slice loss resizes
+        it). ``None`` keeps the slices-are-atomic derivation — the new
+        world re-tiles into the old per-slice size where possible, else
+        single-slice (a caller that knows better passes it)."""
         old = self.accum_steps
         dp = mesh_config.resolve(mesh.size).data_parallel_size
         denom = self.tc.micro_batch_size * dp
@@ -1311,8 +1471,13 @@ class ElasticTrainer:
                     "restore from checkpoint", old_world, mesh.size, e,
                 )
                 new_state = None
+        new_slices = (
+            max(1, int(n_slices)) if n_slices is not None
+            else self._slices_for_size(mesh.size)
+        )
         self.mesh = mesh
         self.mesh_config = mesh_config
+        self.n_slices = new_slices
         self._step_fn = None
         self._eval_fn = None  # its NamedSharding binds the old mesh
         self._pending_resize = {

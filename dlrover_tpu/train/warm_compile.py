@@ -328,14 +328,15 @@ def neighbor_worlds(
     global_batch_size: int,
     micro_batch_size: int,
     max_targets: Optional[int] = None,
+    n_slices: int = 1,
 ) -> List[int]:
     """World sizes a resize is likely to land on, filtered to the ones
     we can actually compile for from here.
 
     Candidates, in priority order: world minus one node (the single
-    most common elastic event — a preemption/eviction), world/2 (slice
-    loss in multislice, or an autoscaler halving), world plus one node
-    (node recovered). A candidate survives only if
+    most common elastic event — a preemption/eviction), world/2 (an
+    autoscaler halving), world plus one node (node recovered). A
+    candidate survives only if
 
     - it differs from ``world`` and is > 0;
     - a mesh for it exists within the attached device set (speculation
@@ -346,13 +347,29 @@ def neighbor_worlds(
       model axes are preserved, so the world must still hold them;
     - the elastic global-batch invariant holds: ``global_batch %
       (micro_batch * dp') == 0`` for the refit config.
-    """
+
+    ``n_slices > 1`` (multislice): the resize unit is a whole SLICE,
+    not a node — a preemption takes the slice with it and the survivor
+    worlds are whole-slice multiples. Candidates become world minus one
+    slice (the most common multislice loss), half the slices, world
+    plus one slice; every candidate must tile into whole slices AND the
+    refit dp must still decompose over the surviving slice count (dp is
+    the only axis allowed to span DCN). A slice loss then resizes warm:
+    the speculated executable was compiled on the slice-major neighbor
+    mesh the re-seated world actually forms."""
     from dlrover_tpu.parallel.mesh import remesh as remesh_config
 
     if max_targets is None:
         max_targets = int(flags.WARM_COMPILE_MAX_TARGETS.get())
     node = max(1, devices_per_node)
-    raw = [world - node, world // 2, world + node]
+    per_slice = world // n_slices if n_slices > 1 else 0
+    if n_slices > 1 and (world % n_slices or per_slice == 0):
+        per_slice = 0
+    if per_slice:
+        raw = [world - per_slice, (n_slices // 2) * per_slice,
+               world + per_slice]
+    else:
+        raw = [world - node, world // 2, world + node]
     out: List[int] = []
     for w in raw:
         if w <= 0 or w == world or w in out:
@@ -361,11 +378,20 @@ def neighbor_worlds(
             continue
         try:
             refit = remesh_config(mesh_config, w)
-            dp = refit.resolve(w).data_parallel_size
+            resolved = refit.resolve(w)
+            dp = resolved.data_parallel_size
         except ValueError:
             continue
         if global_batch_size % (micro_batch_size * dp):
             continue
+        if per_slice:
+            slices = w // per_slice
+            if w % per_slice:
+                continue
+            # the surviving world must still host a legal multislice
+            # mesh: dp spans DCN, nothing else may
+            if slices > 1 and resolved.dp % slices:
+                continue
         out.append(w)
         if len(out) >= max_targets:
             break

@@ -61,7 +61,11 @@ tc = TrainConfig(
     warmup_steps=0, total_steps=TOTAL_STEPS + 1,
 )
 trainer = ElasticTrainer(
-    lambda p, t: llama.loss_fn(p, t, cfg, mesh), specs, mesh, mc, tc
+    lambda p, t: llama.loss_fn(p, t, cfg, mesh), specs, mesh, mc, tc,
+    # slice topology → per-link (ici/dcn) comm inventory; the
+    # hierarchical reduction itself stays flat here (tp=2 mixed mesh,
+    # no loss factory — ops/hier_collectives.py limits)
+    n_slices=n_slices,
 )
 state = trainer.init_state(params)
 

@@ -198,3 +198,49 @@ def test_bench_resize_phase_contract(tmp_path):
     assert z1["argument_saved_bytes"] > 0, z1
     assert z1["temp_saved_bytes"] > 0, z1
     assert z1["on"]["dp_axis_bytes"] < z1["off"]["dp_axis_bytes"]
+
+
+@pytest.mark.slow
+def test_bench_multislice_contract(tmp_path):
+    """ISSUE 13 acceptance, pinned on the 8-device 2-virtual-slice CPU
+    world (dp8, dp_in=4): the bench multislice leg runs both legs, the
+    hierarchical program's ledger DCN bytes are exactly 1/dp_in of the
+    flat path's, the per-link census confirms the drop with its ICI
+    legs dcn-free, and step-loss parity holds (the fast path is the
+    same math).
+
+    Slow-marked for the same budget reason as the ckpt dedup contract;
+    CI runs it explicitly in the tier1.yml hierarchical-collectives
+    step."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DLROVER_BENCH_PROBE_ATTEMPTS"] = "1"
+    env["DLROVER_BENCH_PHASES"] = "multislice"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jitcache")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+    ms = d["detail"]["multislice"]
+    assert "multislice" in d["detail"]["phases_done"], ms
+    assert ms["n_slices"] == 2 and ms["world"] == 8
+    dp_in = ms["world"] // ms["n_slices"]
+    assert ms["flat"]["mode"] == "flat"
+    assert ms["hier"]["mode"] == "hier"
+    # the headline: analytic DCN bytes drop to exactly 1/dp_in
+    assert ms["dcn_bytes_ratio"] == pytest.approx(1.0 / dp_in)
+    # per-link census: the hier program moves strictly less over DCN,
+    # and its within-slice RS/AG legs are dcn-free
+    assert 0 < ms["hier"]["census_dcn_bytes"] < \
+        ms["flat"]["census_dcn_bytes"]
+    cells = ms["hier"]["census_dp_cells"]
+    assert cells["reduce-scatter|dp"]["dcn_bytes"] == 0
+    assert cells["all-gather|dp"]["dcn_bytes"] == 0
+    # contract keys: the hier leg is its own program variant
+    assert ms["hier"]["contract_spec"] == "dp8+2slice"
+    assert ms["flat"]["contract_spec"] == "dp8"
+    # step-loss parity (bitwise-or-tolerance acceptance)
+    assert ms["max_loss_delta"] <= 2e-5

@@ -194,6 +194,121 @@ def test_resize_breakdown_report_reaches_speed_monitor():
     assert back.compile_s == 9.0
 
 
+def test_comm_link_split_reaches_goodput_report():
+    """The per-link comm bytes (GlobalStepReport.comm_links,
+    profiler/comm.py link_bytes) reach the SpeedMonitor through the
+    servicer, aggregate max-across-ranks into the goodput report's
+    ici/dcn section, survive a master relaunch, and are forgotten on
+    eviction."""
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.constants import NodeType
+    from dlrover_tpu.common.serde import deserialize, serialize
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    sm = SpeedMonitor()
+    servicer = MasterServicer(speed_monitor=sm)
+    # the wire path: serde round-trip keeps the split
+    wire = serialize(msg.GlobalStepReport(
+        node_id=0, step=10, comm_links={"ici": 1000, "dcn": 250},
+    ))
+    back = deserialize(wire)
+    assert back.comm_links == {"ici": 1000, "dcn": 250}
+    servicer.report(back)
+    servicer.report(msg.GlobalStepReport(
+        node_id=1, step=10, comm_links={"ici": 1000, "dcn": 260},
+    ))
+    # a link-less report (single-slice worker / old version) is a no-op
+    servicer.report(msg.GlobalStepReport(node_id=2, step=10))
+    report = sm.comm_link_report()
+    assert report["per_step_bytes"] == {"ici": 1000, "dcn": 260}
+    assert report["ranks_reporting"] == 2
+    assert report["dcn_share"] == round(260 / 1260, 4)
+    # malformed payloads are dropped, never raised on the hot path
+    sm.record_comm_links(3, {"dcn": "not-a-number"})
+    assert sm.comm_link_report()["ranks_reporting"] == 2
+    # relaunch: the split survives export/import
+    sm2 = SpeedMonitor()
+    sm2.import_state(sm.export_state())
+    assert sm2.comm_link_report()["per_step_bytes"] == {
+        "ici": 1000, "dcn": 260,
+    }
+    # eviction forgets the departed rank's row
+    sm2.evict_worker(NodeType.WORKER, 1)
+    assert sm2.comm_link_report()["per_step_bytes"]["dcn"] == 250
+
+
+def test_comm_ledger_link_bytes_and_metrics_rows():
+    """profiler/comm.py: link_bytes() splits the analytic inventory by
+    link class (explicit per-event link beats the axis map — the
+    hierarchical legs run BOTH classes over the dp axis), and the
+    /metrics endpoint exports dlrover_tpu_comm_bytes_total{link=...}."""
+    from dlrover_tpu.profiler.comm import CommLedger
+
+    ledger = CommLedger()
+    ledger.set_links({"dp": "dcn", "tp": "ici"})
+    ledger.set_accum_steps(2)
+    ledger.record("dp.grad_allreduce", "psum", "dp", nbytes=100,
+                  per="loss_call")           # dcn via the axis map, x2
+    ledger.record("tp.act", "all_gather", "tp", nbytes=40)   # ici
+    ledger.record("dp.rs_ici", "reduce_scatter", "dp", nbytes=60,
+                  link="ici")                # explicit override wins
+    assert ledger.link_bytes() == {"dcn": 200, "ici": 100}
+    rows = "\n".join(ledger.prometheus_lines())
+    assert 'dlrover_tpu_comm_bytes_total{link="dcn"} 200' in rows
+    assert 'dlrover_tpu_comm_bytes_total{link="ici"} 100' in rows
+    assert 'collective="dp.rs_ici",kind="reduce_scatter",axis="dp",' \
+           'link="ici"' in rows
+
+
+def test_worker_clears_stale_dcn_row_after_slice_loss():
+    """Review fix: a resize that REMOVES the slow link (slice loss →
+    single-slice world) rebuilds the ledger with no dcn row; the
+    worker must ship one final split so the master's last-report-wins
+    row stops advertising slow-link load that no longer exists — then
+    go quiet like any single-slice worker."""
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.profiler.comm import comm_ledger
+    from dlrover_tpu.train.bootstrap import WorkerContext, WorkerEnv
+
+    sent = []
+
+    class _Client:
+        def report_global_step(self, step, digest=None, comm_links=None):
+            sent.append(comm_links)
+
+    ctx = WorkerContext(WorkerEnv(), _Client())
+    ctx.step_report_interval = 0.0
+    comm_ledger.clear()
+    comm_ledger.set_links({"dp": "dcn"})
+    comm_ledger.record("dp.grad_allreduce", "psum", "dp", nbytes=100)
+    sm = SpeedMonitor()
+    ctx.report_step(1, force=True)
+    assert sent[-1] == {"dcn": 100}
+    sm.record_comm_links(0, sent[-1])
+    assert sm.comm_link_report()["per_step_bytes"]["dcn"] == 100
+    # slice loss: the rebuilt inventory has no dcn leg
+    comm_ledger.clear()
+    comm_ledger.set_links({"dp": "ici"})
+    comm_ledger.record("dp.grad_allreduce", "psum", "dp", nbytes=80)
+    ctx.report_step(2, force=True)
+    assert sent[-1] == {"ici": 80}  # the clearing report
+    sm.record_comm_links(0, sent[-1])
+    report = sm.comm_link_report()
+    assert report["per_step_bytes"].get("dcn", 0) == 0
+    assert report["dcn_share"] == 0.0
+    # steady state: a single-link worker goes quiet again
+    ctx.report_step(3, force=True)
+    assert sent[-1] is None
+    # an EMPTY rebuilt ledger still clears (the {"ici": 0} floor keeps
+    # the report truthy through serde)
+    ctx._sent_comm_links = True
+    comm_ledger.clear()
+    ctx.report_step(4, force=True)
+    assert sent[-1] == {"ici": 0}
+    comm_ledger.clear()
+
+
 def test_attribution_scales_overflowing_lost_seconds_into_wall():
     """Catch-up digest reports can compress many windows into a young
     job (also: clock skew); the measured lost categories then exceed
@@ -312,6 +427,11 @@ def test_goodput_over_95_percent_with_injected_failure(tmp_path):
                 "attribution": sm.attribution(),
                 # runtime straggler policy state + per-rank digests
                 "stragglers": sm.straggler_report(),
+                # per-link comm split (ici/dcn bytes per step) the
+                # workers report via GlobalStepReport.comm_links — the
+                # brain/tuner's slow-link signal (profiler/comm.py);
+                # zeros on this single-slice CPU run
+                "comm_links": sm.comm_link_report(),
                 "goodput": round(goodput, 4),
                 "steps": steps,
                 "reference_claim": "README.md:46-48 (69% -> 95%+)",

@@ -180,3 +180,90 @@ def test_multislice_train_loss_and_grads_match_single_device():
         for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_ref))
     )
     assert err < 1e-4, err
+
+
+# -- mesh_slice_of / axis_links edge cases ----------------------------------
+
+
+def test_mesh_slice_of_rejects_bad_topologies():
+    """Non-divisible slice counts and out-of-range indices fail loudly
+    (the old floored quotient silently answered a WRONG slice id for
+    dp % n_slices != 0, and n_slices > dp crashed with // 0)."""
+    mesh = build_mesh(MeshConfig(dp=-1).resolve(8),
+                      devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="tile"):
+        mesh_slice_of(mesh, 3, 0)  # dp=8 % 3 != 0
+    with pytest.raises(ValueError, match=">= 1"):
+        mesh_slice_of(mesh, 0, 0)
+    with pytest.raises(ValueError, match="outside"):
+        mesh_slice_of(mesh, 2, 8)
+    with pytest.raises(ValueError, match="outside"):
+        mesh_slice_of(mesh, 2, -1)
+
+
+def test_mesh_slice_of_single_slice_degenerate():
+    """n_slices=1: every dp index lives on slice 0 (the degenerate
+    mesh every single-slice job runs)."""
+    mesh = build_mesh(MeshConfig(dp=-1).resolve(4),
+                      devices=jax.devices()[:4])
+    assert [mesh_slice_of(mesh, 1, i) for i in range(4)] == [0, 0, 0, 0]
+
+
+def test_axis_links_classification():
+    """axis_links: dp is the ONE dcn axis on a multislice mesh; every
+    axis is ici on a single-slice mesh (degenerate case); virtual
+    (slice_index-less) slices classify the same as real ones — the
+    layout, not the device attribute, decides."""
+    from dlrover_tpu.profiler.comm import axis_links
+
+    # CPU devices carry no slice_index: build_mesh falls back to
+    # contiguous virtual slices, and axis_links still classifies
+    mesh = build_mesh(
+        MeshConfig(dp=4, fsdp=1, ep=1, sp=1, tp=2),
+        devices=jax.devices()[:8], n_slices=2,
+    )
+    assert all(getattr(d, "slice_index", None) is None
+               for d in mesh.devices.flat)
+    links = axis_links(mesh, 2)
+    assert links["dp"] == "dcn"
+    assert links["tp"] == "ici" and links["fsdp"] == "ici"
+    # single-slice degenerate: everything ici, dp included
+    assert set(axis_links(mesh, 1).values()) == {"ici"}
+
+
+def test_axis_links_track_resize_across_slice_counts():
+    """A resize that collapses 2 slices to 1 (slice loss) must re-
+    classify dp as ici — the trainer refreshes the ledger's link map
+    from the post-resize slice count at remesh()."""
+    import numpy as np
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel import named_shardings
+    from dlrover_tpu.profiler.comm import comm_ledger
+    from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    cfg = llama.LlamaConfig.tiny()
+    mc = MeshConfig(dp=-1).resolve(8)
+    mesh = build_mesh(mc, devices=jax.devices()[:8], n_slices=2)
+    tc = TrainConfig(global_batch_size=16, micro_batch_size=2,
+                     warmup_steps=0, total_steps=100)
+    tr = ElasticTrainer(
+        None, llama.param_specs(cfg), mesh, mc, tc,
+        loss_factory=lambda m: (lambda p, t: llama.loss_fn(p, t, cfg, m)),
+        n_slices=2,
+    )
+    params = jax.device_put(
+        llama.init_params(cfg, jax.random.key(0)),
+        named_shardings(mesh, llama.param_specs(cfg)),
+    )
+    state = tr.init_state(params)
+    rows = "\n".join(comm_ledger.prometheus_lines())
+    assert 'link="dcn"' in rows  # the multislice inventory
+    # lose a slice: 8 devices / 2 slices -> 4 devices / 1 slice
+    mc4 = MeshConfig(dp=-1).resolve(4)
+    mesh4 = build_mesh(mc4, devices=jax.devices()[:4])
+    tr.remesh(mesh4, mc4, state=None)
+    assert tr.n_slices == 1
+    rows = "\n".join(comm_ledger.prometheus_lines())
+    assert 'link="dcn"' not in rows  # dp back on ICI
+    assert 'link="ici"' in rows

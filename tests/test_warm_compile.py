@@ -249,6 +249,48 @@ def test_neighbor_worlds_heuristic():
     ) == [2]
 
 
+def test_neighbor_worlds_multislice_slice_steps():
+    """Multislice: the resize unit is a whole SLICE — candidates are
+    whole-slice multiples (a slice loss resizes warm), never node-sized
+    steps that would strand a partial slice."""
+    mc = MeshConfig(dp=-1).resolve(8)
+    # 8 devices = 4 slices of 2: lose a slice (6), half (4), grow (not
+    # available). Every candidate is a whole number of slices.
+    got = wc.neighbor_worlds(
+        8, mc, n_devices_available=8, devices_per_node=1,
+        global_batch_size=24, micro_batch_size=1, n_slices=4,
+        max_targets=3,
+    )
+    assert got == [6, 4]
+    per = 8 // 4
+    assert all(w % per == 0 for w in got)
+    # 2 slices of 4: minus-one-slice and half-the-slices coincide (4);
+    # grow target admitted when the devices exist
+    assert wc.neighbor_worlds(
+        8, mc, n_devices_available=12, devices_per_node=1,
+        global_batch_size=24, micro_batch_size=1, n_slices=2,
+        max_targets=3,
+    ) == [4, 12]
+    # a dp that would not decompose over the surviving slice count is
+    # filtered: world 12 in 3 slices of 4, minus a slice = 8 in 2
+    # slices → dp'=8 % 2 == 0 fine; but with tp=4 → dp'=2, slices
+    # survive; with tp=8 the refit fails entirely
+    mc_tp = MeshConfig(dp=-1, tp=4).resolve(12)
+    got = wc.neighbor_worlds(
+        12, mc_tp, n_devices_available=12, devices_per_node=1,
+        global_batch_size=12, micro_batch_size=1, n_slices=3,
+        max_targets=3,
+    )
+    assert 8 in got
+    # single-slice behavior is byte-identical to before (n_slices=1
+    # defaults)
+    assert wc.neighbor_worlds(
+        8, MeshConfig(dp=-1, fsdp=1, tp=2).resolve(8),
+        n_devices_available=8, devices_per_node=1,
+        global_batch_size=8, micro_batch_size=2, n_slices=1,
+    ) == [4]
+
+
 def test_enable_persistent_cache_respects_existing(tmp_path, monkeypatch):
     """The first configured cache dir wins — never repoint a cache jax
     already has (bench's per-user cache, a user's env)."""

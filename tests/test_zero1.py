@@ -212,12 +212,28 @@ def test_flag_scoped_pin_and_restore(monkeypatch):
 def test_contract_spec_roundtrip():
     assert shardcheck.contract_spec_of({"dp": 4}, True) == "dp4+zero1"
     assert shardcheck.contract_spec_of({"dp": 4}, False) == "dp4"
-    assert shardcheck.parse_contract_spec("dp4+zero1") == ({"dp": 4}, True)
+    assert shardcheck.parse_contract_spec("dp4+zero1") == (
+        {"dp": 4}, True, 1
+    )
     assert shardcheck.parse_contract_spec("sp2xdp2") == (
-        {"sp": 2, "dp": 2}, False
+        {"sp": 2, "dp": 2}, False, 1
+    )
+    # the multislice hierarchical variants (ops/hier_collectives.py):
+    # canonical suffix order mesh + Nslice + zero1
+    assert shardcheck.contract_spec_of({"dp": 4}, False, 2) == \
+        "dp4+2slice"
+    assert shardcheck.contract_spec_of({"dp": 4}, True, 2) == \
+        "dp4+2slice+zero1"
+    assert shardcheck.parse_contract_spec("dp4+2slice+zero1") == (
+        {"dp": 4}, True, 2
+    )
+    assert shardcheck.parse_contract_spec("dp8+4slice") == (
+        {"dp": 8}, False, 4
     )
     with pytest.raises(ValueError):
         shardcheck.parse_contract_spec("zz4+zero1")
+    with pytest.raises(ValueError):
+        shardcheck.parse_contract_spec("+2slice")
 
 
 # ---------------------------------------------------------------------------
