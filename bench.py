@@ -670,7 +670,8 @@ def _bench_state_transfer(
     import jax.numpy as jnp  # noqa: F401  (kept local like the caller)
 
     from dlrover_tpu.checkpoint.engine import CheckpointEngine
-    from dlrover_tpu.parallel import build_mesh
+    from dlrover_tpu.common.world import WorldDescriptor
+    from dlrover_tpu.parallel import config_for, mesh_for
     from dlrover_tpu.parallel.mesh import remesh as remesh_config
     from dlrover_tpu.train import live_reshard as lrs
 
@@ -681,8 +682,14 @@ def _bench_state_transfer(
     avatars = tr._state_avatar
     state_bytes = sum(av.size * av.dtype.itemsize
                       for av in jax.tree.leaves(avatars))
-    mc_t = remesh_config(mc_full, target).resolve(target)
-    mesh_t = build_mesh(mc_t, devices=devs[:target])
+    # the one checked world vocabulary (common/world.py): the shm
+    # round-trip's restore targets and the live transfer resize to the
+    # SAME descriptor
+    wd_t = WorldDescriptor.from_axis_sizes(
+        remesh_config(mc_full, target).resolve(target).shape()
+    )
+    mc_t = config_for(wd_t)
+    mesh_t = mesh_for(wd_t, devices=devs)
 
     # shm round-trip reference: what the restart path pays for state
     tmpd = tempfile.mkdtemp(prefix="dlrover_bench_reshard_")
@@ -753,7 +760,14 @@ def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
     path, flagged in ``mode``)."""
     import numpy as np
 
-    from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
+    from dlrover_tpu.common.world import WorldDescriptor
+    from dlrover_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+        config_for,
+        mesh_for,
+        named_shardings,
+    )
     from dlrover_tpu.parallel.mesh import remesh as remesh_config
     from dlrover_tpu.train import warm_compile as wc
     from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
@@ -804,10 +818,22 @@ def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
         )
         return state, batch
 
+    def descriptor_for(world_n) -> WorldDescriptor:
+        """Candidate worlds as WorldDescriptors (common/world.py): the
+        same checked type the warm-compile speculation targets and the
+        contract specs use, so the cold and warm legs resize to the
+        identical world by construction instead of re-deriving mesh
+        shape per leg."""
+        return WorldDescriptor.from_axis_sizes(
+            remesh_config(mc_full, world_n).resolve(world_n).shape()
+        )
+
+    target_world = descriptor_for(target)
+
     def make_trainer(world_n):
-        mc = remesh_config(mc_full, world_n).resolve(world_n)
-        mesh = build_mesh(mc, devices=devs[:world_n])
-        tr = ElasticTrainer(None, specs, mesh, mc, tc,
+        wd = descriptor_for(world_n)
+        mesh = mesh_for(wd, devices=devs)
+        tr = ElasticTrainer(None, specs, mesh, config_for(wd), tc,
                             loss_factory=factory)
         state, batch = place_for(tr)
         return tr, state, batch
@@ -815,8 +841,8 @@ def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
     def resize_downtime(tr):
         """remesh to the target world (a no-op world change in
         same_world mode) and time remesh→first-step."""
-        mc_t = remesh_config(mc_full, target).resolve(target)
-        mesh_t = build_mesh(mc_t, devices=devs[:target])
+        mc_t = config_for(target_world)
+        mesh_t = mesh_for(target_world, devices=devs)
         tr.remesh(mesh_t, mc_t)
         state_t, batch_t = place_for(tr)
         t0 = time.perf_counter()

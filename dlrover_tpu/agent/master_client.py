@@ -102,13 +102,31 @@ class MasterClient:
 
     def rendezvous_status(
         self, rdzv_name: str = RendezvousName.TRAINING
-    ) -> Tuple[int, int]:
-        """(waiting_num, latest_round). A worker whose seated round is
-        older than ``latest_round`` is hung in a dead collective (the
-        hang watchdog re-formed the world without it) and must re-join
-        even though nobody is waiting."""
+    ) -> Tuple[int, int, Dict]:
+        """(waiting_num, latest_round, speculation_hint). A worker
+        whose seated round is older than ``latest_round`` is hung in a
+        dead collective (the hang watchdog re-formed the world without
+        it) and must re-join even though nobody is waiting. The hint is
+        the goodput planner's intended next world ({} = no intent /
+        pre-planner master — the ``getattr`` default keeps version
+        skew harmless); it rides the SAME response so a caller that
+        already polls membership pays zero extra RPCs for it."""
         resp = self._client.get(msg.NumNodesWaitingRequest(rdzv_name=rdzv_name))
-        return resp.waiting_num, getattr(resp, "latest_round", 0)
+        return (
+            resp.waiting_num,
+            getattr(resp, "latest_round", 0),
+            dict(getattr(resp, "speculation_hint", None) or {}),
+        )
+
+    def speculation_hint(
+        self, rdzv_name: str = RendezvousName.TRAINING
+    ) -> Dict:
+        """Hint-only poll for processes that do NOT otherwise poll
+        membership (the training worker's throttled
+        ``WorkerContext.poll_speculation_hint``); anything already
+        calling :meth:`rendezvous_status` should read the hint from
+        that response instead of paying a second RPC."""
+        return self.rendezvous_status(rdzv_name)[2]
 
     def network_ready(self) -> Tuple[bool, str]:
         resp = self._client.get(msg.NetworkReadyRequest())

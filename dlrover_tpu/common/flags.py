@@ -349,6 +349,55 @@ HANG_WATCHDOG_WINDOW_S = _define(
     "eval); one slow RANK never trips it (that is the straggler "
     "detector's job).",
 )
+# -- goodput planner (brain/planner.py; docs/design/brain_planner.md)
+
+PLANNER = _define(
+    "DLROVER_TPU_PLANNER", False, "bool",
+    "Arm the goodput planner (brain/planner.py): scale decisions are "
+    "driven by the measured goodput ledger (digest p50s, per-link comm "
+    "bytes, resize-downtime breakdown, straggler flags) with "
+    "payback-amortized scoring, hysteresis and cooldown, instead of "
+    "the legacy CPU/memory heuristics. Off by default; the fleet "
+    "harness arms it per scenario.",
+)
+PLANNER_COOLDOWN_S = _define(
+    "DLROVER_TPU_PLANNER_COOLDOWN_S", 300.0, "float",
+    "Seconds after an executed plan during which every decision is "
+    "HOLD — at most one executed plan per cooldown window, so a noisy "
+    "signal can never flap the fleet.",
+)
+PLANNER_HORIZON_S = _define(
+    "DLROVER_TPU_PLANNER_HORIZON_S", 1800.0, "float",
+    "Payback horizon: a resize is accepted only if its predicted "
+    "throughput gain amortizes the measured resize downtime within "
+    "this many seconds (ElasWave-style payback scoring).",
+)
+PLANNER_HYSTERESIS = _define(
+    "DLROVER_TPU_PLANNER_HYSTERESIS", 2, "int",
+    "Consecutive decisions the SAME winning candidate must survive "
+    "before it becomes a plan; instability (stragglers, open downtime) "
+    "resets the streak, so one healthy window never flips a decision.",
+)
+PLANNER_INTERVAL_S = _define(
+    "DLROVER_TPU_PLANNER_INTERVAL_S", 30.0, "float",
+    "Decision cadence: planner.sweep() no-ops until this many seconds "
+    "passed since the last decision.",
+)
+PLANNER_HBM_GB = _define(
+    "DLROVER_TPU_PLANNER_HBM_GB", 0.0, "float",
+    "Per-device HBM capacity (GB) for the planner's shrink-feasibility "
+    "gate: with it set, a candidate whose projected occupancy "
+    "(reported used x world/world') lands inside the headroom reserve "
+    "is rejected. 0 (default) = unknown — the gate is off and the "
+    "trainer's own OOM recovery remains the backstop.",
+)
+PLANNER_DCN_GBPS = _define(
+    "DLROVER_TPU_PLANNER_DCN_GBPS", 25.0, "float",
+    "Assumed DCN bandwidth (GB/s) for converting the measured "
+    "per-step dcn bytes into predicted step seconds at candidate "
+    "worlds (the ICI/DCN byte model from ops/hier_collectives).",
+)
+
 LOCK_TRACKER = _define(
     "DLROVER_TPU_LOCK_TRACKER", False, "bool",
     "Runtime lock-discipline tracker (lint/lock_tracker.py): wraps the "

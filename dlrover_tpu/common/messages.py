@@ -117,6 +117,15 @@ class NumNodesWaitingResponse:
     # nobody is waiting. 0 on a pre-watchdog master — old workers keep
     # the waiting_num-only behavior (serde drops unknown fields)
     latest_round: int = 0
+    # the goodput planner's speculation hint (brain/planner.py): the
+    # EXACT world the planner intends to resize to next —
+    # {"spec": "dp200", "world": 200, "n_slices": 1} — so agents
+    # warm-compile that target instead of blind ±node/±slice neighbors
+    # and a planner-directed resize lands on a pre-compiled executable.
+    # Empty = no intent / planner off. Skew-safe both ways: an old
+    # agent's serde drops the unknown field, a new agent treats a
+    # missing/malformed payload as no hint.
+    speculation_hint: Dict = field(default_factory=dict)
 
 
 @message

@@ -24,6 +24,7 @@ class NodeResource:
     tpu_chips: int = 0
     tpu_type: str = ""  # e.g. "v5p"
     tpu_duty_cycle: float = 0.0  # observed busy fraction (usage only)
+    tpu_hbm_used_mb: float = 0.0  # observed HBM occupancy (usage only)
     gpu_num: int = 0  # parity field; unused on TPU
 
     def to_dict(self) -> Dict:

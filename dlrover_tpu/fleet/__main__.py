@@ -39,6 +39,14 @@ def _print_verdict(v: dict, as_json: bool):
     )
     if v["stragglers_flagged"]:
         print(f"stragglers flagged: {v['stragglers_flagged']}")
+    pl = v.get("planner") or {}
+    if pl.get("armed"):
+        print(
+            f"planner: decisions={pl.get('decisions_total', 0)} "
+            f"({pl.get('counts', {})})  executed="
+            f"{[(e['off'], e['target']) for e in pl.get('executed', [])]}  "
+            f"ledger={pl.get('ledger_digest', '')}"
+        )
     if v["evictions"]:
         print(
             f"evictions: {v['evictions']}  reconciled: {v['reconciled']}"

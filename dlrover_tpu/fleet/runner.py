@@ -36,12 +36,19 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+from dlrover_tpu.brain.planner import LEDGER_CAP
 from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.fleet.loopback import MasterEndpoint, RpcStats
 from dlrover_tpu.fleet.scenario import FaultEvent, Scenario
 from dlrover_tpu.fleet.worker import SimWorker
 from dlrover_tpu.rpc.transport import RequestGate
+
+
+#: how much planner ledger the runner tracks/verdicts — the planner's
+#: own cap (imported), so the two can never drift: a smaller local cap
+#: would silently drop decisions from the event log and digest
+LEDGER_TRACK = LEDGER_CAP
 
 
 class VirtualClock:
@@ -205,6 +212,12 @@ class FleetRunner:
         self._stragglers_seen: set = set()
         self._hang_events: List[Dict] = []
         self._resumed_after_hang = False
+        #: goodput-planner bookkeeping: decisions/executions already
+        #: surfaced into the event log, and the seated-world timeline
+        #: (vt, size) the adoption checks read
+        self._planner_seen = 0
+        self._executed_seen = 0
+        self._world_timeline: List[Tuple[float, int]] = []
         self._relaunches = 0
         self._master_gap: Optional[Tuple[float, float]] = None
         self._archived_master_events: List[Dict] = []
@@ -255,6 +268,17 @@ class FleetRunner:
             eviction_hysteresis=self.sc.eviction_hysteresis,
             lease_ttl=self.sc.lease_ttl_vs,
             hang_window_s=self.sc.hang_window_vs or None,
+            planner=self.sc.planner or None,
+            planner_kwargs=(
+                {
+                    "cooldown_s": self.sc.planner_cooldown_vs,
+                    "horizon_s": self.sc.planner_horizon_vs,
+                    "hysteresis": self.sc.planner_hysteresis,
+                    "decide_interval_s": self.sc.planner_interval_vs,
+                }
+                if self.sc.planner
+                else None
+            ),
         )
         # the runner drives every sweep on the virtual clock; second
         # wall-clock sweepers would add nondeterministic strikes,
@@ -274,6 +298,13 @@ class FleetRunner:
             self.master.state_manager.save_speed(
                 self.master.speed_monitor.export_state()
             )
+            if self.master.planner is not None:
+                # the decision ledger rides the same snapshot cadence:
+                # a SIGKILLed master's successor resumes the cooldown
+                # window instead of re-executing the last plan
+                self.master.state_manager.save_planner(
+                    self.master.planner.export_state()
+                )
         except Exception:
             logger.exception("fleet: master state save failed")
 
@@ -406,6 +437,14 @@ class FleetRunner:
         self._was_active = active
         self.view.training_active = active
         if active:
+            size = len(members)
+            if (
+                not self._world_timeline
+                or self._world_timeline[-1][1] != size
+            ):
+                # the seated-world timeline the planner verdicts read
+                # (capacity loss, gated waiting, adoption)
+                self._world_timeline.append((vt, size))
             steps = self.sc.tick_vs / self.sc.step_time_s
             self._progress += steps
             self.view.global_step = int(self._progress)
@@ -520,6 +559,11 @@ class FleetRunner:
                 self._track_reconciles(vt)
                 for nid in self.master.speed_monitor.stragglers():
                     self._stragglers_seen.add(nid)
+                if self.master.auto_scaler is not None:
+                    # the planner's decide→act cycle on the virtual
+                    # clock (throttled internally by its interval)
+                    self.master.auto_scaler.sweep(now=vt)
+                    self._track_planner(vt)
             if self.master is not None and off >= next_save:
                 next_save += sc.state_save_vs
                 self._save_master_state()
@@ -537,6 +581,34 @@ class FleetRunner:
             order = list(self.workers)
             self._rng.shuffle(order)
             list(self._pool.map(lambda w: w.tick(vt, self.view), order))
+
+    def _track_planner(self, vt: float):
+        """Surface new planner decisions/executions into the event log
+        (and so into the determinism digest): the goodput planner's
+        choices must be as replayable as the faults that provoked them."""
+        planner = self.master.planner if self.master else None
+        if planner is None:
+            return
+        rep = planner.report(last_n=LEDGER_TRACK)
+        new = rep["total"] - self._planner_seen
+        if new > 0:
+            for rec in rep["last"][-new:]:
+                if rec["verdict"] != "hold":
+                    self._event(
+                        vt,
+                        f"planner {rec['verdict'].upper()} "
+                        f"{rec['current_world']} -> {rec['target']} "
+                        f"({rec['reason']})",
+                    )
+            self._planner_seen = rep["total"]
+        if len(rep["executed"]) > self._executed_seen:
+            for ex in rep["executed"][self._executed_seen:]:
+                self._event(
+                    vt,
+                    f"planner plan executed: workers -> "
+                    f"{ex['target_world']} ({ex['target']})",
+                )
+            self._executed_seen = len(rep["executed"])
 
     def note_hang(self, vt: float, ev: Dict):
         """Record one hang-watchdog declaration (tick loop or a
@@ -592,11 +664,17 @@ class FleetRunner:
         cats = attribution.get("categories", {})
         cat_sum = sum(cats.values())
         elapsed = attribution.get("elapsed_wall_s", 0.0)
+        planner_section = self._planner_verdict()
         digest = hashlib.sha256()
         for line in self._events:
             digest.update(line.encode())
         digest.update(f"goodput={goodput:.4f}".encode())
         digest.update(f"downtime={downtime:.1f}".encode())
+        if planner_section:
+            # the decision ledger is part of the replayable record: a
+            # planner whose decisions drift across identical seeds
+            # fails the determinism gate, not just the timing checks
+            digest.update(planner_section["ledger_digest"].encode())
         verdict = {
             "scenario": self.sc.name,
             "seed": self.sc.seed,
@@ -628,6 +706,7 @@ class FleetRunner:
                 "recovered": self._resumed_after_hang,
             },
             "data_plane": self._data_verdict(),
+            "planner": planner_section,
             "lock_tracker": self._tracker_verdict(),
             "schedule_perturbation": (
                 self.perturber.stats() if self.perturber else {}
@@ -694,6 +773,57 @@ class FleetRunner:
             "workers_exhausted": sum(
                 1 for w in self.workers if w.exhausted
             ),
+        }
+
+    def _planner_verdict(self) -> Dict:
+        """The goodput planner's ledger as verdict evidence: decision
+        counts, every execution, the seated-world timeline, and a
+        content digest of the full decision ledger (the bit-determinism
+        gate hashes it)."""
+        if not self.sc.planner:
+            return {}
+        planner = self.master.planner if self.master else None
+        if planner is None:
+            return {"armed": True, "ledger_digest": "no-master"}
+        rep = planner.report(last_n=LEDGER_TRACK)
+        state = planner.export_state()
+
+        def rebased(rec):
+            # the ledger stamps absolute virtual-epoch seconds (so it
+            # merges with trace artifacts); the determinism digest must
+            # hash OFFSETS — the epoch base is wall-sampled per run
+            rec = json.loads(json.dumps(rec))
+            if "ts" in rec:
+                rec["ts"] = round(rec["ts"] - self._base, 3)
+            if isinstance(rec.get("inputs"), dict) and "ts" in rec["inputs"]:
+                rec["inputs"]["ts"] = round(
+                    rec["inputs"]["ts"] - self._base, 3
+                )
+            return rec
+
+        ledger_digest = hashlib.sha256(
+            json.dumps(
+                [rebased(r) for r in state["ledger"]], sort_keys=True
+            ).encode()
+        ).hexdigest()[:16]
+        return {
+            "armed": True,
+            "decisions_total": rep["total"],
+            "counts": rep["counts"],
+            "executed": [
+                {
+                    "target": ex["target"],
+                    "target_world": ex["target_world"],
+                    "off": round(ex["ts"] - self._base, 1),
+                }
+                for ex in rep["executed"]
+            ],
+            "intent": rep["intent"],
+            "ledger_digest": ledger_digest,
+            "world_timeline": [
+                [round(vt - self._base, 1), size]
+                for vt, size in self._world_timeline
+            ],
         }
 
     def _tracker_verdict(self) -> Dict:
@@ -917,6 +1047,75 @@ class FleetRunner:
                 sp.get("total", 0) >= exp["min_perturbations"],
                 sp.get("total", 0), f">= {exp['min_perturbations']}",
             )
+        pl = v.get("planner") or {}
+        if pl.get("armed"):
+            executed = pl.get("executed") or []
+            # one plan per cooldown window, by construction AND by
+            # evidence: consecutive executions must be >= cooldown apart
+            gaps = [
+                round(b["off"] - a["off"], 1)
+                for a, b in zip(executed, executed[1:])
+            ]
+            check(
+                "one_plan_per_cooldown_window",
+                all(g >= self.sc.planner_cooldown_vs for g in gaps),
+                {"executed_offs": [e["off"] for e in executed],
+                 "gaps": gaps},
+                f"gaps >= {self.sc.planner_cooldown_vs}",
+            )
+            if "max_executed_plans" in exp:
+                check(
+                    "executed_plans_bounded",
+                    len(executed) <= exp["max_executed_plans"],
+                    len(executed), f"<= {exp['max_executed_plans']}",
+                )
+            if "min_executed_plans" in exp:
+                check(
+                    "planner_actually_acted",
+                    len(executed) >= exp["min_executed_plans"],
+                    len(executed), f">= {exp['min_executed_plans']}",
+                )
+            if "unstable_windows" in exp:
+                # NO plan may execute while the fleet is unstable (the
+                # scenario names its instability windows explicitly so
+                # the gate is reviewable)
+                bad = [
+                    e["off"] for e in executed
+                    if any(
+                        s <= e["off"] <= t
+                        for s, t in exp["unstable_windows"]
+                    )
+                ]
+                check(
+                    "no_scaleout_while_unstable", not bad, bad,
+                    f"no execution inside {exp['unstable_windows']}",
+                )
+            timeline = pl.get("world_timeline") or []
+            full_at = None
+            dropped = False
+            for off, size in timeline:
+                if size < self.sc.nodes:
+                    dropped = True
+                elif dropped and size >= self.sc.nodes:
+                    full_at = off
+                    break
+            if "readopt_by_vs" in exp:
+                check(
+                    "restored_capacity_adopted_in_time",
+                    full_at is not None
+                    and full_at <= exp["readopt_by_vs"],
+                    full_at, f"<= {exp['readopt_by_vs']}",
+                )
+            if "readopt_not_before_vs" in exp:
+                # the growth gate's evidence: waiting capacity was NOT
+                # adopted during the instability window — full world
+                # reappears only after the planner approved it
+                check(
+                    "growth_gated_until_stable",
+                    full_at is None
+                    or full_at >= exp["readopt_not_before_vs"],
+                    full_at, f">= {exp['readopt_not_before_vs']}",
+                )
         if exp.get("master_survives"):
             served = sum(v["gate"]["served"].values())
             check(
@@ -954,6 +1153,38 @@ class FleetRunner:
             ev = dict(ev)
             ev["tid"] = 50  # own lane, clear of the stall lane
             events.append(ev)
+        # the goodput planner's decisions as their own timeline lane:
+        # HOLDs and RESIZEs on tid 60, executed plans on tid 61 —
+        # sequential in virtual time, so spans never overlap per lane
+        planner = self.master.planner if self.master else None
+        if planner is not None:
+            rep = planner.report(last_n=LEDGER_TRACK)
+            for rec in rep["last"]:
+                events.append({
+                    "name": (
+                        f"planner.{rec['verdict']}"
+                        + (f"->{rec['target']}" if rec["target"] else "")
+                    ),
+                    "cat": "planner", "ph": "X",
+                    "ts": int(rec["ts"] * 1e6),
+                    "dur": int(0.5 * 1e6),
+                    "pid": 0, "tid": 60,
+                    "args": {
+                        "kind": "host", "reason": rec["reason"],
+                        "current_world": rec["current_world"],
+                        "target": rec["target"],
+                    },
+                })
+            for ex in rep["executed"]:
+                events.append({
+                    "name": f"planner.execute->{ex['target']}",
+                    "cat": "planner", "ph": "X",
+                    "ts": int(ex["ts"] * 1e6),
+                    "dur": int(0.5 * 1e6),
+                    "pid": 0, "tid": 61,
+                    "args": {"kind": "host",
+                             "target_world": ex["target_world"]},
+                })
         try:
             path = trace.dump_events(events, role="fleet")
             if path:

@@ -116,6 +116,20 @@ class Scenario:
     #: collective-hang watchdog window in virtual seconds (0 = the
     #: watchdog is not swept — PR 9 behavior)
     hang_window_vs: float = 0.0
+    # -- goodput planner (brain/planner.py): armed, the master's scale
+    # decisions come from the measured goodput ledger; scale-OUT waits
+    # for an executed plan (rendezvous growth gate) and the runner
+    # drives the autoscaler sweep on the virtual clock
+    planner: bool = False
+    #: cooldown between executed plans (at most one per window)
+    planner_cooldown_vs: float = 120.0
+    #: payback horizon the throughput gain must amortize the measured
+    #: resize cost within
+    planner_horizon_vs: float = 600.0
+    #: consecutive decisions the same winning candidate must survive
+    planner_hysteresis: int = 2
+    #: decision cadence on the virtual clock
+    planner_interval_vs: float = 15.0
     # -- adversarial schedule exploration (docs/design/racecheck.md):
     # drive the master's sweeps (deadline sweep, hang watchdog,
     # heartbeat evictor, shard-state writer drain, training-status

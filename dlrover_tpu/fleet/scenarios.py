@@ -33,6 +33,19 @@ docs/design/data_plane.md).
   (not ``unattributed``), and the attribution still sums to elapsed.
 - ``shard_storm_smoke`` — a 60-node cut of the shard storm for tier-1
   tests (seconds of real time), same exactly-once + budget gates.
+- ``autoscale_storm`` — the goodput planner under chaos
+  (docs/design/brain_planner.md): a 200-node fleet loses 20 nodes for
+  four virtual minutes (hang-watchdog re-form at 180), rides a
+  straggler episode, and gets its capacity back WHILE still flagged
+  unstable. Gates: zero scale-outs while unstable (the rendezvous
+  growth gate keeps the waiting capacity invisible to the healthy
+  seated fleet), the restored capacity adopted within the scenario's
+  ``readopt_by_vs`` bound once stability returns, at most one executed
+  plan per cooldown window, the decision ledger bit-deterministic
+  given the seed (its digest folds into the verdict determinism
+  digest), and attribution still summing to elapsed ±1%.
+- ``autoscale_smoke`` — a 60-node cut of the autoscale storm for
+  tier-1 tests (seconds of real time), same planner gates.
 - ``smoke`` — a 40-node, 4-virtual-minute cut of the headline for
   tier-1 tests (seconds of real time).
 - ``perturbed_smoke`` — the racecheck schedule explorer
@@ -232,6 +245,98 @@ BUILTIN = {
             "max_spurious_evictions": 0,
             "relaunches": 1,
             "master_survives": True,
+        },
+    },
+    "autoscale_storm": {
+        "name": "autoscale_storm",
+        "seed": 41,
+        "nodes": 200,
+        "min_nodes": 170,
+        "duration_vs": 600,
+        "step_time_s": 1.0,
+        "report_interval_vs": 15,
+        "membership_poll_vs": 10,
+        "heartbeat_timeout_vs": 60,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 5,
+        "gate_report_cap": 64,
+        # the hang watchdog is the capacity-LOSS recovery path: the
+        # preempted cohort stalls the seated round, the watchdog
+        # re-forms the surviving 180 without waiting out the preemption
+        "hang_window_vs": 45,
+        "planner": True,
+        "planner_cooldown_vs": 120,
+        # a production-shaped payback horizon (the job runs on): the
+        # measured ~64vs resize cost amortizes against the 20-node gain
+        # well inside it — with the scenario's own 600vs horizon the
+        # planner would (correctly!) refuse to pay 64vs for a 10% gain
+        "planner_horizon_vs": 1800,
+        "planner_hysteresis": 2,
+        "planner_interval_vs": 15,
+        "faults": [
+            # capacity loss: 20 explicit nodes preempted for 4 virtual
+            # minutes (long enough that a fleet WITHOUT the watchdog +
+            # planner would either stall or flap)
+            {"kind": "preempt", "at_vs": 60,
+             "nodes": list(range(180, 200)), "duration_vs": 240},
+            # a straggler episode overlapping the capacity restoration:
+            # the capacity comes BACK (t=300) while the fleet is still
+            # flagged unstable — the planner must hold the growth gate
+            # shut until the episode clears (~345)
+            {"kind": "straggle", "at_vs": 150, "nodes": [10, 60, 110],
+             "factor": 1.8, "duration_vs": 180},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.70,
+            "max_rpc_latency_s": 1.0,
+            "master_survives": True,
+            # the planner gates: exactly one executed plan (the
+            # adoption), none of it inside the instability window
+            "max_executed_plans": 1,
+            "min_executed_plans": 1,
+            # straggle 150→330 + detector unflag tail (one healthy
+            # report window) = unstable through ~345
+            "unstable_windows": [[150, 345]],
+            "readopt_not_before_vs": 345,
+            "readopt_by_vs": 430,
+        },
+    },
+    "autoscale_smoke": {
+        "name": "autoscale_smoke",
+        "seed": 42,
+        "nodes": 60,
+        "min_nodes": 50,
+        "duration_vs": 420,
+        "step_time_s": 1.0,
+        "report_interval_vs": 10,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 50,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 5,
+        "gate_report_cap": 32,
+        "hang_window_vs": 30,
+        "planner": True,
+        "planner_cooldown_vs": 60,
+        "planner_horizon_vs": 400,
+        "planner_hysteresis": 2,
+        "planner_interval_vs": 10,
+        "faults": [
+            {"kind": "preempt", "at_vs": 40,
+             "nodes": list(range(52, 60)), "duration_vs": 160},
+            {"kind": "straggle", "at_vs": 90, "nodes": [5, 15, 25],
+             "factor": 2.0, "duration_vs": 120},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.60,
+            "max_rpc_latency_s": 2.0,
+            "master_survives": True,
+            "max_executed_plans": 1,
+            "min_executed_plans": 1,
+            "unstable_windows": [[90, 225]],
+            "readopt_not_before_vs": 220,
+            "readopt_by_vs": 310,
         },
     },
     "seated_hang": {

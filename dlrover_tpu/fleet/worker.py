@@ -362,7 +362,7 @@ class SimWorker:
                 0.75 + 0.5 * self.rng.random()
             )
             try:
-                waiting, latest = self.client.rendezvous_status()
+                waiting, latest, _hint = self.client.rendezvous_status()
                 if waiting > 0 or latest > self.seated_round:
                     self.stepping = False
                     self.state = JOINING

@@ -61,9 +61,21 @@ from dlrover_tpu.lint.engine import Severity, Violation
 #: contracts shipped with the package (``--fix-contracts`` rewrites)
 DEFAULT_CONTRACTS_DIR = os.path.join(os.path.dirname(__file__), "contracts")
 
-#: canonical mesh-axis order (mirrors parallel.mesh.AXIS_ORDER without
-#: importing jax — this module must stay importable dep-free)
-CANONICAL_AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
+#: the world-shape vocabulary lives in common/world.py now (the
+#: WorldDescriptor refactor): the contract-spec grammar, the canonical
+#: axis order and the parse/format pair are defined ONCE there and
+#: re-exported here for the existing call sites — shardcheck, the
+#: trainer hook, the CLI and the planner all describe a program's world
+#: through the same checked type instead of four re-derivations
+from dlrover_tpu.common.world import (  # noqa: F401  (re-exports)
+    CANONICAL_AXES,
+    ZERO1_SUFFIX,
+    WorldDescriptor,
+    contract_spec_of,
+    mesh_spec_of,
+    parse_contract_spec,
+    parse_mesh_spec,
+)
 
 
 class ShardcheckError(RuntimeError):
@@ -77,83 +89,6 @@ class ShardcheckError(RuntimeError):
             + "\n".join(v.format() for v in self.violations)
         )
 
-
-def mesh_spec_of(axis_sizes: Dict[str, int]) -> str:
-    """Canonical spec string for a mesh shape: non-trivial axes in
-    canonical order — ``{"dp": 2, "sp": 2}`` → ``"dp2xsp2"`` (so
-    ``--hlo sp2xdp2`` and ``--hlo dp2xsp2`` share one contract file).
-    Unknown axes sort after the canonical ones."""
-    parts = [
-        f"{a}{axis_sizes[a]}" for a in CANONICAL_AXES
-        if axis_sizes.get(a, 1) > 1
-    ]
-    parts += [
-        f"{a}{s}" for a, s in sorted(axis_sizes.items())
-        if a not in CANONICAL_AXES and s > 1
-    ]
-    return "x".join(parts) if parts else "dp1"
-
-
-#: contract-spec suffix for the zero-1 program variant: the same mesh
-#: lowers a genuinely different step with weight-update sharding on, so
-#: it gets its own contract file (``dp4+zero1.json`` next to
-#: ``dp4.json``)
-ZERO1_SUFFIX = "+zero1"
-
-#: contract-spec suffix pattern for the multislice hierarchical program
-#: variant: ``dp4+2slice`` is the dp4 mesh over 2 slices running the
-#: ICI-first hierarchical gradient reduction (ops/hier_collectives.py)
-#: — its census carries the per-link (ici/dcn) byte split. Canonical
-#: suffix order: mesh, ``+Nslice``, ``+zero1``.
-_SLICE_SUFFIX_RE = re.compile(r"\+([0-9]+)slice$")
-
-
-def contract_spec_of(
-    axis_sizes: Dict[str, int], zero1: bool = False, n_slices: int = 1
-) -> str:
-    """Canonical CONTRACT key for a program: the mesh spec, suffixed
-    with ``+Nslice`` for the hierarchical multislice program variant
-    and ``+zero1`` for weight-update sharding —
-    ``contract_spec_of({"dp": 4}, True, 2)`` → ``"dp4+2slice+zero1"``.
-    A multislice mesh running the FLAT path keys the plain spec (its
-    program is the single-slice one)."""
-    spec = mesh_spec_of(axis_sizes)
-    if n_slices > 1:
-        spec += f"+{n_slices}slice"
-    return spec + (ZERO1_SUFFIX if zero1 else "")
-
-
-def parse_contract_spec(spec: str) -> Tuple[Dict[str, int], bool, int]:
-    """``"dp4+2slice+zero1"`` → ``({"dp": 4}, True, 2)``; plain mesh
-    specs parse with ``zero1=False, n_slices=1``."""
-    zero1 = spec.endswith(ZERO1_SUFFIX)
-    if zero1:
-        spec = spec[: -len(ZERO1_SUFFIX)]
-    n_slices = 1
-    m = _SLICE_SUFFIX_RE.search(spec)
-    if m:
-        n_slices = int(m.group(1))
-        if n_slices < 1:
-            raise ValueError(f"bad slice count in contract spec {spec!r}")
-        spec = spec[: m.start()]
-    return parse_mesh_spec(spec), zero1, n_slices
-
-
-def parse_mesh_spec(spec: str) -> Dict[str, int]:
-    """``"dp2xfsdp2"`` → ``{"dp": 2, "fsdp": 2}``. Raises on syntax the
-    mesh cannot mean (unknown axis, non-integer size)."""
-    out: Dict[str, int] = {}
-    for token in spec.split("x"):
-        m = re.match(r"^([a-z]+)([0-9]+)$", token.strip())
-        if not m or m.group(1) not in CANONICAL_AXES:
-            raise ValueError(
-                f"bad mesh spec token {token!r} in {spec!r} (want e.g. "
-                "dp4, dp2xfsdp2, sp2xdp2)"
-            )
-        out[m.group(1)] = int(m.group(2))
-    if not out:
-        raise ValueError(f"empty mesh spec {spec!r}")
-    return out
 
 #: collective HLO opcodes the census tracks (``-start`` variants fold
 #: into their base op: async pairs describe one transfer)

@@ -139,12 +139,34 @@ class DistributedJobMaster:
         self.metric_collector = JobMetricCollector(
             speed_monitor=self.speed_monitor, reporters=reporters
         )
+        # the goodput planner (brain/planner.py, DLROVER_TPU_PLANNER):
+        # scale decisions from the measured goodput ledger instead of
+        # the legacy heuristics; scale-out gated on its executed plan
+        # and the membership poll carries its speculation hint
+        self.planner = None
+        if flags.PLANNER.get():
+            from dlrover_tpu.brain.planner import GoodputPlanner
+
+            self.planner = GoodputPlanner(
+                speed_monitor=self.speed_monitor,
+                rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
+                job_context=get_job_context(),
+                min_nodes=worker_spec.min_nodes or 1,
+                max_nodes=(
+                    worker_spec.max_nodes or worker_spec.group.count
+                ),
+                node_unit=job_args.node_unit,
+            )
+            self.rdzv_managers[RendezvousName.TRAINING].set_growth_gate(
+                self.planner.growth_allowed
+            )
         self.job_auto_scaler = JobAutoScaler(
             optimizer=optimizer,
             scaler=self.scaler,
             speed_monitor=self.speed_monitor,
             strategy_generator=SimpleStrategyGenerator(),
             metric_collector=self.metric_collector,
+            planner=self.planner,
         )
         self.job_manager = DistributedJobManager(
             job_args=job_args,
@@ -188,6 +210,7 @@ class DistributedJobMaster:
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             metric_collector=self.metric_collector,
+            planner=self.planner,
         )
         self._server = RpcServer(self.servicer, port=port)
         # backpressure must stay inside the liveness budget: a worker
@@ -222,6 +245,12 @@ class DistributedJobMaster:
         speed_state = self.state_manager.load_speed()
         if speed_state:
             self.speed_monitor.import_state(speed_state)
+        if self.planner is not None:
+            planner_state = self.state_manager.load_planner()
+            if planner_state:
+                # decision-ledger continuity: keep the cooldown window
+                # and hysteresis streak across the relaunch
+                self.planner.import_state(planner_state)
         if restored or speed_state:
             logger.info(
                 "master state restored: %s datasets, global_step=%s",
@@ -238,7 +267,7 @@ class DistributedJobMaster:
         from dlrover_tpu.master import metrics as master_metrics
 
         self._metrics_server = master_metrics.maybe_start(
-            self._server, self.speed_monitor
+            self._server, self.speed_monitor, planner=self.planner
         )
         if isinstance(self.scaler, PodScaler):
             self.scaler.set_master_addr(self._resolve_master_addr())
@@ -273,6 +302,10 @@ class DistributedJobMaster:
                 self.state_manager.save_speed(
                     self.speed_monitor.export_state()
                 )
+                if self.planner is not None:
+                    self.state_manager.save_planner(
+                        self.planner.export_state()
+                    )
                 self.job_manager.persist_node_state()
                 stop, reason, message = self.job_manager.should_early_stop()
                 if stop:

@@ -41,6 +41,8 @@ class LocalJobMaster:
         eviction_hysteresis: Optional[int] = None,
         lease_ttl: Optional[float] = None,
         hang_window_s: Optional[float] = None,
+        planner: Optional[bool] = None,
+        planner_kwargs: Optional[Dict] = None,
     ):
         from dlrover_tpu.common import flags
         from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
@@ -102,6 +104,48 @@ class LocalJobMaster:
         self.diagnosis_manager = DiagnosisManager(
             speed_monitor=self.speed_monitor
         )
+        # the goodput planner (brain/planner.py): observe→decide→act
+        # over the SpeedMonitor's measured ledgers. Armed by the ctor
+        # arg (the fleet harness) or DLROVER_TPU_PLANNER; when armed,
+        # scale-out waits for its executed plan (rendezvous growth
+        # gate) and the membership poll carries its speculation hint.
+        self.planner = None
+        self.auto_scaler = None
+        planner_on = (
+            planner if planner is not None else flags.PLANNER.get()
+        )
+        if planner_on:
+            from dlrover_tpu.brain.planner import GoodputPlanner
+            from dlrover_tpu.master.node.job_auto_scaler import (
+                JobAutoScaler,
+            )
+            from dlrover_tpu.master.resource.optimizer import (
+                LocalOptimizer,
+            )
+            from dlrover_tpu.master.scaler.base import LocalScaler
+
+            min_n = min_node_num if min_node_num is not None else node_num
+            self.planner = GoodputPlanner(
+                speed_monitor=self.speed_monitor,
+                rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
+                job_context=get_job_context(),
+                clock=clock,
+                min_nodes=min_n,
+                max_nodes=node_num,
+                **(planner_kwargs or {}),
+            )
+            self.rdzv_managers[RendezvousName.TRAINING].set_growth_gate(
+                self.planner.growth_allowed
+            )
+            self.auto_scaler = JobAutoScaler(
+                optimizer=LocalOptimizer(
+                    min_workers=min_n, max_workers=node_num
+                ),
+                scaler=LocalScaler(),
+                speed_monitor=self.speed_monitor,
+                planner=self.planner,
+                clock=clock,
+            )
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
             job_manager=self.job_manager,
@@ -111,6 +155,7 @@ class LocalJobMaster:
             kv_store=self.kv_store,
             sync_service=self.sync_service,
             elastic_run_configs=elastic_run_configs,
+            planner=self.planner,
         )
         self._server = RpcServer(self.servicer, port=port)
         # Overloaded replies advertise how far a worker may widen its
@@ -147,6 +192,13 @@ class LocalJobMaster:
         speed_state = self.state_manager.load_speed()
         if speed_state:
             self.speed_monitor.import_state(speed_state)
+        if self.planner is not None:
+            planner_state = self.state_manager.load_planner()
+            if planner_state:
+                # decision-ledger continuity: the relaunched planner
+                # keeps its cooldown window and hysteresis streak — it
+                # must not re-execute the plan the dead master paid for
+                self.planner.import_state(planner_state)
         if restored or speed_state:
             logger.info(
                 "local master resumed state: %s datasets, global_step=%s",
@@ -163,7 +215,7 @@ class LocalJobMaster:
         from dlrover_tpu.master import metrics as master_metrics
 
         self._metrics_server = master_metrics.maybe_start(
-            self._server, self.speed_monitor
+            self._server, self.speed_monitor, planner=self.planner
         )
         self.task_manager.start()
         self.job_manager.start()
@@ -181,6 +233,18 @@ class LocalJobMaster:
             while True:
                 time.sleep(poll_interval)
                 self.state_manager.save_speed(self.speed_monitor.export_state())
+                if self.auto_scaler is not None:
+                    # planner-armed standalone runs: the decision cycle
+                    # rides the master poll loop (throttled internally
+                    # by the planner's decide interval)
+                    try:
+                        self.auto_scaler.sweep()
+                    except Exception:
+                        logger.exception("planner sweep failed")
+                if self.planner is not None:
+                    self.state_manager.save_planner(
+                        self.planner.export_state()
+                    )
                 if self.job_manager.all_workers_succeeded():
                     self._exit_reason = JobExitReason.SUCCEEDED
                     break

@@ -91,9 +91,13 @@ def speed_monitor_lines(speed_monitor) -> List[str]:
     return lines
 
 
-def maybe_start(rpc_server, speed_monitor) -> Optional[MasterMetricsServer]:
+def maybe_start(
+    rpc_server, speed_monitor, planner=None
+) -> Optional[MasterMetricsServer]:
     """Boot the endpoint when ``DLROVER_TPU_MASTER_METRICS_PORT`` is
-    set: RPC gate depth/shed counters + goodput gauges."""
+    set: RPC gate depth/shed counters + goodput gauges + (when the
+    goodput planner is armed) ``dlrover_tpu_scale_decisions_total``
+    and the last-decision gauges."""
     from dlrover_tpu.common import flags
 
     if not flags.MASTER_METRICS_PORT.present():
@@ -103,6 +107,8 @@ def maybe_start(rpc_server, speed_monitor) -> Optional[MasterMetricsServer]:
         server.add_provider(rpc_server.gate.prometheus_lines)
     if speed_monitor is not None:
         server.add_provider(lambda: speed_monitor_lines(speed_monitor))
+    if planner is not None:
+        server.add_provider(planner.prometheus_lines)
     try:
         server.start()
     except OSError as e:

@@ -337,6 +337,12 @@ class SpeedMonitor:
                 self._downtime_start = 0.0
                 self._downtime_events += 1
 
+    def downtime_in_progress(self) -> bool:
+        """A downtime bracket is open (failure reported, round
+        re-forming) — the planner's instability gate."""
+        with self._lock:
+            return self._downtime_start > 0.0
+
     def record_downtime_breakdown(
         self,
         rendezvous_s: float = 0.0,

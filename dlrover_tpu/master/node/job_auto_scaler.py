@@ -5,12 +5,23 @@ Parity: reference ``master/node/job_auto_scaler.py:41-375``
 out of scope on TPU). Wires SpeedMonitor observations into the
 LocalOptimizer and executes the resulting plans through a Scaler; also
 handles OOM recovery plans triggered by node failures.
+
+With a :class:`~dlrover_tpu.brain.planner.GoodputPlanner` attached, the
+periodic cycle runs the planner's goodput-ledger decision instead of the
+legacy CPU/memory heuristics (docs/design/brain_planner.md): an accepted
+plan still flows through the same ResourcePlan → Scaler path, and the
+planner is told about the execution so its cooldown window starts.
+
+The whole decision path is **clock-injected** (the ``SpeedMonitor(clock=)``
+pattern): the fleet chaos harness drives ``sweep()`` on virtual time, and
+a test pins that no wall-clock read creeps back in.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
 
 from dlrover_tpu.common.constants import NodeExitReason, NodeType
 from dlrover_tpu.common.global_context import get_master_config
@@ -35,10 +46,20 @@ class JobAutoScaler:
         strategy_generator=None,
         metric_collector=None,
         refine_cooldown_secs: float = 300.0,
+        planner=None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self._optimizer = optimizer
         self._scaler = scaler
         self._speed_monitor = speed_monitor
+        #: goodput planner (brain/planner.py): when set, optimize
+        #: cycles decide from the goodput ledger instead of the legacy
+        #: heuristics
+        self._planner = planner
+        #: injected "now": the only time source of the decision path
+        #: (never read time.time() directly here — the harness drives
+        #: the scaler loop on virtual time, and a test pins it)
+        self._clock = clock or time.time
         # None → read the runtime-mutable global context each cycle
         self._interval_override = interval_secs
         self._sample_after_steps_override = sample_after_steps
@@ -73,9 +94,7 @@ class JobAutoScaler:
     # -- lifecycle ---------------------------------------------------------
 
     def start_auto_scaling(self):
-        import time
-
-        self._started_ts = time.time()
+        self._started_ts = self._clock()
         self._stop_evt.clear()
         self._thread = threading.Thread(
             target=self._loop, name="job-auto-scaler", daemon=True
@@ -86,18 +105,25 @@ class JobAutoScaler:
         self._stop_evt.set()
 
     def _loop(self):
-        import time
-
         while not self._stop_evt.wait(self._interval):
-            if not self._autoscale_enabled:
-                continue
-            warmup = get_master_config().seconds_to_autoscale_worker
-            if time.time() - self._started_ts < warmup:
-                continue  # let rendezvous + first steps settle first
             try:
-                self.optimize_once()
+                self.sweep()
             except Exception:
                 logger.exception("auto-scale cycle failed")
+
+    def sweep(self, now: Optional[float] = None) -> Optional[ScalePlan]:
+        """One guarded cycle on the injected clock — the thread's body,
+        also the harness's virtual-time entry (it calls this instead of
+        running the thread)."""
+        if not self._autoscale_enabled:
+            return None
+        now = self._clock() if now is None else now
+        if self._started_ts == 0.0:
+            self._started_ts = now
+        warmup = get_master_config().seconds_to_autoscale_worker
+        if now - self._started_ts < warmup:
+            return None  # let rendezvous + first steps settle first
+        return self.optimize_once(now=now)
 
     # -- one optimization cycle -------------------------------------------
 
@@ -131,25 +157,58 @@ class JobAutoScaler:
             )
         return stats
 
-    def optimize_once(self) -> ScalePlan:
+    def optimize_once(self, now: Optional[float] = None) -> ScalePlan:
+        now = self._clock() if now is None else now
+        if self._planner is not None:
+            return self._planner_cycle(now)
         stats = self._collect_stats()
         stage = self._current_stage()
         plan = self._optimizer.generate_opt_plan(stage, stats)
         scale_plan = self.execute_job_optimization_plan(plan)
         if stage == JobOptStage.RUNNING:
-            self.maybe_refine_hyperparams()
+            self.maybe_refine_hyperparams(now=now)
         return scale_plan
 
-    def maybe_refine_hyperparams(self):
+    def _planner_cycle(self, now: float) -> ScalePlan:
+        """The goodput-planner decision path: throttled decide; an
+        accepted RESIZE becomes a worker-count ResourcePlan executed
+        through the normal scale path, and the planner is told so its
+        cooldown window opens (at most one executed plan per window).
+        HOLD decisions (instability, cooldown, hysteresis, no paying
+        candidate) execute nothing."""
+        from dlrover_tpu.brain import planner as planner_mod
+
+        decision = self._planner.sweep(now=now)
+        scale_plan = ScalePlan()
+        if decision is None or decision["verdict"] != planner_mod.RESIZE:
+            return scale_plan
+        target = self._planner.intent()
+        if target is None:
+            return scale_plan
+        from dlrover_tpu.common.node import NodeGroupResource
+
+        plan = ResourcePlan(comment=f"planner:{decision['reason']}")
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=target.world_size
+        )
+        scale_plan = self.execute_job_optimization_plan(plan)
+        self._planner.note_executed(target, now=now)
+        logger.info(
+            "planner plan executed: workers -> %d (%s; payback %.0fs)",
+            target.world_size, target.spec,
+            decision.get("payback_s") or 0.0,
+        )
+        return scale_plan
+
+    def maybe_refine_hyperparams(self, now: Optional[float] = None):
         """Runtime batch growth from observed memory headroom, with
         lr/weight-decay sqrt coupling (reference
         ``simple_strategy_generator.py:83-166``); pushed to workers via
         the versioned paral-config channel."""
-        import time
-
+        now = self._clock() if now is None else now
         if self._strategy_generator is None or self._metric_collector is None:
             return
-        if time.time() - self._last_refine_ts < self._refine_cooldown:
+        if now - self._last_refine_ts < self._refine_cooldown:
             return
         profile_d = self._metric_collector.metrics.model_profile
         if not profile_d:
@@ -195,7 +254,7 @@ class JobAutoScaler:
         )
         if suggestion is None:
             return
-        self._last_refine_ts = time.time()
+        self._last_refine_ts = now
         cfg = {**current, **suggestion.to_paral_config()}
         logger.info(
             "hyperparam refinement: batch %s->%s (headroom %.0fMB), "

@@ -84,6 +84,12 @@ class JobManager(ABC):
         duty = kw.get("tpu_duty_cycle")
         if duty is not None:
             node.used_resource.tpu_duty_cycle = float(duty)
+        hbm = kw.get("tpu_hbm_used_mb")
+        if hbm is not None and float(hbm) > 0:
+            # the goodput planner's HBM-feasibility input (a shrink
+            # packs more state per device); 0 readings keep the last
+            # real observation rather than erasing it
+            node.used_resource.tpu_hbm_used_mb = float(hbm)
 
     def collect_node_heartbeat(
         self, node_type: str, node_id: int, ts: float

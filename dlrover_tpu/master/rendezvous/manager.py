@@ -77,6 +77,7 @@ class _WorldSnapshot:
     force_reform: bool = False
     coordinator: str = ""
     latest_world: Tuple[int, ...] = ()
+    alive_ids: FrozenSet[int] = frozenset()
 
 
 class RendezvousManager(ABC):
@@ -111,6 +112,16 @@ class RendezvousManager(ABC):
         # get_comm_world / num_nodes_waiting polls. The reference store
         # is atomic in CPython; readers grab one coherent version.
         self._snapshot = _WorldSnapshot()
+        # the planner's growth gate (brain/planner.py): scale-OUT is a
+        # CHOICE — waiting capacity that would only grow a healthy
+        # seated world is advertised to the fleet (and allowed to
+        # complete a round) only when the gate approves, so the cost of
+        # the re-form downtime is paid when the planner decided it pays
+        # back. Recovery is never gated: a dead/partitioned seated
+        # member, a force_reform, or a waiting node that IS a seated
+        # member re-joining all bypass it. None = no planner (today's
+        # behavior, byte-identical).
+        self._growth_gate = None
 
     def _publish_locked(self):
         """Rebuild the published snapshot. Caller holds the lock."""
@@ -129,6 +140,7 @@ class RendezvousManager(ABC):
             force_reform=self._force_reform,
             coordinator=self.coordinator_addr(),
             latest_world=tuple(self._latest_rdzv_nodes),
+            alive_ids=frozenset(self._alive_nodes),
         )
 
     def world_snapshot(self) -> _WorldSnapshot:
@@ -149,11 +161,18 @@ class RendezvousManager(ABC):
         return self._rdzv_round
 
     def add_alive_node(self, node_id: int):
-        self._alive_nodes.add(node_id)
+        with self._lock:
+            if node_id not in self._alive_nodes:
+                self._alive_nodes.add(node_id)
+                # the snapshot carries alive_ids (the growth gate's
+                # recovery-vs-growth distinction): liveness changes
+                # must republish even when the waiting list is untouched
+                self._publish_locked()
 
     def remove_alive_node(self, node_id: int):
         """Node died: drop it so a pending rendezvous does not stall on it."""
         with self._lock:
+            changed = node_id in self._alive_nodes
             self._alive_nodes.discard(node_id)
             removed = None
             for rank, meta in list(self._waiting_nodes.items()):
@@ -162,12 +181,13 @@ class RendezvousManager(ABC):
                     break
             if removed is not None:
                 del self._waiting_nodes[removed]
-                self._publish_locked()
                 logger.info(
                     "%s rdzv: removed dead node %s from waiting list",
                     self.name,
                     node_id,
                 )
+            if changed or removed is not None:
+                self._publish_locked()
 
     def join_rendezvous(self, node_id: int, node_rank: int, meta: NodeTopologyMeta) -> int:
         with self._lock:
@@ -181,6 +201,27 @@ class RendezvousManager(ABC):
             self._publish_locked()
         return self._rdzv_round
 
+    def set_growth_gate(self, gate) -> None:
+        """Install the planner's growth gate: ``gate(seated_world_size)
+        -> bool``. Called on the poll fast path and under the manager
+        lock from round completion — the gate must only read its own
+        state (the planner holds only its own lock inside)."""
+        self._growth_gate = gate
+
+    @staticmethod
+    def _pure_growth(s: "_WorldSnapshot") -> bool:
+        """True iff admitting the waiting nodes would only GROW a
+        healthy seated world: a round exists, every seated member is
+        still alive, and no waiting node is a seated member re-joining
+        (which would mean a re-form is already in progress). Anything
+        else is recovery and must never wait for the planner."""
+        if not s.latest_world or s.force_reform:
+            return False
+        world = set(s.latest_world)
+        if not world <= s.alive_ids:
+            return False  # a seated member died: re-form is recovery
+        return world.isdisjoint(s.waiting_ids)
+
     def num_nodes_waiting(self) -> int:
         """Agents poll this; >0 during training means a membership
         change. While a re-form is requested (collective-hang recovery)
@@ -188,12 +229,26 @@ class RendezvousManager(ABC):
         the seated-but-stalled cohort drops back into the rendezvous —
         the same signal path a real joiner uses.
 
+        With a planner growth gate installed, waiting capacity that
+        would only grow a healthy seated world is advertised as 0
+        until the planner's executed plan opens the gate — the seated
+        fleet keeps training instead of paying re-form downtime the
+        planner has not approved. Recovery paths are never gated.
+
         Served from the immutable snapshot — the highest-rate poll in
         the protocol (every agent, every poll interval) costs one
         reference read, no lock."""
         s = self._snapshot
         if s.num_waiting == 0 and s.force_reform:
             return 1
+        gate = self._growth_gate
+        if (
+            gate is not None
+            and s.num_waiting > 0
+            and self._pure_growth(s)
+            and not gate(len(s.latest_world))
+        ):
+            return 0
         return s.num_waiting
 
     def request_re_rendezvous(self, exclude=()) -> None:
@@ -232,6 +287,18 @@ class RendezvousManager(ABC):
         """Caller holds the lock. Completes the round when ready."""
         waiting = len(self._waiting_nodes)
         if waiting == 0:
+            return False
+        gate = self._growth_gate
+        if (
+            gate is not None
+            and self._pure_growth(self._snapshot)
+            and not gate(len(self._latest_rdzv_nodes))
+        ):
+            # a pure-growth cohort big enough to complete a round on
+            # its own must not form one behind the planner's back — a
+            # completed round would drag the healthy seated world into
+            # a re-join via the stale-round guard, which is exactly the
+            # downtime the gate exists to defer
             return False
         p = self._params
         completed = False

@@ -38,8 +38,13 @@ class BrainResourceOptimizer(ResourceOptimizer):
         node_unit: int = 1,
         tpu_type: str = "",
         client: Optional[RpcClient] = None,
+        clock=None,
     ):
         self._client = client or RpcClient(brain_addr, timeout=10.0)
+        # injected "now" for wire timestamps (the SpeedMonitor(clock=)
+        # pattern): keeps the whole brain decision path off the wall
+        # clock so the harness can drive it on virtual time
+        self._clock = clock or time.time
         self._job_uuid = job_uuid
         self._job_name = job_name
         self._min_workers = min_workers
@@ -66,7 +71,7 @@ class BrainResourceOptimizer(ResourceOptimizer):
     def report_stats(self, stats: WorkerStats, global_step: int = 0):
         self.report_sample(
             bmsg.RuntimeSample(
-                timestamp=time.time(),
+                timestamp=self._clock(),
                 worker_num=stats.worker_num,
                 speed_steps_per_sec=stats.speed_steps_per_sec,
                 global_step=global_step,

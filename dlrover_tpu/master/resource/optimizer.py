@@ -21,7 +21,6 @@ host memory like the reference (``resource/job.py:313-395``).
 from __future__ import annotations
 
 import statistics
-import time
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple

@@ -35,8 +35,13 @@ class MasterServicer:
         sync_service: Optional[SyncService] = None,
         elastic_run_configs: Optional[Dict] = None,
         metric_collector=None,
+        planner=None,
     ):
         self._metric_collector = metric_collector
+        #: goodput planner (brain/planner.py): the membership poll
+        #: carries its speculation hint so agents pre-compile the
+        #: exact world the planner intends next
+        self._planner = planner
         self._task_manager = task_manager
         self._job_manager = job_manager
         self._speed_monitor = speed_monitor
@@ -212,11 +217,17 @@ class MasterServicer:
 
     def _num_nodes_waiting(self, request: msg.NumNodesWaitingRequest):
         mgr = self._rdzv_managers[request.rdzv_name or RendezvousName.TRAINING]
+        hint: Dict = {}
+        if self._planner is not None:
+            # the planner's intended next world rides the poll every
+            # agent already makes — zero extra RPCs for the hint
+            hint = self._planner.speculation_hint()
         return msg.NumNodesWaitingResponse(
             waiting_num=mgr.num_nodes_waiting(),
             # workers seated in an OLDER round than this are hung in a
             # dead collective (post-watchdog re-form) and must re-join
             latest_round=mgr.get_rdzv_round(),
+            speculation_hint=hint,
         )
 
     def _network_ready(self, request: msg.NetworkReadyRequest):
@@ -311,6 +322,7 @@ class MasterServicer:
                 request.cpu_percent,
                 request.memory_mb,
                 tpu_duty_cycle=request.tpu_duty_cycle,
+                tpu_hbm_used_mb=request.tpu_hbm_used_mb,
             )
         return msg.SimpleResponse()
 

@@ -255,6 +255,7 @@ class MasterStateManager:
     K_DATASET = "tasks"  # tasks/<dataset>
     K_SPEED = "speed"
     K_NODES = "nodes"
+    K_PLANNER = "planner"
 
     def __init__(self, backend: MasterStateBackend, job_uid: str = ""):
         self._backend = backend
@@ -336,6 +337,33 @@ class MasterStateManager:
             return None
         doc = json.loads(raw)
         return doc if self._same_job(doc) else None
+
+    # -- goodput planner decision ledger ---------------------------------
+
+    def save_planner(self, state: Dict):
+        """The planner's decision ledger + cooldown/hysteresis state
+        (brain/planner.py export_state): a relaunched master must not
+        re-execute a plan the dead one just paid for."""
+        fp = json.dumps(state, sort_keys=True, default=str)
+        if self._last_written.get(self.K_PLANNER) == fp:
+            return
+        try:
+            self._backend.set(
+                self.K_PLANNER,
+                json.dumps({"planner": state, "job_uid": self._job_uid}),
+            )
+            self._last_written[self.K_PLANNER] = fp
+        except Exception:
+            logger.exception("planner ledger persist failed")
+
+    def load_planner(self) -> Optional[Dict]:
+        raw = self._backend.get(self.K_PLANNER)
+        if not raw:
+            return None
+        doc = json.loads(raw)
+        if not self._same_job(doc):
+            return None
+        return doc.get("planner") or None
 
     # -- node registry / relaunch budgets --------------------------------
 

@@ -16,6 +16,8 @@ from dlrover_tpu.parallel.mesh import (  # noqa: F401
     SP,
     TP,
     build_mesh,
+    config_for,
+    mesh_for,
     remesh,
     validate_divisibility,
 )

@@ -217,6 +217,37 @@ def mesh_slice_of(mesh: Mesh, n_slices: int, dp_index: int) -> int:
     return dp_index // per
 
 
+def config_for(world) -> MeshConfig:
+    """The :class:`MeshConfig` a
+    :class:`~dlrover_tpu.common.world.WorldDescriptor` describes —
+    fully resolved (no ``-1`` dp), so resolve/build can't reinterpret
+    it. The inverse of ``WorldDescriptor.from_axis_sizes(cfg.shape())``."""
+    sizes = world.axis_sizes()
+    cfg = MeshConfig(**{a: sizes.get(a, 1) for a in AXIS_ORDER})
+    return cfg.resolve(world.world_size)
+
+
+def mesh_for(world, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the Mesh a WorldDescriptor describes (slice-major when it
+    is multislice) and CHECK the result against it — the one
+    descriptor→mesh path, shared by the warm-compile speculation
+    targets, the bench resize phase and planner-directed resizes, so a
+    candidate world and the mesh built for it can never disagree."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)[: world.world_size]
+    if len(devices) < world.world_size:
+        raise ValueError(
+            f"{world.spec} needs {world.world_size} devices; "
+            f"{len(devices)} attached"
+        )
+    mesh = build_mesh(
+        config_for(world), devices=devices, n_slices=world.n_slices
+    )
+    world.check_mesh(mesh)
+    return mesh
+
+
 def remesh(config: MeshConfig, n_devices: int) -> MeshConfig:
     """Re-fit a mesh config after an elastic membership change.
 

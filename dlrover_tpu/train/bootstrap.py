@@ -157,6 +157,36 @@ class WorkerContext:
         except Exception as e:
             logger.warning("resize breakdown report failed: %s", e)
 
+    def poll_speculation_hint(self, trainer) -> Optional[dict]:
+        """Fetch the goodput planner's intended-next-world hint from
+        the membership poll and arm the trainer's warm compiler with it
+        (brain/planner.py; docs/design/brain_planner.md). The master
+        plans in NODES; the hint scales by this process's local device
+        count, so the trainer speculates the exact DEVICE world the
+        planner-directed resize will seat. A missing/empty hint clears
+        nothing armed and returns None — pre-planner masters and
+        version skew are harmless (serde drops the unknown field)."""
+        if self.client is None:
+            return None
+        try:
+            hint = self.client.speculation_hint()
+        except Exception as e:
+            logger.debug("speculation-hint poll failed: %s", e)
+            return None
+        if not hint:
+            return None
+        world_nodes = int(hint.get("world", 0) or 0)
+        if world_nodes <= 0:
+            return None
+        import jax
+
+        devices_per_node = max(1, jax.local_device_count())
+        trainer.set_speculation_hint(
+            world_nodes * devices_per_node,
+            n_slices=int(hint.get("n_slices", 0) or 0) or None,
+        )
+        return hint
+
     def report_step(self, step: int, force: bool = False, digest=None):
         """Throttled global-step report feeding the master's SpeedMonitor.
 

@@ -76,28 +76,40 @@ class LiveReshardError(RuntimeError):
     must fall back to the checkpoint restore path."""
 
 
-def state_shardings(avatar_tree: PyTree, mesh) -> PyTree:
+def state_shardings(avatar_tree: PyTree, mesh, world=None) -> PyTree:
     """Bind each avatar's PartitionSpec to ``mesh``: the NamedSharding
     pytree the post-resize step expects its state in. ``avatar_tree``
     is the trainer's ``_state_avatar`` (or any tree whose leaves carry
     a ``.spec``) — the same machinery ``lower_step`` compiles against,
-    so transfer targets and executable signature can never disagree."""
+    so transfer targets and executable signature can never disagree.
+
+    ``world`` (a :class:`~dlrover_tpu.common.world.WorldDescriptor`):
+    when given, the mesh is CHECKED against it before any sharding is
+    derived — the transfer target and the AOT executable then describe
+    the same world through one checked type instead of trusting that
+    two call sites re-derived the same shape."""
     import jax
     from jax.sharding import NamedSharding
 
+    if world is not None:
+        world.check_mesh(mesh)
     return jax.tree.map(
         lambda av: NamedSharding(mesh, av.spec), avatar_tree
     )
 
 
-def state_targets(avatar_tree: PyTree, mesh) -> PyTree:
+def state_targets(avatar_tree: PyTree, mesh, world=None) -> PyTree:
     """``ShapeDtypeStruct`` (with sharding) pytree for ``mesh`` — the
     restore-target form of :func:`state_shardings`, for callers driving
     the checkpoint engine's placed restore against the same avatars
-    (bench's shm-round-trip leg, parity tests)."""
+    (bench's shm-round-trip leg, parity tests). ``world``: optional
+    WorldDescriptor checked against ``mesh`` exactly as in
+    :func:`state_shardings`."""
     import jax
     from jax.sharding import NamedSharding
 
+    if world is not None:
+        world.check_mesh(mesh)
     return jax.tree.map(
         lambda av: jax.ShapeDtypeStruct(
             av.shape, av.dtype, sharding=NamedSharding(mesh, av.spec)

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
+from dlrover_tpu.common.world import WorldDescriptor
 from dlrover_tpu.lint import retrace_guard
 from dlrover_tpu.observability import trace
 from dlrover_tpu.observability.digest import StepTimeDigest
@@ -188,6 +189,10 @@ class ElasticTrainer:
         # first post-resize step build stamps the compile half and
         # records it to live_reshard.resize_ledger)
         self._pending_resize: Optional[dict] = None
+        # planner-directed speculation target (set_speculation_hint):
+        # the exact WorldDescriptor the master's goodput planner
+        # intends next — compiled FIRST by the speculative thread
+        self._speculation_hint: Optional[WorldDescriptor] = None
         # silent-recompile guard (lint/retrace_guard.py), opt-in via
         # DLROVER_TPU_RETRACE_GUARD: raises in place when the step (or
         # any jitted fn) recompiles an already-seen signature or drifts
@@ -344,6 +349,9 @@ class ElasticTrainer:
                 "state_targets needs avatars: run one step() or call "
                 "record_avatars(state, batch) first"
             )
+        # no world= check here: the only descriptor available derives
+        # from this same mesh (a self-comparison proves nothing);
+        # remesh() passes a config-derived one where it is meaningful
         return live_reshard.state_targets(avatars, mesh)
 
     # ---- elastic global-batch math (reference trainer.py:307-327) ------
@@ -941,6 +949,22 @@ class ElasticTrainer:
             n_slices=self._slices_for(mesh),
         )
 
+    def world_descriptor(self, mesh: Optional[Mesh] = None) -> WorldDescriptor:
+        """The ONE description of the world this trainer builds for
+        ``mesh`` (default: live): resolved mesh axes x slice count x
+        the effective zero-1/hier program modes
+        (:class:`~dlrover_tpu.common.world.WorldDescriptor`). Contract
+        specs, transfer-target checks and the planner's candidate
+        vocabulary all read this instead of re-deriving world shape."""
+        mesh = mesh if mesh is not None else self.mesh
+        hier = self._hier_mode(mesh) == "hier"
+        return WorldDescriptor.from_axis_sizes(
+            dict(mesh.shape),
+            n_slices=self._slices_for(mesh) if hier else 1,
+            zero1=self._zero1_mode(mesh) != "off",
+            hier=hier,
+        )
+
     def _contract_spec(self, mesh: Mesh) -> str:
         """The SC001 contract key for the program this trainer builds
         on ``mesh``: the mesh spec, ``+Nslice`` when the hierarchical
@@ -948,16 +972,7 @@ class ElasticTrainer:
         ``+zero1`` when weight-update sharding is on. A multislice mesh
         running the FLAT path keys the plain spec — its census is the
         single-slice program's."""
-        from dlrover_tpu.lint import shardcheck
-
-        return shardcheck.contract_spec_of(
-            dict(mesh.shape),
-            zero1=self._zero1_mode(mesh) != "off",
-            n_slices=(
-                self._slices_for(mesh)
-                if self._hier_mode(mesh) == "hier" else 1
-            ),
-        )
+        return self.world_descriptor(mesh).spec
 
     def _maybe_shardcheck(
         self, lowered, compiled, mesh, mesh_config, config_hash: str
@@ -1086,13 +1101,82 @@ class ElasticTrainer:
         self._maybe_speculate()
         return fn
 
+    def _descriptor_for_world(
+        self, world: int, n_slices: Optional[int] = None
+    ) -> Optional[WorldDescriptor]:
+        """Refit this trainer's mesh config onto ``world`` devices and
+        describe the result, or None when the world is inadmissible
+        (model axes don't fit, global-batch invariant broken, devices
+        unavailable) — the same filters ``neighbor_worlds`` applies, so
+        a planner hint survives exactly when a neighbor would."""
+        from dlrover_tpu.parallel.mesh import remesh as remesh_config
+
+        if world <= 0 or world > jax.device_count():
+            return None
+        slices = (
+            max(1, int(n_slices)) if n_slices is not None
+            else self._slices_for_size(world)
+        )
+        if slices > 1 and world % slices:
+            return None
+        try:
+            resolved = remesh_config(self.mesh_config, world).resolve(world)
+        except ValueError:
+            return None
+        dp = resolved.data_parallel_size
+        if self.tc.global_batch_size % (self.tc.micro_batch_size * dp):
+            return None
+        if slices > 1 and dp % slices:
+            return None
+        try:
+            return WorldDescriptor.from_axis_sizes(
+                resolved.shape(), n_slices=slices, hier=slices > 1
+            )
+        except ValueError:
+            return None
+
+    def set_speculation_hint(self, hint, n_slices: Optional[int] = None):
+        """Planner-directed speculation (brain/planner.py): tell the
+        warm compiler which EXACT world the master's goodput planner
+        intends to resize to next, so the background thread compiles
+        that target first — a planner-directed resize then lands on a
+        pre-compiled executable instead of hoping the blind ±node/±slice
+        neighbor enumeration guessed right.
+
+        ``hint``: a :class:`WorldDescriptor`, a device-world size (the
+        caller converts the master's node-level hint via its local
+        device count), or None to clear. Inadmissible hints (model axes
+        don't fit, batch invariant broken) are dropped — the neighbor
+        heuristic remains the fallback either way."""
+        if hint is None:
+            self._speculation_hint = None
+            return
+        if isinstance(hint, WorldDescriptor):
+            wd = self._descriptor_for_world(
+                hint.world_size, n_slices=hint.n_slices
+            )
+        else:
+            wd = self._descriptor_for_world(int(hint), n_slices=n_slices)
+        if wd is not None and wd.world_size == self.mesh.size:
+            wd = None  # already there — nothing to pre-compile
+        if wd is not None:
+            logger.info(
+                "speculation hint armed: planner intends world %s",
+                wd.spec,
+            )
+        self._speculation_hint = wd
+
     def _maybe_speculate(self):
-        """After a successful live build, compile the step for neighbor
-        world sizes in the background (bounded daemon thread; skips
+        """After a successful live build, compile the step for likely
+        next worlds in the background (bounded daemon thread; skips
         when the kill-switch is off or no persistent cache dir is
-        configured — see WarmCompiler.speculate). Needs the factory
-        form of the loss: a plain ``loss_fn`` may close over the live
-        mesh and cannot be retargeted to a neighbor world."""
+        configured — see WarmCompiler.speculate). A planner speculation
+        hint (``set_speculation_hint``) takes the FIRST slot — the
+        planner said which world comes next, so that exact target gets
+        compiled before any blind neighbor; without a hint the neighbor
+        enumeration behaves exactly as before. Needs the factory form
+        of the loss: a plain ``loss_fn`` may close over the live mesh
+        and cannot be retargeted to another world."""
         if self.loss_factory is None:
             return
         try:
@@ -1107,35 +1191,40 @@ class ElasticTrainer:
             )
         except Exception:
             return
+        hint = self._speculation_hint
+        if hint is not None and hint.world_size != self.mesh.size:
+            targets = [hint] + [
+                t for t in targets if t.world_size != hint.world_size
+            ]
         if not targets:
             return
 
-        def compile_for_world(w: int):
-            from dlrover_tpu.parallel.mesh import build_mesh
-            from dlrover_tpu.parallel.mesh import remesh as remesh_config
+        def compile_for_world(wd: WorldDescriptor):
+            from dlrover_tpu.parallel.mesh import config_for, mesh_for
 
-            cfg = remesh_config(self.mesh_config, w).resolve(w)
             # multislice: a neighbor world is a whole number of slices
-            # (neighbor_worlds guarantees it) — build it slice-major so
-            # the speculated executable IS the post-slice-loss program
-            # (the hierarchical strategy and the ici/dcn layout both
-            # key on it)
-            slices = self._slices_for_size(w)
-            mesh = build_mesh(
-                cfg, devices=jax.devices()[:w], n_slices=slices
+            # (the descriptor checked it) — mesh_for builds it
+            # slice-major so the speculated executable IS the
+            # post-slice-loss program (the hierarchical strategy and
+            # the ici/dcn layout both key on it) and re-checks the
+            # built mesh against the descriptor
+            mesh = mesh_for(wd)
+            _, info = self.lower_step(
+                mesh, config_for(wd), source="speculative"
             )
-            _, info = self.lower_step(mesh, cfg, source="speculative")
             # no log once shutdown began: the interpreter may have
             # closed the log streams under this daemon thread
             if info["cache"] == "miss" and not self.warm._stop.is_set():
                 logger.info(
-                    "speculative compile: world=%d ready in %.2fs",
-                    w, info["compile_s"],
+                    "speculative compile: world=%s ready in %.2fs",
+                    wd.spec, info["compile_s"],
                 )
 
         if self.warm.speculate(targets, compile_for_world):
             logger.info(
-                "speculating step compiles for neighbor worlds %s", targets
+                "speculating step compiles for worlds %s%s",
+                [t.spec for t in targets],
+                " (planner-hinted)" if hint is not None else "",
             )
 
     def apply_paral_config(self, state: dict, config: dict) -> dict:
@@ -1180,6 +1269,19 @@ class ElasticTrainer:
         if config and version != self._applied_config_version:
             self._applied_config_version = version
             state = self.apply_paral_config(state, config)
+        # the goodput planner's speculation hint rides the same
+        # throttled cadence (brain/planner.py): one cheap membership
+        # poll per ~every_steps host steps arms the warm compiler with
+        # the exact world the planner intends next, so the directed
+        # resize lands warm. Contexts without the helper (older stubs,
+        # tests) are skipped; failures never touch the training loop.
+        if self.worker_ctx is not None and hasattr(
+            self.worker_ctx, "poll_speculation_hint"
+        ):
+            try:
+                self.worker_ctx.poll_speculation_hint(self)
+            except Exception:
+                pass
         return state
 
     def eval_step(self, state: dict, batch) -> jnp.ndarray:
@@ -1461,7 +1563,17 @@ class ElasticTrainer:
                 # moments remesh device-to-device like any other leaf —
                 # including the zero↔off transitions
                 avatars = self._state_avatar_for(mesh)
-                shardings = live_reshard.state_shardings(avatars, mesh)
+                # check the built mesh against the descriptor derived
+                # from the CONFIG (the independent source — deriving it
+                # from mesh.shape would compare the mesh with itself):
+                # a caller passing a mesh inconsistent with the config
+                # it also passed fails here, before any state moves
+                target_world = WorldDescriptor.from_axis_sizes(
+                    mesh_config.resolve(mesh.size).shape()
+                )
+                shardings = live_reshard.state_shardings(
+                    avatars, mesh, world=target_world
+                )
                 new_state, transfer_info = live_reshard.transfer_state(
                     state, shardings
                 )
@@ -1480,6 +1592,14 @@ class ElasticTrainer:
         self.n_slices = new_slices
         self._step_fn = None
         self._eval_fn = None  # its NamedSharding binds the old mesh
+        if (
+            self._speculation_hint is not None
+            and self._speculation_hint.world_size == mesh.size
+        ):
+            # the hinted resize happened — the hint is consumed (the
+            # next build's speculation goes back to neighbors until the
+            # planner publishes a new intent)
+            self._speculation_hint = None
         self._pending_resize = {
             "from": old_world,
             "to": mesh.size,

@@ -329,9 +329,13 @@ def neighbor_worlds(
     micro_batch_size: int,
     max_targets: Optional[int] = None,
     n_slices: int = 1,
-) -> List[int]:
-    """World sizes a resize is likely to land on, filtered to the ones
-    we can actually compile for from here.
+) -> List["WorldDescriptor"]:
+    """Candidate :class:`~dlrover_tpu.common.world.WorldDescriptor`\\ s
+    a resize is likely to land on, filtered to the ones we can actually
+    compile for from here. Each descriptor carries the refit mesh axes
+    and the surviving slice count — the same checked type the goodput
+    planner scores and the contract specs key on, so the speculated
+    executable and everything downstream describe one world.
 
     Candidates, in priority order: world minus one node (the single
     most common elastic event — a preemption/eviction), world/2 (an
@@ -357,6 +361,7 @@ def neighbor_worlds(
     the only axis allowed to span DCN). A slice loss then resizes warm:
     the speculated executable was compiled on the slice-major neighbor
     mesh the re-seated world actually forms."""
+    from dlrover_tpu.common.world import WorldDescriptor
     from dlrover_tpu.parallel.mesh import remesh as remesh_config
 
     if max_targets is None:
@@ -370,9 +375,10 @@ def neighbor_worlds(
                world + per_slice]
     else:
         raw = [world - node, world // 2, world + node]
-    out: List[int] = []
+    out: List[WorldDescriptor] = []
+    seen: set = set()
     for w in raw:
-        if w <= 0 or w == world or w in out:
+        if w <= 0 or w == world or w in seen:
             continue
         if w > n_devices_available:
             continue
@@ -384,6 +390,7 @@ def neighbor_worlds(
             continue
         if global_batch_size % (micro_batch_size * dp):
             continue
+        slices = 1
         if per_slice:
             slices = w // per_slice
             if w % per_slice:
@@ -392,7 +399,17 @@ def neighbor_worlds(
             # mesh: dp spans DCN, nothing else may
             if slices > 1 and resolved.dp % slices:
                 continue
-        out.append(w)
+        try:
+            out.append(
+                WorldDescriptor.from_axis_sizes(
+                    resolved.shape(),
+                    n_slices=max(1, slices),
+                    hier=slices > 1,
+                )
+            )
+        except ValueError:
+            continue
+        seen.add(w)
         if len(out) >= max_targets:
             break
     return out
@@ -465,12 +482,14 @@ class WarmCompiler:
 
     def speculate(
         self,
-        targets: Sequence[int],
-        compile_for_world: Callable[[int], Any],
+        targets: Sequence[Any],
+        compile_for_world: Callable[[Any], Any],
         require_cache_dir: bool = True,
     ) -> bool:
         """Kick the background thread compiling ``compile_for_world(w)``
-        for each target world. Returns True if a thread was started.
+        for each target (``WorldDescriptor``\\ s from
+        ``neighbor_worlds``, or whatever the caller's compile fn
+        accepts). Returns True if a thread was started.
         At most one speculation generation runs at a time; a new call
         while one is in flight is dropped (the next build re-triggers)."""
         if not warm_compile_enabled() or not targets:
@@ -492,7 +511,7 @@ class WarmCompiler:
             self._thread.start()
         return True
 
-    def _run(self, targets: List[int], compile_for_world):
+    def _run(self, targets: List[Any], compile_for_world):
         for w in targets:
             if self._stop.is_set():
                 return
@@ -503,7 +522,8 @@ class WarmCompiler:
                 # heuristic missed, OOM in the compiler) is just an
                 # uncached future resize, not an error worth a restart
                 logger.warning(
-                    "speculative compile for world=%d skipped: %s", w, e
+                    "speculative compile for world=%s skipped: %s",
+                    getattr(w, "spec", w), e,
                 )
 
     def wait_idle(self, timeout: Optional[float] = None) -> bool:

@@ -288,3 +288,67 @@ def test_autoscaler_refines_hyperparams_from_model_report():
             )
     finally:
         JobContext.reset_singleton()
+
+
+def test_autoscaler_planner_path_executes_one_plan_per_cooldown():
+    """The goodput-planner decision path (brain/planner.py wired into
+    JobAutoScaler): a paying RESIZE becomes a worker-count ResourcePlan
+    executed through the normal Scaler, the planner is told (cooldown
+    opens), and the whole cycle runs on the injected clock — no wall
+    time anywhere."""
+    import types
+
+    from dlrover_tpu.brain.planner import GoodputPlanner
+
+    class _Rdzv:
+        def __init__(self):
+            self.waiting = 4
+            self.world = tuple(range(8))
+
+        def world_snapshot(self):
+            return types.SimpleNamespace(
+                latest_world=self.world, num_waiting=self.waiting
+            )
+
+    ctx = add_workers(8)
+    clock = [1000.0]
+    sm = SpeedMonitor(clock=lambda: clock[0])
+    sm.collect_global_step(50, 900.0)
+    for nid in range(8):
+        sm.collect_step_digest(nid, {
+            "count": 10, "mean_s": 1.0, "p50_s": 1.0, "p95_s": 1.05,
+            "max_s": 1.1,
+        })
+    # one measured 10s downtime bracket = the resize cost input
+    sm.mark_downtime_start(ts=900.0)
+    sm.mark_downtime_end(ts=910.0)
+    rdzv = _Rdzv()
+    planner = GoodputPlanner(
+        speed_monitor=sm, rdzv_manager=rdzv, clock=lambda: clock[0],
+        min_nodes=1, max_nodes=12, cooldown_s=100.0, horizon_s=600.0,
+        hysteresis=1, decide_interval_s=1.0,
+    )
+    scaler = LocalScaler()
+    auto = JobAutoScaler(
+        optimizer=LocalOptimizer(min_workers=1, max_workers=12),
+        scaler=scaler,
+        speed_monitor=sm,
+        planner=planner,
+        clock=lambda: clock[0],
+    )
+    # first sweep only starts the warmup window
+    assert auto.sweep() is None
+    clock[0] += 100.0  # past the autoscale warmup
+    plan = auto.sweep()
+    assert plan is not None and not plan.empty()
+    group = plan.node_group_resources[NodeType.WORKER]
+    assert group.count == 12
+    assert len(ctx.alive_nodes(NodeType.WORKER)) == 12
+    executed = planner.report()["executed"]
+    assert [e["target_world"] for e in executed] == [12]
+    # inside the cooldown window: decisions HOLD, nothing new executes
+    rdzv.waiting = 8
+    clock[0] += 10.0
+    plan2 = auto.sweep()
+    assert plan2 is None or plan2.empty()
+    assert len(planner.report()["executed"]) == 1

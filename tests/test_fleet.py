@@ -164,6 +164,59 @@ def test_seated_hang_detected_recovered_attributed(tmp_path):
     assert v["goodput"] >= 0.7
 
 
+def test_autoscale_smoke_planner_gates(tmp_path):
+    """The goodput planner's observe→decide→act loop under chaos
+    (docs/design/brain_planner.md), tier-1 cut: capacity loss →
+    watchdog re-form at 52 → straggler episode overlapping the
+    capacity restoration → planner HOLDs through instability (growth
+    gate keeps the waiting 8 invisible) → exactly one executed plan
+    once stable → full world re-adopted inside the bound."""
+    v = _run("autoscale_smoke", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    pl = v["planner"]
+    assert pl["armed"]
+    assert pl["counts"]["resize"] == 1
+    assert len(pl["executed"]) == 1
+    assert pl["executed"][0]["target_world"] == 60
+    # the seated-world story: full → shrunk → full again, with the
+    # re-adoption strictly after the instability window
+    sizes = [s for _, s in pl["world_timeline"]]
+    assert sizes[0] == 60 and 52 in sizes and sizes[-1] == 60
+    # every decision during the straggler episode was a HOLD (the
+    # no_scaleout check re-derives this from the executed list; the
+    # counts show the planner kept deciding rather than stalling)
+    assert pl["counts"]["hold"] > pl["counts"]["resize"]
+    cats = v["attribution"]["categories"]
+    assert sum(cats.values()) == pytest.approx(
+        v["attribution"]["elapsed_wall_s"], rel=0.01
+    )
+
+
+def test_autoscale_smoke_decisions_deterministic(tmp_path):
+    """The decision-ledger bit-determinism gate: two runs of the same
+    seed produce identical decision ledgers (the ledger digest folds
+    into the verdict determinism digest, so either mismatch fails)."""
+    v1 = _run("autoscale_smoke", tmp_path / "a")
+    v2 = _run("autoscale_smoke", tmp_path / "b")
+    assert v1["planner"]["ledger_digest"] == v2["planner"]["ledger_digest"]
+    assert v1["determinism_digest"] == v2["determinism_digest"]
+    assert v1["planner"]["executed"] == v2["planner"]["executed"]
+
+
+@pytest.mark.slow
+def test_autoscale_storm_scenario(tmp_path):
+    """The acceptance scenario (ISSUE 14): 200 nodes, capacity loss +
+    straggler episode + restoration with the planner armed — zero
+    scale-outs while unstable, adoption inside the bound, ≤1 plan per
+    cooldown, deterministic ledger, attribution sum ±1%. Run
+    explicitly by the fleet-chaos CI step (also via
+    ``python -m dlrover_tpu.fleet run autoscale_storm``)."""
+    v = _run("autoscale_storm", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    assert v["nodes"] == 200
+    assert len(v["planner"]["executed"]) == 1
+
+
 @pytest.mark.slow
 def test_shard_storm_1k_scenario(tmp_path):
     """The data-plane acceptance scenario (ISSUE 11): 1000 workers

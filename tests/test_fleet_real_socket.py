@@ -1,16 +1,20 @@
-"""The ROADMAP-5 "real-gRPC slice": a ~25-node fleet over the REAL
+"""The ROADMAP-5 "real-gRPC slice": a 50-node fleet over the REAL
 ``RpcServer`` socket path — not the in-process loopback — with the
-runtime LockTracker armed.
+runtime LockTracker armed and the ``RequestGate`` pinned NEAR its
+admission watermark.
 
 The loopback harness proves the control plane's logic; this proves a
-slice of its socket/threading behavior: 25 concurrent client threads
+slice of its socket/threading behavior: 50 concurrent client threads
 drive join → world-poll → folded WorkerReport → batched shard leases
 through real gRPC channels (node-id header and all), the servicer
 handles them on the server's thread pool, and every tracked lock
 acquisition the real schedule makes must be consistent with the
-checked-in lock_order.json. Reuses the shed-fast test plumbing
-(tests/test_rpc_policy.py): ``start_local_master`` boots the
-production ``RpcServer``; ``MasterClient`` is the production client.
+checked-in lock_order.json. With the report cap pinned far below the
+thread count, the gate MUST shed some of the barrier-aligned report
+burst — the class of serializer/flow-control regression the loopback
+cannot catch (PR 9's ``OverloadedResponse`` path over a real wire,
+clients honoring it by widening + retrying, everything still
+converging to exactly-once).
 
 Sized for the tier-1 budget: one round, one small dataset, a few
 seconds of real time.
@@ -25,27 +29,46 @@ from dlrover_tpu.agent.master_client import MasterClient
 from dlrover_tpu.common.messages import DatasetShardParams
 from dlrover_tpu.lint import lock_tracker as lt
 from dlrover_tpu.master.local_master import start_local_master
+from dlrover_tpu.rpc.policy import OverloadedError
 from dlrover_tpu.rpc.transport import RpcClient
 
-NODES = 25
+NODES = 50
 DATASET = "real-socket-data"
-RECORDS = 2_500
+RECORDS = 5_000
 SHARD = 100
+#: far below the 50-thread burst: the barrier-aligned folded reports
+#: MUST hit the admission watermark and take the Overloaded path
+REPORT_CAP = 2
 
 
-def _drive_worker(addr, nid, results, barrier):
+def _report_with_backpressure(client, out, **kw):
+    """Send one folded report honoring Overloaded exactly like the real
+    StatusReporter: on a shed, sleep at least the advertised
+    retry_after and try again (bounded)."""
+    for _ in range(40):
+        try:
+            client.report_worker_status(**kw)
+            return True
+        except OverloadedError as e:
+            out["sheds"] += 1
+            time.sleep(max(0.005, min(e.retry_after_s, 0.05)))
+    return False
+
+
+def _drive_worker(addr, nid, results, barrier, report_barrier):
     client = MasterClient(
         addr, nid, client=RpcClient(addr, node_id=nid)
     )
     out = results[nid] = {
-        "seated": False, "rank": -1, "records": 0, "errors": []
+        "seated": False, "rank": -1, "records": 0, "sheds": 0,
+        "errors": [],
     }
     try:
         barrier.wait(timeout=10)
         client.join_rendezvous(
             node_rank=nid, node_ip=f"10.0.0.{nid}", node_port=8476
         )
-        deadline = time.time() + 20
+        deadline = time.time() + 30
         world = None
         while time.time() < deadline:
             resp = client.get_comm_world()
@@ -62,16 +85,21 @@ def _drive_worker(addr, nid, results, barrier):
              if info[0] == nid),
             -1,
         )
-        # the folded report: heartbeat + digest + resource in one RPC,
-        # concurrently from 25 threads (the striped-ledger fold path)
+        # align the folded-report burst so 50 threads hit the 2-deep
+        # gate together: the shed/honor/retry flow-control path runs
+        # for real, over real sockets
+        report_barrier.wait(timeout=30)
         for step in (5, 10):
-            client.report_worker_status(
+            ok = _report_with_backpressure(
+                client, out,
                 step=step if out["rank"] == 0 else -1,
                 digest={"count": 5, "mean_s": 1.0, "p50_s": 1.0,
                         "p95_s": 1.05, "max_s": 1.1},
                 cpu_percent=0.5,
                 memory_mb=512.0,
             )
+            if not ok:
+                out["errors"].append(f"report step {step} never admitted")
         # the batched data plane over the real socket: completions of
         # each batch ride the next lease call under the worker's lease
         # fence (an ack sent without the fence is dropped as a zombie)
@@ -112,6 +140,9 @@ def test_real_socket_fleet_with_lock_tracker_armed():
         master = start_local_master(
             node_num=NODES, rdzv_waiting_timeout=2.0
         )
+        # pin the admission watermark far below the burst: sheds are a
+        # REQUIRED outcome of this test, not an accident
+        master._server.gate.report_cap = REPORT_CAP
         master.task_manager.new_dataset(DatasetShardParams(
             dataset_name=DATASET,
             dataset_size=RECORDS,
@@ -120,10 +151,11 @@ def test_real_socket_fleet_with_lock_tracker_armed():
         addr = f"127.0.0.1:{master.port}"
         results = {}
         barrier = threading.Barrier(NODES)
+        report_barrier = threading.Barrier(NODES)
         threads = [
             threading.Thread(
                 target=_drive_worker,
-                args=(addr, nid, results, barrier),
+                args=(addr, nid, results, barrier, report_barrier),
                 daemon=True,
             )
             for nid in range(NODES)
@@ -131,7 +163,7 @@ def test_real_socket_fleet_with_lock_tracker_armed():
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=40)
+            t.join(timeout=60)
         assert not any(t.is_alive() for t in threads), "worker hung"
 
         errors = {n: r["errors"] for n, r in results.items()
@@ -142,19 +174,27 @@ def test_real_socket_fleet_with_lock_tracker_armed():
         ranks = sorted(r["rank"] for r in results.values())
         assert ranks == list(range(NODES))
         # the folded reports landed: every rank's digest is on file and
-        # the chief's step moved the global ledger
+        # the chief's step moved the global ledger — serializer
+        # round-trips intact under shed-and-retry
         sm = master.speed_monitor
         assert len(sm.running_workers) == NODES
         assert sm.completed_global_step == 10
-        assert len(sm.straggler_report()["rank_digests"]) == NODES
+        digests = sm.straggler_report()["rank_digests"]
+        assert len(digests) == NODES
+        assert all(d["p50_s"] == 1.0 for d in digests.values())
         # the data plane drained exactly once through real sockets
         assert sum(r["records"] for r in results.values()) == RECORDS
         assert master.task_manager.completed_records(DATASET) == RECORDS
-        # real-gRPC slice evidence: the server's gate actually served
-        # this traffic (shed path shared with test_rpc_policy)
+        # flow-control evidence: the pinned gate really shed part of
+        # the aligned burst, clients honored the Overloaded replies,
+        # and the shed counters agree across both sides of the wire
         stats = master._server.gate.stats()
+        client_sheds = sum(r["sheds"] for r in results.values())
+        assert stats["rejected"]["report"] >= 1
+        assert client_sheds == stats["rejected"]["report"]
         assert stats["served"]["report"] >= NODES * 2
         assert stats["served"]["get"] >= NODES * 2
+        assert stats["peak_inflight"] >= REPORT_CAP
         # and the LockTracker watched a real concurrent schedule do it
         # all without a single ordering violation
         assert tracker.acquisitions > 500

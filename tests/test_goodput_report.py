@@ -334,6 +334,54 @@ def test_attribution_scales_overflowing_lost_seconds_into_wall():
     assert cats["unattributed"] == 0.0
 
 
+def test_planner_decisions_section_roundtrip(tmp_path):
+    """The goodput report's ``decisions`` section (brain/planner.py):
+    the decision ledger survives a master relaunch through the durable
+    state backend — counts, executed plans, intent, cooldown — and the
+    report section regenerates identically from the restored planner."""
+    from dlrover_tpu.brain.planner import (
+        RESIZE,
+        GoodputPlanner,
+        PlannerInputs,
+    )
+    from dlrover_tpu.common import flags
+    from dlrover_tpu.master.state_store import (
+        MasterStateManager,
+        create_state_backend,
+    )
+
+    with flags.STATE_BACKEND.scoped("file"), \
+            flags.STATE_DIR.scoped(str(tmp_path)):
+        planner = GoodputPlanner(
+            clock=lambda: 0.0, cooldown_s=100.0, horizon_s=600.0,
+            hysteresis=2, decide_interval_s=10.0,
+        )
+        for t in (0.0, 10.0):
+            d = planner.decide(inputs=PlannerInputs(
+                ts=t, world=8, waiting=4, step_p50_s=1.0,
+                resize_cost_s=10.0,
+            ))
+        assert d["verdict"] == RESIZE
+        planner.note_executed(planner.intent(), now=10.0)
+        mgr = MasterStateManager(create_state_backend("goodput-job"))
+        mgr.save_planner(planner.export_state())
+
+        # "relaunched master": fresh manager + planner on the same
+        # durable backend
+        mgr2 = MasterStateManager(create_state_backend("goodput-job"))
+        restored = mgr2.load_planner()
+        assert restored
+        planner2 = GoodputPlanner(clock=lambda: 20.0, cooldown_s=100.0)
+        planner2.import_state(restored)
+        report = planner2.report()
+        assert report == planner.report()
+        assert report["counts"] == {"hold": 1, "resize": 1}
+        assert [e["target"] for e in report["executed"]] == ["dp12"]
+        assert report["intent"] == "dp12"
+        # the section is JSON-able exactly as the goodput report writes it
+        json.loads(json.dumps({"decisions": report}))
+
+
 def _agent_cmd(addr, job, node_id):
     return [
         sys.executable, "-m", "dlrover_tpu.run.elastic_run",
@@ -359,7 +407,10 @@ def test_goodput_over_95_percent_with_injected_failure(tmp_path):
     # with a concurrent bench), so a slow judge box doesn't flake it
     steps = int(os.environ.get("GOODPUT_TEST_STEPS", "300"))
     crash_at = 30
-    master = start_local_master(node_num=2)
+    # planner armed so the report's `decisions` section carries the
+    # real ledger shape (a 2-node crash/restart run mostly HOLDs —
+    # recovery is never planner-gated)
+    master = start_local_master(node_num=2, planner=True)
     job = "goodput-report"
     try:
         addr = f"127.0.0.1:{master.port}"
@@ -432,6 +483,13 @@ def test_goodput_over_95_percent_with_injected_failure(tmp_path):
                 # brain/tuner's slow-link signal (profiler/comm.py);
                 # zeros on this single-slice CPU run
                 "comm_links": sm.comm_link_report(),
+                # the goodput planner's decision ledger (counts,
+                # executed plans, current intent) —
+                # docs/design/brain_planner.md; export/import-safe
+                # across master relaunch (round-trip test above)
+                "decisions": (
+                    master.planner.report() if master.planner else {}
+                ),
                 "goodput": round(goodput, 4),
                 "steps": steps,
                 "reference_claim": "README.md:46-48 (69% -> 95%+)",

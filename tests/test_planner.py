@@ -1,0 +1,682 @@
+"""The goodput planner (brain/planner.py): scoring model, hysteresis,
+cooldown, feasibility gates, the rendezvous growth gate, the
+speculation-hint wire, and the no-wall-clock pin.
+
+Every test drives :meth:`GoodputPlanner.decide` with explicit
+:class:`PlannerInputs` on an injected clock — the same deterministic
+path the ``autoscale_storm`` fleet scenario proves end to end.
+"""
+
+import ast
+import json
+import os
+
+import pytest
+
+from dlrover_tpu.brain.planner import (
+    HOLD,
+    RESIZE,
+    GoodputPlanner,
+    PlannerInputs,
+)
+from dlrover_tpu.common.world import WorldDescriptor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _planner(**kw):
+    kw.setdefault("clock", lambda: 0.0)
+    kw.setdefault("cooldown_s", 100.0)
+    kw.setdefault("horizon_s", 600.0)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("decide_interval_s", 10.0)
+    return GoodputPlanner(**kw)
+
+
+def _inputs(ts=0.0, world=8, **kw):
+    kw.setdefault("step_p50_s", 1.0)
+    kw.setdefault("resize_cost_s", 10.0)
+    return PlannerInputs(ts=ts, world=world, **kw)
+
+
+def _drive_to_resize(p, make_inputs, start=0.0, step=10.0, max_n=10):
+    """Decide repeatedly until a RESIZE (or give up after max_n)."""
+    t = start
+    for _ in range(max_n):
+        d = p.decide(inputs=make_inputs(t))
+        if d["verdict"] == RESIZE:
+            return d
+        t += step
+    return d
+
+
+# ---------------------------------------------------------------------------
+# payback scoring
+# ---------------------------------------------------------------------------
+
+
+def test_grow_pays_back_and_resizes_after_hysteresis():
+    p = _planner()
+    d1 = p.decide(inputs=_inputs(ts=0.0, waiting=4))
+    assert d1["verdict"] == HOLD and "hysteresis" in d1["reason"]
+    assert d1["target"] == "dp12"  # the winning candidate is recorded
+    d2 = p.decide(inputs=_inputs(ts=10.0, waiting=4))
+    assert d2["verdict"] == RESIZE
+    assert d2["target"] == "dp12" and d2["target_world"] == 12
+    assert d2["payback_s"] is not None and d2["payback_s"] > 0
+    # a DECISION alone opens nothing: a scaler failure must leave the
+    # fleet exactly as gated as before (review fix) — only the
+    # EXECUTED plan arms the growth gate and the speculation hint
+    assert p.speculation_hint() == {}
+    assert not p.growth_allowed(8)
+    p.note_executed(p.intent(), now=10.0)
+    assert p.speculation_hint() == {
+        "spec": "dp12", "world": 12, "n_slices": 1,
+    }
+    assert p.growth_allowed(8)
+
+
+def test_resize_cost_exceeding_horizon_gain_holds():
+    """ElasWave-style payback: a resize whose measured downtime cost
+    cannot be amortized by the throughput gain within the horizon is a
+    HOLD — forever, not just during hysteresis."""
+    p = _planner(horizon_s=600.0)
+    # 8 -> 12 nodes cuts step time 1.0 -> 2/3: the horizon completes
+    # 600 steps today, (600 - cost)/(2/3) steps after the resize. At
+    # cost 250 that is 525 < 600 — the resize LOSES steps.
+    mk = lambda t: _inputs(ts=t, waiting=4, resize_cost_s=250.0)
+    d = _drive_to_resize(p, mk)
+    assert d["verdict"] == HOLD
+    assert d["reason"] == "no_paying_candidate"
+    # the same gain with a cheap resize pays
+    p2 = _planner()
+    d = _drive_to_resize(
+        p2, lambda t: _inputs(ts=t, waiting=4, resize_cost_s=10.0)
+    )
+    assert d["verdict"] == RESIZE
+
+
+def test_unmeasured_resize_cost_uses_conservative_default():
+    p = _planner(default_resize_cost_s=250.0, horizon_s=600.0)
+    # no measured downtime yet (resize_cost_s=0): the default applies
+    d = _drive_to_resize(
+        p, lambda t: _inputs(ts=t, waiting=4, resize_cost_s=0.0)
+    )
+    assert d["verdict"] == HOLD
+
+
+# ---------------------------------------------------------------------------
+# feasibility gates
+# ---------------------------------------------------------------------------
+
+
+def test_hbm_infeasible_shrink_rejected():
+    """Shrinking packs more state per device: a candidate whose
+    projected occupancy lands inside the headroom reserve never enters
+    the candidate set. Growth is unaffected (it frees HBM)."""
+    p = _planner(hbm_headroom_frac=0.10)
+    tight = _inputs(
+        world=8, waiting=4,
+        hbm_used_bytes=14e9, hbm_capacity_bytes=16e9,
+    )
+    cands = {wd.world_size for wd in p.candidates(tight)}
+    assert 7 not in cands  # 14GB * 8/7 = 16GB > 16GB * 0.9
+    assert 12 in cands and 8 in cands
+    roomy = _inputs(
+        world=8, waiting=4,
+        hbm_used_bytes=4e9, hbm_capacity_bytes=16e9,
+    )
+    assert 7 in {wd.world_size for wd in p.candidates(roomy)}
+    # unknown occupancy gates nothing
+    blind = _inputs(world=8, waiting=4)
+    assert 7 in {wd.world_size for wd in p.candidates(blind)}
+
+
+def test_dcn_model_prefers_slice_aligned_shrink():
+    """The comm_links signal in action: on a 4-slice world whose step
+    is DCN-dominated, the slice-aligned shrink (6 nodes = 3 whole
+    slices, hierarchical reduction keeps DCN at B/dp_in) predicts a
+    FASTER step than the larger-but-misaligned 7-node world (ragged
+    slices run the flat reduction: B*(1-1/s) on the slow link)."""
+    p = _planner(dcn_gbps=25.0)
+    # measured: p50 1.0s of which 0.8s is DCN (20 GB/step over 25 GB/s
+    # on the hierarchical path — so the full gradient volume B is
+    # 20 GB * dp_in = 40 GB)
+    inputs = _inputs(
+        world=8, n_slices=4,
+        comm_links={"ici": int(60e9), "dcn": int(20e9)},
+    )
+    aligned = WorldDescriptor.from_axis_sizes(
+        {"dp": 6}, n_slices=3, hier=True
+    )
+    ragged = WorldDescriptor.from_axis_sizes({"dp": 7})
+    t_aligned = p.predict_step_time(aligned, inputs)
+    t_ragged = p.predict_step_time(ragged, inputs)
+    # aligned: compute 0.2 * 8/6 + 40/2/25 = 1.07; ragged: 0.2 * 8/7 +
+    # 40*0.75/25 = 1.43 — fewer nodes, faster step
+    assert t_aligned == pytest.approx(0.2 * 8 / 6 + 0.8, rel=1e-3)
+    assert t_ragged == pytest.approx(0.2 * 8 / 7 + 1.2, rel=1e-3)
+    assert t_aligned < t_ragged
+    s_aligned = p.score(aligned, inputs)
+    s_ragged = p.score(ragged, inputs)
+    assert s_aligned["score"] > s_ragged["score"]
+    # and the candidate enumeration itself offers the aligned shrink
+    # with its surviving slice count
+    cands = {wd.world_size: wd for wd in p.candidates(inputs)}
+    assert cands[6].n_slices == 3 and cands[6].hier
+
+
+def test_single_slice_world_has_no_dcn_penalty():
+    p = _planner()
+    inputs = _inputs(world=8, n_slices=1, comm_links={"ici": int(9e9)})
+    wd = WorldDescriptor.from_axis_sizes({"dp": 4})
+    assert p.predict_step_time(wd, inputs) == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# hysteresis / instability / cooldown
+# ---------------------------------------------------------------------------
+
+
+def test_one_healthy_window_does_not_flip_a_decision():
+    """Hysteresis across instability: the streak resets on every
+    unstable window, so a single healthy observation after a straggler
+    episode can never execute a plan."""
+    p = _planner(hysteresis=2)
+    # healthy decision: streak 1/2
+    d = p.decide(inputs=_inputs(ts=0.0, waiting=4))
+    assert "hysteresis:1/2" in d["reason"]
+    # straggler episode: unstable, streak resets
+    d = p.decide(inputs=_inputs(ts=10.0, waiting=4, stragglers=[3]))
+    assert d["reason"] == "unstable:stragglers"
+    # ONE healthy window: back to 1/2, not a flip to RESIZE
+    d = p.decide(inputs=_inputs(ts=20.0, waiting=4))
+    assert d["verdict"] == HOLD and "hysteresis:1/2" in d["reason"]
+    d = p.decide(inputs=_inputs(ts=30.0, waiting=4))
+    assert d["verdict"] == RESIZE
+
+
+def test_open_downtime_bracket_holds():
+    p = _planner()
+    d = p.decide(inputs=_inputs(ts=0.0, waiting=4, downtime_open=True))
+    assert d["verdict"] == HOLD and d["reason"] == "unstable:downtime"
+
+
+def test_cooldown_bounds_executed_plans():
+    p = _planner(cooldown_s=100.0)
+    d = _drive_to_resize(p, lambda t: _inputs(ts=t, waiting=4))
+    assert d["verdict"] == RESIZE
+    p.note_executed(p.intent(), now=d["ts"])
+    # the world re-formed at the target: intent satisfied...
+    d2 = p.decide(inputs=_inputs(ts=d["ts"] + 10, world=12))
+    assert d2["verdict"] == HOLD and d2["reason"] == "cooldown"
+    assert p.intent() is None  # ...and the growth gate closed
+    # more capacity appears inside the cooldown window: still HOLD
+    d3 = p.decide(inputs=_inputs(ts=d["ts"] + 50, world=12, waiting=4))
+    assert d3["reason"] == "cooldown"
+    # after the window the planner may decide again
+    d4 = p.decide(inputs=_inputs(ts=d["ts"] + 150, world=12, waiting=4))
+    assert "hysteresis" in d4["reason"]
+
+
+def test_no_signal_holds():
+    p = _planner()
+    d = p.decide(inputs=PlannerInputs(ts=0.0, world=8, step_p50_s=0.0))
+    assert d["verdict"] == HOLD and d["reason"] == "no_signal"
+
+
+# ---------------------------------------------------------------------------
+# growth gate
+# ---------------------------------------------------------------------------
+
+
+def test_growth_gate_follows_executed_intent():
+    p = _planner()
+    assert not p.growth_allowed(8)  # no intent: gated
+    d = _drive_to_resize(p, lambda t: _inputs(ts=t, waiting=4))
+    assert not p.growth_allowed(8)  # decided but not executed: gated
+    p.note_executed(p.intent(), now=d["ts"])
+    assert p.growth_allowed(8)       # executed dp12 > seated 8
+    assert not p.growth_allowed(12)  # target seated: gate closes
+
+
+def test_rendezvous_gate_suppresses_only_pure_growth():
+    """The rendezvous manager's half of the gate: waiting capacity
+    that would only grow a healthy seated world is invisible until the
+    planner approves; recovery (a dead seated member) is never gated."""
+    from dlrover_tpu.master.rendezvous.manager import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.rendezvous.net_topology import NodeTopologyMeta
+
+    clock = [0.0]
+    mgr = ElasticTrainingRendezvousManager(clock=lambda: clock[0])
+    mgr.update_rdzv_params(
+        min_nodes=2, max_nodes=4, node_unit=1, waiting_timeout=1.0
+    )
+    for nid in range(4):
+        mgr.join_rendezvous(
+            nid, nid, NodeTopologyMeta(node_id=nid, node_rank=nid)
+        )
+    rdzv_round, _, world, _ = mgr.get_comm_world(0)
+    assert len(world) == 4
+    allowed = [False]
+    mgr.set_growth_gate(lambda seated: allowed[0])
+    # a 5th node joins: pure growth — gated
+    mgr.join_rendezvous(4, 4, NodeTopologyMeta(node_id=4, node_rank=4))
+    assert mgr.num_nodes_waiting() == 0
+    # ...and the waiting cohort cannot complete a round either
+    clock[0] += 10.0
+    with mgr._lock:
+        assert not mgr._check_rdzv_completed()
+    # planner approves: the waiter becomes visible
+    allowed[0] = True
+    assert mgr.num_nodes_waiting() == 1
+    # recovery path: a seated member dies — waiting is advertised even
+    # with the gate shut (the re-form must not wait for a planner)
+    allowed[0] = False
+    mgr.remove_alive_node(2)
+    assert mgr.num_nodes_waiting() == 1
+    # a seated member re-joining (re-form in progress) is not growth
+    mgr.add_alive_node(2)
+    mgr.join_rendezvous(2, 2, NodeTopologyMeta(node_id=2, node_rank=2))
+    assert mgr.num_nodes_waiting() == 2
+
+
+# ---------------------------------------------------------------------------
+# observation from the real master ledgers
+# ---------------------------------------------------------------------------
+
+
+def test_observe_reads_measured_signals():
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.rendezvous.manager import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.rendezvous.net_topology import NodeTopologyMeta
+
+    clock = [1000.0]
+    sm = SpeedMonitor(clock=lambda: clock[0])
+    mgr = ElasticTrainingRendezvousManager(clock=lambda: clock[0])
+    mgr.update_rdzv_params(
+        min_nodes=2, max_nodes=3, node_unit=1, waiting_timeout=0.0
+    )
+    for nid in range(3):
+        mgr.join_rendezvous(
+            nid, nid, NodeTopologyMeta(node_id=nid, node_rank=nid)
+        )
+    mgr.get_comm_world(0)  # completes the round
+    p = GoodputPlanner(
+        speed_monitor=sm, rdzv_manager=mgr,
+        clock=lambda: clock[0], min_nodes=1, max_nodes=8,
+    )
+    sm.collect_global_step(1, 900.0)
+    for nid in range(3):
+        sm.collect_step_digest(nid, {
+            "count": 10, "mean_s": 1.0, "p50_s": 1.0 + nid * 0.01,
+            "p95_s": 1.1, "max_s": 1.2,
+        })
+    sm.record_comm_links(0, {"ici": 1000, "dcn": 250})
+    sm.mark_downtime_start(ts=950.0)
+    sm.mark_downtime_end(ts=960.0)
+    inputs = p.observe()
+    assert inputs.world == 3
+    assert inputs.step_p50_s == pytest.approx(1.01)
+    assert inputs.comm_links == {"ici": 1000, "dcn": 250}
+    assert inputs.resize_cost_s == pytest.approx(10.0)
+    assert not inputs.downtime_open
+    sm.mark_downtime_start()
+    assert p.observe().downtime_open
+    # a new joiner shows as waiting even though the gate (if armed)
+    # would hide it from the fleet — the planner sees RAW capacity
+    mgr.set_growth_gate(lambda seated: False)
+    mgr.join_rendezvous(7, 7, NodeTopologyMeta(node_id=7, node_rank=7))
+    assert mgr.num_nodes_waiting() == 0
+    assert p.observe().waiting == 1
+
+
+# ---------------------------------------------------------------------------
+# ledger continuity + observability
+# ---------------------------------------------------------------------------
+
+
+def test_decision_ledger_survives_relaunch_via_export_import():
+    p = _planner()
+    d = _drive_to_resize(p, lambda t: _inputs(ts=t, waiting=4))
+    assert d["verdict"] == RESIZE
+    p.note_executed(p.intent(), now=d["ts"])
+    state = p.export_state()
+    # JSON round-trip: the state rides the durable state backend
+    state = json.loads(json.dumps(state))
+    p2 = _planner()
+    p2.import_state(state)
+    assert p2.report()["counts"] == p.report()["counts"]
+    assert p2.report()["executed"] == p.report()["executed"]
+    assert p2.intent().spec == "dp12"
+    # the relaunched planner keeps the cooldown: no immediate re-plan
+    d2 = p2.decide(inputs=_inputs(ts=d["ts"] + 10, world=8, waiting=4))
+    assert d2["reason"] == "cooldown"
+
+
+def test_prometheus_lines_count_decisions():
+    p = _planner()
+    p.decide(inputs=_inputs(ts=0.0))
+    d = _drive_to_resize(p, lambda t: _inputs(ts=t, waiting=4), start=10.0)
+    assert d["verdict"] == RESIZE
+    rows = "\n".join(p.prometheus_lines())
+    assert 'dlrover_tpu_scale_decisions_total{verdict="hold"}' in rows
+    assert 'dlrover_tpu_scale_decisions_total{verdict="resize"} 1' in rows
+    assert "dlrover_tpu_planner_intent_world 12" in rows
+    assert "dlrover_tpu_planner_last_target_world 12" in rows
+
+
+# ---------------------------------------------------------------------------
+# speculation hint: wire + version skew + trainer feed
+# ---------------------------------------------------------------------------
+
+
+def test_speculation_hint_rides_membership_poll_wire():
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.serde import deserialize, serialize
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    p = _planner()
+    d = _drive_to_resize(p, lambda t: _inputs(ts=t, waiting=4))
+    p.note_executed(p.intent(), now=d["ts"])
+    servicer = MasterServicer(planner=p)
+    resp = servicer.get(msg.NumNodesWaitingRequest())
+    wire = serialize(resp)
+    back = deserialize(wire)
+    assert back.speculation_hint == {
+        "spec": "dp12", "world": 12, "n_slices": 1,
+    }
+
+
+def test_version_skew_old_agent_ignores_unknown_hint_field():
+    """An OLD agent's serde drops unknown fields: a wire payload
+    carrying speculation_hint (and any future field) deserializes into
+    the known fields only — no error, hint silently ignored. And a NEW
+    agent against an OLD master (no hint field) reads {} through the
+    getattr default."""
+    import json as _json
+
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.serde import deserialize, serialize
+
+    # new master -> old agent: add a field no current class defines to
+    # prove the drop-unknown-fields contract the hint relies on
+    wire = _json.loads(serialize(msg.NumNodesWaitingResponse(
+        waiting_num=3, latest_round=7,
+        speculation_hint={"spec": "dp12", "world": 12, "n_slices": 1},
+    )).decode())
+    wire["some_future_field"] = {"x": 1}
+    back = deserialize(_json.dumps(wire).encode())
+    assert back.waiting_num == 3 and back.latest_round == 7
+    assert not hasattr(back, "some_future_field")
+    # old master -> new agent: strip the hint field from the wire —
+    # the client accessor must read {} (not raise)
+    del wire["speculation_hint"]
+    del wire["some_future_field"]
+    back = deserialize(_json.dumps(wire).encode())
+    assert dict(getattr(back, "speculation_hint", None) or {}) == {}
+
+
+def test_worker_context_hint_poll_feeds_trainer():
+    """WorkerContext.poll_speculation_hint scales the master's
+    node-level hint by the local device count and arms the trainer."""
+    from dlrover_tpu.train.bootstrap import WorkerContext, WorkerEnv
+
+    class _Trainer:
+        def __init__(self):
+            self.hint = None
+
+        def set_speculation_hint(self, world, n_slices=None):
+            self.hint = (world, n_slices)
+
+    class _Client:
+        def __init__(self, hint):
+            self._hint = hint
+
+        def speculation_hint(self):
+            return self._hint
+
+    import jax
+
+    dpn = max(1, jax.local_device_count())
+    ctx = WorkerContext(WorkerEnv(), _Client({"spec": "dp2", "world": 2,
+                                              "n_slices": 1}))
+    tr = _Trainer()
+    assert ctx.poll_speculation_hint(tr) is not None
+    assert tr.hint == (2 * dpn, 1)
+    # empty hint (planner off / old master): nothing armed, no error
+    ctx2 = WorkerContext(WorkerEnv(), _Client({}))
+    tr2 = _Trainer()
+    assert ctx2.poll_speculation_hint(tr2) is None
+    assert tr2.hint is None
+
+
+# ---------------------------------------------------------------------------
+# the wall-clock pin
+# ---------------------------------------------------------------------------
+
+
+def test_no_wall_clock_reads_in_decision_path():
+    """Graftlint-style pin: the planner/autoscaler decision path is
+    clock-injected — no ``time.time()``/``monotonic()``/
+    ``perf_counter()`` CALL may appear in these modules (referencing
+    ``time.time`` as the injected-clock default is fine; calling it
+    is how nondeterminism creeps back into the harness-proven path)."""
+    files = [
+        "dlrover_tpu/brain/planner.py",
+        "dlrover_tpu/master/node/job_auto_scaler.py",
+        "dlrover_tpu/master/resource/optimizer.py",
+        "dlrover_tpu/master/resource/brain_optimizer.py",
+    ]
+    offenders = []
+    for rel in files:
+        path = os.path.join(REPO, rel)
+        tree = ast.parse(open(path).read(), filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+                and fn.attr in ("time", "monotonic", "perf_counter")
+            ):
+                offenders.append(f"{rel}:{node.lineno} time.{fn.attr}()")
+    assert not offenders, (
+        "wall-clock reads crept back into the clock-injected decision "
+        f"path: {offenders}"
+    )
+
+
+def test_master_metrics_endpoint_serves_planner_lines(monkeypatch):
+    """The master /metrics endpoint gains the planner provider:
+    ``dlrover_tpu_scale_decisions_total{verdict}`` + last-decision
+    gauges appear next to the gate/goodput rows."""
+    import urllib.request
+
+    from dlrover_tpu.common import flags
+    from dlrover_tpu.master import metrics as mm
+
+    monkeypatch.setenv(flags.MASTER_METRICS_PORT.name, "0")
+    p = _planner()
+    p.decide(inputs=_inputs(ts=0.0, waiting=4))
+    server = mm.maybe_start(None, None, planner=p)
+    assert server is not None
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5
+        ).read().decode()
+        assert 'dlrover_tpu_scale_decisions_total{verdict="hold"} 1' in body
+        assert "dlrover_tpu_planner_last_target_world" in body
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# review-fix regressions
+# ---------------------------------------------------------------------------
+
+
+def test_stale_intent_expires_when_capacity_dies_or_instability_hits():
+    """Review fix: an executed-but-unadopted intent must not hold the
+    growth gate open forever. It expires when (a) the capacity it
+    targeted is gone, or (b) the fleet goes unstable — an approval
+    never outlives the conditions it was granted under."""
+    p = _planner()
+    d = _drive_to_resize(p, lambda t: _inputs(ts=t, waiting=4))
+    p.note_executed(p.intent(), now=d["ts"])
+    assert p.growth_allowed(8)
+    # (a) the waiting nodes died before adoption: target unreachable
+    d = p.decide(inputs=_inputs(ts=200.0, world=8, waiting=0))
+    assert p.intent() is None and not p.growth_allowed(8)
+    # re-earn an intent, then (b) instability clears it too
+    d = _drive_to_resize(
+        p, lambda t: _inputs(ts=t, waiting=4), start=310.0
+    )
+    p.note_executed(p.intent(), now=d["ts"])
+    assert p.growth_allowed(8)
+    d = p.decide(
+        inputs=_inputs(ts=500.0, world=8, waiting=4, stragglers=[1])
+    )
+    assert d["reason"] == "unstable:stragglers"
+    assert p.intent() is None and not p.growth_allowed(8)
+    assert p.speculation_hint() == {}
+
+
+def test_observe_derives_n_slices_from_seated_slice_names():
+    """Review fix: the master derives the real slice count from the
+    slice names agents report at join — the DCN scoring model and the
+    slice-aligned candidates work without any configured topology."""
+    from dlrover_tpu.master.rendezvous.manager import (
+        ElasticTrainingRendezvousManager,
+    )
+    from dlrover_tpu.master.rendezvous.net_topology import NodeTopologyMeta
+
+    mgr = ElasticTrainingRendezvousManager(clock=lambda: 0.0)
+    mgr.update_rdzv_params(
+        min_nodes=4, max_nodes=4, node_unit=1, waiting_timeout=0.0
+    )
+    for nid in range(4):
+        mgr.join_rendezvous(nid, nid, NodeTopologyMeta(
+            node_id=nid, node_rank=nid,
+            slice_name=f"slice-{nid // 2}",
+        ))
+    mgr.get_comm_world(0)
+    p = GoodputPlanner(rdzv_manager=mgr, clock=lambda: 0.0)
+    inputs = p.observe()
+    assert inputs.world == 4
+    assert inputs.n_slices == 2
+
+
+def test_decision_counter_survives_ledger_cap():
+    """Review fix: report()['total'] is a TRUE monotonic counter, not
+    the capped ledger length — runners tracking 'new decisions since'
+    keep working past LEDGER_CAP decisions."""
+    from dlrover_tpu.brain import planner as planner_mod
+
+    p = _planner(hysteresis=10_000)  # never resizes: pure HOLD stream
+    n = planner_mod.LEDGER_CAP + 7
+    for i in range(n):
+        p.decide(inputs=_inputs(ts=float(i), waiting=4))
+    rep = p.report()
+    assert rep["total"] == n
+    assert len(p.export_state()["ledger"]) == planner_mod.LEDGER_CAP
+    # export/import keeps the true counter
+    p2 = _planner()
+    p2.import_state(json.loads(json.dumps(p.export_state())))
+    assert p2.report()["total"] == n
+
+
+def test_world_descriptor_rejects_unknown_axes_loudly():
+    """Review fix: a non-trivial axis outside the canonical vocabulary
+    raises instead of silently shrinking the described world (the old
+    mesh_spec_of appended unknown axes; silent dropping would key the
+    wrong contract and report a fraction of the real world size)."""
+    with pytest.raises(ValueError, match="non-canonical"):
+        WorldDescriptor.from_axis_sizes({"dp": 2, "custom": 4})
+    # trivial unknown axes are harmless (size-1 placeholder dims)
+    wd = WorldDescriptor.from_axis_sizes({"dp": 2, "custom": 1})
+    assert wd.world_size == 2
+
+
+def test_rendezvous_status_carries_hint_in_one_rpc():
+    """Review fix: the hint rides the SAME NumNodesWaiting response a
+    membership poller already gets — no second RPC."""
+    from dlrover_tpu.agent.master_client import MasterClient
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    p = _planner()
+    d = _drive_to_resize(p, lambda t: _inputs(ts=t, waiting=4))
+    p.note_executed(p.intent(), now=d["ts"])
+    servicer = MasterServicer(planner=p)
+
+    class _Loop:
+        def __init__(self):
+            self.calls = 0
+
+        def get(self, m, **kw):
+            self.calls += 1
+            return servicer.get(m)
+
+        def report(self, m, **kw):
+            return servicer.report(m)
+
+    loop = _Loop()
+    client = MasterClient("loop://", 0, client=loop)
+    waiting, latest, hint = client.rendezvous_status()
+    assert loop.calls == 1
+    assert hint == {"spec": "dp12", "world": 12, "n_slices": 1}
+
+
+def test_observe_reads_reported_hbm_through_job_context():
+    """Review fix: the HBM feasibility gate is reachable on the wired
+    path — workers' ResourceUsageReport.tpu_hbm_used_mb lands on the
+    node registry, and with DLROVER_TPU_PLANNER_HBM_GB configured the
+    planner's observe() feeds the gate (max across the fleet)."""
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.constants import NodeStatus, NodeType
+    from dlrover_tpu.common.node import Node
+    from dlrover_tpu.master.node.job_context import (
+        JobContext,
+        get_job_context,
+    )
+    from dlrover_tpu.master.node.job_manager import LocalJobManager
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    JobContext.reset_singleton()
+    try:
+        ctx = get_job_context()
+        for i in range(2):
+            ctx.update_node(Node(NodeType.WORKER, i,
+                                 status=NodeStatus.RUNNING))
+        jm = LocalJobManager()
+        servicer = MasterServicer(job_manager=jm)
+        servicer.report(msg.ResourceUsageReport(
+            node_type=NodeType.WORKER, node_id=0, cpu_percent=0.5,
+            memory_mb=1024.0, tpu_hbm_used_mb=14_000.0,
+        ))
+        servicer.report(msg.ResourceUsageReport(
+            node_type=NodeType.WORKER, node_id=1, cpu_percent=0.5,
+            memory_mb=1024.0, tpu_hbm_used_mb=9_000.0,
+        ))
+        p = GoodputPlanner(
+            job_context=ctx, clock=lambda: 0.0, hbm_capacity_gb=16.0,
+        )
+        inputs = p.observe()
+        assert inputs.hbm_used_bytes == pytest.approx(14e9)
+        assert inputs.hbm_capacity_bytes == pytest.approx(16e9)
+        # ...and the gate actually bites: 8 -> 7 projects past headroom
+        inputs.world, inputs.waiting = 8, 4
+        inputs.step_p50_s = 1.0
+        assert 7 not in {wd.world_size for wd in p.candidates(inputs)}
+        # capacity unconfigured (flag default 0): the gate stays off
+        p2 = GoodputPlanner(job_context=ctx, clock=lambda: 0.0)
+        assert p2.observe().hbm_used_bytes == 0.0
+    finally:
+        JobContext.reset_singleton()

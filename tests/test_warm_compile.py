@@ -215,27 +215,34 @@ def test_speculation_skipped_without_cache_dir():
     )
 
 
+def _sizes(descriptors):
+    """neighbor_worlds returns WorldDescriptors (the one world
+    vocabulary — common/world.py); these tests assert on the candidate
+    world sizes they describe."""
+    return [d.world_size for d in descriptors]
+
+
 def test_neighbor_worlds_heuristic():
     mc = MeshConfig(dp=-1, fsdp=1, tp=2).resolve(8)
     # 8 devices live: 8-1=7 (model axes tp=2 don't divide), 4 (ok)
-    assert wc.neighbor_worlds(
+    assert _sizes(wc.neighbor_worlds(
         8, mc, n_devices_available=8, devices_per_node=1,
         global_batch_size=8, micro_batch_size=2,
-    ) == [4]
+    )) == [4]
     # node-sized steps: 8-4=4 first, then 8//2=4 dedupes
-    assert wc.neighbor_worlds(
+    assert _sizes(wc.neighbor_worlds(
         8, mc, n_devices_available=8, devices_per_node=4,
         global_batch_size=8, micro_batch_size=2,
-    ) == [4]
+    )) == [4]
     # growth target admitted only when devices exist for it
-    assert wc.neighbor_worlds(
+    assert _sizes(wc.neighbor_worlds(
         4, mc, n_devices_available=8, devices_per_node=4,
         global_batch_size=8, micro_batch_size=2,
-    ) == [2, 8]
-    assert wc.neighbor_worlds(
+    )) == [2, 8]
+    assert _sizes(wc.neighbor_worlds(
         4, mc, n_devices_available=4, devices_per_node=4,
         global_batch_size=8, micro_batch_size=2,
-    ) == [2]
+    )) == [2]
     # global-batch invariant filters: gb=2, micro=2 → dp' must be 1,
     # which no neighbor of 8 satisfies under tp=2
     assert wc.neighbor_worlds(
@@ -243,10 +250,18 @@ def test_neighbor_worlds_heuristic():
         global_batch_size=2, micro_batch_size=2,
     ) == []
     # ...but world 4's shrink target does: 2 devices, tp=2, dp'=1
-    assert wc.neighbor_worlds(
+    assert _sizes(wc.neighbor_worlds(
         4, mc, n_devices_available=8, devices_per_node=1,
         global_batch_size=2, micro_batch_size=2,
-    ) == [2]
+    )) == [2]
+    # the descriptor carries the refit mesh axes (the checked type the
+    # planner and the contract specs share)
+    (d,) = wc.neighbor_worlds(
+        8, mc, n_devices_available=8, devices_per_node=1,
+        global_batch_size=8, micro_batch_size=2,
+    )
+    assert d.axis_sizes() == {"dp": 2, "tp": 2}
+    assert d.n_slices == 1 and not d.hier
 
 
 def test_neighbor_worlds_multislice_slice_steps():
@@ -261,16 +276,22 @@ def test_neighbor_worlds_multislice_slice_steps():
         global_batch_size=24, micro_batch_size=1, n_slices=4,
         max_targets=3,
     )
-    assert got == [6, 4]
+    assert _sizes(got) == [6, 4]
     per = 8 // 4
-    assert all(w % per == 0 for w in got)
+    assert all(d.world_size % per == 0 for d in got)
+    # every multislice candidate records its surviving slice count
+    assert [d.n_slices for d in got] == [3, 2]
+    assert all(d.hier for d in got)
     # 2 slices of 4: minus-one-slice and half-the-slices coincide (4);
-    # grow target admitted when the devices exist
-    assert wc.neighbor_worlds(
+    # grow target admitted when the devices exist. The collapse to one
+    # slice is a flat (single-slice) descriptor.
+    got2 = wc.neighbor_worlds(
         8, mc, n_devices_available=12, devices_per_node=1,
         global_batch_size=24, micro_batch_size=1, n_slices=2,
         max_targets=3,
-    ) == [4, 12]
+    )
+    assert _sizes(got2) == [4, 12]
+    assert [d.n_slices for d in got2] == [1, 3]
     # a dp that would not decompose over the surviving slice count is
     # filtered: world 12 in 3 slices of 4, minus a slice = 8 in 2
     # slices → dp'=8 % 2 == 0 fine; but with tp=4 → dp'=2, slices
@@ -281,14 +302,14 @@ def test_neighbor_worlds_multislice_slice_steps():
         global_batch_size=12, micro_batch_size=1, n_slices=3,
         max_targets=3,
     )
-    assert 8 in got
+    assert 8 in _sizes(got)
     # single-slice behavior is byte-identical to before (n_slices=1
     # defaults)
-    assert wc.neighbor_worlds(
+    assert _sizes(wc.neighbor_worlds(
         8, MeshConfig(dp=-1, fsdp=1, tp=2).resolve(8),
         n_devices_available=8, devices_per_node=1,
         global_batch_size=8, micro_batch_size=2, n_slices=1,
-    ) == [4]
+    )) == [4]
 
 
 def test_enable_persistent_cache_respects_existing(tmp_path, monkeypatch):
@@ -536,3 +557,69 @@ def test_sync_host_step_seeds_from_restored_state():
     # stateless dicts are a no-op, not a crash
     tr2.sync_host_step({})
     assert tr2._host_step == 41
+
+
+# ---------------------------------------------------------------------------
+# Planner-directed speculation (the goodput planner's speculation hint)
+# ---------------------------------------------------------------------------
+
+
+def test_planner_hint_makes_directed_resize_a_warm_hit(
+    tmp_path, monkeypatch
+):
+    """The acceptance journey (docs/design/brain_planner.md): the
+    planner publishes its intended next world; the trainer's
+    speculation compiles THAT exact target first — even though the
+    blind neighbor enumeration would never have guessed it — and the
+    planner-directed remesh lands on the pre-compiled executable (no
+    cold XLA compile on the resize path)."""
+    monkeypatch.setenv(wc.ENV_CACHE_DIR, str(tmp_path / "cc"))
+    tr, state, batch = _make_trainer(world=8, fsdp=1, tp=2, gb=8)
+    # neighbors of 8 are [4] here — world 2 only compiles via the hint
+    neighbor_sizes = _sizes(wc.neighbor_worlds(
+        8, tr.mesh_config, n_devices_available=8, devices_per_node=1,
+        global_batch_size=8, micro_batch_size=2,
+    ))
+    assert 2 not in neighbor_sizes
+    tr.set_speculation_hint(2)
+    assert tr._speculation_hint is not None
+    assert tr._speculation_hint.world_size == 2
+    state, _ = tr.step(state, batch)  # kicks speculation, hint first
+    assert tr.warm.wait_idle(timeout=300)
+    worlds = {e["world"] for e in wc.compile_ledger.entries().values()}
+    assert 2 in worlds, "the hinted target was not speculatively compiled"
+    # the planner-directed resize: remesh to the hinted world
+    mc2 = remesh_config(tr.mesh_config, 2).resolve(2)
+    mesh2 = build_mesh(mc2, devices=jax.devices()[:2])
+    tr.remesh(mesh2, mc2)
+    assert tr._speculation_hint is None  # hint consumed by the resize
+    params2 = jax.device_put(
+        llama.init_params(CFG, jax.random.key(0)),
+        named_shardings(mesh2, llama.param_specs(CFG)),
+    )
+    state2 = tr.init_state(params2)
+    a, b = tr.step_batch_shape
+    batch2 = jax.random.randint(jax.random.key(1), (a, b, SEQ), 0,
+                                CFG.vocab_size)
+    _, loss = tr.step(state2, batch2)
+    assert np.isfinite(float(loss))
+    # remesh→first-step landed on the pre-compiled executable
+    assert tr._last_build_info["cache"] == "warm"
+    entry = next(
+        e for e in wc.compile_ledger.entries().values() if e["world"] == 2
+    )
+    assert [c["source"] for c in entry["compiles"]] == [
+        "speculative", "warm",
+    ]
+
+
+def test_speculation_hint_rejects_inadmissible_worlds():
+    """A hint the mesh config cannot host (model axes don't divide,
+    batch invariant broken) is dropped — the neighbor fallback stays."""
+    tr, _, _ = _make_trainer(world=8, fsdp=1, tp=2, gb=8)
+    tr.set_speculation_hint(7)  # tp=2 does not divide 7
+    assert tr._speculation_hint is None
+    tr.set_speculation_hint(8)  # already the live world: nothing to do
+    assert tr._speculation_hint is None
+    tr.set_speculation_hint(None)  # clearing is always fine
+    assert tr._speculation_hint is None
