@@ -161,17 +161,21 @@ class MasterClient:
             )
         )
 
-    def report_heartbeat(self) -> List[msg.DiagnosisAction]:
+    def report_heartbeat(
+        self, timestamp: float = 0.0
+    ) -> List[msg.DiagnosisAction]:
         """Legacy heartbeat-only RPC. The agent now sends the folded
         :meth:`report_worker_status` instead (heartbeat + digest +
         resource in one message, backpressure-honoring —
         agent/reporter.py); this wrapper stays for version skew and
-        tests, not for new callers."""
+        tests, not for new callers. ``timestamp`` defaults to now
+        (injectable: the fleet harness's version_skew scenarios drive
+        N-1 workers through this path on the virtual clock)."""
         resp = self._client.report(
             msg.HeartbeatReport(
                 node_type=self.node_type,
                 node_id=self.node_id,
-                timestamp=time.time(),
+                timestamp=timestamp or time.time(),
             )
         )
         return resp.actions if resp else []
@@ -230,12 +234,13 @@ class MasterClient:
         step: int,
         digest: Optional[Dict] = None,
         comm_links: Optional[Dict] = None,
+        timestamp: float = 0.0,
     ):
         return self._client.report(
             msg.GlobalStepReport(
                 node_id=self.node_id,
                 step=step,
-                timestamp=time.time(),
+                timestamp=timestamp or time.time(),
                 digest=dict(digest) if digest else {},
                 comm_links=dict(comm_links) if comm_links else {},
             )

@@ -37,12 +37,15 @@ class OverloadedResponse:
     request instead of queueing it (rpc/transport.py RequestGate).
     Clients honor it by widening their report interval (periodic
     reporters) or sleeping at least ``retry_after_s`` before retrying
-    (one-shot calls). Version-skew note: a PRE-gate client deserializes
-    this fine but then fails its typed field access with an
-    AttributeError OUTSIDE its retry loop — shed load surfaces to the
-    old caller as an application error, not a retry. Upgrade masters
-    LAST (or raise the cap during the rollout) when old agents are in
-    the fleet."""
+    (one-shot calls). Version-skew note: a PRE-gate client has no
+    ``OverloadedResponse`` class at all, so its serde raises on the
+    unknown ``_t``. Clients from this tree onward map that into the
+    typed, non-retried :class:`~dlrover_tpu.rpc.policy.
+    UnknownMessageTypeError` naming the type (wirecheck WC003);
+    clients OLDER than that mapping still surface it as a raw
+    ValueError outside their retry loop — so the rollout rule stands:
+    upgrade masters LAST (or raise the cap during the rollout) when
+    old agents are in the fleet."""
 
     retry_after_s: float = 1.0
     queue_depth: int = 0

@@ -17,6 +17,27 @@ from typing import Any, Dict, Type
 _REGISTRY: Dict[str, Type] = {}
 
 
+class UnknownMessageError(ValueError):
+    """The wire carried a ``_t`` this process has no class for.
+
+    This is the version-skew signature, not corruption: the peer runs a
+    newer (or older) binary whose message vocabulary differs. Subclasses
+    ``ValueError`` so pre-existing ``except ValueError`` sites keep
+    working, but carries ``type_name`` so dispatch/decode paths can
+    degrade deliberately (servers answer ``SimpleResponse``, clients
+    raise the typed taxonomy error — see rpc/policy.py) instead of
+    surfacing a raw parse error. wirecheck WC003 requires every
+    ``deserialize`` call site outside this module to handle it."""
+
+    def __init__(self, type_name: str):
+        self.type_name = str(type_name)
+        super().__init__(
+            f"unknown message type {self.type_name!r} (version skew: the "
+            "peer's message vocabulary differs from this process's — "
+            "see docs/design/wirecheck.md)"
+        )
+
+
 def message(cls=None):
     """Class decorator: make a dataclass wire-serializable."""
 
@@ -43,7 +64,21 @@ def _encode(obj: Any) -> Any:
     if isinstance(obj, (list, tuple)):
         return [_encode(v) for v in obj]
     if isinstance(obj, dict):
-        return {str(k): _encode(v) for k, v in obj.items()}
+        for k in obj:
+            if not isinstance(k, str):
+                # Formally banned (wirecheck WC004): coercing via
+                # str(k) would silently change the key type across one
+                # round trip — {1: x} decodes as {"1": x} — so an int-
+                # keyed dict is a bug at the SENDER, not a decode
+                # surprise at every reader. Messages that need non-str
+                # keys stringify explicitly (CommWorldResponse.world).
+                raise TypeError(
+                    f"non-string dict key {k!r} ({type(k).__name__}) in "
+                    "control-plane message: JSON round-trips keys as "
+                    "strings, which would silently change the key type "
+                    "on the peer — stringify explicitly at the sender"
+                )
+        return {k: _encode(v) for k, v in obj.items()}
     if isinstance(obj, (str, int, float, bool)) or obj is None:
         return obj
     if isinstance(obj, bytes):
@@ -59,7 +94,7 @@ def _decode(obj: Any) -> Any:
         if t is not None:
             cls = _REGISTRY.get(t)
             if cls is None:
-                raise ValueError(f"unknown message type: {t}")
+                raise UnknownMessageError(t)
             hints = typing.get_type_hints(cls)
             kwargs = {}
             for f in dataclasses.fields(cls):

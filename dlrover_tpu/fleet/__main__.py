@@ -47,6 +47,14 @@ def _print_verdict(v: dict, as_json: bool):
             f"{[(e['off'], e['target']) for e in pl.get('executed', [])]}  "
             f"ledger={pl.get('ledger_digest', '')}"
         )
+    vs = v.get("version_skew") or {}
+    if vs:
+        print(
+            f"version skew ({vs['mode']}): stripped={vs['stripped_fields']} "
+            f"unknown_replies={vs['unknown_replies']} "
+            f"lease_fallbacks={vs['lease_fallbacks']} "
+            f"decode_errors={vs['decode_errors']}"
+        )
     if v["evictions"]:
         print(
             f"evictions: {v['evictions']}  reconciled: {v['reconciled']}"

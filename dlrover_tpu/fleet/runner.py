@@ -198,6 +198,20 @@ class FleetRunner:
         gate.liveness_ceiling_s = scenario.heartbeat_timeout_vs / 3.0
         self.endpoint = MasterEndpoint(gate)
         self.stats = RpcStats()
+        #: version-skew shim (docs/design/wirecheck.md): makes every
+        #: worker's wire behave like an N-1 peer sits on the other end.
+        #: Default drop set = the schema registry's skew_guarded fields
+        #: — the checked-in record of what the previous version knew.
+        self.shim = None
+        if scenario.skew_mode:
+            from dlrover_tpu.lint import wirecheck
+            from dlrover_tpu.lint.skew_shim import SkewShim
+
+            self.shim = SkewShim(
+                scenario.skew_drop or wirecheck.skew_baseline_drops(),
+                scenario.skew_unknown,
+                label=scenario.skew_mode,
+            )
         self.master = None
         self.workers: List[SimWorker] = []
         self.view = FleetView()
@@ -501,7 +515,8 @@ class FleetRunner:
                     shard_size=sc.shard_size,
                 ))
             self.workers = [
-                SimWorker(i, sc, self.endpoint, self.stats)
+                SimWorker(i, sc, self.endpoint, self.stats,
+                          shim=self.shim)
                 for i in range(sc.nodes)
             ]
             self._event(self._base, f"fleet up: {sc.nodes} workers")
@@ -706,6 +721,7 @@ class FleetRunner:
                 "recovered": self._resumed_after_hang,
             },
             "data_plane": self._data_verdict(),
+            "version_skew": self._skew_verdict(),
             "planner": planner_section,
             "lock_tracker": self._tracker_verdict(),
             "schedule_perturbation": (
@@ -773,6 +789,33 @@ class FleetRunner:
             "workers_exhausted": sum(
                 1 for w in self.workers if w.exhausted
             ),
+        }
+
+    def _skew_verdict(self) -> Dict:
+        """The version_skew evidence: what the shim actually stripped
+        and refused, how many workers fell back to the legacy
+        protocols, and — the headline gate — how many RAW decode
+        errors the client side of the wire saw (must be zero: every
+        skewed exchange degrades through a typed path)."""
+        if self.shim is None:
+            return {}
+        s = self.shim.stats()
+        return {
+            "mode": self.sc.skew_mode,
+            "stripped_fields": s["stripped_fields"],
+            "unknown_replies": s["unknown_replies"],
+            "drop_rules": s["drop_rules"],
+            "unknown_types": s["unknown_types"],
+            "lease_fallbacks": sum(
+                w.lease_fallbacks for w in self.workers
+            ),
+            "legacy_data_workers": sum(
+                1 for w in self.workers if w.legacy_data
+            ),
+            "legacy_control_workers": sum(
+                1 for w in self.workers if w.legacy_control
+            ),
+            "decode_errors": self.stats.snapshot()["decode_errors"],
         }
 
     def _planner_verdict(self) -> Dict:
@@ -894,6 +937,36 @@ class FleetRunner:
                 dp.get("rpc_ratio", 1.0) <= exp["max_data_rpc_ratio"],
                 dp.get("rpc_ratio"),
                 f"<= {exp['max_data_rpc_ratio']} of the per-task baseline",
+            )
+        vs = v.get("version_skew") or {}
+        if vs:
+            # the wirecheck runtime gates: every skewed exchange must
+            # degrade through a typed path — a single raw decode error
+            # client-side fails the scenario — and the shim must have
+            # actually exercised the skew (a drop map that never fires
+            # proves nothing)
+            check(
+                "skew_no_raw_decode_errors",
+                vs["decode_errors"] == 0,
+                vs["decode_errors"], "== 0",
+            )
+            check(
+                "skew_exercised", vs["stripped_fields"] > 0,
+                vs["stripped_fields"], "> 0 fields stripped",
+            )
+        if "min_lease_fallbacks" in exp:
+            check(
+                "lease_fallback_engaged",
+                vs.get("lease_fallbacks", 0) >= exp["min_lease_fallbacks"],
+                vs.get("lease_fallbacks", 0),
+                f">= {exp['min_lease_fallbacks']}",
+            )
+        if "min_unknown_replies" in exp:
+            check(
+                "unknown_types_answered_old_way",
+                vs.get("unknown_replies", 0) >= exp["min_unknown_replies"],
+                vs.get("unknown_replies", 0),
+                f">= {exp['min_unknown_replies']}",
             )
         hangs = v.get("hangs") or {}
         if "min_hangs" in exp:

@@ -130,6 +130,22 @@ class Scenario:
     planner_hysteresis: int = 2
     #: decision cadence on the virtual clock
     planner_interval_vs: float = 15.0
+    # -- version skew (docs/design/wirecheck.md): simulate an N-1
+    # binary on one side of the wire via the serde-level shim
+    # (lint/skew_shim.py). "old_master": the master behaves like the
+    # previous version — response fields it never knew are stripped
+    # and request types it never knew are answered SimpleResponse
+    # (workers must fall back, e.g. lease_shards -> get_task).
+    # "old_workers": the fleet behaves like N-1 workers — they speak
+    # the legacy control/data RPCs (heartbeat + per-task dispatch) and
+    # their requests/responses are stripped of post-baseline fields.
+    # Gates: exactly-once convergence and ZERO raw decode errors.
+    skew_mode: str = ""
+    #: message -> [fields] the N-1 side does not know; empty = derived
+    #: from wire_schema.json's skew_guarded marks
+    skew_drop: Dict = dataclasses.field(default_factory=dict)
+    #: request message types the old master does not know at all
+    skew_unknown: List[str] = dataclasses.field(default_factory=list)
     # -- adversarial schedule exploration (docs/design/racecheck.md):
     # drive the master's sweeps (deadline sweep, hang watchdog,
     # heartbeat evictor, shard-state writer drain, training-status
@@ -150,6 +166,11 @@ class Scenario:
             f if isinstance(f, FaultEvent) else FaultEvent(**f)
             for f in self.faults
         ]
+        if self.skew_mode not in ("", "old_master", "old_workers"):
+            raise ValueError(
+                f"unknown skew_mode {self.skew_mode!r}; one of "
+                "'', 'old_master', 'old_workers'"
+            )
 
     @classmethod
     def from_dict(cls, d: Dict) -> "Scenario":

@@ -60,6 +60,22 @@ docs/design/data_plane.md).
   must be indistinguishable from the tick-aligned one in every
   verdict-visible way.
 
+- ``version_skew_old_master`` / ``version_skew_old_workers`` — the
+  wirecheck runtime gates (docs/design/wirecheck.md): the serde-level
+  skew shim (lint/skew_shim.py) makes the wire behave like an N-1
+  binary sits on one end. ``old_master``: response fields the previous
+  version never knew (wire_schema.json's skew_guarded set) are
+  stripped and ``ShardLeaseRequest`` — which the old master has no
+  decoder for — is answered with the typed unknown-message
+  ``SimpleResponse``, so every worker must fall back to the legacy
+  per-task protocol mid-flight and keep consuming exactly-once
+  through a preemption and a master relaunch. ``old_workers``: the
+  fleet runs the N-1 protocols (heartbeat + chief step report instead
+  of the folded WorkerReport, per-task dispatch instead of leases,
+  fence-less TaskResults) against the current master. Both gate on
+  exactly-once convergence, goodput, and ZERO raw decode errors —
+  every skewed exchange must degrade through a typed path.
+
 Note one modeling rule: membership faults (preempt/crash) must not
 overlap a ``heartbeat_loss``/``partition`` window in scenarios WITHOUT
 the hang watchdog — a silent worker stalls the seated round (it cannot
@@ -412,6 +428,86 @@ BUILTIN = {
             "max_rpc_latency_s": 2.0,
             "data_exactly_once": True,
             "min_perturbations": 20,
+            "master_survives": True,
+        },
+    },
+    "version_skew_old_master": {
+        "name": "version_skew_old_master",
+        "seed": 51,
+        "nodes": 40,
+        "min_nodes": 38,
+        "duration_vs": 300,
+        "step_time_s": 1.0,
+        "report_interval_vs": 10,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 60,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 2,
+        "gate_report_cap": 32,
+        "dataset_size": 40_000,
+        "shard_size": 100,
+        "lease_count": 8,
+        "lease_ttl_vs": 60,
+        "records_per_step": 25,
+        # no hang watchdog: its re-join signal (latest_round) is one of
+        # the fields the old master never sends — re-forms ride the
+        # waiting_num path, which both versions speak
+        "skew_mode": "old_master",
+        # the old master predates the leased data plane (PR 11): the
+        # batched lease RPC is an unknown message to it
+        "skew_unknown": ["ShardLeaseRequest"],
+        "faults": [
+            {"kind": "preempt", "at_vs": 80, "count": 3,
+             "duration_vs": 15},
+            # the relaunched master is the SAME old version (a rolling
+            # upgrade relaunches onto whatever image the pod pins)
+            {"kind": "master_relaunch", "at_vs": 180, "duration_vs": 10},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.70,
+            "max_rpc_latency_s": 2.0,
+            "data_exactly_once": True,
+            # every worker's first lease attempt meets the unknown-
+            # message reply and falls back (revived workers re-probe)
+            "min_lease_fallbacks": 40,
+            "min_unknown_replies": 40,
+            "relaunches": 1,
+            "master_survives": True,
+        },
+    },
+    "version_skew_old_workers": {
+        "name": "version_skew_old_workers",
+        "seed": 52,
+        "nodes": 40,
+        "min_nodes": 38,
+        "duration_vs": 300,
+        "step_time_s": 1.0,
+        "report_interval_vs": 10,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 60,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 2,
+        "gate_report_cap": 32,
+        "dataset_size": 40_000,
+        "shard_size": 100,
+        "lease_count": 8,
+        "lease_ttl_vs": 60,
+        "records_per_step": 25,
+        # the fleet IS the previous version: legacy heartbeat + chief
+        # step report, per-task data dispatch, fence-less TaskResults
+        # (lease_epoch stripped decodes as -1 = legacy path), failure
+        # reports without the timestamp field
+        "skew_mode": "old_workers",
+        "faults": [
+            {"kind": "preempt", "at_vs": 100, "count": 4,
+             "duration_vs": 15},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.70,
+            "max_rpc_latency_s": 2.0,
+            "data_exactly_once": True,
             "master_survives": True,
         },
     },

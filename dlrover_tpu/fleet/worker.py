@@ -61,7 +61,7 @@ DEAD = "dead"
 
 
 class SimWorker:
-    def __init__(self, node_id: int, scenario, endpoint, stats):
+    def __init__(self, node_id: int, scenario, endpoint, stats, shim=None):
         self.node_id = node_id
         self.sc = scenario
         self.rng = random.Random(scenario.seed * 1_000_003 + node_id)
@@ -70,7 +70,7 @@ class SimWorker:
             f"loopback://{node_id}",
             node_id,
             client=LoopbackClient(
-                endpoint, self.link, stats, node_id=node_id
+                endpoint, self.link, stats, node_id=node_id, shim=shim
             ),
         )
         self.state = JOINING
@@ -118,6 +118,18 @@ class SimWorker:
         #: count happened)
         self.acked_ranges: List[Tuple[int, int]] = []
         self.data_rpcs = 0
+        # -- version skew (docs/design/wirecheck.md) -------------------
+        #: "old_workers" mode: this worker IS an N-1 binary — it speaks
+        #: the legacy control protocol (heartbeat + chief step report
+        #: instead of the folded WorkerReport) and the legacy per-task
+        #: data protocol from the start. In "old_master" mode it starts
+        #: current and FALLS BACK to legacy data dispatch when the old
+        #: master answers lease_shards with the unknown-message
+        #: SimpleResponse (the production ShardingClient's path).
+        self.legacy_control = scenario.skew_mode == "old_workers"
+        self.legacy_data = scenario.skew_mode == "old_workers"
+        self.lease_fallbacks = 0
+        self._next_legacy_poll = 0.0
         # verdict counters
         self.reports_sent = 0
         self.reports_failed = 0
@@ -184,6 +196,11 @@ class SimWorker:
         self._lease_inflight = False
         self._data_idle = False
         self.exhausted = False
+        # a revived worker re-discovers the master's protocol level:
+        # in old_master mode it optimistically retries the lease RPC
+        # (and falls back again); an N-1 worker stays legacy forever
+        self.legacy_data = self.sc.skew_mode == "old_workers"
+        self._next_legacy_poll = 0.0
 
     # -- training model hooks (the runner calls these) -----------------
 
@@ -399,6 +416,8 @@ class SimWorker:
         self._dispatch(vt, lambda: self._do_report(vt, step, digest))
 
     def _do_report(self, vt: float, step: int, digest: Optional[Dict]):
+        if self.legacy_control:
+            return self._do_report_legacy(vt, step, digest)
         shed = False
         try:
             resp = self.client.report_worker_status(
@@ -447,6 +466,30 @@ class SimWorker:
             delay = self.interval.next_delay_s(self.rng)
             self._next_report = vt + delay * (0.5 + self.rng.random())
 
+    def _do_report_legacy(
+        self, vt: float, step: int, digest: Optional[Dict]
+    ):
+        """An N-1 worker's chatty protocol: a HeartbeatReport every
+        period plus the chief's GlobalStepReport while stepping — two
+        RPCs where the folded WorkerReport sends one. Non-chief digests
+        are DROPPED, as an old worker genuinely drops them (the old
+        binary never sent any) — attribution degrades to its residual
+        fallback, which is the honest N-1 behavior."""
+        try:
+            self.client.report_heartbeat(timestamp=vt)
+            if self.is_chief and self.stepping and step >= 0:
+                self.client.report_global_step(
+                    step, digest=digest, timestamp=vt
+                )
+        except Exception:
+            self.reports_failed += 1
+            self.interval.widen()
+            delay = self.interval.next_delay_s(self.rng)
+            self._next_report = vt + delay * (0.5 + self.rng.random())
+        else:
+            self.reports_sent += 1
+            self.interval.ok()
+
     # -- the data plane ------------------------------------------------
 
     def _shards_left(self) -> int:
@@ -454,6 +497,9 @@ class SimWorker:
 
     def _tick_data(self, vt: float):
         if self.sc.dataset_size <= 0:
+            return
+        if self.legacy_data:
+            self._tick_data_legacy(vt)
             return
         self._consume(vt)
         if self._lease_inflight or self.exhausted:
@@ -505,6 +551,8 @@ class SimWorker:
         """One batched data-plane RPC (runs at DELIVERY time when the
         link has latency — a renewal-starved lease may have expired in
         between, which is exactly the at-least-once path under test)."""
+        from dlrover_tpu.common.messages import ShardLeaseResponse
+
         done, self._done_pending = self._done_pending, []
         try:
             resp = self.client.lease_shards(
@@ -520,6 +568,16 @@ class SimWorker:
             return
         self.data_rpcs += 1
         self._lease_inflight = False
+        if not isinstance(resp, ShardLeaseResponse):
+            # version skew: an OLD master answers the unknown message
+            # type with the typed SimpleResponse — switch to the legacy
+            # per-task protocol (the production ShardingClient's
+            # fallback) and re-report the batched completions through
+            # it, one per tick
+            self.legacy_data = True
+            self.lease_fallbacks += 1
+            self._done_pending = done + self._done_pending
+            return
         acked = set(resp.acked)
         for tid in done:
             rng = self._unacked.pop(tid, None)
@@ -542,3 +600,66 @@ class SimWorker:
                 # todo dry but shards still in flight elsewhere: go
                 # idle and wake on the report-ack data_todo hint
                 self._data_idle = True
+
+    # -- the LEGACY data plane (version skew / old_workers mode) -------
+
+    def _tick_data_legacy(self, vt: float):
+        """The N-1 per-task protocol: one ``get_task`` per shard, one
+        ``report_task_result`` per completion, no leases and no fences
+        (``lease_epoch`` stays -1, the master's legacy timeout path
+        governs re-delivery). One data op per tick keeps the model
+        deterministic; empty grants back off with a jittered poll —
+        the old protocol has no data_todo wakeup hint to ride."""
+        self._consume(vt)
+        if self._lease_inflight:
+            return
+        if self._done_pending:
+            tid = self._done_pending.pop(0)
+            self._lease_inflight = True
+            self._dispatch(vt, lambda: self._do_report_task(tid))
+            return
+        if (
+            self.stepping
+            and len(self.shard_q) <= 1
+            and vt >= self._next_legacy_poll
+        ):
+            self._lease_inflight = True
+            self._dispatch(vt, lambda: self._do_get_task(vt))
+
+    def _do_get_task(self, vt: float):
+        try:
+            task = self.client.get_task(self.sc.dataset_name)
+        except Exception:
+            self.reports_failed += 1
+            self._lease_inflight = False
+            return
+        self.data_rpcs += 1
+        self._lease_inflight = False
+        if task is None or getattr(task, "task_id", -1) < 0:
+            # todo drained (end of epoch, or shards in flight
+            # elsewhere): jittered re-poll — the legacy protocol's
+            # only discovery mechanism
+            self._next_legacy_poll = vt + 4.0 + 4.0 * self.rng.random()
+            return
+        self.shard_q.append(task)
+
+    def _do_report_task(self, tid: int):
+        rng_range = self._unacked.get(tid)
+        try:
+            resp = self.client.report_task_result(
+                self.sc.dataset_name, tid, True
+            )
+        except Exception:
+            self.reports_failed += 1
+            self._done_pending.insert(0, tid)
+            self._lease_inflight = False
+            return
+        self.data_rpcs += 1
+        self._lease_inflight = False
+        self._unacked.pop(tid, None)
+        if rng_range is not None and bool(getattr(resp, "success", False)):
+            # the master counted it — the exactly-once ledger entry.
+            # success=False = the legacy timeout re-issued the shard
+            # (this report is a zombie's): the new holder's completion
+            # is the one that counts
+            self.acked_ranges.append(rng_range)

@@ -22,6 +22,12 @@ docstring names the shipped bug it encodes. The runtime companion
 :mod:`dlrover_tpu.lint.retrace_guard` catches the one invariant static
 analysis cannot see — silent XLA recompiles of an already-compiled
 step signature.
+
+Sibling layers sharing this package: :mod:`~dlrover_tpu.lint.
+shardcheck` (the lowered IR), :mod:`~dlrover_tpu.lint.racecheck` (the
+lock structure, with :mod:`~dlrover_tpu.lint.lock_tracker` at
+runtime), and :mod:`~dlrover_tpu.lint.wirecheck` (the wire & durable
+protocol, with :mod:`~dlrover_tpu.lint.skew_shim` at runtime).
 """
 
 from dlrover_tpu.lint.engine import (  # noqa: F401

@@ -1,17 +1,22 @@
-"""graftlint + shardcheck + racecheck CLI.
+"""graftlint + shardcheck + racecheck + wirecheck CLI.
 
     python -m dlrover_tpu.lint [options] paths...       # AST rules
     python -m dlrover_tpu.lint --hlo dp4 [--hlo ...]    # IR rules
     python -m dlrover_tpu.lint --race [paths...]        # concurrency
+    python -m dlrover_tpu.lint --wire [paths...]        # wire schema
 
 Exit codes: 0 clean (against the baseline / contracts / lock-order
-graph), 1 new violations, unparsable files, missing contracts, or
-lock-graph drift, 2 usage error. ``--fix-baseline`` rewrites the AST
-baseline; ``--fix-contracts`` regenerates the SC001 collective-census
-contracts for the given mesh specs; ``--fix-lock-order`` /
-``--fix-race-baseline`` re-record the RC001 acquisition graph and the
-racecheck baseline (all: use after deliberate grandfathering or a
-reviewed edge, never to silence a new violation you should fix).
+graph / wire schema + corpus), 1 new violations, unparsable files,
+missing contracts, or lock-graph/schema drift, 2 usage error.
+``--fix-baseline`` rewrites the AST baseline; ``--fix-contracts``
+regenerates the SC001 collective-census contracts for the given mesh
+specs; ``--fix-lock-order`` / ``--fix-race-baseline`` re-record the
+RC001 acquisition graph and the racecheck baseline;
+``--fix-wire-schema`` records a wire/durable schema change (give the
+compat rationale via ``--wire-note``) and ``--fix-wire-corpus``
+regenerates the golden serialized corpus (all: use after deliberate
+grandfathering or a reviewed change, never to silence a new violation
+you should fix).
 
 The ``--hlo`` path lowers the pinned contract model (see
 lint/contract_model.py) on virtual CPU devices — no TPU, no live
@@ -121,10 +126,48 @@ def main(argv=None) -> int:
         action="store_true",
         help="rewrite the racecheck baseline to the current finding set",
     )
+    p.add_argument(
+        "--wire",
+        action="store_true",
+        help="wire mode: schema registry diff against the checked-in "
+        "lint/wire_schema.json, golden-corpus replay, and the WC skew "
+        "rules over the AST (docs/design/wirecheck.md)",
+    )
+    p.add_argument(
+        "--wire-schema",
+        default=None,
+        help="wire schema file (default: the checked-in "
+        "dlrover_tpu/lint/wire_schema.json)",
+    )
+    p.add_argument(
+        "--wire-corpus",
+        default=None,
+        help="golden corpus directory (default: the checked-in "
+        "dlrover_tpu/lint/wire_corpus)",
+    )
+    p.add_argument(
+        "--fix-wire-schema",
+        action="store_true",
+        help="record the current wire/durable schema (with a history "
+        "entry; pair with --wire-note explaining why the change is "
+        "skew-compatible)",
+    )
+    p.add_argument(
+        "--fix-wire-corpus",
+        action="store_true",
+        help="regenerate the golden serialized corpus (legacy pins are "
+        "frozen and never rewritten)",
+    )
+    p.add_argument(
+        "--wire-note",
+        default="",
+        help="compat note recorded in the schema history by "
+        "--fix-wire-schema",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
-        from dlrover_tpu.lint import racecheck
+        from dlrover_tpu.lint import racecheck, wirecheck
 
         for rid, name, doc in rule_catalog():
             print(f"{rid}  {name:28s} {doc}")
@@ -132,7 +175,26 @@ def main(argv=None) -> int:
             print(f"{rid}  {name:28s} {doc}")
         for rid, name, doc in racecheck.RC_RULES:
             print(f"{rid}  {name:28s} {doc}")
+        for rid, name, doc in wirecheck.WC_RULES:
+            print(f"{rid}  {name:28s} {doc}")
         return 0
+    if args.wire:
+        if args.hlo or args.race or args.fix_baseline or args.no_baseline \
+                or args.rule:
+            print(
+                "error: --wire (schema mode) cannot be combined with "
+                "--hlo, --race, --fix-baseline, --no-baseline or "
+                "--rule — run them as separate invocations",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_wire(args)
+    if args.fix_wire_schema or args.fix_wire_corpus:
+        print(
+            "error: --fix-wire-schema / --fix-wire-corpus need --wire",
+            file=sys.stderr,
+        )
+        return 2
     if args.race:
         if args.hlo or args.fix_baseline or args.no_baseline or args.rule:
             print(
@@ -208,6 +270,33 @@ def main(argv=None) -> int:
         result = engine.run(args.paths, baseline_path=args.baseline,
                             rules=rules)
     engine.report(result)
+    return 1 if result.failed else 0
+
+
+def _run_wire(args) -> int:
+    """Wire mode: schema diff + golden-corpus replay + WC AST rules."""
+    from dlrover_tpu.lint import wirecheck
+
+    result = wirecheck.run(
+        paths=args.paths or None,
+        schema_path=args.wire_schema or wirecheck.DEFAULT_SCHEMA,
+        corpus_dir=args.wire_corpus or wirecheck.DEFAULT_CORPUS_DIR,
+        fix_schema=args.fix_wire_schema,
+        fix_corpus=args.fix_wire_corpus,
+        note=args.wire_note,
+    )
+    if args.fix_wire_schema:
+        print(
+            "wirecheck: schema "
+            f"{args.wire_schema or wirecheck.DEFAULT_SCHEMA} recorded"
+        )
+    if args.fix_wire_corpus:
+        print(
+            "wirecheck: corpus "
+            f"{args.wire_corpus or wirecheck.DEFAULT_CORPUS_DIR} "
+            "regenerated"
+        )
+    wirecheck.report(result)
     return 1 if result.failed else 0
 
 

@@ -1,0 +1,129 @@
+"""Version-skew simulation at the serde wire (wirecheck's runtime
+companion, in the retrace_guard / lock_tracker mold).
+
+The static layers (schema diff, WC rules, golden corpus) prove the
+vocabulary evolves compatibly; this shim proves the RUNNING system
+degrades gracefully when one side of the wire is an N-1 binary. It
+operates on raw wire BYTES — never on decoded objects — so what it
+simulates is exactly what an old peer's serde does:
+
+- **Field dropping.** An N-1 binary's dataclass lacks the fields added
+  since; its serde never encodes them (old sender) and drops them as
+  unknown kwargs (old receiver). Either way the field vanishes across
+  the hop, so the shim strips it from the JSON by ``_t`` in BOTH
+  directions. The default drop map comes from the schema registry's
+  ``skew_guarded`` marks (:func:`dlrover_tpu.lint.wirecheck.
+  skew_baseline_drops`) — the machine-readable record of "what the
+  previous version did not know".
+- **Unknown request types.** An old MASTER has no decoder for a
+  message type added since; the production transport answers the typed
+  ``SimpleResponse`` (``transport._skew_reply``). The shim intercepts
+  configured request types before dispatch and returns that exact
+  reply, so client fallbacks (``lease_shards`` -> ``get_task``) are
+  exercised against the real wire shape.
+
+Driven by the fleet harness's ``version_skew`` scenarios
+(fleet/scenarios.py): old-master-vs-new-workers and the inverse, gated
+on exactly-once convergence and ZERO raw decode errors. Deterministic
+and lock-free by design — the harness runs it single-threaded
+(``parallelism=1``); counters are best-effort tallies, not synchronized
+state.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class SkewShim:
+    """Makes a wire behave as if an N-1 peer sat on the other end."""
+
+    def __init__(
+        self,
+        drop_fields: Optional[Dict[str, Iterable[str]]] = None,
+        unknown_types: Iterable[str] = (),
+        label: str = "n-1",
+    ):
+        self.drop_fields = {
+            t: frozenset(fields) for t, fields in (drop_fields or {}).items()
+        }
+        self.unknown_types = frozenset(unknown_types)
+        self.label = label
+        #: tally of fields actually removed (a drop rule that never
+        #: fires means the scenario exercised nothing — the verdict's
+        #: ``skew_exercised`` check reads this)
+        self.stripped_fields = 0
+        #: tally of unknown-type requests answered the old way
+        self.unknown_replies = 0
+
+    # -- the two wire hooks (loopback calls these) ----------------------
+
+    def request_wire(self, payload: bytes) -> Tuple[bytes, Optional[bytes]]:
+        """(possibly stripped request, override reply or None). An
+        override means the simulated old peer answered WITHOUT
+        dispatching — the unknown-message-type path."""
+        try:
+            data = json.loads(payload.decode())
+        except Exception:
+            return payload, None
+        t = data.get("_t") if isinstance(data, dict) else None
+        if t in self.unknown_types:
+            self.unknown_replies += 1
+            return payload, self._unknown_reply(t)
+        return self._dump(self._strip(data)), None
+
+    def response_wire(self, payload: bytes) -> bytes:
+        if not payload:
+            return payload
+        try:
+            data = json.loads(payload.decode())
+        except Exception:
+            return payload
+        return self._dump(self._strip(data))
+
+    # -- internals ------------------------------------------------------
+
+    def _unknown_reply(self, type_name: str) -> bytes:
+        # byte-identical to transport._skew_reply's wire form, built
+        # WITHOUT the message classes: an old master does not have this
+        # process's vocabulary
+        return self._dump({
+            "_t": "SimpleResponse",
+            "success": False,
+            "reason": (
+                f"unknown message type {type_name!r} (version skew)"
+            ),
+        })
+
+    def _strip(self, obj):
+        """Recursively remove dropped fields from every typed dict in
+        the JSON tree (messages nest: RunningNodesResponse carries
+        NodeMeta items)."""
+        if isinstance(obj, dict):
+            dropped = self.drop_fields.get(obj.get("_t"), ())
+            out = {}
+            for k, v in obj.items():
+                if k in dropped:
+                    self.stripped_fields += 1
+                    continue
+                out[k] = self._strip(v)
+            return out
+        if isinstance(obj, list):
+            return [self._strip(v) for v in obj]
+        return obj
+
+    @staticmethod
+    def _dump(data) -> bytes:
+        return json.dumps(data, separators=(",", ":")).encode()
+
+    def stats(self) -> Dict:
+        return {
+            "label": self.label,
+            "drop_rules": {
+                t: sorted(f) for t, f in sorted(self.drop_fields.items())
+            },
+            "unknown_types": sorted(self.unknown_types),
+            "stripped_fields": self.stripped_fields,
+            "unknown_replies": self.unknown_replies,
+        }

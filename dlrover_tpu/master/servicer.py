@@ -106,14 +106,29 @@ class MasterServicer:
         handler = self._get_handlers.get(type(request))
         if handler is None:
             logger.warning("no get handler for %s", type(request).__name__)
-            return msg.SimpleResponse(success=False, reason="unknown message")
+            # the SAME reply shape transport._skew_reply sends for a
+            # type serde cannot even decode: clients get one skew
+            # signature to feature-detect on, with the type named
+            return msg.SimpleResponse(
+                success=False,
+                reason=(
+                    f"unknown message type {type(request).__name__!r} "
+                    "(version skew)"
+                ),
+            )
         return handler(request)
 
     def report(self, request, context=None):
         handler = self._report_handlers.get(type(request))
         if handler is None:
             logger.warning("no report handler for %s", type(request).__name__)
-            return msg.SimpleResponse(success=False, reason="unknown message")
+            return msg.SimpleResponse(
+                success=False,
+                reason=(
+                    f"unknown message type {type(request).__name__!r} "
+                    "(version skew)"
+                ),
+            )
         return handler(request)
 
     # -- data sharding ------------------------------------------------------
@@ -533,6 +548,9 @@ class MasterServicer:
                 rendezvous_s=request.rendezvous_s,
                 compile_s=request.compile_s,
                 state_transfer_s=request.state_transfer_s,
-                restore_tier=request.restore_tier,
+                # restore_tier postdates the message (wire_schema marks
+                # it skew-guarded): a pre-tier worker's report simply
+                # lacks it — found by wirecheck WC002
+                restore_tier=getattr(request, "restore_tier", ""),
             )
         return msg.SimpleResponse()

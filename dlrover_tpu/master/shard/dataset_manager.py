@@ -34,10 +34,18 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional, Set, Tuple
 
+from dlrover_tpu.common import versioned_format
 from dlrover_tpu.common.constants import DefaultValues
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.common.messages import Task
 from dlrover_tpu.master.shard.dataset_splitter import DatasetSplitter, Shard
+
+#: the shard checkpoint's durable format. v2 = explicit version stamp +
+#: doing_meta entries ALWAYS written as 6 elements (fence included);
+#: version-less documents are the pre-versioning writers, whose
+#: doing_meta may be 5-element (pre-lease) — normalized by the legacy
+#: adapter below, the one place the 5-vs-6 shape knowledge lives now.
+SHARD_CKPT_FORMAT = versioned_format.register("dataset_shard_ckpt", 2)
 
 # deadline-heap entry kinds
 _LEASE = 0
@@ -131,25 +139,30 @@ class DatasetShardCheckpoint:
 
     def to_json(self) -> str:
         return json.dumps(
-            {
-                "dataset_name": self.dataset_name,
-                "todo": self.todo,
-                "doing": self.doing,
-                "epoch": self.epoch,
-                "completed_records": self.completed_records,
-                "partition_offsets": self.partition_offsets,
-                "doing_meta": self.doing_meta,
-                "task_id_seq": self.task_id_seq,
-                "epoch_unit": self.epoch_unit,
-                "epoch_factor": self.epoch_factor,
-                "leases": self.leases,
-                "lease_seq": self.lease_seq,
-            }
+            SHARD_CKPT_FORMAT.wrap(
+                {
+                    "dataset_name": self.dataset_name,
+                    "todo": self.todo,
+                    "doing": self.doing,
+                    "epoch": self.epoch,
+                    "completed_records": self.completed_records,
+                    "partition_offsets": self.partition_offsets,
+                    # v2 invariant: every entry carries all 6 elements
+                    "doing_meta": _normalize_doing_meta(self.doing_meta),
+                    "task_id_seq": self.task_id_seq,
+                    "epoch_unit": self.epoch_unit,
+                    "epoch_factor": self.epoch_factor,
+                    "leases": self.leases,
+                    "lease_seq": self.lease_seq,
+                }
+            )
         )
 
     @classmethod
     def from_json(cls, content: str) -> "DatasetShardCheckpoint":
-        d = json.loads(content)
+        d = SHARD_CKPT_FORMAT.parse(
+            json.loads(content), legacy=_legacy_shard_ckpt
+        )
         return cls(
             dataset_name=d.get("dataset_name", ""),
             todo=d.get("todo", []),
@@ -157,7 +170,7 @@ class DatasetShardCheckpoint:
             epoch=d.get("epoch", 0),
             completed_records=d.get("completed_records", 0),
             partition_offsets=d.get("partition_offsets", {}),
-            doing_meta=d.get("doing_meta", []),
+            doing_meta=_normalize_doing_meta(d.get("doing_meta", [])),
             task_id_seq=d.get("task_id_seq", 0),
             epoch_unit=d.get("epoch_unit", "pass"),
             epoch_factor=d.get("epoch_factor", 1),
@@ -166,8 +179,28 @@ class DatasetShardCheckpoint:
         )
 
 
+def _normalize_doing_meta(entries: List) -> List:
+    """Every ``doing_meta`` entry as the full 6-element v2 shape
+    ``[task_id, node_id, partition, start, end, lease_epoch]``; a
+    missing fence (pre-lease writer) decodes as -1 = legacy per-task
+    dispatch, exactly what the hand-rolled 5-vs-6 decode used to do."""
+    return [
+        list(e[:5]) + [int(e[5]) if len(e) > 5 else -1] for e in entries
+    ]
+
+
+def _legacy_shard_ckpt(payload: Dict) -> Dict:
+    """Version-less shard checkpoint (pre-versioned_format writer):
+    same field names, but doing_meta may carry 5-element entries."""
+    out = dict(payload)
+    out["doing_meta"] = _normalize_doing_meta(payload.get("doing_meta", []))
+    return out
+
+
 def _meta_fence(entry) -> int:
-    """doing_meta lease fence; legacy 5-element entries carry none."""
+    """doing_meta lease fence; legacy 5-element entries carry none.
+    (from_json normalizes to 6 elements, but raw entries reach here
+    from in-memory paths too — keep the defensive read.)"""
     return int(entry[5]) if len(entry) > 5 else -1
 
 

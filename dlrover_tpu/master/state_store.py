@@ -28,13 +28,23 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from dlrover_tpu.common import flags
+from dlrover_tpu.common import flags, versioned_format
 from dlrover_tpu.common.log import logger
 
 # names derive from the typed registry — the single owner of the env
 # contract — so a flag rename can never split readers from writers
 STATE_BACKEND_ENV = flags.STATE_BACKEND.name
 STATE_DIR_ENV = flags.STATE_DIR.name
+
+# the four continuity-document families, versioned going forward
+# (common/versioned_format.py): v2 = first stamped version; a
+# version-less document is a pre-stamp master's and reads as-is.
+# wirecheck extracts these registrations into wire_schema.json, so a
+# version bump is a reviewable, gated diff like any wire change.
+SPEED_FORMAT = versioned_format.register("state_speed", 2)
+NODES_FORMAT = versioned_format.register("state_nodes", 2)
+PLANNER_FORMAT = versioned_format.register("state_planner", 2)
+DATASET_FORMAT = versioned_format.register("state_dataset", 2)
 
 
 class MasterStateBackend:
@@ -279,8 +289,10 @@ class MasterStateManager:
 
     def save_dataset(self, name: str, params: Dict, ckpt_json: str):
         doc = json.dumps(
-            {"params": params, "ckpt": json.loads(ckpt_json),
-             "time": time.time(), "job_uid": self._job_uid}
+            DATASET_FORMAT.wrap(
+                {"params": params, "ckpt": json.loads(ckpt_json),
+                 "time": time.time(), "job_uid": self._job_uid}
+            )
         )
         try:
             self._backend.set(f"{self.K_DATASET}/{name}", doc)
@@ -301,7 +313,7 @@ class MasterStateManager:
                         key, doc.get("job_uid"), self._job_uid,
                     )
                     continue
-                out[key.split("/", 1)[1]] = doc
+                out[key.split("/", 1)[1]] = DATASET_FORMAT.parse(doc)
         except Exception:
             logger.exception("dataset state load failed")
         return out
@@ -324,7 +336,11 @@ class MasterStateManager:
         try:
             self._backend.set(
                 self.K_SPEED,
-                json.dumps({**state, "job_uid": self._job_uid}),
+                json.dumps(
+                    SPEED_FORMAT.wrap(
+                        {**state, "job_uid": self._job_uid}
+                    )
+                ),
             )
             self._last_written[self.K_SPEED] = fp
             self._speed_written_at = now
@@ -336,7 +352,7 @@ class MasterStateManager:
         if not raw:
             return None
         doc = json.loads(raw)
-        return doc if self._same_job(doc) else None
+        return SPEED_FORMAT.parse(doc) if self._same_job(doc) else None
 
     # -- goodput planner decision ledger ---------------------------------
 
@@ -350,7 +366,11 @@ class MasterStateManager:
         try:
             self._backend.set(
                 self.K_PLANNER,
-                json.dumps({"planner": state, "job_uid": self._job_uid}),
+                json.dumps(
+                    PLANNER_FORMAT.wrap(
+                        {"planner": state, "job_uid": self._job_uid}
+                    )
+                ),
             )
             self._last_written[self.K_PLANNER] = fp
         except Exception:
@@ -363,7 +383,7 @@ class MasterStateManager:
         doc = json.loads(raw)
         if not self._same_job(doc):
             return None
-        return doc.get("planner") or None
+        return PLANNER_FORMAT.parse(doc).get("planner") or None
 
     # -- node registry / relaunch budgets --------------------------------
 
@@ -379,7 +399,11 @@ class MasterStateManager:
         try:
             self._backend.set(
                 self.K_NODES,
-                json.dumps({**state, "job_uid": self._job_uid}),
+                json.dumps(
+                    NODES_FORMAT.wrap(
+                        {**state, "job_uid": self._job_uid}
+                    )
+                ),
             )
             self._last_written[self.K_NODES] = fp
             self._nodes_written_at = now
@@ -391,7 +415,7 @@ class MasterStateManager:
         if not raw:
             return None
         doc = json.loads(raw)
-        return doc if self._same_job(doc) else None
+        return NODES_FORMAT.parse(doc) if self._same_job(doc) else None
 
     def clear(self):
         """Job finished cleanly: drop the continuity state so a future

@@ -63,6 +63,32 @@ class OverloadedError(Exception):
         )
 
 
+class UnknownMessageTypeError(Exception):
+    """The peer answered with a message type this binary cannot decode
+    (``serde.UnknownMessageError`` mapped into the taxonomy by
+    ``RpcClient._call``).
+
+    This is version skew, not a transport blip: retrying replays the
+    same decode failure, so it classifies ``application`` (never
+    retried) and the message is actionable — it names the unknown
+    ``_t`` and the rollout rule. Before this class existed the raw
+    ``ValueError`` escaped the retry loop and surfaced at whatever call
+    site happened to touch the response first (the documented
+    OverloadedResponse hazard: a pre-gate client saw shed load as an
+    AttributeError/ValueError instead of backpressure)."""
+
+    def __init__(self, type_name: str, peer: str = ""):
+        self.type_name = str(type_name)
+        self.peer = str(peer)
+        where = f" from {self.peer}" if self.peer else ""
+        super().__init__(
+            f"peer{where} sent unknown message type {self.type_name!r} — "
+            "version skew between this binary and the peer; align "
+            "versions, and upgrade masters LAST so old clients keep "
+            "receiving only message types they know"
+        )
+
+
 class RetryBudgetExceeded(Exception):
     """Retries exhausted; ``last_error`` holds the final failure."""
 
@@ -77,6 +103,10 @@ def classify(exc: BaseException) -> str:
     harness's in-process loopback — classify identically."""
     if isinstance(exc, OverloadedError):
         return OVERLOADED
+    if isinstance(exc, UnknownMessageTypeError):
+        # version skew: the peer is healthy and reachable, retrying
+        # replays the identical decode failure
+        return APPLICATION
     code = None
     code_fn = getattr(exc, "code", None)
     if callable(code_fn):

@@ -37,7 +37,11 @@ from typing import Any, Callable, Dict, List, Optional
 import grpc
 
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.common.serde import deserialize, serialize
+from dlrover_tpu.common.serde import (
+    UnknownMessageError,
+    deserialize,
+    serialize,
+)
 from dlrover_tpu.rpc import policy as rpc_policy
 from dlrover_tpu.rpc.policy import OverloadedError
 
@@ -294,6 +298,14 @@ class RpcServer:
             msg = deserialize(request)
             resp = self._servicer.get(msg, context)
             return serialize(resp) if resp is not None else b""
+        except UnknownMessageError as e:
+            # a newer client's request on an older master: degrade to
+            # the same typed SimpleResponse the servicer's unknown-
+            # handler path returns (wirecheck WC003) — the client's
+            # feature-detection fallbacks (e.g. lease_shards ->
+            # get_task) key on exactly this reply, an INTERNAL abort
+            # would read as a master outage and burn the retry budget
+            return serialize(_skew_reply(e))
         except Exception:
             logger.exception("error handling get RPC")
             context.abort(grpc.StatusCode.INTERNAL, "get failed")
@@ -307,6 +319,8 @@ class RpcServer:
             msg = deserialize(request)
             resp = self._servicer.report(msg, context)
             return serialize(resp) if resp is not None else b""
+        except UnknownMessageError as e:
+            return serialize(_skew_reply(e))
         except Exception:
             logger.exception("error handling report RPC")
             context.abort(grpc.StatusCode.INTERNAL, "report failed")
@@ -434,11 +448,23 @@ class RpcClient:
         while True:
             hint = 0.0
             try:
-                resp = deserialize(
-                    self._stub(kind)(
-                        payload, timeout=timeout, metadata=self._metadata
+                try:
+                    resp = deserialize(
+                        self._stub(kind)(
+                            payload, timeout=timeout, metadata=self._metadata
+                        )
                     )
-                )
+                except UnknownMessageError as e:
+                    # version skew INSIDE the retry loop: map to the
+                    # typed taxonomy error (named _t, actionable) and
+                    # never retry — the peer is healthy, replaying the
+                    # call replays the identical decode failure. This
+                    # closes the documented OverloadedResponse hazard
+                    # class: a raw ValueError used to escape here and
+                    # surface at whatever site touched the response
+                    raise rpc_policy.UnknownMessageTypeError(
+                        e.type_name, peer=self.addr
+                    ) from e
                 if _is_overloaded(resp):
                     err = OverloadedError(
                         resp.retry_after_s,
@@ -503,3 +529,20 @@ def _is_overloaded(resp: Any) -> bool:
     from dlrover_tpu.common import messages as msg
 
     return isinstance(resp, msg.OverloadedResponse)
+
+
+def _skew_reply(e: UnknownMessageError):
+    """The server half of unknown-message degradation: a typed
+    SimpleResponse naming the unknown ``_t``, identical in shape to the
+    servicer's no-handler reply so clients have ONE skew signature to
+    feature-detect on."""
+    from dlrover_tpu.common import messages as msg
+
+    logger.warning(
+        "request carried unknown message type %r (version skew); "
+        "answering SimpleResponse", e.type_name,
+    )
+    return msg.SimpleResponse(
+        success=False,
+        reason=f"unknown message type {e.type_name!r} (version skew)",
+    )

@@ -34,7 +34,8 @@ def test_builtin_scenarios_load():
     for name in (
         "headline_1k", "overload_10x", "smoke",
         "shard_storm_1k", "shard_storm_smoke", "seated_hang",
-        "perturbed_smoke",
+        "perturbed_smoke", "version_skew_old_master",
+        "version_skew_old_workers",
     ):
         sc = load_scenario(name)
         assert sc.nodes > 0 and sc.duration_vs > 0
@@ -134,6 +135,59 @@ def test_shard_storm_smoke_deterministic(tmp_path):
     v2 = _run("shard_storm_smoke", tmp_path / "b")
     assert v1["determinism_digest"] == v2["determinism_digest"]
     assert v1["data_plane"] == v2["data_plane"]
+
+
+# -- version skew (docs/design/wirecheck.md) --------------------------------
+
+
+def test_version_skew_old_master_scenario(tmp_path):
+    """Old master vs new workers: the skew shim strips the fields the
+    old master never knew and answers the lease RPC (which it has no
+    decoder for) with the typed unknown-message SimpleResponse. Every
+    worker must fall back to the legacy per-task protocol mid-flight
+    and the epoch must still converge exactly-once — with ZERO raw
+    decode errors anywhere on the wire."""
+    v = _run("version_skew_old_master", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    vs = v["version_skew"]
+    assert vs["mode"] == "old_master"
+    assert vs["decode_errors"] == 0
+    # every worker's first lease met the unknown-message reply and fell
+    # back (revived workers re-probe and fall back again)
+    assert vs["lease_fallbacks"] >= 40
+    assert vs["unknown_replies"] == vs["lease_fallbacks"]
+    assert vs["legacy_data_workers"] == 40
+    assert vs["stripped_fields"] > 0
+    dp = v["data_plane"]
+    assert dp["acked_records"] == dp["dataset_size"] == 40_000
+    assert dp["master_completed_records"] == 40_000
+    assert v["master_relaunches"] == 1
+
+
+def test_version_skew_old_workers_scenario(tmp_path):
+    """New master vs old workers: the fleet speaks the N-1 protocols
+    (legacy heartbeat + chief step report, per-task dispatch,
+    fence-less TaskResults) with post-baseline request fields stripped
+    by the shim. The current master must serve them exactly-once with
+    zero decode errors — the upgrade-masters-LAST direction."""
+    v = _run("version_skew_old_workers", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    vs = v["version_skew"]
+    assert vs["mode"] == "old_workers"
+    assert vs["decode_errors"] == 0
+    assert vs["legacy_control_workers"] == 40
+    assert vs["legacy_data_workers"] == 40
+    assert vs["lease_fallbacks"] == 0  # never tried the lease RPC
+    dp = v["data_plane"]
+    assert dp["acked_records"] == dp["dataset_size"] == 40_000
+    assert dp["master_completed_records"] == 40_000
+
+
+def test_version_skew_deterministic(tmp_path):
+    v1 = _run("version_skew_old_master", tmp_path / "a")
+    v2 = _run("version_skew_old_master", tmp_path / "b")
+    assert v1["determinism_digest"] == v2["determinism_digest"]
+    assert v1["version_skew"] == v2["version_skew"]
 
 
 def test_seated_hang_detected_recovered_attributed(tmp_path):
