@@ -371,12 +371,22 @@ def _bench_multislice(jax, jnp, llama) -> dict:
     bytes/step per link class; the contract test pins the hier leg's
     ledger DCN bytes at 1/dp_in of the flat leg's.
 
+    The third leg is the overlap SCHEDULE of the hierarchical
+    reduction (``+overlap``): per-leg ``overlap_ratio`` /
+    exposed-vs-overlapped DCN bytes come from the shardcheck SC006
+    classifier over the lowered HLO, and the contract test pins the
+    overlap leg's *exposed* DCN bytes strictly below the fused-hier
+    baseline at loss parity.
+
     The legs are decided by the TrainConfig knob alone — an exported
-    ``DLROVER_TPU_HIER_COLLECTIVES`` would otherwise override both legs
-    to the same program (same reasoning as the zero-1 compare)."""
+    ``DLROVER_TPU_HIER_COLLECTIVES`` / ``DLROVER_TPU_OVERLAP_*`` would
+    otherwise override every leg to the same program (same reasoning
+    as the zero-1 compare)."""
     from dlrover_tpu.common import flags
 
-    with flags.HIER_COLLECTIVES.scoped(None), flags.ZERO1.scoped(None):
+    with flags.HIER_COLLECTIVES.scoped(None), flags.ZERO1.scoped(None), \
+            flags.OVERLAP_COLLECTIVES.scoped(None), \
+            flags.OVERLAP_BUCKET_MB.scoped(None):
         return _bench_multislice_legs(jax, jnp, llama)
 
 
@@ -399,14 +409,21 @@ def _bench_multislice_legs(jax, jnp, llama) -> dict:
     mesh = build_mesh(mc, devices=jax.devices()[:world],
                       n_slices=n_slices)
     seq, micro, steps = 64, 2, 3
+    # accum=3 for EVERY leg: the overlap schedule pipelines the DCN
+    # exchange across gradient-accumulation microbatches, and its
+    # peeled scan must survive to the optimized HLO (trip 2 — XLA
+    # inlines a trip-1 loop and the schedule evidence with it). Same
+    # batch for the other legs keeps the loss parity comparable.
+    accum = 3
     out = {"world": world, "n_slices": n_slices, "model": "llama_tiny",
-           "seq": seq, "micro_batch": micro}
+           "seq": seq, "micro_batch": micro, "accum_steps": accum}
     losses = {}
-    for leg in ("flat", "hier"):
+    for leg in ("flat", "hier", "overlap"):
         tc = TrainConfig(
-            global_batch_size=micro * mc.data_parallel_size,
+            global_batch_size=accum * micro * mc.data_parallel_size,
             micro_batch_size=micro, warmup_steps=0, total_steps=100,
-            hier_collectives=(leg == "hier"),
+            hier_collectives=(leg != "flat"),
+            overlap_collectives=(leg == "overlap"),
         )
         tr = ElasticTrainer(
             None, specs, mesh, mc, tc,
@@ -451,16 +468,29 @@ def _bench_multislice_legs(jax, jnp, llama) -> dict:
                 if k.split("|")[1] == "dp"
             }
             leg_out["contract_spec"] = tr._contract_spec(mesh)
+            # the SC006 split: trip-weighted DCN bytes the schedule
+            # hides behind compute vs. bytes exposed on the critical
+            # path — the overlap leg's selling point, measured from
+            # the same lowered HLO the census reads
+            rep = shardcheck.overlap_report(
+                program.hlo, program.coords()
+            )
+            leg_out["overlap_ratio"] = rep["overlap_ratio"]
+            leg_out["dcn_exposed_bytes"] = rep["dcn_exposed_bytes"]
+            leg_out["dcn_overlapped_bytes"] = rep["dcn_overlapped_bytes"]
         except Exception as e:
             leg_out["census_error"] = str(e)[:200]
         out[leg] = leg_out
         _release(jax, state, params)
         del tr, state, params
-    if losses.get("flat") and losses.get("hier"):
-        # the fast path is the same math: per-step loss parity between
-        # the flat and hierarchical reductions
+    done = [leg for leg in ("flat", "hier", "overlap") if losses.get(leg)]
+    if len(done) > 1:
+        # the fast path is the same math: per-step loss parity across
+        # the flat, fused-hier and overlap-scheduled reductions
         out["max_loss_delta"] = max(
-            abs(a - b) for a, b in zip(losses["flat"], losses["hier"])
+            abs(x - y)
+            for i, a in enumerate(done) for b in done[i + 1:]
+            for x, y in zip(losses[a], losses[b])
         )
     flat_dcn = out.get("flat", {}).get(
         "ledger_link_bytes", {}).get("dcn", 0)

@@ -234,6 +234,7 @@ class MasterClient:
         step: int,
         digest: Optional[Dict] = None,
         comm_links: Optional[Dict] = None,
+        overlap_ratio: float = -1.0,
         timestamp: float = 0.0,
     ):
         return self._client.report(
@@ -243,6 +244,7 @@ class MasterClient:
                 timestamp=timestamp or time.time(),
                 digest=dict(digest) if digest else {},
                 comm_links=dict(comm_links) if comm_links else {},
+                overlap_ratio=float(overlap_ratio),
             )
         )
 

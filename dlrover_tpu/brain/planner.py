@@ -76,6 +76,10 @@ class PlannerInputs:
     step_p50_s: float = 0.0
     #: per-link analytic comm bytes/step ({"ici": N, "dcn": M})
     comm_links: Dict[str, int] = dataclasses.field(default_factory=dict)
+    #: DCN overlap ratio the fleet reports (share of DCN bytes the
+    #: schedule hides behind compute; −1.0 = unmeasured). Discounts the
+    #: DCN cost term: overlapped bytes don't stretch the step.
+    overlap_ratio: float = -1.0
     #: measured average downtime one membership change costs this job
     resize_cost_s: float = 0.0
     #: ranks the step-digest detector currently flags
@@ -106,6 +110,7 @@ class PlannerInputs:
             "step_p50_s": round(self.step_p50_s, 6),
             "comm_links": {k: int(v) for k, v in self.comm_links.items()},
             "dcn_share": round(self.dcn_share, 4),
+            "overlap_ratio": round(self.overlap_ratio, 4),
             "resize_cost_s": round(self.resize_cost_s, 3),
             "stragglers": sorted(self.stragglers),
             "downtime_open": bool(self.downtime_open),
@@ -266,8 +271,12 @@ class GoodputPlanner:
             )
             if p50s:
                 inputs.step_p50_s = p50s[len(p50s) // 2]
-            links = self._sm.comm_link_report().get("per_step_bytes", {})
+            link_report = self._sm.comm_link_report()
+            links = link_report.get("per_step_bytes", {})
             inputs.comm_links = {k: int(v) for k, v in links.items()}
+            inputs.overlap_ratio = float(
+                link_report.get("overlap_ratio", -1.0)
+            )
             inputs.resize_cost_s = self._sm.avg_downtime()
             inputs.stragglers = list(self._sm.stragglers())
             inputs.downtime_open = self._sm.downtime_in_progress()
@@ -336,13 +345,22 @@ class GoodputPlanner:
         base = inputs.step_p50_s
         if base <= 0 or inputs.world <= 0:
             return 0.0
+        # only EXPOSED DCN bytes sit on the critical path: the fleet's
+        # reported overlap_ratio discounts the transfer seconds the
+        # schedule hides behind compute (−1 sentinel = no discount)
+        exposed = (
+            1.0 - inputs.overlap_ratio
+            if 0.0 <= inputs.overlap_ratio <= 1.0 else 1.0
+        )
         dcn_now = (
-            float(inputs.comm_links.get("dcn", 0)) / self._dcn_bytes_per_s
+            float(inputs.comm_links.get("dcn", 0)) * exposed
+            / self._dcn_bytes_per_s
             if self._dcn_bytes_per_s > 0 else 0.0
         )
         compute = max(base - dcn_now, base * 0.05)
         dcn_next = (
-            self._candidate_dcn_bytes(wd, inputs) / self._dcn_bytes_per_s
+            self._candidate_dcn_bytes(wd, inputs) * exposed
+            / self._dcn_bytes_per_s
             if self._dcn_bytes_per_s > 0 else 0.0
         )
         return compute * (inputs.world / wd.world_size) + dcn_next

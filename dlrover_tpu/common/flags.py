@@ -243,6 +243,23 @@ HIER_COLLECTIVES = _define(
     "to the config. Read at step-build time; no-op on single-slice "
     "meshes.",
 )
+OVERLAP_COLLECTIVES = _define(
+    "DLROVER_TPU_OVERLAP_COLLECTIVES", "", "str",
+    "Latency-hiding overlap schedule for the hierarchical DCN "
+    "gradient reduction (ops/hier_collectives.py): overrides the "
+    "TrainConfig.overlap_collectives knob in BOTH directions — 0 is "
+    "the kill-switch (the hier engine runs its fused, serialized "
+    "schedule), any other non-empty value forces the bucketed "
+    "DCN-behind-backward pipeline on; empty defers to the config. "
+    "Only effective where the hier engine itself applies.",
+)
+OVERLAP_BUCKET_MB = _define(
+    "DLROVER_TPU_OVERLAP_BUCKET_MB", None, "int",
+    "Size bound (MiB) of one gradient bucket in the overlap schedule "
+    "— each bucket becomes one fused DCN collective carried behind "
+    "the next microbatch's backward. Unset = the engine default "
+    "(ops/hier_collectives.py DEFAULT_BUCKET_MB).",
+)
 RETRACE_GUARD = _define(
     "DLROVER_TPU_RETRACE_GUARD", 0, "int",
     "Silent-recompile guard (lint/retrace_guard.py): 0 off, 1 on with "

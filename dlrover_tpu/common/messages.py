@@ -259,6 +259,13 @@ class GlobalStepReport:
     # brain/tuner — sees how loaded the slow inter-slice link is.
     # Empty = single-link world or a pre-link worker (skew-safe).
     comm_links: Dict = field(default_factory=dict)
+    # DCN overlap ratio of the running step program (overlapped /
+    # total trip-weighted DCN bytes — the shardcheck SC006 split the
+    # worker knows analytically from its schedule). Own float field —
+    # comm_links values are int-coerced master-side. −1.0 sentinel =
+    # not measured (single-slice, fused-hier, or a pre-overlap worker:
+    # serde fills the default on skew, so old reports stay harmless).
+    overlap_ratio: float = -1.0
 
 
 @message

@@ -43,6 +43,12 @@ CANONICAL_AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 #: variant (docs/design/zero1.md)
 ZERO1_SUFFIX = "+zero1"
 
+#: ``+overlap`` marks the latency-hiding bucketed schedule of the
+#: hierarchical reduction (the DCN leg of microbatch N rides behind
+#: the backward of microbatch N+1) — a genuinely different program
+#: with its own census/overlap contract
+OVERLAP_SUFFIX = "+overlap"
+
 #: ``+Nslice`` marks the HIERARCHICAL multislice program variant
 #: (docs/design/hier_collectives.md); a multislice mesh running the
 #: flat path keys the plain spec — its program is the single-slice one
@@ -66,6 +72,10 @@ class WorldDescriptor:
     n_slices: int = 1
     zero1: bool = False
     hier: bool = False
+    #: the hierarchical reduction runs the bucketed, overlap-scheduled
+    #: pipeline (DCN exchange of microbatch N behind the backward of
+    #: microbatch N+1) — requires ``hier``
+    overlap: bool = False
 
     def __post_init__(self):
         if not self.axes:
@@ -101,6 +111,11 @@ class WorldDescriptor:
             raise ValueError(
                 "hier (ICI-first hierarchical reduction) needs "
                 "n_slices > 1"
+            )
+        if self.overlap and not self.hier:
+            raise ValueError(
+                "overlap (latency-hiding DCN schedule) is a schedule "
+                "OF the hierarchical reduction — it needs hier"
             )
 
     # -- derived shape ---------------------------------------------------
@@ -147,14 +162,21 @@ class WorldDescriptor:
         out = self.mesh_spec
         if self.hier and self.n_slices > 1:
             out += f"+{self.n_slices}slice"
+        if self.overlap:
+            out += OVERLAP_SUFFIX
         return out + (ZERO1_SUFFIX if self.zero1 else "")
 
     @classmethod
     def parse(cls, spec: str) -> "WorldDescriptor":
-        """Inverse of ``spec``: ``"dp4+2slice+zero1"`` round-trips."""
+        """Inverse of ``spec``: ``"dp4+2slice+overlap+zero1"``
+        round-trips (suffix order: ``+Nslice``, ``+overlap``,
+        ``+zero1``)."""
         zero1 = spec.endswith(ZERO1_SUFFIX)
         if zero1:
             spec = spec[: -len(ZERO1_SUFFIX)]
+        overlap = spec.endswith(OVERLAP_SUFFIX)
+        if overlap:
+            spec = spec[: -len(OVERLAP_SUFFIX)]
         n_slices = 1
         m = _SLICE_SUFFIX_RE.search(spec)
         if m:
@@ -167,6 +189,7 @@ class WorldDescriptor:
             n_slices=n_slices,
             zero1=zero1,
             hier=n_slices > 1,
+            overlap=overlap,
         )
 
     # -- constructors -----------------------------------------------------
@@ -178,6 +201,7 @@ class WorldDescriptor:
         n_slices: int = 1,
         zero1: bool = False,
         hier: bool = False,
+        overlap: bool = False,
     ) -> "WorldDescriptor":
         """From an ``{axis: size}`` mapping (a ``Mesh.shape``, a
         resolved ``MeshConfig.shape()``); trivial axes are kept only to
@@ -204,17 +228,21 @@ class WorldDescriptor:
         )
         if not axes:
             axes = (("dp", 1),)
-        return cls(axes=axes, n_slices=n_slices, zero1=zero1, hier=hier)
+        return cls(
+            axes=axes, n_slices=n_slices, zero1=zero1, hier=hier,
+            overlap=overlap,
+        )
 
     @classmethod
     def from_mesh(
         cls, mesh, n_slices: int = 1, zero1: bool = False,
-        hier: bool = False,
+        hier: bool = False, overlap: bool = False,
     ) -> "WorldDescriptor":
         """From a live ``jax.sharding.Mesh`` (duck-typed: anything with
         ``.shape`` mapping axis names to sizes)."""
         return cls.from_axis_sizes(
-            dict(mesh.shape), n_slices=n_slices, zero1=zero1, hier=hier
+            dict(mesh.shape), n_slices=n_slices, zero1=zero1, hier=hier,
+            overlap=overlap,
         )
 
     # -- checks -----------------------------------------------------------
@@ -284,18 +312,20 @@ def parse_mesh_spec(spec: str) -> Dict[str, int]:
 
 
 def contract_spec_of(
-    axis_sizes: Dict[str, int], zero1: bool = False, n_slices: int = 1
+    axis_sizes: Dict[str, int], zero1: bool = False, n_slices: int = 1,
+    overlap: bool = False,
 ) -> str:
     """Canonical CONTRACT key for a program (compat face of
     :class:`WorldDescriptor.spec`): ``contract_spec_of({"dp": 4}, True,
     2)`` → ``"dp4+2slice+zero1"``. ``n_slices > 1`` means the
     hierarchical program variant (flat multislice keys the plain
-    spec)."""
+    spec); ``overlap`` the latency-hiding schedule on top of it."""
     return WorldDescriptor.from_axis_sizes(
         axis_sizes,
         n_slices=n_slices,
         zero1=zero1,
         hier=n_slices > 1,
+        overlap=overlap,
     ).spec
 
 

@@ -74,9 +74,10 @@ def main(argv=None) -> int:
         metavar="MESHSPEC",
         help="IR mode: lower the contract model for this mesh spec "
         "(e.g. dp4, dp2xfsdp2, sp2xdp2, a zero-1 variant like "
-        "dp4+zero1, or a multislice hierarchical variant like "
-        "dp4+2slice / dp4+2slice+zero1; repeatable) and run the SC "
-        "rules over the lowered program",
+        "dp4+zero1, a multislice hierarchical variant like "
+        "dp4+2slice / dp4+2slice+zero1, or an overlap-scheduled one "
+        "like dp4+2slice+overlap; repeatable) and run the SC rules "
+        "over the lowered program",
     )
     p.add_argument(
         "--contracts",
@@ -362,25 +363,21 @@ def _run_hlo(args) -> int:
     from dlrover_tpu.lint import contract_model
 
     specs = []
+    worlds = []
     for raw in args.hlo:
         try:
-            axis_sizes, zero1, n_slices = \
-                shardcheck.parse_contract_spec(raw)
+            wd = shardcheck.WorldDescriptor.parse(raw)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
-        specs.append(
-            shardcheck.contract_spec_of(axis_sizes, zero1, n_slices)
-        )
+        specs.append(wd.spec)  # canonicalized
+        w = 1
+        for s in wd.axis_sizes().values():
+            w *= s
+        worlds.append(w)
 
     # every spec shares one jax process: size the virtual CPU device
     # pool to the largest world before anything touches jax
-    worlds = []
-    for spec in specs:
-        w = 1
-        for s in shardcheck.parse_contract_spec(spec)[0].values():
-            w *= s
-        worlds.append(w)
     contract_model.ensure_cpu_devices(max(worlds))
 
     failed = False
@@ -403,10 +400,16 @@ def _run_hlo(args) -> int:
                     "zero1": program.zero1,
                 },
             )
+            note = ""
+            if "overlap" in data:
+                note = (
+                    f", dcn overlap_ratio="
+                    f"{data['overlap']['overlap_ratio']:.4f}"
+                )
             print(
                 f"shardcheck: contract {spec} rewritten "
                 f"({len(data['census'])} collective cell(s), "
-                f"world={program.world})"
+                f"world={program.world}{note})"
             )
             continue
         try:
@@ -443,10 +446,20 @@ def _run_hlo(args) -> int:
             for line in better:
                 print(f"  {line}")
         status = "FAIL" if violations else "ok"
+        overlap_note = ""
+        if program.n_slices > 1:
+            rep = shardcheck.overlap_report(
+                program.hlo, program.coords()
+            )
+            overlap_note = (
+                f", dcn exposed={rep['dcn_exposed_bytes']}B "
+                f"overlapped={rep['dcn_overlapped_bytes']}B "
+                f"ratio={rep['overlap_ratio']:.4f}"
+            )
         print(
             f"shardcheck: {spec} {status} ({len(violations)} violation(s),"
             f" {sum(c['count'] for c in census.values())} collectives over"
-            f" {len(census)} cell(s))"
+            f" {len(census)} cell(s){overlap_note})"
         )
         failed = failed or bool(violations)
     return 1 if failed else 0

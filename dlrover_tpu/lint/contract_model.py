@@ -32,6 +32,16 @@ MICRO_BATCH = 2
 CE_CHUNK = 64
 VOCAB = 256
 
+#: global batch of the ``+overlap`` contract variants ONLY: the overlap
+#: schedule pipelines the DCN leg across gradient-accumulation
+#: microbatches, so its contract program must actually accumulate —
+#: and the peeled scan must survive to the optimized HLO (the overlap
+#: dimension reads loop structure). dp4 × micro 2 → accum 3 → a
+#: trip-count-2 scan, which XLA keeps as a real while (a trip-count-1
+#: loop is inlined away and the schedule evidence with it). Scoped to
+#: overlap specs so every pre-existing contract keeps its config_hash.
+OVERLAP_GLOBAL_BATCH = 24
+
 
 def ensure_cpu_devices(n: int) -> None:
     """Force the CPU platform with ≥ ``n`` virtual host devices. Must
@@ -60,7 +70,8 @@ def ensure_cpu_devices(n: int) -> None:
 
 
 def build_contract_trainer(
-    axis_sizes: Dict[str, int], zero1: bool = False, n_slices: int = 1
+    axis_sizes: Dict[str, int], zero1: bool = False, n_slices: int = 1,
+    overlap: bool = False,
 ):
     """(trainer, state, batch) for the pinned contract model on the
     mesh ``axis_sizes`` describes, placed on CPU host devices.
@@ -99,11 +110,14 @@ def build_contract_trainer(
     )
     specs = llama.param_specs(cfg)
     tc = TrainConfig(
-        global_batch_size=GLOBAL_BATCH,
+        global_batch_size=(
+            OVERLAP_GLOBAL_BATCH if overlap else GLOBAL_BATCH
+        ),
         micro_batch_size=MICRO_BATCH,
         warmup_steps=0,
         total_steps=100,
         zero1=zero1,
+        overlap_collectives=overlap,
     )
     trainer = ElasticTrainer(
         None, specs, mesh, mc, tc,
@@ -136,24 +150,27 @@ def build_program(
     import contextlib
 
     from dlrover_tpu.common import flags
+    from dlrover_tpu.common.world import WorldDescriptor
 
-    axis_sizes, zero1, n_slices = shardcheck.parse_contract_spec(spec)
+    wd = WorldDescriptor.parse(spec)
+    axis_sizes = wd.axis_sizes()
     world = 1
     for s in axis_sizes.values():
         world *= s
     ensure_cpu_devices(world)
     with contextlib.ExitStack() as stack:
         # the spec decides the variant; exported DLROVER_TPU_ZERO1 /
-        # DLROVER_TPU_HIER_COLLECTIVES would otherwise override the
-        # knobs at init_state/lower time and build (or --fix-contracts:
-        # RECORD) the wrong program
+        # DLROVER_TPU_HIER_COLLECTIVES / DLROVER_TPU_OVERLAP_* would
+        # otherwise override the knobs at init_state/lower time and
+        # build (or --fix-contracts: RECORD) the wrong program
         stack.enter_context(flags.ZERO1.scoped(None))
         stack.enter_context(flags.HIER_COLLECTIVES.scoped(None))
+        stack.enter_context(flags.OVERLAP_COLLECTIVES.scoped(None))
+        stack.enter_context(flags.OVERLAP_BUCKET_MB.scoped(None))
         trainer, _, _ = build_contract_trainer(
-            axis_sizes, zero1=zero1, n_slices=n_slices
+            axis_sizes, zero1=wd.zero1, n_slices=wd.n_slices,
+            overlap=wd.overlap,
         )
         program = trainer.step_ir(pinned=pinned)
-    program.label = "hlo:" + shardcheck.contract_spec_of(
-        axis_sizes, zero1, n_slices
-    )
+    program.label = "hlo:" + wd.spec
     return program, trainer

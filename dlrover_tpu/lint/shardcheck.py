@@ -625,6 +625,422 @@ def census_dcn_bytes(census: Dict[str, Dict[str, int]]) -> int:
 
 
 # ---------------------------------------------------------------------------
+# SC006 — exposed vs. overlapped DCN bytes (schedule analysis)
+# ---------------------------------------------------------------------------
+#
+# The census counts WHAT crosses the slice boundary; this section asks
+# WHEN — can the transfer hide behind compute, or does the step stall
+# on it?  It reads the post-GSPMD HLO as a graph of computations and
+# classifies every DCN collective as OVERLAPPED or EXPOSED:
+#
+# - **async pairs** (``-start``/``-done``, how a latency-hiding TPU
+#   schedule spells overlap): overlapped iff some compute-class op in
+#   the same computation is neither an ancestor of the start nor a
+#   descendant of the done — i.e. the scheduler has real work to run
+#   while the transfer is in flight.
+# - **sync collectives** (CPU contract programs — the CPU backend never
+#   emits async pairs, so structure must stand in for the schedule): a
+#   DCN collective is overlapped iff it executes inside a ``while``
+#   body AND its transitive operand closure *within that body* contains
+#   no compute-class op — it consumes only loop-carried state (gtes
+#   through passive reshapes/concats), so it is issueable at iteration
+#   entry, concurrent with the whole iteration's compute.  This is the
+#   shape ``overlap_value_and_grad`` lowers to: the exchange of micro
+#   k-1's gradients rides the loop carry while micro k's backward runs.
+#   Deliberately conservative: a collective fed by ANY in-iteration
+#   compute (the fused hierarchical engine's per-micro DCN leg, the
+#   loss psum) counts exposed even though XLA may find partial overlap
+#   — partial credit would let a re-serializing change hide behind
+#   scheduler luck.
+#
+# Bytes are weighted by the product of enclosing loop trip counts
+# (``backend_config known_trip_count``) so "exposed bytes per step"
+# compares schedules honestly: a DCN leg issued once per microbatch
+# inside a trip-N accumulation scan costs N transfers; the overlap
+# schedule's single post-scan flush costs one.
+
+#: opcodes that ARE the work a transfer could hide behind (plus any
+#: collective: a DCN op gated on another transfer is not issueable at
+#: iteration entry)
+_COMPUTE_OPS = frozenset({
+    "dot", "convolution", "cholesky", "triangular-solve", "fft",
+    "custom-call", "scatter", "sort",
+})
+
+_COMPUTATION_HEAD_RE = re.compile(r"^(ENTRY\s+)?%([\w.\-]+)\s*\(")
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+_REF_RE = re.compile(r"%([\w.\-]+)")
+#: optional shape prefix (absent after a tuple-shaped result has been
+#: skipped — ``(s32[], f32[2]{0}) while(...)``), then the opcode; a
+#: shape can never false-match the opcode group (``[`` follows it, not
+#: ``(``)
+_SHAPE_OPCODE_RE = re.compile(
+    r"(?:[a-z][a-z0-9]*\[[0-9,]*\](?:\{[^}]*\})?\s*)?([a-z][a-z0-9\-]*)\("
+)
+_TRIP_RE = re.compile(r"\"known_trip_count\":\{\"n\":\"(\d+)\"\}")
+
+
+@dataclasses.dataclass
+class _HloInstr:
+    name: str
+    opcode: str
+    line: int  # 1-indexed line in the module text
+    operands: Tuple[str, ...]  # same-computation value refs
+    called: Tuple[str, ...]  # computations fusion/call/cond branches run
+    body: str = ""  # while only: the body computation
+    trip: int = 1  # while only: known_trip_count (1 when unknown)
+
+
+@dataclasses.dataclass
+class _HloComputation:
+    name: str
+    entry: bool
+    instrs: Dict[str, _HloInstr] = dataclasses.field(default_factory=dict)
+
+
+def _split_instr_rhs(rhs: str) -> Tuple[str, str, str]:
+    """``(opcode, operand_segment, attr_tail)`` of an HLO instruction's
+    right-hand side. Tuple-shaped results (``(s32[], f32[2]{0}) while``)
+    are skipped by balanced-paren counting — layout tiles like
+    ``{1,0:T(8,128)}`` keep parens balanced, so this survives them."""
+    s = rhs.lstrip()
+    if s.startswith("("):
+        depth = 0
+        for i, ch in enumerate(s):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    s = s[i + 1:].lstrip()
+                    break
+    m = _SHAPE_OPCODE_RE.match(s)
+    if not m:
+        return "", "", ""
+    opcode = m.group(1)
+    depth, i = 1, m.end()
+    start = i
+    while i < len(s) and depth:
+        if s[i] == "(":
+            depth += 1
+        elif s[i] == ")":
+            depth -= 1
+        i += 1
+    return opcode, s[start:i - 1], s[i:]
+
+
+def _called_computations(attr_tail: str) -> Tuple[List[str], str]:
+    """``(called, body)``: computation refs in the attributes that mean
+    "this op RUNS that computation" (fusion/call/conditional/while —
+    NOT ``to_apply`` reducers, which are scalar add/max lambdas), and
+    the while body specifically."""
+    called: List[str] = []
+    body = ""
+    for key in ("calls", "body", "condition", "branch_computations"):
+        val = _attr(attr_tail, key)
+        if not val:
+            continue
+        refs = _REF_RE.findall(val)
+        called.extend(refs)
+        if key == "body" and refs:
+            body = refs[0]
+    return called, body
+
+
+def _parse_hlo_module(hlo_text: str) -> Dict[str, _HloComputation]:
+    """The module as named computations of def-use-linked instructions.
+    Line-oriented, like the rest of this file: optimized HLO prints one
+    instruction per line and closes every computation with ``}``."""
+    comps: Dict[str, _HloComputation] = {}
+    current: Optional[_HloComputation] = None
+    for lineno, line in enumerate(hlo_text.splitlines(), start=1):
+        if current is None:
+            m = _COMPUTATION_HEAD_RE.match(line)
+            if m and line.rstrip().endswith("{"):
+                current = _HloComputation(
+                    name=m.group(2), entry=m.group(1) is not None
+                )
+                comps[current.name] = current
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, rhs = m.group(1), m.group(2)
+        opcode, operand_seg, attr_tail = _split_instr_rhs(rhs)
+        if not opcode:
+            continue
+        called, body = _called_computations(attr_tail)
+        trip_m = _TRIP_RE.search(attr_tail)
+        current.instrs[name] = _HloInstr(
+            name=name,
+            opcode=opcode,
+            line=lineno,
+            operands=tuple(_REF_RE.findall(operand_seg)),
+            called=tuple(called),
+            body=body,
+            trip=int(trip_m.group(1)) if trip_m else 1,
+        )
+    return comps
+
+
+def _while_body_context(
+    comps: Dict[str, _HloComputation]
+) -> Dict[str, Tuple[str, int]]:
+    """``{body_computation: (computation holding the while, trip)}``."""
+    ctx: Dict[str, Tuple[str, int]] = {}
+    for comp in comps.values():
+        for ins in comp.instrs.values():
+            if ins.opcode == "while" and ins.body:
+                ctx[ins.body] = (comp.name, ins.trip)
+    return ctx
+
+
+def _trip_product(
+    comp_name: str, while_ctx: Dict[str, Tuple[str, int]]
+) -> int:
+    """Product of trip counts of every loop enclosing ``comp_name``
+    (1 for entry-level code)."""
+    product, seen = 1, set()
+    while comp_name in while_ctx and comp_name not in seen:
+        seen.add(comp_name)
+        comp_name, trip = while_ctx[comp_name]
+        product *= max(trip, 1)
+    return product
+
+
+def _is_collective_opcode(opcode: str) -> bool:
+    return any(
+        opcode == c or opcode.startswith(c + "-") for c in COLLECTIVE_OPS
+    )
+
+
+def _computation_has_compute(
+    name: str, comps: Dict[str, _HloComputation], memo: Dict[str, bool]
+) -> bool:
+    if name not in comps:
+        return False
+    if name in memo:
+        return memo[name]
+    memo[name] = False  # cycle guard (HLO call graphs are acyclic)
+    memo[name] = any(
+        _is_compute_instr(ins, comps, memo)
+        for ins in comps[name].instrs.values()
+    )
+    return memo[name]
+
+
+def _is_compute_instr(
+    ins: _HloInstr, comps: Dict[str, _HloComputation], memo: Dict[str, bool]
+) -> bool:
+    if ins.opcode in _COMPUTE_OPS or _is_collective_opcode(ins.opcode):
+        return True
+    if ins.called:  # fusion / call / while / conditional
+        return any(
+            _computation_has_compute(c, comps, memo) for c in ins.called
+        )
+    return False
+
+
+def _closure_has_compute(
+    start: _HloInstr,
+    comp: _HloComputation,
+    comps: Dict[str, _HloComputation],
+    memo: Dict[str, bool],
+) -> bool:
+    """Does the transitive operand closure of ``start`` WITHIN ``comp``
+    contain a compute-class instruction?  (Refs that are not local
+    instruction names — parameters, computation names — terminate.)"""
+    stack, seen = list(start.operands), set()
+    while stack:
+        ref = stack.pop()
+        if ref in seen:
+            continue
+        seen.add(ref)
+        ins = comp.instrs.get(ref)
+        if ins is None:
+            continue
+        if _is_compute_instr(ins, comps, memo):
+            return True
+        stack.extend(ins.operands)
+    return False
+
+
+def _async_pair_overlapped(
+    start: _HloInstr,
+    comp: _HloComputation,
+    comps: Dict[str, _HloComputation],
+    memo: Dict[str, bool],
+) -> bool:
+    """``-start``/``-done`` rule: overlapped iff some compute-class op
+    in the same computation is ordered with NEITHER half — not an
+    ancestor of the start, not a descendant of the done — so the
+    scheduler can run it while the transfer is in flight."""
+    done = next(
+        (
+            i for i in comp.instrs.values()
+            if i.opcode.endswith("-done") and start.name in i.operands
+        ),
+        None,
+    )
+    users: Dict[str, List[str]] = {}
+    for ins in comp.instrs.values():
+        for ref in ins.operands:
+            users.setdefault(ref, []).append(ins.name)
+
+    def _reach(roots: Iterable[str], edges) -> set:
+        out, stack = set(), list(roots)
+        while stack:
+            ref = stack.pop()
+            if ref in out:
+                continue
+            out.add(ref)
+            stack.extend(edges(ref))
+        return out
+
+    ancestors = _reach(
+        start.operands,
+        lambda r: comp.instrs[r].operands if r in comp.instrs else (),
+    )
+    descendants = _reach(
+        users.get(done.name, []) if done is not None else [],
+        lambda r: users.get(r, []),
+    )
+    ordered = ancestors | descendants | {start.name}
+    if done is not None:
+        ordered.add(done.name)
+    return any(
+        ins.name not in ordered and _is_compute_instr(ins, comps, memo)
+        for ins in comp.instrs.values()
+    )
+
+
+def overlap_report(
+    hlo_text: str,
+    coords: MeshCoords,
+    collectives: Optional[List[CollectiveOp]] = None,
+) -> Dict:
+    """Classify every DCN collective of an optimized multislice program
+    as overlapped or exposed (module docstring above) and total the
+    trip-weighted bytes:
+
+    ``{"dcn_exposed_bytes", "dcn_overlapped_bytes", "overlap_ratio",
+    "ops": [...]}``
+
+    ``overlap_ratio`` = overlapped / (overlapped + exposed), 0.0 when
+    the program moves no DCN bytes at all.  ``ops`` carries the
+    per-collective verdicts for the CLI/bench surface; the contract
+    stores only the three totals."""
+    if collectives is None:
+        collectives = parse_collectives(hlo_text, coords)
+    dcn = [op for op in collectives if op.link == "dcn" and op.dcn_bytes]
+    exposed = overlapped = 0
+    rows: List[Dict] = []
+    if dcn:
+        comps = _parse_hlo_module(hlo_text)
+        line_map: Dict[int, Tuple[_HloComputation, _HloInstr]] = {}
+        for comp in comps.values():
+            for ins in comp.instrs.values():
+                line_map[ins.line] = (comp, ins)
+        while_ctx = _while_body_context(comps)
+        memo: Dict[str, bool] = {}
+        for op in dcn:
+            hit = line_map.get(op.line)
+            if hit is None:  # unparseable line: count it exposed
+                exposed += op.dcn_bytes
+                continue
+            comp, ins = hit
+            weight = _trip_product(comp.name, while_ctx)
+            nbytes = op.dcn_bytes * weight
+            if ins.opcode.endswith("-start"):
+                is_overlapped = _async_pair_overlapped(
+                    ins, comp, comps, memo
+                )
+            else:
+                is_overlapped = (
+                    comp.name in while_ctx
+                    and not _closure_has_compute(ins, comp, comps, memo)
+                )
+            if is_overlapped:
+                overlapped += nbytes
+            else:
+                exposed += nbytes
+            rows.append({
+                "kind": op.kind,
+                "line": op.line,
+                "dcn_bytes": nbytes,
+                "overlapped": is_overlapped,
+            })
+    total = exposed + overlapped
+    return {
+        "dcn_exposed_bytes": int(exposed),
+        "dcn_overlapped_bytes": int(overlapped),
+        "overlap_ratio": round(overlapped / total, 4) if total else 0.0,
+        "ops": rows,
+    }
+
+
+#: SC006: a re-serialization may keep the ratio but still regress the
+#: absolute stall (payload growth); exposed bytes get the same growth
+#: tolerance as SC001, the ratio an absolute slack for float noise
+OVERLAP_RATIO_SLACK = 0.02
+
+
+def check_overlap_against_contract(
+    program: StepProgram,
+    contract: Dict,
+    byte_tolerance: float = DEFAULT_BYTE_TOLERANCE,
+    report: Optional[Dict] = None,
+) -> List[Violation]:
+    """SC006: diff the program's exposed-vs-overlapped DCN split
+    against the contract's recorded ``overlap`` section.  Fails when
+    exposed bytes grew beyond tolerance or the overlap ratio dropped —
+    both spell "a change re-serialized the DCN leg the schedule used
+    to hide".  Silent when the contract has no ``overlap`` section
+    (pre-overlap contract vintage) or on a config-hash mismatch (SC001
+    already reports that)."""
+    ref = contract.get("overlap")
+    if not ref:
+        return []
+    if contract.get("config_hash") and program.config_hash and \
+            contract["config_hash"] != program.config_hash:
+        return []
+    if report is None:
+        report = overlap_report(program.hlo, program.coords())
+    out: List[Violation] = []
+    ref_exposed = ref.get("dcn_exposed_bytes", 0)
+    got_exposed = report["dcn_exposed_bytes"]
+    if got_exposed > ref_exposed * (1.0 + byte_tolerance) and \
+            got_exposed > ref_exposed:
+        out.append(
+            program.violation(
+                "SC006",
+                f"exposed DCN bytes grew {ref_exposed} -> {got_exposed} "
+                f"(> {byte_tolerance:.0%} tolerance): the step now "
+                "STALLS on slice-boundary transfers the contract "
+                "records as hidden behind compute — a change "
+                "re-serialized the DCN schedule.",
+            )
+        )
+    ref_ratio = float(ref.get("overlap_ratio", 0.0))
+    got_ratio = report["overlap_ratio"]
+    if ref_ratio > 0.0 and got_ratio < ref_ratio - OVERLAP_RATIO_SLACK:
+        out.append(
+            program.violation(
+                "SC006",
+                f"DCN overlap_ratio dropped {ref_ratio:.4f} -> "
+                f"{got_ratio:.4f}: transfers the overlap schedule "
+                "pipelined behind the accumulation scan are exposed "
+                "again — justify and --fix-contracts, or restore the "
+                "schedule.",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # StableHLO entry-signature parsing (SC002/SC003/SC004 substrate)
 # ---------------------------------------------------------------------------
 
@@ -755,6 +1171,15 @@ class StepProgram:
     #: multislice program, flat or hierarchical, so the census always
     #: shows what the slow link carries
     n_slices: int = 1
+    #: the step was built with the latency-hiding overlap schedule
+    #: (ops/hier_collectives.py overlap_value_and_grad): arms the
+    #: SC006 exposed-vs-overlapped DCN-bytes contract dimension
+    overlap: bool = False
+    #: gradient-accumulation factor of the step — the overlap analysis
+    #: weights in-scan DCN legs by the scan trip count so "exposed
+    #: bytes per step" compares schedules honestly (hier-flat exposes
+    #: its DCN leg once per MICROBATCH; overlap once per step)
+    accum_steps: int = 1
 
     def coords(self) -> MeshCoords:
         return MeshCoords(self.axis_sizes, n_slices=self.n_slices)
@@ -1192,13 +1617,18 @@ def check_program(
     replicated_threshold: int = DEFAULT_REPLICATED_BYTES,
     census: Optional[Dict[str, Dict[str, int]]] = None,
 ) -> List[Violation]:
-    """SC002–SC005 always; SC001 only when a contract is supplied
-    (there is nothing to diff against otherwise)."""
+    """SC002–SC005 always; SC001/SC006 only when a contract is
+    supplied (there is nothing to diff against otherwise)."""
     out: List[Violation] = []
     if contract is not None and program.hlo:
         out.extend(
             check_census_against_contract(
                 program, contract, byte_tolerance, census=census
+            )
+        )
+        out.extend(
+            check_overlap_against_contract(
+                program, contract, byte_tolerance
             )
         )
     if program.stablehlo:
@@ -1263,6 +1693,19 @@ def write_contract(
         # records what the census unit means for this contract
         data["n_slices"] = program.n_slices
         data["dcn_bytes_total"] = census_dcn_bytes(census)
+        # arms SC006: the exposed/overlapped split of those DCN bytes.
+        # Recorded for EVERY multislice contract — a flat or fused-hier
+        # program banks ratio 0.0 with its exposure baseline, so even
+        # without the overlap schedule a change that inflates the
+        # stalled bytes fails the contract.
+        report = overlap_report(program.hlo, program.coords())
+        data["overlap"] = {
+            k: report[k]
+            for k in (
+                "dcn_exposed_bytes", "dcn_overlapped_bytes",
+                "overlap_ratio",
+            )
+        }
     if extra:
         data.update(extra)
     path = contract_path(contracts_dir, mesh_spec)
@@ -1294,4 +1737,9 @@ SC_RULES: List[Tuple[str, str, str]] = [
      "from its input sharding."),
     ("SC005", "host-transfer-in-jit",
      "Host callback / infeed / outfeed inside the jitted step."),
+    ("SC006", "exposed-dcn-bytes",
+     "Trip-weighted exposed vs. overlapped DCN bytes diffed against "
+     "the contract's recorded split — vetoes a change that "
+     "re-serializes slice-boundary transfers the schedule used to "
+     "hide behind compute."),
 ]

@@ -227,6 +227,11 @@ class SpeedMonitor:
         # brain/tuner has a real slow-link signal instead of step-time
         # guesswork.
         self._comm_links: Dict[int, Dict[str, int]] = {}
+        # per-rank DCN overlap ratio (shardcheck SC006 semantics:
+        # overlapped / total trip-weighted DCN bytes). −1.0 sentinel =
+        # not measured (single-slice or pre-overlap worker) — kept out
+        # of _comm_links because that dict int-coerces its values
+        self._overlap_ratio: Dict[int, float] = {}
         # master-side span buffer for the job timeline: closed downtime
         # brackets as (start, end) epoch pairs (bounded)
         self._downtime_spans: List[Tuple[float, float]] = []
@@ -429,12 +434,22 @@ class SpeedMonitor:
             node, p50_s, count=count, ts=ts
         )
 
-    def record_comm_links(self, node_id: int, links: Dict):
+    def record_comm_links(
+        self, node_id: int, links: Dict, overlap_ratio: float = -1.0
+    ):
         """One rank's per-link analytic comm bytes/step
-        (``{"ici": N, "dcn": M}`` — GlobalStepReport.comm_links). Last
-        report wins per rank; bad payloads are dropped, not raised (the
+        (``{"ici": N, "dcn": M}`` — GlobalStepReport.comm_links) plus
+        its DCN ``overlap_ratio`` (−1.0 = not measured). Last report
+        wins per rank; bad payloads are dropped, not raised (the
         report hot path must never fail on a malformed split)."""
+        try:
+            ratio = float(overlap_ratio)
+        except (TypeError, ValueError):
+            ratio = -1.0
         if not links:
+            if ratio >= 0.0:
+                with self._lock:
+                    self._overlap_ratio[int(node_id)] = ratio
             return
         clean: Dict[str, int] = {}
         try:
@@ -444,10 +459,17 @@ class SpeedMonitor:
             return
         with self._lock:
             self._comm_links[int(node_id)] = clean
+            if ratio >= 0.0:
+                self._overlap_ratio[int(node_id)] = ratio
+            else:
+                # a real split with no measured ratio (slice loss /
+                # downgraded schedule): drop the rank's stale one
+                self._overlap_ratio.pop(int(node_id), None)
 
     def evict_comm_links(self, node_id: int):
         with self._lock:
             self._comm_links.pop(int(node_id), None)
+            self._overlap_ratio.pop(int(node_id), None)
 
     def comm_link_report(self) -> Dict:
         """The goodput report's ici/dcn section: per-link bytes/step
@@ -456,6 +478,7 @@ class SpeedMonitor:
         the dcn share of all comm, and how many ranks reported."""
         with self._lock:
             per_rank = {k: dict(v) for k, v in self._comm_links.items()}
+            ratios = [r for r in self._overlap_ratio.values() if r >= 0.0]
         links: Dict[str, int] = {}
         for row in per_rank.values():
             for link, b in row.items():
@@ -466,6 +489,10 @@ class SpeedMonitor:
             "dcn_share": (
                 round(links.get("dcn", 0) / total, 4) if total else 0.0
             ),
+            # min across ranks: every rank of one program carries the
+            # same analytic ratio, so min is robust to a stale (higher)
+            # report surviving a schedule regression. −1.0 = unmeasured.
+            "overlap_ratio": round(min(ratios), 4) if ratios else -1.0,
             "ranks_reporting": len(per_rank),
         }
 
@@ -664,6 +691,9 @@ class SpeedMonitor:
                 "comm_links": {
                     str(k): dict(v) for k, v in self._comm_links.items()
                 },
+                "overlap_ratio": {
+                    str(k): v for k, v in self._overlap_ratio.items()
+                },
                 "last_progress_ts": self._last_progress_ts,
                 "straggler": self.straggler_detector.export_state(),
                 # when the old master dies with no open bracket, the
@@ -702,6 +732,10 @@ class SpeedMonitor:
             self._comm_links = {
                 int(k): {str(a): int(b) for a, b in dict(v).items()}
                 for k, v in (state.get("comm_links") or {}).items()
+            }
+            self._overlap_ratio = {
+                int(k): float(v)
+                for k, v in (state.get("overlap_ratio") or {}).items()
             }
         raw_blocking = state.get("ckpt_blocking_s") or {}
         if not isinstance(raw_blocking, dict):

@@ -356,11 +356,15 @@ class MasterServicer:
                     request.timestamp or time.time(),
                 )
             comm_links = getattr(request, "comm_links", None)
-            if comm_links:
-                # per-link comm split (profiler/comm.py): feeds the
-                # goodput report's ici/dcn section
+            # getattr-with-default: a pre-overlap worker's report has
+            # no overlap_ratio field — skew reads the sentinel
+            ratio = getattr(request, "overlap_ratio", -1.0)
+            if comm_links or (ratio is not None and ratio >= 0.0):
+                # per-link comm split (profiler/comm.py) + DCN overlap
+                # ratio: feeds the goodput report's ici/dcn section
                 self._speed_monitor.record_comm_links(
-                    request.node_id, comm_links
+                    request.node_id, comm_links or {},
+                    overlap_ratio=ratio if ratio is not None else -1.0,
                 )
         return msg.SimpleResponse()
 

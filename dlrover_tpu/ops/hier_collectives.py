@@ -61,14 +61,24 @@ PyTree = Any
 SLICE_AXIS = "slice"
 DP_IN_AXIS = "dp_in"
 
+#: default size bound (MiB) of one overlap bucket — each bucket is one
+#: fused DCN collective in the exchange half of the pipeline; the
+#: DLROVER_TPU_OVERLAP_BUCKET_MB typed flag overrides it
+DEFAULT_BUCKET_MB = 4
+
 __all__ = [
     "SLICE_AXIS",
     "DP_IN_AXIS",
+    "DEFAULT_BUCKET_MB",
     "enabled",
+    "overlap_enabled",
+    "overlap_bucket_bytes",
     "mode_for",
     "hier_mesh",
     "split_spec",
     "hier_value_and_grad",
+    "overlap_value_and_grad",
+    "hier_param_gather",
 ]
 
 
@@ -83,6 +93,33 @@ def enabled(train_config) -> bool:
     return bool(getattr(train_config, "hier_collectives", True))
 
 
+def overlap_enabled(train_config) -> bool:
+    """Effective overlap-schedule setting: the
+    ``DLROVER_TPU_OVERLAP_COLLECTIVES`` env flag when set (``0`` =
+    kill-switch, anything else = on), else the
+    ``TrainConfig.overlap_collectives`` knob."""
+    flag = flags.OVERLAP_COLLECTIVES
+    if flag.present():
+        return flag.get() != "0"
+    return bool(getattr(train_config, "overlap_collectives", True))
+
+
+def overlap_bucket_bytes() -> int:
+    """Size bound of one overlap bucket in bytes (the
+    ``DLROVER_TPU_OVERLAP_BUCKET_MB`` flag, else
+    :data:`DEFAULT_BUCKET_MB`)."""
+    mb = flags.OVERLAP_BUCKET_MB.get()
+    if mb is None or mb <= 0:
+        mb = DEFAULT_BUCKET_MB
+    return int(mb) << 20
+
+
+#: one-time latch for the mixed-mesh silent-fallback warning (the
+#: documented mode_for gap): warn the first time a genuinely multislice
+#: mixed mesh falls back to flat, naming the flag, then stay quiet
+_warned_mixed_flat = False
+
+
 def mode_for(
     mesh,
     n_slices: int,
@@ -90,8 +127,9 @@ def mode_for(
     has_factory: bool,
     zero1_mode: str = "off",
     enabled_override: Optional[bool] = None,
+    overlap_override: Optional[bool] = None,
 ) -> str:
-    """``"flat"`` | ``"hier"`` for this build.
+    """``"flat"`` | ``"hier"`` | ``"overlap"`` for this build.
 
     ``hier`` needs: >1 slice; a dp axis that actually decomposes
     (``dp % n_slices == 0`` with a non-trivial within-slice remainder —
@@ -103,9 +141,16 @@ def mode_for(
     zero-1 only arises on mixed meshes, which already fail the
     trivial-axes test, or without a factory).
 
-    ``enabled_override`` mirrors ``zero1.mode_for``'s: the trainer pins
-    the flag read once per build so a concurrent ``scoped`` window can
-    never flip the answer between cache key and program build."""
+    ``overlap`` is ``hier`` plus the latency-hiding bucketed schedule
+    (:func:`overlap_value_and_grad`): same eligibility, gated by
+    :func:`overlap_enabled`. It is a schedule of the SAME reduction —
+    every ``mode != "flat"`` check treats the two alike.
+
+    ``enabled_override`` / ``overlap_override`` mirror
+    ``zero1.mode_for``'s: the trainer pins the flag reads once per
+    build so a concurrent ``scoped`` window can never flip the answer
+    between cache key and program build."""
+    global _warned_mixed_flat
     on = (
         enabled(train_config)
         if enabled_override is None else enabled_override
@@ -120,8 +165,24 @@ def mode_for(
         return "flat"
     if any(s > 1 for a, s in shape.items() if a != "dp"):
         # the body is single-device model code; a non-trivial model
-        # axis would need its own manual handling (future work —
-        # docs/design/hier_collectives.md "limits")
+        # axis would need its own manual handling (or a GSPMD-level
+        # schedule — docs/design/hier_collectives.md "limits" explains
+        # why that stays out on this jax). Loud, once: an operator who
+        # exported the flag on a mixed multislice world would otherwise
+        # pay full-gradient DCN with no hint why.
+        if not _warned_mixed_flat:
+            _warned_mixed_flat = True
+            nontrivial = {
+                a: s for a, s in shape.items() if a != "dp" and s > 1
+            }
+            logger.warning(
+                "hier collectives: multislice mesh has non-trivial "
+                "model axes %s — the manual ICI-first engine needs a "
+                "pure-dp mesh, running the FLAT dp reduction (full "
+                "gradient on the DCN cut). DLROVER_TPU_HIER_COLLECTIVES"
+                " cannot force hier here; see docs/design/"
+                "hier_collectives.md (limits).", nontrivial,
+            )
         return "flat"
     if zero1_mode == "gspmd":
         return "flat"
@@ -131,7 +192,11 @@ def mode_for(
             SLICE_AXIS, DP_IN_AXIS,
         )
         return "flat"
-    return "hier"
+    ov = (
+        overlap_enabled(train_config)
+        if overlap_override is None else overlap_override
+    )
+    return "overlap" if ov else "hier"
 
 
 def hier_mesh(mesh, n_slices: int):
@@ -309,5 +374,312 @@ def hier_value_and_grad(
             out_specs=(P(), out_grad_specs),
             check_vma=False,
         )(p, micro)
+
+    return fn
+
+
+def _partition_buckets(items, sizes, bound: int):
+    """Greedy size-bounded partition of ``items`` (kept in order) into
+    buckets whose summed ``sizes`` stay under ``bound`` — an oversized
+    item gets a bucket of its own. Deterministic in (items, sizes,
+    bound): the bucket layout is part of the program identity."""
+    buckets, cur, cur_bytes = [], [], 0
+    for item, size in zip(items, sizes):
+        if cur and cur_bytes + size > bound:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(item)
+        cur_bytes += size
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def overlap_value_and_grad(
+    local_loss, mesh, n_slices: int, p_specs, params,
+    zero1_scatter: bool = False,
+    bucket_bytes: Optional[int] = None,
+):
+    """The latency-hiding split of :func:`hier_value_and_grad` —
+    FlexLink's second half: the same ICI-first hierarchical reduction,
+    cut into a ``compute`` half and an ``exchange`` half so the trainer
+    can carry the DCN leg of microbatch N through the accumulation scan
+    and hide it behind the backward of microbatch N+1.
+
+    Returns ``(compute_fn, exchange_fn)``:
+
+    - ``compute_fn(params, micro) -> (loss, pending)`` runs the local
+      loss+backward and ONLY the eager ICI leg per grad leaf
+      (reduce-scatter over ``dp_in``; zero-1 leaves pre-permuted
+      slice-major first, exactly like the fused engine; non-divisible
+      leaves psum over ``dp_in``). ``pending`` is a flat list of
+      slice-local partials — every leaf carried with a leading
+      ``(slice, dp_in)``-sharded stacking axis, so it crosses the
+      shard_map boundary as a global array and rides a ``lax.scan``
+      carry untouched.
+    - ``exchange_fn(pending) -> grads`` runs the deferred DCN leg —
+      partials are grouped into size-bounded buckets
+      (``DLROVER_TPU_OVERLAP_BUCKET_MB``) and each bucket is ONE fused
+      DCN collective: a single ``psum`` over ``slice`` of the bucket's
+      concatenated partials (replicated update + non-divisible leaves),
+      or a single ``psum_scatter`` over ``slice`` straight into the
+      owned zero-1 shards — then the trailing ICI all-gather per
+      replicated leaf. Because the exchange consumes only the CARRIED
+      pending (data-independent of the current iteration's backward),
+      the scheduler is free to run the DCN transfer under compute; the
+      shardcheck overlap dimension proves it from the lowered HLO.
+
+    Addition order per element is identical to the fused engine's —
+    compute+exchange back-to-back IS ``hier_value_and_grad`` (the
+    bucket concat only batches independent elements through one op) —
+    which is what makes the flat↔hier↔overlap parity suite tight.
+
+    ``params`` may be live arrays, tracers or avatars: only ``.shape``
+    and ``.dtype`` are read.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.ops.shard_map_compat import shard_map
+    from dlrover_tpu.parallel.sharding import batch_spec
+    from dlrover_tpu.train import zero1
+
+    hmesh = hier_mesh(mesh, n_slices)
+    axis_sizes = dict(mesh.shape)
+    dp = axis_sizes["dp"]
+    dp_in = dp // n_slices
+    inv_dp = 1.0 / dp
+    if bucket_bytes is None:
+        bucket_bytes = overlap_bucket_bytes()
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+
+    # flatten once; the pending list and every bucket layout follow
+    # this leaf order (deterministic: part of the program identity)
+    spec_leaves, treedef = jax.tree.flatten(p_specs, is_leaf=is_spec)
+    param_leaves = treedef.flatten_up_to(params)
+
+    # per-leaf plan: ("scatter", d) lands in the zero-1 layout via a
+    # slice psum_scatter; ("repl", d) rebuilds the full leaf via slice
+    # psum + dp_in all-gather; ("residual", None) has no dp_in- (or
+    # dp-) divisible dim — eager psum(dp_in), deferred psum(slice)
+    plans = []
+    for spec, leaf in zip(spec_leaves, param_leaves):
+        if zero1_scatter:
+            d = zero1.scatter_dim(spec, leaf.shape, axis_sizes)
+            plans.append(("scatter", d) if d is not None
+                         else ("residual", None))
+        else:
+            d = _first_divisible_dim(leaf.shape, dp_in)
+            plans.append(("repl", d) if d is not None
+                         else ("residual", None))
+
+    def _block_shape(kind, d, shape):
+        if kind == "residual":
+            return tuple(shape)
+        return tuple(shape[:d]) + (shape[d] // dp_in,) + tuple(
+            shape[d + 1:]
+        )
+
+    block_bytes = [
+        int(np.prod(_block_shape(k, d, leaf.shape), dtype=np.int64)
+            or 1) * np.dtype(leaf.dtype).itemsize
+        for (k, d), leaf in zip(plans, param_leaves)
+    ]
+    # two bucket streams: psum-kind (repl + residual share the fused
+    # slice psum; they differ only in ICI post-processing) and
+    # scatter-kind (the fused op is a slice psum_scatter)
+    psum_idx = [i for i, (k, _) in enumerate(plans) if k != "scatter"]
+    scat_idx = [i for i, (k, _) in enumerate(plans) if k == "scatter"]
+    psum_buckets = _partition_buckets(
+        psum_idx, [block_bytes[i] for i in psum_idx], bucket_bytes
+    )
+    scat_buckets = _partition_buckets(
+        scat_idx, [block_bytes[i] for i in scat_idx], bucket_bytes
+    )
+
+    if zero1_scatter:
+        out_grad_specs = [
+            split_spec(
+                zero1.partition_spec(s, leaf.shape, axis_sizes) or s
+            )
+            for s, leaf in zip(spec_leaves, param_leaves)
+        ]
+    else:
+        out_grad_specs = [split_spec(s) for s in spec_leaves]
+    split_p_specs = jax.tree.map(split_spec, p_specs, is_leaf=is_spec)
+    # pending leaves stack the per-slice partials on a leading axis
+    # sharded over the WHOLE decomposed dp — one block per device, a
+    # plain global array between the two shard_maps and in the carry
+    pending_spec = P((SLICE_AXIS, DP_IN_AXIS))
+
+    def compute_body(p, micro):
+        loss, g = jax.value_and_grad(local_loss)(p, micro)
+        g_leaves = treedef.flatten_up_to(g)
+        pending = []
+        for (kind, d), leaf in zip(plans, g_leaves):
+            if kind == "residual":
+                part = lax.psum(leaf, DP_IN_AXIS)
+            elif kind == "scatter":
+                shp = leaf.shape
+                gg = leaf.reshape(
+                    shp[:d] + (n_slices, dp_in, shp[d] // dp)
+                    + shp[d + 1:]
+                )
+                gg = jnp.swapaxes(gg, d, d + 1).reshape(shp)
+                part = lax.psum_scatter(
+                    gg, DP_IN_AXIS, scatter_dimension=d, tiled=True
+                )
+            else:  # repl
+                part = lax.psum_scatter(
+                    leaf, DP_IN_AXIS, scatter_dimension=d, tiled=True
+                )
+            pending.append(part[None])  # leading (slice, dp_in) axis
+        # global batch mean, reduced eagerly (4 DCN bytes — the grad
+        # payload is what the pipeline defers)
+        loss = lax.psum(loss, (DP_IN_AXIS, SLICE_AXIS)) * inv_dp
+        return loss, pending
+
+    def exchange_body(pending):
+        blocks = [x[0] for x in pending]
+        out = [None] * len(blocks)
+        for bucket in psum_buckets:
+            flat = jnp.concatenate(
+                [blocks[i].reshape(-1) for i in bucket]
+            )
+            flat = lax.psum(flat, SLICE_AXIS)  # ONE fused DCN leg
+            off = 0
+            for i in bucket:
+                size = int(np.prod(blocks[i].shape, dtype=np.int64)
+                           or 1)
+                piece = flat[off:off + size].reshape(blocks[i].shape)
+                off += size
+                kind, d = plans[i]
+                if kind == "repl":
+                    piece = lax.all_gather(
+                        piece, DP_IN_AXIS, axis=d, tiled=True
+                    )
+                out[i] = piece * inv_dp
+        for bucket in scat_buckets:
+            rows = []
+            for i in bucket:
+                d = plans[i][1]
+                b = blocks[i]
+                pre, post = b.shape[:d], b.shape[d + 1:]
+                shard = b.shape[d] // n_slices
+                x = b.reshape(pre + (n_slices, shard) + post)
+                x = jnp.moveaxis(x, len(pre), 0)
+                rows.append(x.reshape(n_slices, -1))
+            cat = jnp.concatenate(rows, axis=1)
+            red = lax.psum_scatter(  # ONE fused DCN leg → owned shards
+                cat, SLICE_AXIS, scatter_dimension=0, tiled=True
+            )
+            off = 0
+            for i in bucket:
+                d = plans[i][1]
+                b = blocks[i]
+                pre, post = b.shape[:d], b.shape[d + 1:]
+                shard = b.shape[d] // n_slices
+                size = int(np.prod(
+                    pre + (shard,) + post, dtype=np.int64) or 1)
+                piece = red[0, off:off + size].reshape(
+                    pre + (shard,) + post
+                )
+                off += size
+                out[i] = piece * inv_dp
+        return out
+
+    def compute_fn(p, micro):
+        micro_specs = jax.tree.map(
+            lambda _: split_spec(batch_spec()), micro
+        )
+        return shard_map(
+            compute_body, mesh=hmesh,
+            in_specs=(split_p_specs, micro_specs),
+            out_specs=(P(), [pending_spec] * len(plans)),
+            check_vma=False,
+        )(p, micro)
+
+    def exchange_fn(pending):
+        leaves = shard_map(
+            exchange_body, mesh=hmesh,
+            in_specs=([pending_spec] * len(plans),),
+            out_specs=out_grad_specs,
+            check_vma=False,
+        )(pending)
+        return jax.tree.unflatten(treedef, leaves)
+
+    return compute_fn, exchange_fn
+
+
+def hier_param_gather(mesh, n_slices: int, p_specs, params):
+    """Hierarchize the zero-1 trailing param all-gather on a multislice
+    pure-dp mesh: instead of the flat GSPMD gather over the whole dp
+    axis (whose DCN cut carries ``param_bytes × (1 − 1/s)``), gather
+    the owned 1/dp shard over ``slice`` FIRST — the DCN leg moves only
+    the slice-local ``1/dp_in`` of the params — then over ``dp_in`` on
+    ICI, then undo the ``(dp_in, slice)`` block interleave locally (the
+    zero-1 layout is slice-major; gathering slice-first brings the
+    blocks back dp_in-major). Pure data movement: bitwise identical to
+    the flat gather.
+
+    Returns ``fn(params) -> params`` taking leaves in the zero-1 layout
+    (``zero1.partition_spec``) and returning them in their base layout;
+    leaves the sharding rule left replicated pass through untouched.
+    ``params`` may be live arrays, tracers or avatars (only ``.shape``
+    is read)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from dlrover_tpu.ops.shard_map_compat import shard_map
+    from dlrover_tpu.train import zero1
+
+    hmesh = hier_mesh(mesh, n_slices)
+    axis_sizes = dict(mesh.shape)
+    dp = axis_sizes["dp"]
+    dp_in = dp // n_slices
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+
+    dims = jax.tree.map(
+        lambda s, leaf: zero1.scatter_dim(s, leaf.shape, axis_sizes),
+        p_specs, params, is_leaf=is_spec,
+    )
+    in_specs = jax.tree.map(
+        lambda s, leaf: split_spec(
+            zero1.partition_spec(s, leaf.shape, axis_sizes) or s
+        ),
+        p_specs, params, is_leaf=is_spec,
+    )
+    out_specs = jax.tree.map(split_spec, p_specs, is_leaf=is_spec)
+
+    def body(p):
+        def gather_leaf(d, leaf):
+            if d is None:
+                return leaf  # replicated fallback: nothing to gather
+            x = lax.all_gather(leaf, SLICE_AXIS, axis=d, tiled=True)
+            x = lax.all_gather(x, DP_IN_AXIS, axis=d, tiled=True)
+            shp = x.shape
+            xx = x.reshape(
+                shp[:d] + (dp_in, n_slices, shp[d] // dp) + shp[d + 1:]
+            )
+            return jnp.swapaxes(xx, d, d + 1).reshape(shp)
+
+        return jax.tree.map(
+            gather_leaf, dims, p,
+            is_leaf=lambda x: x is None or isinstance(x, int),
+        )
+
+    def fn(p):
+        return shard_map(
+            body, mesh=hmesh,
+            in_specs=(in_specs,),
+            out_specs=out_specs,
+            check_vma=False,
+        )(p)
 
     return fn

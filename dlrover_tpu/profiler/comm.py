@@ -89,6 +89,11 @@ class CommLedger:
         self._bandwidth_gbps: Dict[str, float] = {}  # axis -> measured
         self._links: Dict[str, str] = {}             # axis -> ici|dcn
         self._accum_steps = 1  # trainer-set loss_call -> step multiplier
+        # share of DCN bytes the current program's schedule hides
+        # behind compute (ops/hier_collectives.py overlap engine);
+        # -1.0 = no program has reported yet (the wire sentinel —
+        # 0.0 means "measured, fully exposed", which is a real signal)
+        self._overlap_ratio = -1.0
 
     def record(self, name: str, kind: str, axis: str, nbytes: int,
                count: int = 1, per: str = "step", link: str = ""):
@@ -138,9 +143,23 @@ class CommLedger:
         with self._lock:
             self._links.update(links)
 
+    def set_overlap_ratio(self, ratio: float):
+        """Trainer-reported share of the program's DCN grad bytes the
+        schedule overlaps behind compute (0.0 = fully exposed/flat;
+        see ``_record_data_parallel_comm``)."""
+        with self._lock:
+            self._overlap_ratio = float(ratio)
+
+    def overlap_ratio(self) -> float:
+        """Last reported overlap share, ``-1.0`` when no program has
+        reported one (absent ≠ zero on the wire)."""
+        with self._lock:
+            return self._overlap_ratio
+
     def clear(self):
         with self._lock:
             self._events.clear()
+            self._overlap_ratio = -1.0
 
     def events(self) -> List[CollectiveEvent]:
         with self._lock:
@@ -212,6 +231,14 @@ class CommLedger:
             lines.append(
                 f'dlrover_tpu_comm_bytes_total{{link="{link}"}} '
                 f"{per_link[link]}"
+            )
+        with self._lock:
+            ratio = self._overlap_ratio
+        if ratio >= 0.0:
+            lines.append("# TYPE dlrover_tpu_comm_dcn_overlap_ratio "
+                         "gauge")
+            lines.append(
+                f"dlrover_tpu_comm_dcn_overlap_ratio {ratio:.6f}"
             )
         for axis, gbps in sorted(bw.items()):
             link = links.get(axis, "ici")

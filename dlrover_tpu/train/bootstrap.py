@@ -235,6 +235,7 @@ class WorkerContext:
         # master's now-stale dcn row (record_comm_links is
         # last-report-wins per rank).
         comm_links = None
+        overlap_ratio = -1.0
         try:
             from dlrover_tpu.profiler.comm import comm_ledger
 
@@ -242,6 +243,9 @@ class WorkerContext:
             if links.get("dcn"):
                 comm_links = links
                 self._sent_comm_links = True
+                # the schedule's DCN overlap share rides with the dcn
+                # row it qualifies (−1.0 = program reported no split)
+                overlap_ratio = comm_ledger.overlap_ratio()
             elif self._sent_comm_links:
                 # the {"ici": 0} floor keeps the clearing report
                 # truthy through serde (an empty dict would be
@@ -253,11 +257,18 @@ class WorkerContext:
         try:
             try:
                 self.client.report_global_step(
-                    step, digest=payload, comm_links=comm_links
+                    step, digest=payload, comm_links=comm_links,
+                    overlap_ratio=overlap_ratio,
                 )
             except TypeError:
-                # link-unaware client (older stubs): plain report
-                self.client.report_global_step(step, digest=payload)
+                # link/overlap-unaware client (older stubs): retry
+                # without the newest field, then plain
+                try:
+                    self.client.report_global_step(
+                        step, digest=payload, comm_links=comm_links
+                    )
+                except TypeError:
+                    self.client.report_global_step(step, digest=payload)
             self._last_reported_step = step
             self._last_report_ts = now
         except Exception as e:

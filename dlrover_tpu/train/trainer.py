@@ -95,6 +95,16 @@ class TrainConfig:
     # this knob in both directions; the flat path is the fallback.
     # No-op on single-slice meshes (the trainer's n_slices).
     hier_collectives: bool = True
+    # Latency-hiding schedule of the hierarchical reduction
+    # (ops/hier_collectives.py overlap_value_and_grad): bucket the
+    # grads, run the ICI leg eagerly and carry each microbatch's DCN
+    # exchange through the accumulation scan behind the NEXT
+    # microbatch's backward. Same reduction, pipelined — the
+    # DLROVER_TPU_OVERLAP_COLLECTIVES env flag overrides in both
+    # directions (0 = kill-switch). Only effective where hier itself
+    # applies; with accum == 1 there is no backward to hide behind and
+    # the schedule degenerates to hier's.
+    overlap_collectives: bool = True
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -245,11 +255,13 @@ class ElasticTrainer:
             return
         tls.enabled = zero1.enabled(self.tc)
         tls.hier_enabled = hier_collectives.enabled(self.tc)
+        tls.overlap_enabled = hier_collectives.overlap_enabled(self.tc)
         try:
             yield
         finally:
             tls.enabled = None
             tls.hier_enabled = None
+            tls.overlap_enabled = None
 
     def _zero1_mode(self, mesh: Mesh) -> str:
         """``"off"`` | ``"scatter"`` | ``"gspmd"`` — how the weight
@@ -281,16 +293,21 @@ class ElasticTrainer:
         return 1
 
     def _hier_mode(self, mesh: Mesh) -> str:
-        """``"flat"`` | ``"hier"`` — how the dp gradient reduction is
-        scheduled over the slice topology (ops/hier_collectives.py).
-        Inside a ``_zero1_pin`` block the flag read is the pinned
-        snapshot, same as zero-1's."""
+        """``"flat"`` | ``"hier"`` | ``"overlap"`` — how the dp
+        gradient reduction is scheduled over the slice topology
+        (ops/hier_collectives.py); ``overlap`` is the hierarchy plus
+        the latency-hiding bucketed DCN pipeline. Inside a
+        ``_zero1_pin`` block the flag reads are the pinned snapshot,
+        same as zero-1's."""
         return hier_collectives.mode_for(
             mesh, self._slices_for(mesh), self.tc,
             self.loss_factory is not None,
             zero1_mode=self._zero1_mode(mesh),
             enabled_override=getattr(
                 self._zero1_tls, "hier_enabled", None
+            ),
+            overlap_override=getattr(
+                self._zero1_tls, "overlap_enabled", None
             ),
         )
 
@@ -494,8 +511,20 @@ class ElasticTrainer:
             # step; the census-diff test (tests/test_zero1.py) pins
             # this inventory against the lowered IR.
             grad_payload = param_bytes // max(fsdp, 1)
-            hier = self._hier_mode(self.mesh) == "hier"
+            hier_mode = self._hier_mode(self.mesh)
+            hier = hier_mode != "flat"
             dp_in = dp // self.n_slices if hier else dp
+            # overlap is a SCHEDULE of the hierarchical reduction — the
+            # byte inventory below is identical; what changes is how
+            # much of the DCN leg sits exposed on the critical path.
+            # accum microbatches pipeline accum-1 exchanges behind
+            # backward compute (the analytic ratio; the shardcheck
+            # overlap dimension proves the scheduled one from the HLO)
+            comm_ledger.set_overlap_ratio(
+                (self.accum_steps - 1) / self.accum_steps
+                if hier_mode == "overlap" and self.accum_steps > 1
+                else 0.0
+            )
             if hier and mode == "scatter":
                 # hierarchical zero-1 (ops/hier_collectives.py): ICI
                 # reduce-scatter within the slice, then a DCN
@@ -546,12 +575,27 @@ class ElasticTrainer:
                     nbytes=grad_payload, count=1, per="loss_call",
                 )
             if mode != "off":
-                # zero-1's second half: the dp-sharded updates gather
-                # back into full params once per optimizer step
-                record_collective(
-                    "dp.param_all_gather", "all_gather", "dp",
-                    nbytes=grad_payload // dp, count=1,
-                )
+                if hier and mode == "scatter":
+                    # hierarchized trailing gather (hier_param_gather):
+                    # AG over slice FIRST — the DCN leg carries only
+                    # the owned 1/dp shard per issue — then an ICI AG
+                    # of the slice-complete 1/dp_in block
+                    record_collective(
+                        "dp.param_all_gather_dcn", "all_gather", "dp",
+                        nbytes=grad_payload // dp, count=1, link="dcn",
+                    )
+                    record_collective(
+                        "dp.param_all_gather_ici", "all_gather", "dp",
+                        nbytes=grad_payload // dp_in, count=1,
+                        link="ici",
+                    )
+                else:
+                    # zero-1's second half: the dp-sharded updates
+                    # gather back into full params once per step
+                    record_collective(
+                        "dp.param_all_gather", "all_gather", "dp",
+                        nbytes=grad_payload // dp, count=1,
+                    )
 
     def _build_step(
         self,
@@ -583,7 +627,8 @@ class ElasticTrainer:
             else self.loss_fn
         )
         z1_mode = self._zero1_mode(mesh)
-        hier = self._hier_mode(mesh) == "hier"
+        hier_mode = self._hier_mode(mesh)
+        hier = hier_mode != "flat"
         if z1_mode != "off" and self._params_avatar is None:
             # zero-1 derives its per-leaf layout from the param shapes;
             # a step built before any state exists (init_state and
@@ -594,6 +639,15 @@ class ElasticTrainer:
                 "building the replicated step"
             )
             z1_mode = "off"
+        if hier_mode == "overlap" and self._params_avatar is None:
+            # the bucket layout derives from the param shapes, same
+            # dependency as zero-1's: degrade to the fused hierarchy
+            # (which handles replicated leaves shape-free)
+            logger.warning(
+                "overlap collectives requested but no params avatar "
+                "captured yet; building the fused hierarchical step"
+            )
+            hier_mode = "hier"
         is_spec = lambda s: isinstance(s, P)  # noqa: E731
         # the params' own layout, as placement targets: pins the f32
         # grad accumulator (a full extra param-sized pytree that used
@@ -615,7 +669,31 @@ class ElasticTrainer:
                 self.p_specs, self._params_avatar, is_leaf=is_spec,
             )
         hier_grad_fn = None
+        ov_compute = ov_exchange = None
+        gather_fn = None
         if z1_mode == "scatter" and hier:
+            # satellite of the hierarchy: the trailing param all-gather
+            # runs AG(slice) → AG(dcn-free dp_in) → local unpermute
+            # instead of the flat GSPMD gather over the whole dp axis,
+            # so its DCN cut carries 1/dp_in of the params
+            gather_fn = hier_collectives.hier_param_gather(
+                mesh, self._slices_for(mesh), self.p_specs,
+                self._params_avatar,
+            )
+        if hier_mode == "overlap":
+            # latency-hiding split of the hierarchy: the eager half
+            # (backward + ICI leg) and the deferred half (bucketed DCN
+            # exchange) — the step below carries each microbatch's
+            # exchange through the scan behind the NEXT backward
+            ov_compute, ov_exchange = (
+                hier_collectives.overlap_value_and_grad(
+                    self.loss_factory(None), mesh,
+                    self._slices_for(mesh), self.p_specs,
+                    self._params_avatar,
+                    zero1_scatter=(z1_mode == "scatter"),
+                )
+            )
+        elif z1_mode == "scatter" and hier:
             # multislice pure-dp: the dp reduction is the two-stage
             # hierarchy — ICI reduce-scatter within the slice, then a
             # DCN reduce-scatter of only the slice-local shard straight
@@ -651,7 +729,48 @@ class ElasticTrainer:
                 else hier_grad_fn if hier_grad_fn is not None
                 else jax.value_and_grad(loss_fn)
             )
-            if accum == 1:
+            if ov_compute is not None and accum == 1:
+                # single microbatch: no later backward to hide behind —
+                # compute and exchange run back-to-back, which IS the
+                # fused hierarchical reduction (same ops, bucketed)
+                loss_sum, pend = ov_compute(
+                    state["params"], jax.tree.map(lambda x: x[0], batch)
+                )
+                grads = ov_exchange(pend)
+            elif ov_compute is not None:
+                # the overlap pipeline, peeled: microbatch 0's backward
+                # runs outside the scan so every scan iteration pairs
+                # the PREVIOUS microbatch's deferred DCN exchange with
+                # the CURRENT microbatch's backward — data-independent
+                # halves the scheduler is free to run concurrently —
+                # and the last exchange flushes after the scan.
+                # Addition order matches the fused path exactly:
+                # ((0+g0)+g1)+…+g_last into the f32 accumulator.
+                acc_put = param_put if z1_mode == "off" else z1_grad_put
+                zero = jax.tree.map(
+                    lambda p, sh: jax.lax.with_sharding_constraint(
+                        jnp.zeros(p.shape, jnp.float32), sh
+                    ),
+                    state["params"], acc_put,
+                )
+                loss0, pend0 = ov_compute(
+                    state["params"], jax.tree.map(lambda x: x[0], batch)
+                )
+
+                def micro_overlap(carry, micro):
+                    loss_sum, acc, pend = carry
+                    g = ov_exchange(pend)  # previous micro's DCN leg
+                    acc = jax.tree.map(jnp.add, acc, g)
+                    loss, pend = ov_compute(state["params"], micro)
+                    return (loss_sum + loss, acc, pend), None
+
+                (loss_sum, acc, pend), _ = jax.lax.scan(
+                    micro_overlap, (loss0, zero, pend0),
+                    jax.tree.map(lambda x: x[1:], batch),
+                )
+                g = ov_exchange(pend)  # flush the last microbatch
+                grads = jax.tree.map(jnp.add, acc, g)
+            elif accum == 1:
                 # single microbatch: no accumulator scan — grads stay in
                 # param dtype and the f32 accumulation buffer (a full extra
                 # param-sized pytree) is never allocated
@@ -707,7 +826,21 @@ class ElasticTrainer:
                     jax.lax.with_sharding_constraint, updates, z1_grad_put
                 )
             params = optax.apply_updates(state["params"], updates)
-            if z1_mode != "off":
+            if z1_mode != "off" and gather_fn is not None:
+                # zero-1's second half, hierarchized: pin the summed
+                # params to the zero-1 layout (the add runs on the
+                # owned shard) and gather explicitly — AG over slice
+                # first, so the DCN cut carries 1/dp_in of the params
+                # instead of the flat gather's full (1 − 1/s) share
+                params = jax.tree.map(
+                    jax.lax.with_sharding_constraint, params,
+                    z1_grad_put,
+                )
+                params = gather_fn(params)
+                params = jax.tree.map(
+                    jax.lax.with_sharding_constraint, params, param_put
+                )
+            elif z1_mode != "off":
                 # zero-1's second half: the dp-sharded updates gather
                 # back into the params' own layout — the param
                 # all-gather that replaces the grad all-reduce's
@@ -770,12 +903,18 @@ class ElasticTrainer:
             # miss its own checked-in plain contract (a spurious
             # config_hash-mismatch failure, a veto under strict mode)
             parts.append("zero1=1")
-        if self._hier_mode(mesh) == "hier":
+        hier_mode = self._hier_mode(mesh)
+        if hier_mode != "flat":
             # same asymmetry: the hierarchical step is a genuinely
             # different program (its own +Nslice contract); flat-path
             # hashes — including flat-on-a-multislice-mesh, the
             # kill-switch fallback — stay what they always were
             parts.append(f"hier={self._slices_for(mesh)}")
+        if hier_mode == "overlap":
+            # the overlap schedule lowers a different program again
+            # (bucketed exchanges, peeled scan): its own +overlap
+            # contract, its own hash
+            parts.append("overlap=1")
         for av in jax.tree.leaves(self._state_avatar):
             parts.append(f"{av.shape}/{av.dtype}")
         return warm_compile.signature_hash(parts)
@@ -916,7 +1055,10 @@ class ElasticTrainer:
         }
 
     # ---- shardcheck (lint/shardcheck.py) -------------------------------
-    def _program_of(self, lowered, compiled, mesh, config_hash: str):
+    def _program_of(
+        self, lowered, compiled, mesh, config_hash: str,
+        mesh_config: Optional[MeshConfig] = None,
+    ):
         """Build the shardcheck analysis context from one lowering."""
         from dlrover_tpu.lint import shardcheck
 
@@ -931,7 +1073,7 @@ class ElasticTrainer:
                     hints["seq_len"] = int(av.shape[2])
                     break
         z1 = self._zero1_mode(mesh) != "off"
-        hier = self._hier_mode(mesh) == "hier"
+        overlap = self._hier_mode(mesh) == "overlap"
         return shardcheck.StepProgram(
             label="hlo:" + self._contract_spec(mesh),
             stablehlo=lowered.as_text(),
@@ -947,6 +1089,14 @@ class ElasticTrainer:
             # even a flat (kill-switch) program's census shows what the
             # slow link carries
             n_slices=self._slices_for(mesh),
+            # overlap programs additionally carry the exposed-vs-
+            # overlapped DCN-bytes contract dimension
+            overlap=overlap,
+            accum_steps=self._accum_for(
+                mesh,
+                mesh_config if mesh_config is not None
+                else self.mesh_config,
+            ),
         )
 
     def world_descriptor(self, mesh: Optional[Mesh] = None) -> WorldDescriptor:
@@ -957,12 +1107,14 @@ class ElasticTrainer:
         specs, transfer-target checks and the planner's candidate
         vocabulary all read this instead of re-deriving world shape."""
         mesh = mesh if mesh is not None else self.mesh
-        hier = self._hier_mode(mesh) == "hier"
+        mode = self._hier_mode(mesh)
+        hier = mode != "flat"
         return WorldDescriptor.from_axis_sizes(
             dict(mesh.shape),
             n_slices=self._slices_for(mesh) if hier else 1,
             zero1=self._zero1_mode(mesh) != "off",
             hier=hier,
+            overlap=(mode == "overlap"),
         )
 
     def _contract_spec(self, mesh: Mesh) -> str:
@@ -988,7 +1140,9 @@ class ElasticTrainer:
         from dlrover_tpu.lint import shardcheck
 
         try:
-            program = self._program_of(lowered, compiled, mesh, config_hash)
+            program = self._program_of(
+                lowered, compiled, mesh, config_hash, mesh_config
+            )
             contracts_dir = (
                 flags.SHARDCHECK_CONTRACTS.get()
                 or shardcheck.DEFAULT_CONTRACTS_DIR
@@ -1062,7 +1216,7 @@ class ElasticTrainer:
             mesh, mesh_config, out_shardings=out_sh if pinned else None
         ).lower(state_av, batch_av)
         return self._program_of(
-            lowered, lowered.compile(), mesh, config_hash
+            lowered, lowered.compile(), mesh, config_hash, mesh_config
         )
 
     def _acquire_step_fn(self):

@@ -202,12 +202,16 @@ def test_bench_resize_phase_contract(tmp_path):
 
 @pytest.mark.slow
 def test_bench_multislice_contract(tmp_path):
-    """ISSUE 13 acceptance, pinned on the 8-device 2-virtual-slice CPU
-    world (dp8, dp_in=4): the bench multislice leg runs both legs, the
-    hierarchical program's ledger DCN bytes are exactly 1/dp_in of the
-    flat path's, the per-link census confirms the drop with its ICI
-    legs dcn-free, and step-loss parity holds (the fast path is the
-    same math).
+    """ISSUE 13 + 16 acceptance, pinned on the 8-device
+    2-virtual-slice CPU world (dp8, dp_in=4): the bench multislice
+    phase runs three legs — flat, fused-hier, and the
+    overlap-scheduled hierarchy. The hierarchical program's ledger DCN
+    bytes are exactly 1/dp_in of the flat path's, the per-link census
+    confirms the drop with its ICI legs dcn-free, the overlap leg's
+    *exposed* DCN bytes land strictly below the fused-hier baseline
+    with a positive SC006 overlap_ratio, and step-loss parity holds
+    across all legs (the overlap schedule is the same math in the same
+    addition order).
 
     Slow-marked for the same budget reason as the ckpt dedup contract;
     CI runs it explicitly in the tier1.yml hierarchical-collectives
@@ -239,8 +243,23 @@ def test_bench_multislice_contract(tmp_path):
     cells = ms["hier"]["census_dp_cells"]
     assert cells["reduce-scatter|dp"]["dcn_bytes"] == 0
     assert cells["all-gather|dp"]["dcn_bytes"] == 0
-    # contract keys: the hier leg is its own program variant
+    # contract keys: each leg is its own program variant
     assert ms["hier"]["contract_spec"] == "dp8+2slice"
     assert ms["flat"]["contract_spec"] == "dp8"
-    # step-loss parity (bitwise-or-tolerance acceptance)
-    assert ms["max_loss_delta"] <= 2e-5
+    assert ms["overlap"]["contract_spec"] == "dp8+2slice+overlap"
+    # the overlap headline (PR 16 acceptance): the schedule hides most
+    # of the DCN leg behind compute — trip-weighted EXPOSED bytes
+    # strictly below the fused-hier baseline (whose DCN is all
+    # exposed), ratio (accum-1)/accum with accum=3
+    assert ms["overlap"]["mode"] == "overlap"
+    assert ms["hier"]["overlap_ratio"] == 0.0
+    assert ms["hier"]["dcn_overlapped_bytes"] == 0
+    assert 0 < ms["overlap"]["dcn_exposed_bytes"] < \
+        ms["hier"]["dcn_exposed_bytes"]
+    assert ms["overlap"]["overlap_ratio"] == pytest.approx(
+        2.0 / 3.0, abs=0.01
+    )
+    assert ms["overlap"]["dcn_overlapped_bytes"] > \
+        ms["overlap"]["dcn_exposed_bytes"]
+    # overlap never changes the loss: step parity across ALL legs
+    assert ms["max_loss_delta"] <= 1e-5

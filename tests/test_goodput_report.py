@@ -238,6 +238,85 @@ def test_comm_link_split_reaches_goodput_report():
     assert sm2.comm_link_report()["per_step_bytes"]["dcn"] == 250
 
 
+def test_overlap_ratio_reaches_goodput_report_and_planner():
+    """GlobalStepReport.overlap_ratio (the DCN share the overlap
+    schedule hides behind compute) rides the same throttled report as
+    comm_links: serde keeps the float, the servicer feeds it to the
+    SpeedMonitor (getattr-with-default, so a pre-overlap report reads
+    the −1 sentinel), the goodput report aggregates min-across-ranks,
+    the split survives relaunch, and GoodputPlanner.observe() snapshots
+    it."""
+    from dlrover_tpu.brain.planner import GoodputPlanner
+    from dlrover_tpu.common import messages as msg
+    from dlrover_tpu.common.serde import deserialize, serialize
+    from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+    from dlrover_tpu.master.servicer import MasterServicer
+
+    sm = SpeedMonitor()
+    servicer = MasterServicer(speed_monitor=sm)
+    # unmeasured fleet: the sentinel, not 0.0 (absent ≠ fully exposed)
+    servicer.report(msg.GlobalStepReport(
+        node_id=0, step=5, comm_links={"ici": 1000, "dcn": 250},
+    ))
+    assert sm.comm_link_report()["overlap_ratio"] == -1.0
+    wire = serialize(msg.GlobalStepReport(
+        node_id=0, step=10, comm_links={"ici": 1000, "dcn": 250},
+        overlap_ratio=0.6667,
+    ))
+    servicer.report(deserialize(wire))
+    servicer.report(msg.GlobalStepReport(
+        node_id=1, step=10, comm_links={"ici": 1000, "dcn": 250},
+        overlap_ratio=0.75,
+    ))
+    report = sm.comm_link_report()
+    # min across ranks: robust to one stale (higher) report
+    assert report["overlap_ratio"] == 0.6667
+    assert report["dcn_share"] > 0
+    # skew: a field-less dict deserializes to the default sentinel and
+    # must not clobber... (a report with NO links and no ratio is a
+    # no-op, same as the pre-overlap wire shape)
+    servicer.report(msg.GlobalStepReport(node_id=2, step=11))
+    assert sm.comm_link_report()["overlap_ratio"] == 0.6667
+    # relaunch continuity
+    sm2 = SpeedMonitor()
+    sm2.import_state(sm.export_state())
+    assert sm2.comm_link_report()["overlap_ratio"] == 0.6667
+    # a clearing split (slice loss: dcn row gone, schedule downgraded)
+    # drops the rank's stale ratio
+    sm2.record_comm_links(0, {"ici": 1000})
+    sm2.record_comm_links(1, {"ici": 1000})
+    assert sm2.comm_link_report()["overlap_ratio"] == -1.0
+    # the planner snapshots it from the same report
+    planner = GoodputPlanner(speed_monitor=sm, clock=lambda: 100.0)
+    inputs = planner.observe()
+    assert inputs.overlap_ratio == 0.6667
+    assert inputs.snapshot()["overlap_ratio"] == 0.6667
+    # and discounts overlapped DCN seconds from the critical path:
+    # only the exposed 1/3 of the 250 B/step (at 250 B/s → 0.333 s,
+    # not 1.0 s) would be bought back by escaping DCN — so a DCN-free
+    # single-slice shrink looks LESS attractive than under a fully
+    # exposed schedule, which is exactly the overlap feature's point
+    planner._dcn_bytes_per_s = 250.0
+    inputs.step_p50_s = 2.0
+    inputs.world = 8
+    inputs.n_slices = 2
+    from dlrover_tpu.common.world import WorldDescriptor
+
+    wd = WorldDescriptor.parse("dp4")  # fits one slice: zero DCN
+    t_overlap = planner.predict_step_time(wd, inputs)
+    ratio_measured = inputs.overlap_ratio
+    inputs.overlap_ratio = -1.0
+    t_exposed = planner.predict_step_time(wd, inputs)
+    # shrink pays 2x compute either way; the exposed schedule deducts
+    # the full DCN second from compute first, the overlapped one only
+    # its exposed third
+    assert t_overlap == pytest.approx(
+        2 * (2.0 - (1.0 - ratio_measured)), abs=1e-3
+    )
+    assert t_exposed == pytest.approx(2 * (2.0 - 1.0), abs=1e-3)
+    assert t_overlap > t_exposed
+
+
 def test_comm_ledger_link_bytes_and_metrics_rows():
     """profiler/comm.py: link_bytes() splits the analytic inventory by
     link class (explicit per-event link beats the axis map — the

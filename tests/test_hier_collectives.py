@@ -35,6 +35,8 @@ GB = 16  # micro=2 → accum 2 on dp4 (the grad-accum scan composes)
 @pytest.fixture(autouse=True)
 def _fresh(monkeypatch):
     monkeypatch.delenv(flags.HIER_COLLECTIVES.name, raising=False)
+    monkeypatch.delenv(flags.OVERLAP_COLLECTIVES.name, raising=False)
+    monkeypatch.delenv(flags.OVERLAP_BUCKET_MB.name, raising=False)
     monkeypatch.delenv(flags.ZERO1.name, raising=False)
     monkeypatch.delenv(wc.ENV_KILL_SWITCH, raising=False)
     monkeypatch.delenv(wc.ENV_CACHE_DIR, raising=False)
@@ -45,7 +47,11 @@ def _factory(mesh):
     return lambda p, t: llama.loss_fn(p, t, CFG, mesh)
 
 
-def _make(world, n_slices, zero1=False, hier=True, gb=GB):
+def _make(world, n_slices, zero1=False, hier=True, gb=GB,
+          overlap=False):
+    """``overlap=False`` pins the FUSED hierarchical engine — the
+    TrainConfig default is overlap-on, and most of this file tests the
+    fused engine's census/ledger shape specifically."""
     mc = MeshConfig(dp=-1).resolve(world)
     mesh = build_mesh(
         mc, devices=jax.devices()[:world],
@@ -53,7 +59,7 @@ def _make(world, n_slices, zero1=False, hier=True, gb=GB):
     )
     tc = TrainConfig(global_batch_size=gb, micro_batch_size=2,
                      warmup_steps=0, total_steps=100, zero1=zero1,
-                     hier_collectives=hier)
+                     hier_collectives=hier, overlap_collectives=overlap)
     tr = ElasticTrainer(None, llama.param_specs(CFG), mesh, mc, tc,
                         loss_factory=_factory, n_slices=n_slices)
     params = jax.device_put(
@@ -70,8 +76,8 @@ def _batch(tr, key):
                               CFG.vocab_size)
 
 
-def _run(world, n_slices, zero1, hier, steps):
-    tr, state = _make(world, n_slices, zero1, hier)
+def _run(world, n_slices, zero1, hier, steps, overlap=False):
+    tr, state = _make(world, n_slices, zero1, hier, overlap=overlap)
     losses = []
     for i in range(steps):
         state, loss = tr.step(state, _batch(tr, 100 + i))
@@ -99,11 +105,16 @@ class _FakeMesh:
 
 
 def test_mode_for():
-    tc = TrainConfig(hier_collectives=True)
+    tc = TrainConfig(hier_collectives=True, overlap_collectives=False)
+    ov = TrainConfig()  # both knobs default on → overlap
     off = TrainConfig(hier_collectives=False)
     # multislice pure dp with a non-trivial within-slice remainder
     assert hc.mode_for(_FakeMesh(dp=4), 2, tc, True) == "hier"
     assert hc.mode_for(_FakeMesh(dp=8), 2, tc, True, "scatter") == "hier"
+    # overlap = hier eligibility + the overlap knob
+    assert hc.mode_for(_FakeMesh(dp=4), 2, ov, True) == "overlap"
+    assert hc.mode_for(_FakeMesh(dp=8), 2, ov, True, "scatter") == \
+        "overlap"
     # single slice / knob off / no factory → flat
     assert hc.mode_for(_FakeMesh(dp=4), 1, tc, True) == "flat"
     assert hc.mode_for(_FakeMesh(dp=4), 2, off, True) == "flat"
@@ -114,9 +125,54 @@ def test_mode_for():
     assert hc.mode_for(_FakeMesh(dp=6), 4, tc, True) == "flat"
     # non-trivial model axis: the manual body is single-device code
     assert hc.mode_for(_FakeMesh(dp=4, tp=2), 2, tc, True) == "flat"
-    # gspmd zero-1 has no manual engine to compose with
+    # gspmd zero-1 has no manual engine to compose with — and overlap
+    # never outlives hier eligibility
     assert hc.mode_for(_FakeMesh(dp=4), 2, tc, True, "gspmd") == "flat"
+    assert hc.mode_for(_FakeMesh(dp=4), 2, ov, True, "gspmd") == "flat"
     assert hc.mode_for(_FakeMesh(dp=4), 2, tc, True, "off") == "hier"
+
+
+def test_mixed_mesh_flat_fallback_warns_once(monkeypatch):
+    """satellite: the mixed-mesh silent flat fallback is silent no
+    more — the FIRST multislice mixed-mesh build logs a warning naming
+    the flag and the docs, subsequent ones stay quiet (one latch, not
+    one log line per lowering)."""
+    monkeypatch.setattr(hc, "_warned_mixed_flat", False)
+    warnings = []
+    monkeypatch.setattr(
+        hc.logger, "warning",
+        lambda msg, *a, **k: warnings.append(msg % a if a else msg),
+    )
+    ov = TrainConfig()
+    assert hc.mode_for(_FakeMesh(dp=4, tp=2), 2, ov, True) == "flat"
+    assert hc.mode_for(_FakeMesh(dp=4, tp=2), 2, ov, True) == "flat"
+    named = [w for w in warnings if "DLROVER_TPU_HIER_COLLECTIVES" in w]
+    assert len(named) == 1, warnings
+    assert "tp" in named[0]  # names the offending axes too
+
+
+def test_overlap_kill_switch_overrides_both_directions(monkeypatch):
+    tc_on = TrainConfig()
+    tc_off = TrainConfig(overlap_collectives=False)
+    assert hc.overlap_enabled(tc_on) and not hc.overlap_enabled(tc_off)
+    monkeypatch.setenv(flags.OVERLAP_COLLECTIVES.name, "0")
+    assert not hc.overlap_enabled(tc_on)  # forced off
+    monkeypatch.setenv(flags.OVERLAP_COLLECTIVES.name, "1")
+    assert hc.overlap_enabled(tc_off)  # forced on
+    monkeypatch.setenv(flags.OVERLAP_COLLECTIVES.name, "")
+    assert hc.overlap_enabled(tc_on) and not hc.overlap_enabled(tc_off)
+
+
+def test_partition_buckets():
+    items = list("abcdef")
+    sizes = [10, 20, 30, 40, 50, 60]
+    # greedy in-order, bound respected, oversized item → own bucket
+    assert hc._partition_buckets(items, sizes, 60) == \
+        [["a", "b", "c"], ["d"], ["e"], ["f"]]
+    assert hc._partition_buckets(items, sizes, 1) == \
+        [[i] for i in items]
+    assert hc._partition_buckets(items, sizes, 10 ** 9) == [items]
+    assert hc._partition_buckets([], [], 5) == []
 
 
 def test_kill_switch_overrides_both_directions(monkeypatch):
@@ -188,6 +244,89 @@ def test_parity_zero1_dp4_2slice():
         if getattr(l, "ndim", 0) > 0
     }
     assert any("'dp'" in s for s in specs), specs
+
+
+def test_parity_overlap_replicated_dp4_2slice():
+    """satellite (bucketing parity): 8 steps, replicated weight
+    update — the overlap schedule (pipelined DCN exchange + post-scan
+    flush) matches BOTH the flat path and the fused hierarchical
+    engine within float tolerance. The accumulation order is
+    constructed identical; only op fusion differs."""
+    tr_f, s_f, l_f = _run(4, 2, False, hier=False, steps=8)
+    tr_o, s_o, l_o = _run(4, 2, False, hier=True, steps=8,
+                          overlap=True)
+    assert tr_o._hier_mode(tr_o.mesh) == "overlap"
+    _assert_parity(l_f, l_o, s_f, s_o)
+
+
+def test_parity_overlap_zero1_dp4_2slice():
+    """satellite (bucketing parity), zero-1 scatter mode: the bucketed
+    psum_scatter exchange lands the same shards as the fused chained
+    scatters, and the hierarchized trailing param gather rebuilds the
+    same params."""
+    tr_h, s_h, l_h = _run(4, 2, True, hier=True, steps=8)
+    tr_o, s_o, l_o = _run(4, 2, True, hier=True, steps=8, overlap=True)
+    assert tr_o._zero1_mode(tr_o.mesh) == "scatter"
+    assert tr_o._hier_mode(tr_o.mesh) == "overlap"
+    _assert_parity(l_h, l_o, s_h, s_o)
+
+
+def test_overlap_kill_switch_restores_hier_program(monkeypatch):
+    """DLROVER_TPU_OVERLAP_COLLECTIVES=0 downgrades an overlap trainer
+    to the fused hier program — contract key and mode revert, hier
+    itself stays on."""
+    tr, _ = _make(4, 2, overlap=True)
+    assert tr._hier_mode(tr.mesh) == "overlap"
+    assert tr._contract_spec(tr.mesh) == "dp4+2slice+overlap"
+    monkeypatch.setenv(flags.OVERLAP_COLLECTIVES.name, "0")
+    assert tr._hier_mode(tr.mesh) == "hier"
+    assert tr._contract_spec(tr.mesh) == "dp4+2slice"
+    # and the hier kill-switch still flattens everything
+    monkeypatch.setenv(flags.HIER_COLLECTIVES.name, "0")
+    assert tr._hier_mode(tr.mesh) == "flat"
+    assert tr._contract_spec(tr.mesh) == "dp4"
+
+
+def test_overlap_engine_bucket_bounds_do_not_change_math():
+    """Engine-level: ANY bucket bound — single-bucket degenerate, a
+    bound that cuts mid-list (non-dividing), one-leaf-per-bucket —
+    produces gradients equal to the fused engine's, in both weight
+    -update layouts (per-element addition order is identical by
+    construction; tolerance covers op-fusion rounding)."""
+    mesh = build_mesh(
+        MeshConfig(dp=-1).resolve(4), devices=jax.devices()[:4],
+        n_slices=2,
+    )
+    specs = llama.param_specs(CFG)
+    params = jax.device_put(
+        llama.init_params(CFG, jax.random.key(0)),
+        named_shardings(mesh, specs),
+    )
+    micro = jax.random.randint(jax.random.key(7), (4, SEQ), 0,
+                               CFG.vocab_size)
+    # mesh=None: inside the full-manual engines the loss must not emit
+    # its own sharding constraints (the trainer passes None the same way)
+    loss = _factory(None)
+    for z1 in (False, True):
+        fused = jax.jit(hc.hier_value_and_grad(
+            loss, mesh, 2, specs, params, zero1_scatter=z1
+        ))
+        l_ref, g_ref = fused(params, micro)
+        for bb in (1, 50_000, 1 << 30):
+            comp, exch = hc.overlap_value_and_grad(
+                loss, mesh, 2, specs, params, zero1_scatter=z1,
+                bucket_bytes=bb,
+            )
+            l_o, pending = jax.jit(comp)(params, micro)
+            g_o = jax.jit(exch)(pending)
+            np.testing.assert_allclose(
+                float(l_ref), float(l_o), rtol=0, atol=1e-6
+            )
+            for a, b in zip(jax.tree.leaves(g_ref),
+                            jax.tree.leaves(g_o)):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=0, atol=1e-6,
+                )
 
 
 # ---------------------------------------------------------------------------
@@ -271,9 +410,11 @@ def test_census_dcn_drop_replicated():
 def test_census_dcn_drop_zero1_exact():
     """zero-1 scatter mode: BOTH engines sit outside every scan, so
     the census comparison is equal-footing and exact — the hier grad
-    reduce-scatter's DCN bytes are flat's × 1/dp_in, and the trailing
-    param all-gather (the existing gather, no extra pass) is
-    byte-identical between the two programs."""
+    reduce-scatter's DCN bytes are flat's × 1/dp_in, and (satellite)
+    the trailing param all-gather is hierarchized too: AG over slice
+    first (DCN carries only the 1/dp_in slice-local shard) then AG
+    over dp_in on ICI — its DCN bytes are also flat's × 1/dp_in, at
+    the cost of a second ICI stage (doubled op count)."""
     n_slices, dp = 2, 4
     dp_in = dp // n_slices
     tr_f, s_f = _make(dp, n_slices, zero1=True, hier=False)
@@ -282,7 +423,11 @@ def test_census_dcn_drop_zero1_exact():
     hier = _census_of(tr_h, s_h)
     assert hier["reduce-scatter|dp"]["dcn_bytes"] * dp_in == \
         flat["reduce-scatter|dp"]["dcn_bytes"]
-    assert hier["all-gather|dp"] == flat["all-gather|dp"]
+    assert hier["all-gather|dp"]["dcn_bytes"] * dp_in == \
+        flat["all-gather|dp"]["dcn_bytes"]
+    assert hier["all-gather|dp"]["dcn_bytes"] > 0
+    assert hier["all-gather|dp"]["count"] == \
+        2 * flat["all-gather|dp"]["count"]
 
 
 # ---------------------------------------------------------------------------
@@ -307,8 +452,10 @@ def test_checked_in_2slice_contracts_show_the_drop():
     # replicated: the contract model is accum=1 and its grad psums are
     # per-leaf outside the hier engine's scans — param bytes of the
     # pinned tiny model (the flat baseline payload) recovered from the
-    # zero-1 contract's param gather: contribution × dp
-    param_bytes = z1["census"]["all-gather|dp"]["bytes"] * 4
+    # zero-1 contract's DCN reduce-scatter leg: per-leaf dcn model is
+    # (leaf/dp) × n_slices × (1 − 1/n_slices) = leaf/dp, so the cell's
+    # dcn_bytes × dp is the full payload
+    param_bytes = z1["census"]["reduce-scatter|dp"]["dcn_bytes"] * 4
     flat_baseline = param_bytes * (1.0 - 1.0 / 2)  # flat AR, 2 slices
     hier_dcn = repl["census"]["all-reduce|dp"]["dcn_bytes"]
     assert 0 < hier_dcn <= (1.0 / dp_in + 0.05) * flat_baseline
@@ -324,6 +471,179 @@ def test_checked_in_2slice_contracts_show_the_drop():
     flat = shardcheck.load_contract(shardcheck.DEFAULT_CONTRACTS_DIR,
                                     "dp4")
     assert repl["config_hash"] != flat["config_hash"]
+
+
+def test_checked_in_overlap_contracts_record_positive_ratio():
+    """The overlap acceptance bar, pinned on checked-in artifacts: the
+    ``+overlap`` contracts exist and record ``overlap_ratio > 0`` with
+    most DCN bytes classified overlapped — so a change that
+    re-serializes the DCN exchange fails SC006 in CI. The fused-hier
+    contracts carry the same section at ratio 0.0 (their exposure
+    baseline)."""
+    d = shardcheck.DEFAULT_CONTRACTS_DIR
+    ov = shardcheck.load_contract(d, "dp4+2slice+overlap")
+    ovz = shardcheck.load_contract(d, "dp4+2slice+overlap+zero1")
+    repl = shardcheck.load_contract(d, "dp4+2slice")
+    assert ov is not None and ovz is not None
+    # accum=3 → 2 of 3 exchanges ride the scan carry: ratio 2/3; the
+    # zero-1 variant adds the (exposed) hierarchized param gather
+    assert ov["overlap"]["overlap_ratio"] == pytest.approx(2 / 3,
+                                                           abs=0.01)
+    assert ovz["overlap"]["overlap_ratio"] == pytest.approx(0.5,
+                                                            abs=0.01)
+    assert ov["overlap"]["dcn_overlapped_bytes"] > \
+        ov["overlap"]["dcn_exposed_bytes"]
+    assert repl["overlap"]["overlap_ratio"] == 0.0
+    # distinct program identity from the fused-hier contract
+    assert ov["config_hash"] != repl["config_hash"]
+
+
+# ---------------------------------------------------------------------------
+# the overlap classifier itself + the SC006 veto (seeded regressions)
+# ---------------------------------------------------------------------------
+
+# hand-written post-GSPMD HLO for a dp4 / 2-slice world (slice-major:
+# devices {0,1} are slice 0, {2,3} slice 1 — groups {{0,2},{1,3}} span
+# the DCN cut). One trip-4 loop whose body carries TWO dcn all-reduces:
+# %pipelined consumes only loop-carried state (overlapped), %serial
+# consumes this iteration's dot (exposed); plus an entry-level flush.
+_SCHED_HLO = """\
+HloModule sched_test
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %r = f32[] add(f32[] %a, f32[] %b)
+}
+
+%cond (cp: (s32[], f32[256], f32[256], f32[256])) -> pred[] {
+  %cp = (s32[], f32[256], f32[256], f32[256]) parameter(0)
+  %ci = s32[] get-tuple-element((s32[], f32[256], f32[256], f32[256]) %cp), index=0
+  %lim = s32[] constant(4)
+  ROOT %lt = pred[] compare(s32[] %ci, s32[] %lim), direction=LT
+}
+
+%body (bp: (s32[], f32[256], f32[256], f32[256])) -> (s32[], f32[256], f32[256], f32[256]) {
+  %bp = (s32[], f32[256], f32[256], f32[256]) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[256], f32[256], f32[256]) %bp), index=0
+  %one = s32[] constant(1)
+  %ni = s32[] add(s32[] %i, s32[] %one)
+  %x = f32[256] get-tuple-element((s32[], f32[256], f32[256], f32[256]) %bp), index=1
+  %carry = f32[256] get-tuple-element((s32[], f32[256], f32[256], f32[256]) %bp), index=2
+  %resh = f32[256] reshape(f32[256] %carry)
+  %pipelined = f32[256] all-reduce(f32[256] %resh), channel_id=1, replica_groups={{0,2},{1,3}}, use_global_device_ids=true, to_apply=%add
+  %m = f32[16,16] reshape(f32[256] %x)
+  %d = f32[16,16] dot(f32[16,16] %m, f32[16,16] %m), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %flatd = f32[256] reshape(f32[16,16] %d)
+  %serial = f32[256] all-reduce(f32[256] %flatd), channel_id=2, replica_groups={{0,2},{1,3}}, use_global_device_ids=true, to_apply=%add
+  ROOT %bt = (s32[], f32[256], f32[256], f32[256]) tuple(s32[] %ni, f32[256] %x, f32[256] %serial, f32[256] %pipelined)
+}
+
+ENTRY %main (p0: f32[256], p1: f32[256]) -> f32[256] {
+  %p0 = f32[256] parameter(0)
+  %p1 = f32[256] parameter(1)
+  %zero = s32[] constant(0)
+  %t = (s32[], f32[256], f32[256], f32[256]) tuple(s32[] %zero, f32[256] %p0, f32[256] %p1, f32[256] %p1)
+  %w = (s32[], f32[256], f32[256], f32[256]) while((s32[], f32[256], f32[256], f32[256]) %t), condition=%cond, body=%body, backend_config={"known_trip_count":{"n":"4"}}
+  %res = f32[256] get-tuple-element((s32[], f32[256], f32[256], f32[256]) %w), index=3
+  ROOT %flush = f32[256] all-reduce(f32[256] %res), channel_id=3, replica_groups={{0,2},{1,3}}, use_global_device_ids=true, to_apply=%add
+}
+"""
+
+
+def _sched_coords():
+    return shardcheck.MeshCoords({"dp": 4}, n_slices=2)
+
+
+def test_overlap_report_sync_classification():
+    """The sync closure rule on the seeded module: the loop-carried
+    all-reduce is overlapped (trip-weighted ×4), the dot-fed one and
+    the entry flush exposed. All three move the same 512 modeled DCN
+    bytes per issue (1024B result × (1 − 1/2))."""
+    rep = shardcheck.overlap_report(_SCHED_HLO, _sched_coords())
+    per_issue = 1024 // 2
+    assert rep["dcn_overlapped_bytes"] == 4 * per_issue
+    assert rep["dcn_exposed_bytes"] == 4 * per_issue + per_issue
+    assert 0 < rep["overlap_ratio"] < 1
+    verdicts = {r["line"]: r["overlapped"] for r in rep["ops"]}
+    assert list(verdicts.values()).count(True) == 1
+
+
+def test_overlap_report_async_pairs():
+    """The async rule: a ``-start``/``-done`` pair with an independent
+    dot in the same computation is overlapped; when the only compute
+    consumes the ``-done`` (or feeds the ``-start``), it is exposed."""
+    hidden = """\
+HloModule async_ok
+
+ENTRY %main (p0: f32[256], p1: f32[16,16]) -> (f32[512], f32[16,16]) {
+  %p0 = f32[256] parameter(0)
+  %p1 = f32[16,16] parameter(1)
+  %ags = (f32[256], f32[512]) all-gather-start(f32[256] %p0), channel_id=1, replica_groups={{0,2},{1,3}}, dimensions={0}, use_global_device_ids=true
+  %d = f32[16,16] dot(f32[16,16] %p1, f32[16,16] %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %agd = f32[512] all-gather-done((f32[256], f32[512]) %ags)
+  ROOT %out = (f32[512], f32[16,16]) tuple(f32[512] %agd, f32[16,16] %d)
+}
+"""
+    serial = hidden.replace("async_ok", "async_serial").replace(
+        "dot(f32[16,16] %p1", "dot(f32[16,16] %dep"
+    ).replace(
+        "%d = f32[16,16] ",
+        "%mat = f32[16,16] reshape(f32[512] %agd)\n"
+        "  %dep = f32[16,16] slice(f32[16,16] %mat), "
+        "slice={[0:16], [0:16]}\n  %d = f32[16,16] ",
+    )
+    coords = _sched_coords()
+    rep_ok = shardcheck.overlap_report(hidden, coords)
+    assert rep_ok["dcn_overlapped_bytes"] > 0
+    assert rep_ok["dcn_exposed_bytes"] == 0
+    rep_bad = shardcheck.overlap_report(serial, coords)
+    assert rep_bad["dcn_overlapped_bytes"] == 0
+    assert rep_bad["dcn_exposed_bytes"] > 0
+
+
+def test_sc006_serialized_program_fails_overlap_contract():
+    """satellite (seeded shardcheck regression): a program whose DCN
+    exchange was deliberately re-serialized — the loop-carried
+    all-reduce now consumes the CURRENT iteration's dot — fails the
+    overlap contract on BOTH arms (exposed bytes grew, ratio
+    dropped); the faithful program passes the same contract."""
+    good_rep = shardcheck.overlap_report(_SCHED_HLO, _sched_coords())
+    contract = {
+        "config_hash": "h", "n_slices": 2,
+        "census": {}, "overlap": {
+            "dcn_exposed_bytes": good_rep["dcn_exposed_bytes"],
+            "dcn_overlapped_bytes": good_rep["dcn_overlapped_bytes"],
+            "overlap_ratio": good_rep["overlap_ratio"],
+        },
+    }
+    # re-serialize: feed the pipelined all-reduce from the dot instead
+    # of the loop carry
+    serialized = _SCHED_HLO.replace(
+        "all-reduce(f32[256] %resh)", "all-reduce(f32[256] %flatd)"
+    )
+    mk = lambda hlo: shardcheck.StepProgram(  # noqa: E731
+        label="t", axis_sizes={"dp": 4}, hlo=hlo, config_hash="h",
+        n_slices=2, overlap=True,
+    )
+    assert shardcheck.check_overlap_against_contract(
+        mk(_SCHED_HLO), contract
+    ) == []
+    v = shardcheck.check_overlap_against_contract(
+        mk(serialized), contract
+    )
+    assert len(v) == 2 and all(x.rule == "SC006" for x in v)
+    assert any("re-serialized" in x.message for x in v)
+    assert any("overlap_ratio dropped" in x.message for x in v)
+    # a contract with no overlap section (pre-overlap vintage) or a
+    # different config hash stays silent
+    assert shardcheck.check_overlap_against_contract(
+        mk(serialized), {"config_hash": "h", "census": {}}
+    ) == []
+    other = dict(contract, config_hash="other")
+    assert shardcheck.check_overlap_against_contract(
+        mk(serialized), other
+    ) == []
 
 
 def test_sc001_dcn_veto():
@@ -456,5 +776,7 @@ def test_cli_passes_checked_in_2slice_contracts():
     from dlrover_tpu.lint.__main__ import main as lint_main
 
     assert lint_main(
-        ["--hlo", "dp4+2slice", "--hlo", "dp4+2slice+zero1"]
+        ["--hlo", "dp4+2slice", "--hlo", "dp4+2slice+zero1",
+         "--hlo", "dp4+2slice+overlap",
+         "--hlo", "dp4+2slice+overlap+zero1"]
     ) == 0
