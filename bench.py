@@ -26,6 +26,7 @@ Prints ONE json line:
    "vs_baseline": <ours / 0.40 reference-class GPU MFU>, "detail": {...}}
 """
 
+import contextlib
 import json
 import os
 import shutil
@@ -132,20 +133,35 @@ def _bench_candidates(llama, jnp):
     # gated on the same DLROVER_TPU_CHUNKED_CE kill-switch as the op, so
     # a bisection run with =0 sweeps the known-fitting dense candidates.
     from dlrover_tpu.ops.chunked_ce import chunked_ce_enabled
+    from dlrover_tpu.ops.fused_ce import fused_ce_available, fused_ce_enabled
 
     unlocked = []
+    # Fused-CE Pallas kernel (ops/fused_ce.py): the whole CE loss in
+    # VMEM, no per-chunk logits HBM round-trip. TPU-gated — off-TPU the
+    # dispatcher falls back to the chunked scan, so a CPU candidate
+    # named _fce would silently measure the chunked program. The _cce
+    # counterpart below pins FUSED_CE off (candidate entry 5th element:
+    # flag overrides), so fce-vs-cce is a real kernel A/B on the same
+    # config and the sweep's winner records which kernel earned the
+    # headline.
+    if fused_ce_enabled() and fused_ce_available():
+        unlocked += [
+            ("llama_1.2B_seq2k_b16_mlp_q512k1024_fce",
+             b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024),
+             16, 2048, {"FUSED_CE": True}),
+        ]
     if chunked_ce_enabled():
-        unlocked = [
+        unlocked += [
             # doubled batch over the r5 winner: the freed logits HBM fits
             # the extra activations under mlp-remat
             ("llama_1.2B_seq2k_b16_mlp_q512k1024_cce",
              b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024),
-             16, 2048),
+             16, 2048, {"FUSED_CE": False}),
             # seq 4k at the winner's batch: doubles the CREDITED causal
             # attention flops per token; fits only without dense logits
             ("llama_1.2B_seq4k_b4_mlp_q512k1024_cce",
              b12(remat_policy="mlp", attn_block_q=512, attn_block_k=1024,
-                 max_seq_len=4096), 4, 4096),
+                 max_seq_len=4096), 4, 4096, {"FUSED_CE": False}),
         ]
     # Ordered by expected MFU: the metric credits MODEL flops only, so
     # recompute is pure loss — full-remat burns ~33% uncredited flops,
@@ -182,22 +198,36 @@ def _bench_candidates(llama, jnp):
     ]
 
 
-def _run_mfu(jax, jnp, llama, cfg, micro_batch: int, seq: int, steps: int):
+def _run_mfu(jax, jnp, llama, cfg, micro_batch: int, seq: int, steps: int,
+             attn_block_q: int = 0, attn_block_k: int = 0):
     """Build trainer + state, time `steps` donated train steps. Returns
     (trainer, state, batch, mean_step_seconds, per_step_seconds).
-    Raises on OOM."""
+    Raises on OOM. ``attn_block_q``/``attn_block_k`` are the TrainConfig
+    flash-tile knobs — non-zero values override the model config's
+    tiling (the autotune sweep's lever)."""
+    import dataclasses
+
     from dlrover_tpu.parallel import MeshConfig, build_mesh
     from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
+
+    tc = TrainConfig(
+        global_batch_size=micro_batch, micro_batch_size=micro_batch,
+        warmup_steps=0, total_steps=10_000,
+        attn_block_q=attn_block_q, attn_block_k=attn_block_k,
+    )
+    # the TrainConfig knobs override the model default (0 = keep)
+    tiles = {}
+    if tc.attn_block_q:
+        tiles["attn_block_q"] = tc.attn_block_q
+    if tc.attn_block_k:
+        tiles["attn_block_k"] = tc.attn_block_k
+    if tiles:
+        cfg = dataclasses.replace(cfg, **tiles)
 
     mc = MeshConfig(dp=1, fsdp=1, sp=1, tp=1).resolve(1)
     mesh = build_mesh(mc, devices=jax.devices()[:1])
     params = jax.jit(lambda k: llama.init_params(cfg, k))(jax.random.key(0))
     jax.block_until_ready(params)
-
-    tc = TrainConfig(
-        global_batch_size=micro_batch, micro_batch_size=micro_batch,
-        warmup_steps=0, total_steps=10_000,
-    )
     # mesh=None in the loss: single chip wants the plain-gather embedding
     trainer = ElasticTrainer(
         lambda p, t: llama.loss_fn(p, t, cfg, None), llama.param_specs(cfg),
@@ -257,6 +287,73 @@ def _comm_census(trainer) -> dict:
         return shardcheck.collective_census(compiled.as_text(), coords)
     except Exception as e:  # telemetry only
         return {"error": str(e)[:200]}
+
+
+def _kernel_breakdown(trainer, step_s: float) -> dict:
+    """Per-kernel attribution of the winner's measured step time
+    (profiler/kernel_ledger): walk the compiled step's optimized HLO,
+    classify every attributable site onto the census operator names
+    (attention fwd/bwd, ce fwd/bwd, matmul, comm.*, optimizer) and
+    distribute ``step_s`` by roofline weight. ``top`` is the smallest
+    prefix covering >= 80% of the step — the MFU-gap shortlist. Warm
+    (``lower_step`` cache hit) and telemetry only: never fails a bench
+    phase. Also records into the kernel-ledger singleton, so a bench
+    process serving /metrics exports dlrover_tpu_kernel_seconds_total."""
+    try:
+        from dlrover_tpu.profiler import kernel_ledger
+
+        compiled, _ = trainer.lower_step(trainer.mesh, trainer.mesh_config)
+        rows = kernel_ledger.capture_step(compiled, step_s)
+        top = kernel_ledger.top_k(rows)
+        # coverage counts the NAMED prefix only — the folded tail row
+        # is the loud remainder, not part of the >=80 % claim
+        named = [r for r in top if not r.get("tail")]
+        return {
+            "top": [
+                {"op": r["op"], "seconds": round(r["seconds"], 6),
+                 "share": round(r["share"], 4), "sites": r["sites"]}
+                for r in top
+            ],
+            "covered_share": round(sum(r["share"] for r in named), 4),
+            "ops_total": len(rows),
+        }
+    except Exception as e:  # telemetry only
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
+def _attn_tiling_sweep(jax, jnp, llama, cfg, micro: int, seq: int,
+                       steps: int, base_step_s: float, on_tpu: bool) -> dict:
+    """Measured flash-attention tile autotune on the mfu winner: re-run
+    the SAME winning candidate under alternative (block_q, block_k)
+    tilings via the TrainConfig knobs and keep each leg's step seconds.
+    The llama.py tile defaults are a VMEM-budget guess — this makes the
+    choice a measured number per hardware generation. TPU-only: the CPU
+    path runs reference attention, which ignores the tiles."""
+    if not on_tpu:
+        return {"skipped": "reference attention ignores tile sizes"}
+    base_q = getattr(cfg, "attn_block_q", 0) or 0
+    base_k = getattr(cfg, "attn_block_k", 0) or 0
+    legs = [{"tiling": f"q{base_q}k{base_k}",
+             "step_s": round(base_step_s, 4), "base": True}]
+    for q, k in ((256, 512), (512, 1024), (1024, 1024)):
+        if (q, k) == (base_q, base_k) or len(legs) >= 3:
+            continue
+        try:
+            tr, st, bt, dt, _ = _run_mfu(
+                jax, jnp, llama, cfg, micro, seq, steps,
+                attn_block_q=q, attn_block_k=k,
+            )
+            legs.append({"tiling": f"q{q}k{k}", "step_s": round(dt, 4)})
+            _release(jax, st, bt)
+            del tr, st, bt
+        except NanLossError:
+            raise
+        except Exception as e:  # OOM tilings fall through, recorded
+            legs.append({"tiling": f"q{q}k{k}",
+                         "error": f"{type(e).__name__}: {str(e)[:120]}"})
+    ok = [l for l in legs if "step_s" in l]
+    winner = min(ok, key=lambda l: l["step_s"]) if ok else {}
+    return {"legs": legs, "winner": winner.get("tiling", "")}
 
 
 def _memory_stats(trainer) -> dict:
@@ -631,6 +728,51 @@ LAST_TPU_RESULT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
 )
 
+def _load_cached_tpu_result(path: str = None) -> dict:
+    """The CPU-fallback view of the last real TPU measurement, annotated
+    with its age and a loud staleness flag. ``None`` when there is no
+    (readable) cache.
+
+    - ``age_hours`` distinguishes "the tunnel died minutes after a real
+      measurement this session" from a stale previous-round relic;
+    - ``reconstructed`` is machine-readable provenance, always present:
+      True when the cache entry was hand-rebuilt (e.g. from a killed
+      run's stderr) rather than written by bench.py itself;
+    - ``stale`` marks entries older than the DLROVER_TPU_BENCH_STALE_HOURS
+      horizon (default one week): a months-old cached headline
+      re-surfacing on every CPU run reads like a fresh measurement
+      unless it is loudly marked otherwise.
+    """
+    path = LAST_TPU_RESULT if path is None else path
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            cached = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(cached, dict):
+        return None
+    cached["age_hours"] = round(
+        (time.time() - cached.get("time", 0)) / 3600, 2
+    )
+    cached["reconstructed"] = bool(cached.get("reconstructed", False))
+    from dlrover_tpu.common import flags as _bflags
+
+    stale_after = _bflags.BENCH_STALE_HOURS.get()
+    cached["stale"] = bool(
+        stale_after > 0 and cached["age_hours"] > stale_after
+    )
+    if cached["stale"]:
+        print(
+            f"warning: cached TPU result is {cached['age_hours']:.0f}h "
+            f"old (> {stale_after:.0f}h horizon) — re-run on TPU "
+            "before trusting the cached headline",
+            file=sys.stderr,
+        )
+    return cached
+
+
 KNOWN_PHASES = ("mfu", "ckpt", "interposer", "resize", "multislice")
 
 
@@ -948,6 +1090,45 @@ def _bench_resize(jax, jnp, llama, on_tpu: bool) -> dict:
             out["state"] = _bench_state_transfer(
                 jax, make_trainer, world, target, mc_full, devs, seq, cfg
             )
+
+        # ---- layout leg: same-world dp ↔ dp×fsdp flip ----
+        # The planner's layout_payback action (brain/planner.py
+        # layout_candidates): no membership change, the same chips
+        # re-factorized. Flip A→B pays B's first compile in the first
+        # step; flipping back B→A lands on the executable this very
+        # trainer built minutes ago — the warm in-process remesh a
+        # planner-hinted layout flip is promised. Needs an even world.
+        if target >= 2 and target % 2 == 0:
+            dp_wd = descriptor_for(target)
+            fs_wd = WorldDescriptor.from_axis_sizes(
+                {"dp": target // 2, "fsdp": 2}
+            )
+            tr3, state3, batch3 = make_trainer(target)
+            st3, l3 = tr3.step(state3, batch3)  # dp-layout compile
+            jax.block_until_ready(l3)
+            drop(st3, batch3)
+            del state3  # donated into the step above
+
+            def flip(wd):
+                tr3.remesh(mesh_for(wd, devices=devs), config_for(wd))
+                s, b = place_for(tr3)
+                t0 = time.perf_counter()
+                ns, loss = tr3.step(s, b)
+                jax.block_until_ready(loss)
+                dt = time.perf_counter() - t0
+                drop(ns, b)
+                return dt
+
+            flip_to_s = flip(fs_wd)    # pays the fsdp-layout compile
+            flip_back_s = flip(dp_wd)  # warm: the dp executable is cached
+            out["layout"] = {
+                "from": dp_wd.spec,
+                "to": fs_wd.spec,
+                "flip_to_s": round(flip_to_s, 4),
+                "flip_back_warm_s": round(flip_back_s, 4),
+                "warm_hit": bool(flip_back_s <= flip_to_s),
+            }
+            del tr3, batch3
     finally:
         if saved_kill is None:
             os.environ.pop(wc.ENV_KILL_SWITCH, None)
@@ -1041,16 +1222,32 @@ def main():
     max_measured = 3 if on_tpu else 1
     if any("_cce" in c[0] for c in candidates):
         max_measured += 1
+    if any("_fce" in c[0] for c in candidates):
+        # the fused-CE kernel candidate is speculative too: widen so
+        # it cannot evict a known-fitting chunked config from the sweep
+        max_measured += 1
     if "mfu" not in phases:
         # phase excluded: one candidate still builds (the later phases
         # and the JSON contract need a winner), but the multi-candidate
         # sweep is skipped and phases_done won't claim "mfu"
         max_measured = 1
-    for name, cand, cand_micro, cand_seq in candidates:
+    from dlrover_tpu.common import flags as _flags
+
+    for entry in candidates:
+        name, cand, cand_micro, cand_seq = entry[:4]
+        # optional 5th element: env-flag overrides for this candidate
+        # (the fused-vs-chunked CE A/B); scoped so a candidate's pin
+        # never leaks into the next one's trace
+        overrides = entry[4] if len(entry) > 4 else {}
         try:
-            c_trainer, c_state, c_batch, c_step_s, c_samples = _run_mfu(
-                jax, jnp, llama, cand, cand_micro, cand_seq, timed_steps
-            )
+            with contextlib.ExitStack() as cand_stack:
+                for flag_name, value in overrides.items():
+                    cand_stack.enter_context(
+                        getattr(_flags, flag_name).scoped(value)
+                    )
+                c_trainer, c_state, c_batch, c_step_s, c_samples = _run_mfu(
+                    jax, jnp, llama, cand, cand_micro, cand_seq, timed_steps
+                )
         except NanLossError:
             raise
         except Exception as e:
@@ -1080,7 +1277,7 @@ def main():
         cand_digest = digest_of(c_samples) or {}
         results.append(
             (rate, name, cand, cand_micro, cand_seq, c_step_s, cand_hbm,
-             cand_digest)
+             cand_digest, overrides)
         )
         measured += 1
         _free(c_state, c_batch)
@@ -1093,9 +1290,29 @@ def main():
     model_name = "none"
     cfg = None
     win_digest = {}
+    attn_tiling = {"skipped": "no winner"}
     if results:
-        _, model_name, cfg, micro, seq, step_s, _, win_digest = max(
-            results, key=lambda r: r[0]
+        (_, model_name, cfg, micro, seq, step_s, _, win_digest,
+         win_overrides) = max(results, key=lambda r: r[0])
+        # the winner's flag pins stay in force for the REST of the
+        # bench (never exited — the process ends with main): the ckpt /
+        # interposer phases re-step this exact program, and a _cce
+        # winner re-traced under the ambient fused-CE default would be
+        # a different program than the one that won
+        win_stack = contextlib.ExitStack()
+        for flag_name, value in win_overrides.items():
+            win_stack.enter_context(
+                getattr(_flags, flag_name).scoped(value)
+            )
+        # flash-tile autotune on the winner, BEFORE its rebuild below
+        # holds HBM again (each leg builds a full trainer of its own)
+        attn_tiling = (
+            _attn_tiling_sweep(
+                jax, jnp, llama, cfg, micro, seq, timed_steps, step_s,
+                on_tpu,
+            )
+            if "mfu" in phases
+            else {"skipped": "mfu not in DLROVER_BENCH_PHASES"}
         )
         # rebuild the winner (its arrays were freed during the sweep) for
         # the flash-checkpoint measurement below; untimed
@@ -1138,13 +1355,21 @@ def main():
             {"name": n, "model_tflops": round(r / 1e12, 2),
              "step_s": round(t, 4),
              "step_p50_s": dg.get("p50_s"), "step_p95_s": dg.get("p95_s"),
-             "hbm": h}
-            for r, n, _, _, _, t, h, dg in results
+             "hbm": h,
+             **({"flags": {k: v for k, v in ov.items()}} if ov else {})}
+            for r, n, _, _, _, t, h, dg, ov in results
         ],
         "phases_done": ["mfu"] if "mfu" in phases else [],
         # ckpt/interposer re-measure THIS program, so one census covers
         # the three same-program phases; resize records its own below
         "collective_census": _comm_census(trainer),
+        # where the measured step seconds actually go, by operator —
+        # the top rows cover >= 80% of the step, so "what do we tune
+        # next for MFU" is read straight off the bench JSON
+        "kernel_breakdown": _kernel_breakdown(trainer, step_s),
+        # measured flash-tile autotune on the winner (TPU-only legs,
+        # run above before the winner rebuild re-occupied HBM)
+        "attn_tiling": attn_tiling,
         # XLA's HBM accounting for the winner, plus the zero-1 on/off
         # comparison on the same (tiny model, full-world dp mesh,
         # batch) — the measured form of the moment-sharding and
@@ -1367,26 +1592,10 @@ def main():
         # remember the last real-TPU measurement so a CPU fallback run
         # (wedged tunnel) can still surface it — clearly marked as cached
         _persist_last(result)
-    elif os.path.exists(LAST_TPU_RESULT):
-        try:
-            with open(LAST_TPU_RESULT) as f:
-                cached = json.load(f)
-            if isinstance(cached, dict):
-                # age distinguishes "the tunnel died minutes after a real
-                # measurement this session" from a stale previous-round
-                # relic
-                cached["age_hours"] = round(
-                    (time.time() - cached.get("time", 0)) / 3600, 2
-                )
-                # machine-readable provenance, always present: True when
-                # the cache entry was hand-rebuilt (e.g. from a killed
-                # run's stderr) rather than written by bench.py itself
-                cached["reconstructed"] = bool(
-                    cached.get("reconstructed", False)
-                )
-                detail["last_tpu_run_cached"] = cached
-        except (OSError, ValueError):
-            pass
+    else:
+        cached = _load_cached_tpu_result()
+        if cached is not None:
+            detail["last_tpu_run_cached"] = cached
     print(json.dumps(result))
     return 0
 

@@ -80,6 +80,17 @@ class PlannerInputs:
     #: schedule hides behind compute; −1.0 = unmeasured). Discounts the
     #: DCN cost term: overlapped bytes don't stretch the step.
     overlap_ratio: float = -1.0
+    #: the seated world's layout (contract spec, e.g. "dp2xfsdp2+zero1");
+    #: "" = unknown, treated as the pure-dp default layout
+    layout_spec: str = ""
+    #: per-operator share of step time from the kernel ledger
+    #: ({"matmul": 0.6, "comm.all-reduce": 0.1, ...}; {} = unmeasured).
+    #: The layout scorer reads the comm.* share — without it the
+    #: planner cannot tell comm-bound from compute-bound and HOLDs on
+    #: layout flips (it never guesses).
+    kernel_breakdown: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
     #: measured average downtime one membership change costs this job
     resize_cost_s: float = 0.0
     #: ranks the step-digest detector currently flags
@@ -111,6 +122,11 @@ class PlannerInputs:
             "comm_links": {k: int(v) for k, v in self.comm_links.items()},
             "dcn_share": round(self.dcn_share, 4),
             "overlap_ratio": round(self.overlap_ratio, 4),
+            "layout_spec": self.layout_spec,
+            "kernel_breakdown": {
+                k: round(float(v), 4)
+                for k, v in sorted(self.kernel_breakdown.items())
+            },
             "resize_cost_s": round(self.resize_cost_s, 3),
             "stragglers": sorted(self.stragglers),
             "downtime_open": bool(self.downtime_open),
@@ -145,6 +161,7 @@ class GoodputPlanner:
         decide_interval_s: Optional[float] = None,
         min_gain_frac: float = 0.02,
         hbm_headroom_frac: float = 0.10,
+        layout_cost_s: float = 5.0,
         hbm_capacity_gb: Optional[float] = None,
         dcn_gbps: Optional[float] = None,
         default_resize_cost_s: float = 30.0,
@@ -185,6 +202,11 @@ class GoodputPlanner:
         )
         self.min_gain_frac = float(min_gain_frac)
         self.hbm_headroom_frac = float(hbm_headroom_frac)
+        #: cost charged for a SAME-world layout flip: a warm in-process
+        #: remesh (the target layout is speculation-hinted, so the step
+        #: re-lower is a warm cache hit), not a membership change — far
+        #: cheaper than resize_cost_s, but never free
+        self.layout_cost_s = float(layout_cost_s)
         self._dcn_bytes_per_s = float(
             dcn_gbps if dcn_gbps is not None else flags.PLANNER_DCN_GBPS.get()
         ) * 1e9
@@ -280,6 +302,18 @@ class GoodputPlanner:
             inputs.resize_cost_s = self._sm.avg_downtime()
             inputs.stragglers = list(self._sm.stragglers())
             inputs.downtime_open = self._sm.downtime_in_progress()
+            # per-kernel shares (the workers' kernel ledger, relayed by
+            # the speed monitor when wired) — optional: an older monitor
+            # without the method leaves the breakdown unmeasured and
+            # the layout scorer inert
+            kb = getattr(self._sm, "kernel_breakdown", None)
+            if callable(kb):
+                inputs.kernel_breakdown = {
+                    str(k): float(v) for k, v in (kb() or {}).items()
+                }
+            layout = getattr(self._sm, "layout_spec", None)
+            if callable(layout):
+                inputs.layout_spec = str(layout() or "")
         if self._job_context is not None and self._hbm_capacity_bytes > 0:
             # the workers' reported per-device HBM occupancy (max
             # across the fleet — the tightest device gates a shrink)
@@ -345,6 +379,14 @@ class GoodputPlanner:
         base = inputs.step_p50_s
         if base <= 0 or inputs.world <= 0:
             return 0.0
+        if (
+            wd.world_size == inputs.world
+            and wd.spec != self._current_spec(inputs)
+        ):
+            # same chips, different mesh factorization: the world-ratio
+            # model below would predict zero change — the layout model
+            # scores the comm-share delta instead
+            return self.predict_layout_step_time(wd, inputs)
         # only EXPOSED DCN bytes sit on the critical path: the fleet's
         # reported overlap_ratio discounts the transfer seconds the
         # schedule hides behind compute (−1 sentinel = no discount)
@@ -364,6 +406,74 @@ class GoodputPlanner:
             if self._dcn_bytes_per_s > 0 else 0.0
         )
         return compute * (inputs.world / wd.world_size) + dcn_next
+
+    def _current_spec(self, inputs: PlannerInputs) -> str:
+        """The seated world's layout spec: the reported one, else the
+        pure-dp default descriptor for (world, n_slices)."""
+        if inputs.layout_spec:
+            return inputs.layout_spec
+        wd = self._descriptor(inputs.world, inputs.n_slices)
+        return wd.spec if wd is not None else ""
+
+    @staticmethod
+    def _layout_comm_ratio(wd: WorldDescriptor) -> float:
+        """Relative per-step ICI comm volume of a layout, in units of
+        the global parameter bytes P (ring-collective cost model,
+        docs/design/kernels.md):
+
+        - dp axis ``d``: gradient all-reduce ``2(d-1)/d`` on the grad
+          bytes the axis still carries;
+        - fsdp axis ``f``: parameter all-gather fwd+bwd ``2(f-1)/f``
+          plus gradient reduce-scatter ``(f-1)/f``, and the dp-axis
+          all-reduce shrinks to its ``1/f`` shard;
+        - zero-1: one extra sharded-parameter all-gather ``(d-1)/d``
+          after the update.
+
+        A *model*, not a measurement — it only ever scales the comm
+        share the kernel ledger measured, so an error here distorts a
+        fraction of a fraction of the step."""
+        axes = wd.axis_sizes()
+        d = axes.get("dp", 1)
+        f = axes.get("fsdp", 1)
+        grads = 2.0 * (d - 1) / d / f
+        params = (2.0 * (f - 1) / f + (f - 1) / f) if f > 1 else 0.0
+        z1 = (d - 1) / d if wd.zero1 else 0.0
+        return grads + params + z1
+
+    def predict_layout_step_time(
+        self, wd: WorldDescriptor, inputs: PlannerInputs
+    ) -> float:
+        """Predicted p50 step seconds after a SAME-world layout flip:
+        the kernel ledger's measured ``comm.*`` share of the step is
+        rescaled by the layouts' relative comm-volume model; the
+        compute share is untouched (same chips, same per-device flops).
+        No measured breakdown → no predicted change → the gain gate
+        HOLDs (the planner never flips a layout on an unmeasured
+        claim)."""
+        base = inputs.step_p50_s
+        if base <= 0:
+            return 0.0
+        comm_share = sum(
+            v for k, v in inputs.kernel_breakdown.items()
+            if k.startswith("comm.")
+        )
+        comm_share = min(max(comm_share, 0.0), 0.95)
+        if comm_share <= 0:
+            return base
+        cur = self._descriptor_of_spec(self._current_spec(inputs))
+        cur_ratio = self._layout_comm_ratio(cur) if cur is not None \
+            else None
+        if not cur_ratio:
+            return base
+        scale = self._layout_comm_ratio(wd) / cur_ratio
+        return base * (1.0 - comm_share) + base * comm_share * scale
+
+    @staticmethod
+    def _descriptor_of_spec(spec: str) -> Optional[WorldDescriptor]:
+        try:
+            return WorldDescriptor.parse(spec) if spec else None
+        except ValueError:
+            return None
 
     def _hbm_feasible(
         self, wd: WorldDescriptor, inputs: PlannerInputs
@@ -391,13 +501,18 @@ class GoodputPlanner:
         pays back inside the horizon."""
         t_now = inputs.step_p50_s
         t_next = self.predict_step_time(wd, inputs)
+        cur_spec = self._current_spec(inputs)
         if t_now <= 0 or t_next <= 0:
             return {"spec": wd.spec, "world": wd.world_size,
-                    "score": 1.0 if wd.world_size == inputs.world else 0.0,
+                    "score": 1.0 if wd.spec == cur_spec else 0.0,
                     "t_pred_s": round(t_next, 6), "payback_s": None}
         cost = 0.0
         if wd.world_size != inputs.world:
             cost = inputs.resize_cost_s or self.default_resize_cost_s
+        elif wd.spec != cur_spec:
+            # same-world layout flip: a warm in-process remesh, not a
+            # membership change
+            cost = self.layout_cost_s
         horizon = max(self.horizon_s, cost)
         steps_next = max(0.0, horizon - cost) / t_next
         steps_now = horizon / t_now
@@ -465,8 +580,17 @@ class GoodputPlanner:
             raw.append((shrink, slices))
         out: List[WorldDescriptor] = []
         seen = set()
+        seen_nodes = set()
+        # the HOLD baseline must be the CURRENT layout, not the pure-dp
+        # default of the same size — a zero1/fsdp fleet scored against
+        # the wrong incumbent would mistake the flip for a hold
+        cur = self._descriptor_of_spec(self._current_spec(inputs))
+        if cur is not None and cur.world_size == world:
+            out.append(cur)
+            seen.add(cur.spec)
+            seen_nodes.add(world)
         for nodes, slices in raw:
-            if nodes < max(1, inputs.min_nodes) or nodes in seen:
+            if nodes < max(1, inputs.min_nodes) or nodes in seen_nodes:
                 continue
             if inputs.max_nodes > 0 and nodes > inputs.max_nodes:
                 continue
@@ -475,8 +599,52 @@ class GoodputPlanner:
                 continue
             if not self._hbm_feasible(wd, inputs):
                 continue
-            seen.add(nodes)
+            seen_nodes.add(nodes)
+            seen.add(wd.spec)
             out.append(wd)
+        for wd in self.layout_candidates(inputs):
+            if wd.spec not in seen:
+                seen.add(wd.spec)
+                out.append(wd)
+        return out
+
+    def layout_candidates(
+        self, inputs: PlannerInputs
+    ) -> List[WorldDescriptor]:
+        """SAME-world candidates that re-factorize the mesh instead of
+        changing membership: dp↔fsdp splits of the seated node count
+        and the zero-1 toggle on the current factorization. Acting on
+        one is a warm in-process remesh (the speculation hint carries
+        the target spec, so workers warm-compile it), not a resize.
+        Single-slice worlds only — a multislice layout flip also moves
+        the DCN schedule and is a different decision."""
+        world = inputs.world
+        if world <= 0 or inputs.n_slices > 1:
+            return []
+        cur = self._descriptor_of_spec(self._current_spec(inputs))
+        out: List[WorldDescriptor] = []
+
+        def _add(axes: Dict[str, int], zero1: bool):
+            try:
+                wd = WorldDescriptor.from_axis_sizes(
+                    dict(axes), n_slices=1, zero1=zero1
+                )
+            except ValueError:
+                return
+            if cur is None or wd.spec != cur.spec:
+                out.append(wd)
+
+        cur_axes = cur.axis_sizes() if cur is not None else {"dp": world}
+        cur_z1 = cur.zero1 if cur is not None else False
+        # dp <-> fsdp factorizations of the same node count
+        for f in (1, 2, 4, 8):
+            if f < world and world % f == 0:
+                axes = {"dp": world // f}
+                if f > 1:
+                    axes["fsdp"] = f
+                _add(axes, cur_z1)
+        # the zero-1 toggle on the current factorization
+        _add(cur_axes, not cur_z1)
         return out
 
     # -- the decision ------------------------------------------------------
@@ -531,10 +699,20 @@ class GoodputPlanner:
             last_exec = self._last_exec_ts
         if intent is not None:
             target = intent.world_size
-            satisfied = (
-                inputs.world >= target if target >= intent_from
-                else inputs.world <= target
-            )
+            if target == intent_from:
+                # a layout intent: the node count never moves, so
+                # "seated" means the fleet reports the target layout —
+                # a layout-blind fleet satisfies immediately (the act
+                # path is an in-process remesh; nothing to wait on)
+                satisfied = (
+                    not inputs.layout_spec
+                    or inputs.layout_spec == intent.spec
+                )
+            else:
+                satisfied = (
+                    inputs.world >= target if target >= intent_from
+                    else inputs.world <= target
+                )
             reachable = inputs.world + max(0, inputs.waiting)
             expired = (
                 # the capacity the intent targeted died before adoption:
@@ -583,12 +761,17 @@ class GoodputPlanner:
         scores = [self.score(wd, inputs) for wd in cands]
         by_spec = {wd.spec: wd for wd in cands}
         best = max(scores, key=lambda s: (s["score"], -s["world"]))
+        # the HOLD baseline is the current LAYOUT, not just the current
+        # world size: same-world layout candidates share the size and
+        # must not be mistaken for the incumbent
+        cur_spec = self._current_spec(inputs)
         current_score = next(
-            (s for s in scores if s["world"] == inputs.world), None
+            (s for s in scores if s["spec"] == cur_spec),
+            next((s for s in scores if s["world"] == inputs.world), None),
         )
         baseline = current_score["score"] if current_score else 1.0
         if (
-            best["world"] == inputs.world
+            best["spec"] == cur_spec
             or best["score"] < baseline * (1.0 + self.min_gain_frac)
         ):
             self._reset_streak()
@@ -608,8 +791,13 @@ class GoodputPlanner:
                 payback=best.get("payback_s"),
             )
         self._reset_streak()
+        target = by_spec[best["spec"]]
+        reason = (
+            "layout_payback" if target.world_size == inputs.world
+            else "payback"
+        )
         return record(
-            RESIZE, "payback", target=by_spec[best["spec"]],
+            RESIZE, reason, target=target,
             scores=scores, payback=best.get("payback_s"),
         )
 

@@ -68,12 +68,21 @@ class EnvFlag:
             )
             return self.default
 
+    def _stringify(self, value: Any) -> str:
+        # bools must round-trip through "1"/"0": str(False) == "False"
+        # reads back TRUE under the raw != "0" parse, so a scoped(False)
+        # pin would silently leave the flag on (explicit raw strings
+        # pass through untouched)
+        if self.kind == "bool" and not isinstance(value, str):
+            return "1" if value else "0"
+        return str(value)
+
     def propagate(self, value: Any) -> None:
         """Write the flag back into ``os.environ`` so CHILD processes
         (speculative compile helpers, restarted workers forked from
         this env) inherit it. The registry is the only sanctioned env
         *writer* for its own flags, same as it is the only reader."""
-        os.environ[self.name] = str(value)
+        os.environ[self.name] = self._stringify(value)
 
     @contextlib.contextmanager
     def scoped(self, value: Optional[Any]):
@@ -87,7 +96,7 @@ class EnvFlag:
             if value is None:
                 os.environ.pop(self.name, None)
             else:
-                os.environ[self.name] = str(value)
+                os.environ[self.name] = self._stringify(value)
             yield
         finally:
             if prev is None:
@@ -105,7 +114,9 @@ def child_env(overrides: Optional[Dict[str, Any]] = None) -> Dict[str, str]:
     here instead of cloning ``os.environ`` raw (graftlint JG003)."""
     env = dict(os.environ)
     if overrides:
-        env.update({k: str(v) for k, v in overrides.items()})
+        for k, v in overrides.items():
+            flag = _REGISTRY.get(k)
+            env[k] = flag._stringify(v) if flag is not None else str(v)
     return env
 
 
@@ -167,6 +178,19 @@ CHUNKED_CE = _define(
     "DLROVER_TPU_CHUNKED_CE", True, "bool",
     "Chunked fused cross-entropy kill-switch: 0 restores the dense "
     "[B,T,V] logits path (ops/chunked_ce.py). Read at trace time.",
+)
+FUSED_CE = _define(
+    "DLROVER_TPU_FUSED_CE", True, "bool",
+    "Fused-CE Pallas kernel kill-switch: 0 restores the scan-based "
+    "chunked-CE path even on TPU (ops/fused_ce.py). Off-TPU the "
+    "dispatcher falls back to the chunked path regardless. Read at "
+    "trace time.",
+)
+BENCH_STALE_HOURS = _define(
+    "DLROVER_TPU_BENCH_STALE_HOURS", 168.0, "float",
+    "Staleness horizon (hours) for the cached BENCH_TPU_LAST.json "
+    "headline bench re-reports on CPU-only hosts: older entries get "
+    "stale=true and an age warning instead of a silent re-report.",
 )
 COMM_METRICS_PORT = _define(
     "DLROVER_TPU_COMM_METRICS_PORT", None, "int",

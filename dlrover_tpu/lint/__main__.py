@@ -409,6 +409,7 @@ def _run_hlo(args) -> int:
             print(
                 f"shardcheck: contract {spec} rewritten "
                 f"({len(data['census'])} collective cell(s), "
+                f"{len(data['custom_calls'])} kernel target(s), "
                 f"world={program.world}{note})"
             )
             continue
@@ -456,10 +457,12 @@ def _run_hlo(args) -> int:
                 f"overlapped={rep['dcn_overlapped_bytes']}B "
                 f"ratio={rep['overlap_ratio']:.4f}"
             )
+        kernels = shardcheck.custom_call_census(program.hlo)
         print(
             f"shardcheck: {spec} {status} ({len(violations)} violation(s),"
             f" {sum(c['count'] for c in census.values())} collectives over"
-            f" {len(census)} cell(s){overlap_note})"
+            f" {len(census)} cell(s),"
+            f" {len(kernels)} kernel target(s){overlap_note})"
         )
         failed = failed or bool(violations)
     return 1 if failed else 0

@@ -167,6 +167,11 @@ def build_program(
         stack.enter_context(flags.HIER_COLLECTIVES.scoped(None))
         stack.enter_context(flags.OVERLAP_COLLECTIVES.scoped(None))
         stack.enter_context(flags.OVERLAP_BUCKET_MB.scoped(None))
+        # CE path choice is part of the contracted program too: pin the
+        # kernel dispatch flags to their defaults (fused falls back to
+        # chunked off-TPU, so the recorded census is the PR 1 program)
+        stack.enter_context(flags.CHUNKED_CE.scoped(None))
+        stack.enter_context(flags.FUSED_CE.scoped(None))
         trainer, _, _ = build_contract_trainer(
             axis_sizes, zero1=wd.zero1, n_slices=wd.n_slices,
             overlap=wd.overlap,

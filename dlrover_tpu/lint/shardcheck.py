@@ -40,6 +40,13 @@ SC004  output-sharding drift: a donated state input whose paired output
        sharding is missing (left to XLA — free to drift) or different.
 SC005  host transfer inside the jitted step: host callbacks, infeed /
        outfeed, host send/recv.
+SC006  exposed-DCN-bytes: the exposed/overlapped split of slice-boundary
+       transfers diffed against the contract (the overlap schedule's
+       regression gate).
+SC007  custom-call census: every non-benign custom-call (the Pallas /
+       Mosaic kernels) recorded per contract — a contracted kernel
+       vanishing from the lowered step is a silent fallback to the
+       reference path, a new un-contracted one is an unreviewed kernel.
 
 Everything here is text analysis over the two IR strings plus a small
 ``StepProgram`` context object — no jax import, no device use — so the
@@ -130,6 +137,12 @@ _BENIGN_CUSTOM_CALLS = {
 }
 
 _HOST_CALLBACK_HINTS = ("cpu_callback", "host_callback", "py_callback")
+
+#: custom_call targets that ARE the device kernels this repo ships
+#: (Pallas lowers through Mosaic to ``tpu_custom_call``). Never host
+#: transfers — SC005 must not flag them — and exactly what the SC007
+#: census exists to track.
+_DEVICE_KERNEL_HINTS = ("tpu_custom_call", "mosaic", "triton_kernel_call")
 
 
 # ---------------------------------------------------------------------------
@@ -1579,6 +1592,10 @@ def check_host_transfer(program: StepProgram) -> List[Violation]:
             target = tgt.group(1)
             if target in _BENIGN_CUSTOM_CALLS:
                 continue
+            if any(h in target.lower() for h in _DEVICE_KERNEL_HINTS):
+                # a Pallas/Mosaic device kernel: the opposite of a host
+                # transfer. Tracked by the SC007 census, never SC005.
+                continue
             if any(h in target.lower() for h in _HOST_CALLBACK_HINTS):
                 hit = f"host callback custom-call {target}"
         if hit is None:
@@ -1600,6 +1617,122 @@ def check_host_transfer(program: StepProgram) -> List[Violation]:
                     "them out or gate them off for training builds).",
                     line=lineno,
                     snippet=line.strip(),
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SC007 — custom-call census (the kernel contract)
+# ---------------------------------------------------------------------------
+
+_CC_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+_CC_SHAPE_RE = re.compile(r"\b[a-z]+[0-9]*\[[0-9,]*\]")
+
+
+def custom_call_census(hlo_text: str) -> Dict[str, Dict]:
+    """Every non-benign custom-call in the lowered text, keyed by
+    target: ``{target: {"count": n, "sites": ["(operands) -> result"]}}``
+    with ``sites`` the sorted unique shape signatures.
+
+    This is the kernel inventory of the step program. A Pallas kernel
+    that stops lowering (dispatcher flag flipped, ``fused_ce_available``
+    regressed, a jax upgrade changing the Mosaic target name) does not
+    error — the model silently takes the reference path and only the
+    step time notices. Diffing this census against the contract makes
+    the fallback loud. Partitioner plumbing (``Sharding`` & co.) is
+    excluded: it says nothing about kernels and churns with GSPMD
+    internals."""
+    census: Dict[str, Dict] = {}
+    for line in hlo_text.splitlines():
+        if "custom-call" not in line and "custom_call" not in line:
+            continue
+        m = _CC_TARGET_RE.search(line) or re.search(
+            r"stablehlo\.custom_call @([\w.\-]+)", line
+        )
+        if m is None:
+            continue
+        target = m.group(1)
+        if target in _BENIGN_CUSTOM_CALLS:
+            continue
+        head, sep, tail = line.partition("custom-call(")
+        operands = _CC_SHAPE_RE.findall(tail.split(")", 1)[0]) if sep \
+            else []
+        results = _CC_SHAPE_RE.findall(head) if sep else []
+        res = results[0] if len(results) == 1 else \
+            "(" + ", ".join(results) + ")"
+        sig = f"({', '.join(operands)}) -> {res}"
+        entry = census.setdefault(target, {"count": 0, "sites": []})
+        entry["count"] += 1
+        if sig not in entry["sites"]:
+            entry["sites"].append(sig)
+    for entry in census.values():
+        entry["sites"].sort()
+    return census
+
+
+def check_custom_calls_against_contract(
+    program: StepProgram,
+    contract: Dict,
+    census: Optional[Dict[str, Dict]] = None,
+) -> List[Violation]:
+    """Diff the program's custom-call census against the contract's
+    recorded ``custom_calls`` section.
+
+    Fails on: a contracted kernel target missing from the program (the
+    silent-fallback case — the kernel stopped lowering and nobody
+    noticed); a target the contract has never seen (an un-contracted
+    kernel entered the step); count or operand/result-shape drift in an
+    existing target. Contracts written before SC007 have no
+    ``custom_calls`` section and skip the rule — regenerate with
+    ``--fix-contracts`` to arm it."""
+    want = contract.get("custom_calls")
+    if want is None:
+        return []
+    if contract.get("config_hash") and program.config_hash and \
+            contract["config_hash"] != program.config_hash:
+        return []  # SC001 already reports the hash mismatch
+    if census is None:
+        census = custom_call_census(program.hlo)
+    out: List[Violation] = []
+    for target in sorted(want):
+        if target not in census:
+            out.append(
+                program.violation(
+                    "SC007",
+                    f"contracted kernel {target} vanished from the "
+                    f"lowered step ({want[target]['count']} call(s) in "
+                    "the contract): the program silently fell back to "
+                    "the reference path — check the dispatcher flags "
+                    "and kernel availability, or --fix-contracts if "
+                    "the removal is deliberate.",
+                    snippet=target,
+                )
+            )
+    for target in sorted(census):
+        got = census[target]
+        ref = want.get(target)
+        if ref is None:
+            out.append(
+                program.violation(
+                    "SC007",
+                    f"new custom-call kernel {target}: {got['count']} "
+                    "call(s) not in the contract — contract every "
+                    "kernel the step runs (review, then "
+                    "--fix-contracts).",
+                    snippet=target,
+                )
+            )
+            continue
+        if got["count"] != ref["count"] or \
+                got["sites"] != ref.get("sites", []):
+            out.append(
+                program.violation(
+                    "SC007",
+                    f"kernel {target} drifted from the contract: "
+                    f"count {ref['count']} -> {got['count']}, sites "
+                    f"{ref.get('sites', [])} -> {got['sites']}.",
+                    snippet=target,
                 )
             )
     return out
@@ -1631,6 +1764,7 @@ def check_program(
                 program, contract, byte_tolerance
             )
         )
+        out.extend(check_custom_calls_against_contract(program, contract))
     if program.stablehlo:
         out.extend(check_replicated_large(program, replicated_threshold))
         out.extend(check_replicated_moments(program, replicated_threshold))
@@ -1687,6 +1821,10 @@ def write_contract(
         "world": program.world,
         "config_hash": program.config_hash,
         "census": {k: census[k] for k in sorted(census)},
+        # SC007: the kernel inventory. Empty on CPU-lowered contracts
+        # (no Pallas custom-calls off-TPU) — still armed: a kernel
+        # APPEARING un-contracted fails just like one vanishing.
+        "custom_calls": custom_call_census(program.hlo),
     }
     if program.n_slices > 1:
         # arms the per-cell dcn_bytes diff (the slow-link veto) and
@@ -1742,4 +1880,9 @@ SC_RULES: List[Tuple[str, str, str]] = [
      "the contract's recorded split — vetoes a change that "
      "re-serializes slice-boundary transfers the schedule used to "
      "hide behind compute."),
+    ("SC007", "custom-call-census",
+     "Every non-benign custom-call (Pallas/Mosaic kernel) in the "
+     "lowered step, with operand/result shapes, diffed against the "
+     "contract — a contracted kernel vanishing is a silent fallback "
+     "to the reference path; a new one is un-reviewed."),
 ]

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from dlrover_tpu.ops import (
     apply_rope,
     chunked_ce_enabled,
-    chunked_cross_entropy,
+    cross_entropy_sums,
     embed_lookup,
     flash_attention,
     mha_reference,
@@ -75,7 +75,11 @@ class LlamaConfig:
     attn_impl: str = "auto"   # auto | flash | reference | ring | ulysses
     # flash-attention tile sizes — a hardware tuning knob (MXU is
     # 128x128; longer q tiles amortize the kv-loop overhead when the
-    # per-core sequence is long enough)
+    # per-core sequence is long enough). These defaults are a
+    # VMEM-budget guess, not a measurement: bench.py's mfu phase runs a
+    # tiling sweep (detail.attn_tiling) that times 2-3 tilings on the
+    # winning config, and TrainConfig.attn_block_q/attn_block_k let a
+    # deployment pin what its own chips prefer.
     attn_block_q: int = 128
     attn_block_k: int = 128
     # chunked fused cross-entropy (ops/chunked_ce.py): vocab columns per
@@ -605,8 +609,10 @@ def loss_fn(
         # Shifted-target form (last position's target is the -1 sentinel)
         # computes the head on the same b*s positions the dense path does,
         # so the bench's model-FLOPs accounting is unchanged.
+        # cross_entropy_sums dispatches: Pallas fused-CE kernel on TPU
+        # (ops/fused_ce.py), the chunked scan everywhere else.
         x = forward_hidden(params, tokens, cfg, mesh)
-        nll_sum, n_valid = chunked_cross_entropy(
+        nll_sum, n_valid = cross_entropy_sums(
             x, params["lm_head"], _shift_targets(tokens),
             chunk_size=cfg.ce_chunk_size,
         )
@@ -632,7 +638,11 @@ def _pp_loss(
     # the pp rows unrecorded; this entry runs per call and records are
     # idempotent
     _record_pp_comm(cfg, mesh, tokens.shape[0], tokens.shape[1])
-    return _jitted_pp_loss(cfg, mesh, chunked_ce_enabled())(params, tokens)
+    from dlrover_tpu.ops import fused_ce_enabled
+
+    return _jitted_pp_loss(
+        cfg, mesh, chunked_ce_enabled(), fused_ce_enabled()
+    )(params, tokens)
 
 
 def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
@@ -695,12 +705,14 @@ def _record_pp_comm(cfg: LlamaConfig, mesh: Mesh, b: int, s: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _jitted_pp_loss(cfg: LlamaConfig, mesh: Mesh, chunked_ce: bool):
-    # ``chunked_ce`` is part of the cache KEY only: _head_loss_sums
-    # re-reads the env var at trace time (which happens on the first call
-    # for this key, when the env still matches), so toggling
-    # DLROVER_TPU_CHUNKED_CE between calls retraces instead of silently
-    # reusing the other path's cached program.
+def _jitted_pp_loss(cfg: LlamaConfig, mesh: Mesh, chunked_ce: bool,
+                    fused_ce: bool = True):
+    # ``chunked_ce``/``fused_ce`` are part of the cache KEY only:
+    # _head_loss_sums re-reads the env vars at trace time (which happens
+    # on the first call for this key, when the env still matches), so
+    # toggling DLROVER_TPU_CHUNKED_CE / DLROVER_TPU_FUSED_CE between
+    # calls retraces instead of silently reusing the other path's cached
+    # program.
     return jax.jit(
         functools.partial(_pp_loss_impl, cfg=cfg, mesh=mesh)
     )
@@ -828,7 +840,7 @@ def _head_loss_sums(cfg: LlamaConfig, out, final_norm, lm_head, tgt):
     value_and_grad the 1f1b schedule takes through this function)."""
     h = rms_norm(out, final_norm, cfg.norm_eps)
     if chunked_ce_enabled():
-        return chunked_cross_entropy(
+        return cross_entropy_sums(
             h, lm_head, tgt, chunk_size=cfg.ce_chunk_size
         )
     return _ce_sums_shifted(unembed(h, lm_head), tgt)

@@ -34,7 +34,7 @@ from dlrover_tpu.models import llama
 from dlrover_tpu.ops import (
     apply_rope,
     chunked_ce_enabled,
-    chunked_cross_entropy,
+    cross_entropy_sums,
     embed_lookup,
     rms_norm,
     rope_frequencies,
@@ -345,7 +345,7 @@ def loss_fn(
         # (x.astype(f32) @ lm_head.astype(f32)) — the op casts w to x's
         # dtype, so promoting x keeps chunked-vs-dense numerics identical
         # rather than silently moving MoE to bf16-operand logits
-        nll_sum, n_valid = chunked_cross_entropy(
+        nll_sum, n_valid = cross_entropy_sums(
             x.astype(jnp.float32), params["lm_head"],
             llama._shift_targets(tokens),
             chunk_size=cfg.ce_chunk_size,

@@ -24,7 +24,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.ops.attention import flash_attention, mha_reference
-from dlrover_tpu.ops.chunked_ce import chunked_ce_enabled, chunked_cross_entropy
+from dlrover_tpu.ops.chunked_ce import chunked_ce_enabled
+from dlrover_tpu.ops.fused_ce import cross_entropy_sums
 from dlrover_tpu.ops.norms import rms_norm
 from dlrover_tpu.parallel.mesh import BATCH_AXES, FSDP, TP
 
@@ -233,7 +234,7 @@ def loss_fn(params: Params, batch, cfg: ViTConfig, mesh=None) -> jnp.ndarray:
         # sharing the op keeps the CE semantics (pad < 0, f32 MXU
         # accumulation) defined in exactly one place
         pooled = forward_pooled(params, images, cfg, mesh)
-        nll_sum, n_valid = chunked_cross_entropy(
+        nll_sum, n_valid = cross_entropy_sums(
             pooled, params["head"], labels
         )
         return nll_sum / jnp.maximum(n_valid, 1.0)

@@ -41,7 +41,11 @@ from dlrover_tpu.common.log import logger
 
 #: the span taxonomy (docs/design/observability.md). ``downtime`` is
 #: master-side only (the SpeedMonitor's bracket spans); ``host`` is the
-#: catch-all PyTracer user spans map onto.
+#: catch-all PyTracer user spans map onto; ``kernel`` is the per-kernel
+#: breakdown lane the kernel ledger (profiler/kernel_ledger.py) emits —
+#: its spans nest INSIDE step spans, which is why the kind is absent
+#: from KIND_CATEGORY below (it decomposes "productive", it does not
+#: add to it).
 SPAN_KINDS = (
     "step",
     "compile",
@@ -54,6 +58,7 @@ SPAN_KINDS = (
     "eval",
     "downtime",
     "host",
+    "kernel",
 )
 
 
@@ -293,7 +298,10 @@ def dump_at_exit(role: str = "worker", **meta) -> bool:
 # ---------------------------------------------------------------------------
 
 #: span kind -> lost-time attribution category (the same vocabulary the
-#: master's SpeedMonitor.attribution() uses; docs/design/observability.md)
+#: master's SpeedMonitor.attribution() uses; docs/design/observability.md).
+#: ``kernel`` is deliberately unmapped: kernel spans are a breakdown of
+#: the step spans they nest inside — mapping them to "productive" would
+#: double-count step time in the attribution sums.
 KIND_CATEGORY = {
     "step": "productive",
     "eval": "productive",

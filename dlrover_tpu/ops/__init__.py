@@ -10,6 +10,12 @@ from dlrover_tpu.ops.chunked_ce import (  # noqa: F401
     chunked_ce_enabled,
     chunked_cross_entropy,
 )
+from dlrover_tpu.ops.fused_ce import (  # noqa: F401
+    cross_entropy_sums,
+    fused_ce_available,
+    fused_ce_enabled,
+    fused_cross_entropy,
+)
 from dlrover_tpu.ops.embedding import embed_lookup  # noqa: F401
 from dlrover_tpu.ops.norms import rms_norm  # noqa: F401
 from dlrover_tpu.ops.ring_attention import ring_attention  # noqa: F401

@@ -465,27 +465,32 @@ def flash_attention_with_lse(q, k, v, causal: bool = True,
 
 
 def _flash_with_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
-    if _HAS_PALLAS and (interpret or _on_tpu()):
-        out, lse = _flash_fwd_pallas(q, k, v, causal, block_q, block_k,
-                                     interpret=interpret)
-    else:
-        out, lse = mha_reference_with_lse(q, k, v, causal=causal)
+    # named scope = the kernel ledger's attribution key
+    # (profiler/kernel_ledger.py classifies HLO sites by op_name path)
+    with jax.named_scope("attention_fwd"):
+        if _HAS_PALLAS and (interpret or _on_tpu()):
+            out, lse = _flash_fwd_pallas(q, k, v, causal, block_q,
+                                         block_k, interpret=interpret)
+        else:
+            out, lse = mha_reference_with_lse(q, k, v, causal=causal)
     return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_with_lse_bwd(causal, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     g_out, g_lse = g
-    if _HAS_PALLAS and (interpret or _on_tpu()):
-        return _flash_bwd_pallas(
-            q, k, v, o, lse, g_out, g_lse, causal, block_q, block_k,
-            interpret=interpret,
+    with jax.named_scope("attention_bwd"):
+        if _HAS_PALLAS and (interpret or _on_tpu()):
+            return _flash_bwd_pallas(
+                q, k, v, o, lse, g_out, g_lse, causal, block_q, block_k,
+                interpret=interpret,
+            )
+        _, vjp = jax.vjp(
+            lambda q, k, v: mha_reference_with_lse(q, k, v,
+                                                   causal=causal),
+            q, k, v,
         )
-    _, vjp = jax.vjp(
-        lambda q, k, v: mha_reference_with_lse(q, k, v, causal=causal),
-        q, k, v,
-    )
-    return vjp((g_out, g_lse))
+        return vjp((g_out, g_lse))
 
 
 flash_attention_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)

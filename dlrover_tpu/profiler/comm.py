@@ -346,6 +346,17 @@ def _start_metrics_server(port: int):
                     rows = rows + trace_lines()
                 except Exception:
                     pass
+                try:
+                    # per-kernel step-time attribution: cumulative
+                    # seconds + last-step share per op family, from the
+                    # HLO-walk roofline ledger (profiler/kernel_ledger)
+                    from dlrover_tpu.profiler.kernel_ledger import (
+                        prometheus_lines as kernel_lines,
+                    )
+
+                    rows = rows + kernel_lines()
+                except Exception:
+                    pass
                 body = ("\n".join(rows) + "\n").encode()
                 self.send_response(200)
                 self.send_header("Content-Type",

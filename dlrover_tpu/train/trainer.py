@@ -105,6 +105,14 @@ class TrainConfig:
     # applies; with accum == 1 there is no backward to hide behind and
     # the schedule degenerates to hier's.
     overlap_collectives: bool = True
+    # Flash-attention Pallas tile sizes (ops/attention.py block_q /
+    # block_k). 0 = keep the model config's default (the llama.py
+    # numbers are a VMEM-budget guess, not a measurement — bench.py's
+    # mfu tiling sweep measures 2–3 tilings and reports the winner, so
+    # a deployment pins what its own chips prefer). Callers that build
+    # a model config thread non-zero values into it.
+    attn_block_q: int = 0
+    attn_block_k: int = 0
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -813,19 +821,25 @@ class ElasticTrainer:
                 grads = jax.tree.map(
                     jax.lax.with_sharding_constraint, grads, z1_grad_put
                 )
-            updates, opt_state = self.optimizer.update(
-                grads, state["opt"], state["params"]
-            )
-            lr_scale = state.get("lr_scale")
-            if lr_scale is not None:
-                updates = jax.tree.map(
-                    lambda u: u * lr_scale.astype(u.dtype), updates
+            # named scope = the kernel ledger's attribution key: every
+            # optimizer-update op carries it in HLO metadata, so the
+            # per-kernel breakdown blames "optimizer", not "other"
+            # (profiler/kernel_ledger.py)
+            with jax.named_scope("optimizer_update"):
+                updates, opt_state = self.optimizer.update(
+                    grads, state["opt"], state["params"]
                 )
-            if z1_mode != "off":
-                updates = jax.tree.map(
-                    jax.lax.with_sharding_constraint, updates, z1_grad_put
-                )
-            params = optax.apply_updates(state["params"], updates)
+                lr_scale = state.get("lr_scale")
+                if lr_scale is not None:
+                    updates = jax.tree.map(
+                        lambda u: u * lr_scale.astype(u.dtype), updates
+                    )
+                if z1_mode != "off":
+                    updates = jax.tree.map(
+                        jax.lax.with_sharding_constraint, updates,
+                        z1_grad_put,
+                    )
+                params = optax.apply_updates(state["params"], updates)
             if z1_mode != "off" and gather_fn is not None:
                 # zero-1's second half, hierarchized: pin the summed
                 # params to the zero-1 layout (the add runs on the

@@ -680,3 +680,114 @@ def test_observe_reads_reported_hbm_through_job_context():
         assert p2.observe().hbm_used_bytes == 0.0
     finally:
         JobContext.reset_singleton()
+
+
+# ---------------------------------------------------------------------------
+# same-world layout tuning (ISSUE 17: planner-driven layout flips)
+# ---------------------------------------------------------------------------
+
+
+def test_layout_comm_ratio_model():
+    """The ring-collective volume model (docs/design/kernels.md), in
+    units of the global parameter bytes."""
+    ratio = GoodputPlanner._layout_comm_ratio
+    # pure dp d=4: gradient all-reduce 2(d-1)/d
+    assert ratio(WorldDescriptor.parse("dp4")) == pytest.approx(1.5)
+    # zero-1 adds the post-update sharded-param all-gather (d-1)/d
+    assert ratio(WorldDescriptor.parse("dp4+zero1")) == pytest.approx(
+        1.5 + 0.75
+    )
+    # dp2xfsdp2: grads 2(1/2)/2 = 0.5, params 2(1/2) + 1/2 = 1.5
+    assert ratio(WorldDescriptor.parse("dp2xfsdp2")) == pytest.approx(2.0)
+    # a single node moves nothing
+    assert ratio(WorldDescriptor.parse("dp1")) == 0.0
+
+
+def test_layout_candidates_same_world_only():
+    p = _planner()
+    inputs = _inputs(world=4, layout_spec="dp4+zero1")
+    cands = p.layout_candidates(inputs)
+    specs = {wd.spec for wd in cands}
+    # dp<->fsdp refactorizations keep the current zero-1 setting; the
+    # toggle flips it on the current axes; the incumbent is excluded
+    assert "dp4+zero1" not in specs
+    assert "dp2xfsdp2+zero1" in specs
+    assert "dp4" in specs  # the zero-1 toggle
+    assert all(wd.world_size == 4 for wd in cands)
+    # multislice worlds sit out (a layout flip would move the DCN
+    # schedule too — a different decision)
+    assert p.layout_candidates(
+        _inputs(world=8, n_slices=2, layout_spec="")
+    ) == []
+
+
+def test_predict_layout_step_time_rescales_only_comm_share():
+    p = _planner()
+    kb = {"comm.all-reduce": 0.3, "comm.all-gather": 0.1,
+          "matmul": 0.4, "attention.bwd": 0.2}
+    inputs = _inputs(world=4, layout_spec="dp4+zero1",
+                     kernel_breakdown=kb)
+    # flip dp4+zero1 (ratio 2.25) -> dp4 (ratio 1.5): the measured 40%
+    # comm share scales by 1.5/2.25, compute share untouched
+    t = p.predict_layout_step_time(WorldDescriptor.parse("dp4"), inputs)
+    assert t == pytest.approx(0.6 + 0.4 * (1.5 / 2.25))
+    # no measured breakdown -> no predicted change (never guesses)
+    blind = _inputs(world=4, layout_spec="dp4+zero1")
+    assert p.predict_layout_step_time(
+        WorldDescriptor.parse("dp4"), blind
+    ) == pytest.approx(1.0)
+
+
+def test_layout_flip_resizes_with_layout_payback_reason():
+    """A measured comm-heavy dp4+zero1 fleet flips to dp4 through the
+    normal hysteresis path; the decision lands in the ledger with the
+    layout_payback reason and the same node count."""
+    p = _planner()
+    kb = {"comm.all-reduce": 0.4, "matmul": 0.6}
+    mk = lambda t: _inputs(ts=t, world=4, layout_spec="dp4+zero1",
+                           kernel_breakdown=kb)
+    d = _drive_to_resize(p, mk)
+    assert d["verdict"] == RESIZE
+    assert d["reason"] == "layout_payback"
+    assert d["target"] == "dp4" and d["target_world"] == 4
+    # the incumbent layout is among the scored candidates (the HOLD
+    # baseline), as is the winning same-world flip
+    scored = {s["spec"] for s in d["scores"]}
+    assert {"dp4+zero1", "dp4"} <= scored
+    # the decision is in the ledger with its scores
+    rec = p.export_state()["ledger"][-1]
+    assert rec["verdict"] == RESIZE and rec["reason"] == "layout_payback"
+    assert rec["inputs"]["layout_spec"] == "dp4+zero1"
+    # the speculation hint carries the target layout spec once executed
+    p.note_executed(p.intent(), now=100.0)
+    assert p.speculation_hint()["spec"] == "dp4"
+
+
+def test_layout_flip_holds_without_measured_breakdown():
+    """No kernel breakdown -> the layout model predicts no change ->
+    the gain gate HOLDs. The planner never flips a layout on an
+    unmeasured claim."""
+    p = _planner()
+    mk = lambda t: _inputs(ts=t, world=4, layout_spec="dp4+zero1")
+    d = _drive_to_resize(p, mk)
+    assert d["verdict"] == HOLD
+    assert d["reason"] == "no_paying_candidate"
+
+
+def test_layout_intent_satisfied_by_reported_spec():
+    """A layout intent's node count never moves: 'seated' means the
+    fleet reports the target layout (or is layout-blind)."""
+    p = _planner()
+    kb = {"comm.all-reduce": 0.4, "matmul": 0.6}
+    mk = lambda t: _inputs(ts=t, world=4, layout_spec="dp4+zero1",
+                           kernel_breakdown=kb)
+    d = _drive_to_resize(p, mk)
+    assert d["verdict"] == RESIZE and d["target"] == "dp4"
+    assert p.intent() is not None
+    # still reporting the old layout: the intent stays open
+    p.decide(inputs=mk(200.0))
+    assert p.intent() is not None
+    # the remesh landed: the fleet reports the target spec -> closed
+    p.decide(inputs=_inputs(ts=300.0, world=4, layout_spec="dp4",
+                            kernel_breakdown=kb))
+    assert p.intent() is None

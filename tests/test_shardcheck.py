@@ -478,3 +478,154 @@ def test_hook_off_by_default(contract_setup, monkeypatch):
     trainer.warm.clear()
     compiled, _ = trainer.lower_step(trainer.mesh, trainer.mesh_config)
     assert compiled is not None
+
+
+# ---------------------------------------------------------------------------
+# SC007 — custom-call census (the kernel contract)
+# ---------------------------------------------------------------------------
+
+_KERNEL_HLO = """\
+HloModule jit_step
+
+ENTRY %main.1 (p0: f32[256,512], p1: bf16[512,128]) -> f32[256,8] {
+  %p0 = f32[256,512]{1,0} parameter(0)
+  %p1 = bf16[512,128]{1,0} parameter(1)
+  %cc.1 = f32[256,8]{1,0} custom-call(f32[256,512]{1,0} %p0, bf16[512,128]{1,0} %p1), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/fused_ce_fwd/pallas_call"}
+  %cc.2 = f32[256,8]{1,0} custom-call(f32[256,512]{1,0} %p0, bf16[512,128]{1,0} %p1), custom_call_target="tpu_custom_call", metadata={op_name="jit(step)/attention_fwd/pallas_call"}
+  %sh.1 = f32[256,8]{1,0} custom-call(f32[256,8]{1,0} %cc.1), custom_call_target="Sharding"
+  ROOT %cc.3 = f32[256,8]{1,0} custom-call(f32[256,8]{1,0} %cc.2), custom_call_target="OtherLib"
+}
+"""
+
+
+def test_sc007_census_parses_canned_hlo():
+    census = shardcheck.custom_call_census(_KERNEL_HLO)
+    # the partitioner's Sharding plumbing is benign — never censused
+    assert "Sharding" not in census
+    tcc = census["tpu_custom_call"]
+    assert tcc["count"] == 2
+    # two calls, identical shape signature -> one unique site
+    assert tcc["sites"] == [
+        "(f32[256,512], bf16[512,128]) -> f32[256,8]"
+    ]
+    assert census["OtherLib"]["count"] == 1
+
+
+def test_sc005_never_flags_device_kernels():
+    """A Pallas/Mosaic tpu_custom_call is a DEVICE kernel — the exact
+    opposite of a host transfer. SC005 must stay quiet on it (SC007
+    owns the kernel inventory); a genuine host callback on the same
+    program still fires."""
+    program = shardcheck.StepProgram(
+        label="t", hlo=_KERNEL_HLO, axis_sizes={},
+    )
+    assert shardcheck.check_host_transfer(program) == []
+
+    with_cb = _KERNEL_HLO.replace(
+        'custom_call_target="OtherLib"',
+        'custom_call_target="xla_ffi_python_cpu_callback"',
+    )
+    program = shardcheck.StepProgram(label="t", hlo=with_cb,
+                                     axis_sizes={})
+    v = shardcheck.check_host_transfer(program)
+    assert len(v) == 1 and v[0].rule == "SC005"
+    # and SC007's census still inventories the device kernels next to it
+    assert "tpu_custom_call" in shardcheck.custom_call_census(with_cb)
+
+
+def test_sc007_contract_roundtrip_and_seeded_regressions(
+    contract_setup, tmp_path
+):
+    """generate → pass; a contracted kernel the program lacks fires the
+    silent-fallback violation; an un-contracted kernel and count drift
+    fire too; pre-SC007 contracts (no custom_calls section) skip."""
+    _, _, _, program = contract_setup
+    contract = shardcheck.write_contract(str(tmp_path), "dp2xfsdp2",
+                                         program)
+    assert "custom_calls" in contract
+    assert shardcheck.check_custom_calls_against_contract(
+        program, contract
+    ) == []
+
+    # the headline regression: the contract remembers a kernel the
+    # program no longer lowers (dispatcher silently fell back)
+    seeded = json.loads(json.dumps(contract))
+    seeded["custom_calls"]["tpu_custom_call"] = {
+        "count": 2,
+        "sites": ["(f32[256,512], bf16[512,128]) -> f32[256,8]"],
+    }
+    v = shardcheck.check_custom_calls_against_contract(program, seeded)
+    assert any(
+        x.rule == "SC007" and "vanished" in x.message for x in v
+    )
+
+    # a kernel the contract never saw
+    census = shardcheck.custom_call_census(program.hlo)
+    census["tpu_custom_call"] = {"count": 1, "sites": ["() -> f32[1]"]}
+    v = shardcheck.check_custom_calls_against_contract(
+        program, contract, census=census
+    )
+    assert any(
+        x.rule == "SC007" and "new custom-call kernel" in x.message
+        for x in v
+    )
+
+    # count/shape drift on an existing target
+    seeded = json.loads(json.dumps(contract))
+    seeded["custom_calls"]["k"] = {"count": 1, "sites": ["() -> f32[1]"]}
+    census = shardcheck.custom_call_census(program.hlo)
+    census["k"] = {"count": 3, "sites": ["() -> f32[2]"]}
+    v = shardcheck.check_custom_calls_against_contract(
+        program, seeded, census=census
+    )
+    assert any(x.rule == "SC007" and "drifted" in x.message for x in v)
+
+    # pre-SC007 contract: rule unarmed (regenerate to arm)
+    legacy = json.loads(json.dumps(contract))
+    del legacy["custom_calls"]
+    assert shardcheck.check_custom_calls_against_contract(
+        program, legacy
+    ) == []
+
+    # another model's contract: SC001 owns the hash mismatch report
+    other = json.loads(json.dumps(contract))
+    other["config_hash"] = "0000deadbeef"
+    other["custom_calls"]["ghost"] = {"count": 1, "sites": []}
+    assert shardcheck.check_custom_calls_against_contract(
+        program, other
+    ) == []
+
+
+def test_sc007_seeded_kernel_drop_fails_cli(tmp_path, monkeypatch):
+    """ISSUE 17 acceptance: regenerate the dp4 contract into a scratch
+    dir, seed a kernel entry the CPU-lowered program cannot have, and
+    the shardcheck CLI exits non-zero on exactly that contract."""
+    cdir = str(tmp_path)
+    assert lint_main(
+        ["--hlo", "dp4", "--contracts", cdir, "--fix-contracts"]
+    ) == 0
+    assert lint_main(["--hlo", "dp4", "--contracts", cdir]) == 0
+
+    path = shardcheck.contract_path(cdir, "dp4")
+    with open(path) as f:
+        data = json.load(f)
+    data["custom_calls"]["tpu_custom_call"] = {
+        "count": 1, "sites": ["(f32[8,8]) -> f32[8,8]"],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f)
+    assert lint_main(["--hlo", "dp4", "--contracts", cdir]) == 1
+
+
+def test_checked_in_contracts_carry_custom_calls_section():
+    """Every checked-in contract is SC007-armed (regenerated after the
+    rule landed): the section exists, so a kernel appearing on any
+    contracted mesh diffs loudly even though the CPU census is empty."""
+    cdir = os.path.join(
+        os.path.dirname(shardcheck.__file__), "contracts"
+    )
+    specs = [f[:-5] for f in os.listdir(cdir) if f.endswith(".json")]
+    assert specs
+    for spec in specs:
+        contract = shardcheck.load_contract(cdir, spec)
+        assert contract.get("custom_calls") is not None, spec
