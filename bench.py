@@ -357,25 +357,52 @@ def _attn_tiling_sweep(jax, jnp, llama, cfg, micro: int, seq: int,
 
 
 def _memory_stats(trainer) -> dict:
-    """XLA's own HBM accounting for the compiled step executable
-    (``compiled.memory_analysis()``): argument / output / temp /
-    generated-code bytes. Warm by construction — ``lower_step`` is a
-    cache hit for a trainer that already stepped — and telemetry only:
-    never fails a bench phase. This is what makes HBM claims (zero-1
-    moment sharding, the pinned grad accumulator) measured numbers on
-    CPU instead of assertions."""
+    """XLA's own HBM accounting for the compiled step executable, read
+    through the ONE guarded reader every caller shares
+    (``memcheck.read_memory_analysis`` — None / partial / throwing
+    backends degrade to a warn-once instead of a crash): argument /
+    output / temp / generated-code bytes plus the derived peak. Warm by
+    construction — ``lower_step`` is a cache hit for a trainer that
+    already stepped — and telemetry only: never fails a bench phase.
+    This is what makes HBM claims (zero-1 moment sharding, the pinned
+    grad accumulator) measured numbers on CPU instead of assertions."""
+    from dlrover_tpu.lint import memcheck
+
     try:
         compiled, _ = trainer.lower_step(trainer.mesh, trainer.mesh_config)
-        ma = compiled.memory_analysis()
-        out = {}
-        for k in ("argument_size_in_bytes", "output_size_in_bytes",
-                  "temp_size_in_bytes", "alias_size_in_bytes",
-                  "generated_code_size_in_bytes"):
-            v = getattr(ma, k, None)
-            if v is not None:
-                out[k.replace("_size_in_bytes", "_bytes")] = int(v)
+        out = memcheck.read_memory_analysis(compiled, label="bench")
         if not out:
             return {"error": "memory_analysis returned no known fields"}
+        return out
+    except Exception as e:  # telemetry only
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+
+
+def _hbm_parity(trainer) -> dict:
+    """Predicted-vs-measured HBM peak for the winner's executable: the
+    memcheck analytic per-component model (params / moments /
+    grads_accum / activations / temp, lint/memcheck.py) against XLA's
+    own accounting of the same build. ``parity_frac`` is the bench's
+    standing evidence that the static model the planner's OOM veto
+    prices candidate worlds with tracks the real executable (the
+    contract gate holds it within 10% on the pinned program). Warm —
+    ``memcheck_payload`` re-lowers through the executable cache — and
+    telemetry only."""
+    try:
+        payload = trainer.memcheck_payload(trainer.mesh,
+                                           trainer.mesh_config)
+        out = {
+            "components": payload["components"],
+            "predicted_peak_bytes": int(payload["peak_bytes"]),
+        }
+        measured = payload.get("measured") or {}
+        peak = measured.get("peak_bytes")
+        if peak:
+            out["measured_peak_bytes"] = int(peak)
+            out["parity_frac"] = round(
+                abs(out["predicted_peak_bytes"] - peak) / peak, 4
+            )
+            out["within_10pct"] = out["parity_frac"] <= 0.10
         return out
     except Exception as e:  # telemetry only
         return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
@@ -1379,6 +1406,10 @@ def main():
         # single-phase mfu contract run lean.
         "hbm": {
             "winner": _memory_stats(trainer),
+            # the static memcheck model vs XLA's accounting on the
+            # winner — the same analytic components the planner's
+            # oom_veto oracle scales to candidate worlds
+            "predicted": _hbm_parity(trainer),
             "zero1": (
                 _zero1_hbm_compare(jax, llama)
                 if "resize" in phases
@@ -1505,7 +1536,9 @@ def main():
     if on_tpu and "interposer" in phases:
         import subprocess
 
-        env = dict(os.environ)
+        from dlrover_tpu.common import flags as _eflags
+
+        env = _eflags.env_snapshot()
         # parent's sitecustomize gate OFF so the child can register the
         # interposer-wrapped plugin itself
         env.pop("PALLAS_AXON_POOL_IPS", None)

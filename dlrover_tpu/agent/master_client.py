@@ -255,6 +255,7 @@ class MasterClient:
         cpu_percent: Optional[float] = None,
         memory_mb: float = 0.0,
         tpu_duty_cycle: float = 0.0,
+        tpu_hbm_used_mb: float = 0.0,
         timestamp: float = 0.0,
     ) -> msg.WorkerReportResponse:
         """The folded periodic report: heartbeat + step digest +
@@ -273,6 +274,7 @@ class MasterClient:
                 cpu_percent=cpu_percent or 0.0,
                 memory_mb=memory_mb,
                 tpu_duty_cycle=tpu_duty_cycle,
+                tpu_hbm_used_mb=tpu_hbm_used_mb,
             ),
             retries=1,
             on_overload="raise",

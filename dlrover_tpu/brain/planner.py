@@ -45,7 +45,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dlrover_tpu.common import flags
 from dlrover_tpu.common.log import logger
@@ -165,6 +165,7 @@ class GoodputPlanner:
         hbm_capacity_gb: Optional[float] = None,
         dcn_gbps: Optional[float] = None,
         default_resize_cost_s: float = 30.0,
+        headroom_oracle=None,
     ):
         from dlrover_tpu.lint.lock_tracker import maybe_track
 
@@ -175,6 +176,14 @@ class GoodputPlanner:
         #: gate; capacity comes from DLROVER_TPU_PLANNER_HBM_GB (the
         #: deployment knows its chip; 0 = unknown, gate off)
         self._job_context = job_context
+        #: the STATIC side of the same gate
+        #: (:class:`dlrover_tpu.lint.memcheck.HeadroomOracle`): measured
+        #: occupancy only exists for worlds that have run — the oracle
+        #: prices EVERY candidate (never-visited worlds, layout flips)
+        #: against its device-class budget, and a candidate that cannot
+        #: fit is vetoed with decision reason ``oom_veto`` instead of
+        #: ever becoming an intent. None = unarmed.
+        self._oracle = headroom_oracle
         self._hbm_capacity_bytes = float(
             hbm_capacity_gb if hbm_capacity_gb is not None
             else flags.PLANNER_HBM_GB.get()
@@ -608,6 +617,45 @@ class GoodputPlanner:
                 out.append(wd)
         return out
 
+    def _oracle_vetoes(
+        self, cands: List[WorldDescriptor], inputs: PlannerInputs
+    ) -> Tuple[List[WorldDescriptor], List[WorldDescriptor], List[Dict]]:
+        """Price every non-incumbent candidate through the static
+        headroom oracle. Returns ``(survivors, vetoed_wds,
+        veto_records)`` — the records are the ledger-facing evidence
+        ({spec, world, predicted/usable/budget bytes}). The incumbent
+        is never vetoed: the fleet is already running it, and HOLD
+        needs its baseline. Unarmed oracle -> everything survives."""
+        if self._oracle is None:
+            return cands, [], []
+        cur_spec = self._current_spec(inputs)
+        survivors: List[WorldDescriptor] = []
+        vetoed: List[WorldDescriptor] = []
+        records: List[Dict] = []
+        for wd in cands:
+            if wd.spec == cur_spec:
+                survivors.append(wd)
+                continue
+            try:
+                verdict = self._oracle.fits(wd)
+            except Exception as e:
+                # a broken oracle must never stall scaling decisions
+                logger.warning("planner: headroom oracle failed: %s", e)
+                survivors.append(wd)
+                continue
+            if verdict.get("fits", True):
+                survivors.append(wd)
+            else:
+                vetoed.append(wd)
+                records.append({
+                    "spec": wd.spec,
+                    "world": wd.world_size,
+                    "predicted_bytes": int(verdict.get("peak_bytes", 0)),
+                    "usable_bytes": int(verdict.get("usable_bytes", 0)),
+                    "budget_bytes": int(verdict.get("budget_bytes", 0)),
+                })
+        return survivors, vetoed, records
+
     def layout_candidates(
         self, inputs: PlannerInputs
     ) -> List[WorldDescriptor]:
@@ -660,7 +708,8 @@ class GoodputPlanner:
             inputs = self.observe(now)
         now = inputs.ts if now is None else now
 
-        def record(verdict, reason, target=None, scores=None, payback=None):
+        def record(verdict, reason, target=None, scores=None, payback=None,
+                   vetoes=None):
             rec = {
                 "ts": round(now, 3),
                 "verdict": verdict,
@@ -672,6 +721,12 @@ class GoodputPlanner:
                 ),
                 "scores": scores or [],
                 "payback_s": payback,
+                # the oracle's oom evidence rides EVERY record of the
+                # round that produced it (post-baseline ledger readers
+                # use .get — wirecheck WC002 discipline), so a veto is
+                # auditable even when the verdict itself is a plain
+                # hold/resize on a surviving candidate
+                "vetoes": list(vetoes or []),
                 "inputs": inputs.snapshot(),
             }
             with self._lock:
@@ -754,10 +809,15 @@ class GoodputPlanner:
         if last_exec > 0 and now - last_exec < self.cooldown_s:
             self._reset_streak()
             return record(HOLD, "cooldown")
-        cands = self.candidates(inputs)
+        cands, vetoed_wds, vetoes = self._oracle_vetoes(
+            self.candidates(inputs), inputs
+        )
         if not cands:
             self._reset_streak()
-            return record(HOLD, "no_candidates")
+            return record(
+                HOLD, "oom_veto" if vetoes else "no_candidates",
+                vetoes=vetoes,
+            )
         scores = [self.score(wd, inputs) for wd in cands]
         by_spec = {wd.spec: wd for wd in cands}
         best = max(scores, key=lambda s: (s["score"], -s["world"]))
@@ -770,12 +830,38 @@ class GoodputPlanner:
             next((s for s in scores if s["world"] == inputs.world), None),
         )
         baseline = current_score["score"] if current_score else 1.0
+        # the oracle's vetoed candidates are still SCORED: when the
+        # throughput winner is a world that cannot fit, the honest
+        # verdict is "oom_veto on that world", not "no paying
+        # candidate" — the ledger must show the resize the planner
+        # WANTED and why it refused it. A HOLD forms no intent, so the
+        # vetoed target is never gated in and never pre-warmed.
+        if vetoed_wds:
+            veto_scores = [self.score(wd, inputs) for wd in vetoed_wds]
+            best_vetoed = max(
+                veto_scores, key=lambda s: (s["score"], -s["world"])
+            )
+            if (
+                best_vetoed["score"] > best["score"]
+                and best_vetoed["score"]
+                >= baseline * (1.0 + self.min_gain_frac)
+            ):
+                self._reset_streak()
+                vetoed_by_spec = {wd.spec: wd for wd in vetoed_wds}
+                return record(
+                    HOLD, "oom_veto",
+                    target=vetoed_by_spec[best_vetoed["spec"]],
+                    scores=scores + veto_scores,
+                    payback=best_vetoed.get("payback_s"),
+                    vetoes=vetoes,
+                )
         if (
             best["spec"] == cur_spec
             or best["score"] < baseline * (1.0 + self.min_gain_frac)
         ):
             self._reset_streak()
-            return record(HOLD, "no_paying_candidate", scores=scores)
+            return record(HOLD, "no_paying_candidate", scores=scores,
+                          vetoes=vetoes)
         # hysteresis: the SAME winning candidate must survive K
         # consecutive decisions before it becomes a plan
         with self._lock:
@@ -788,7 +874,7 @@ class GoodputPlanner:
             return record(
                 HOLD, f"hysteresis:{streak}/{self.hysteresis}",
                 target=by_spec[best["spec"]], scores=scores,
-                payback=best.get("payback_s"),
+                payback=best.get("payback_s"), vetoes=vetoes,
             )
         self._reset_streak()
         target = by_spec[best["spec"]]
@@ -798,7 +884,7 @@ class GoodputPlanner:
         )
         return record(
             RESIZE, reason, target=target,
-            scores=scores, payback=best.get("payback_s"),
+            scores=scores, payback=best.get("payback_s"), vetoes=vetoes,
         )
 
     def _reset_streak(self):

@@ -105,6 +105,17 @@ class EnvFlag:
                 os.environ[self.name] = prev
 
 
+def env_snapshot() -> Dict[str, str]:
+    """The sanctioned raw clone of the current process environment, for
+    call sites that must hand a subprocess the *whole* inherited
+    environment (bench legs re-execing python, the dryrun stress
+    spawn). This is deliberately the only place the clone happens: the
+    registry is the one reader/writer of its flags, and a site that
+    needs the full environment says so by calling here instead of
+    scattering ``dict(os.environ)`` (graftlint JG003)."""
+    return dict(os.environ)
+
+
 def child_env(overrides: Optional[Dict[str, Any]] = None) -> Dict[str, str]:
     """The sanctioned environment clone for spawning child processes
     (worker launch, node-check workloads): the parent's environment —
@@ -112,7 +123,7 @@ def child_env(overrides: Optional[Dict[str, Any]] = None) -> Dict[str, str]:
     — plus per-child overrides, stringified. This is the subprocess
     face of the ``propagate()`` path: call sites build their child env
     here instead of cloning ``os.environ`` raw (graftlint JG003)."""
-    env = dict(os.environ)
+    env = env_snapshot()
     if overrides:
         for k, v in overrides.items():
             flag = _REGISTRY.get(k)
@@ -249,6 +260,36 @@ SHARDCHECK_CONTRACTS = _define(
     "DLROVER_TPU_SHARDCHECK_CONTRACTS", "", "str",
     "Directory of SC001 collective-census contracts for the lower-time "
     "hook (default: the checked-in dlrover_tpu/lint/contracts).",
+)
+MEMCHECK = _define(
+    "DLROVER_TPU_MEMCHECK", 0, "int",
+    "Static per-device memory analysis at lower time (lint/memcheck.py):"
+    " 0 off, 1 warn on violations, 2 strict (reject the build before it "
+    "enters the executable cache). Diffs the compiled step's "
+    "memory_analysis() + the analytic avatar model against the "
+    "checked-in mem-<spec>.json contract and, with a budget configured, "
+    "the device-class HBM budget. Runs on every lowering, including "
+    "speculative neighbor worlds.",
+)
+MEMCHECK_CONTRACTS = _define(
+    "DLROVER_TPU_MEMCHECK_CONTRACTS", "", "str",
+    "Directory of MC001 per-device memory contracts for the lower-time "
+    "hook (default: the checked-in dlrover_tpu/lint/contracts, "
+    "mem-<spec>.json next to the SC001 files).",
+)
+MEMCHECK_DEVICE_CLASS = _define(
+    "DLROVER_TPU_MEMCHECK_DEVICE_CLASS", "", "str",
+    "Device class whose HBM budget gates the memcheck headroom oracle "
+    "(v5e | v5p | cpu-host — the ROADMAP item 5 vocabulary). Empty = "
+    "no class budget; DLROVER_TPU_MEMCHECK_BUDGET_GB still applies "
+    "when set.",
+)
+MEMCHECK_BUDGET_GB = _define(
+    "DLROVER_TPU_MEMCHECK_BUDGET_GB", 0.0, "float",
+    "Explicit per-device HBM budget (GB) for the memcheck headroom "
+    "oracle — overrides the device-class table (tests, odd SKUs). "
+    "0 = defer to DLROVER_TPU_MEMCHECK_DEVICE_CLASS; with neither "
+    "set the MC002 budget gate and the speculation filter are off.",
 )
 ZERO1 = _define(
     "DLROVER_TPU_ZERO1", "", "str",

@@ -302,6 +302,10 @@ class WorkerReport:
     cpu_percent: float = 0.0
     memory_mb: float = 0.0
     tpu_duty_cycle: float = 0.0
+    # per-device HBM occupancy (MB) — the fleet-side input to the
+    # planner's memcheck headroom oracle; 0.0 = not measured (old
+    # senders omit the field entirely, wire default applies)
+    tpu_hbm_used_mb: float = 0.0
 
 
 @message

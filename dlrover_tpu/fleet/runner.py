@@ -283,16 +283,7 @@ class FleetRunner:
             lease_ttl=self.sc.lease_ttl_vs,
             hang_window_s=self.sc.hang_window_vs or None,
             planner=self.sc.planner or None,
-            planner_kwargs=(
-                {
-                    "cooldown_s": self.sc.planner_cooldown_vs,
-                    "horizon_s": self.sc.planner_horizon_vs,
-                    "hysteresis": self.sc.planner_hysteresis,
-                    "decide_interval_s": self.sc.planner_interval_vs,
-                }
-                if self.sc.planner
-                else None
-            ),
+            planner_kwargs=self._planner_kwargs(),
         )
         # the runner drives every sweep on the virtual clock; second
         # wall-clock sweepers would add nondeterministic strikes,
@@ -306,6 +297,40 @@ class FleetRunner:
         self.endpoint.gate.clock = self.clock.now
         master.job_manager.attach_gate(self.endpoint.gate)
         return master
+
+    def _planner_kwargs(self):
+        if not self.sc.planner:
+            return None
+        kwargs = {
+            "cooldown_s": self.sc.planner_cooldown_vs,
+            "horizon_s": self.sc.planner_horizon_vs,
+            "hysteresis": self.sc.planner_hysteresis,
+            "decide_interval_s": self.sc.planner_interval_vs,
+        }
+        if self.sc.hbm_budget_gb > 0:
+            kwargs["headroom_oracle"] = self._headroom_oracle()
+        return kwargs
+
+    def _headroom_oracle(self):
+        """The scenario-shaped static OOM veto (lint/memcheck.py): the
+        sharded model state totals ``hbm_model_gb_per_node * nodes``
+        globally (zero1-packed moments — a shrink divides it across
+        fewer devices) on top of a fixed per-device arena. Candidate
+        worlds whose per-device sum exceeds the budget less headroom
+        are refused with decision reason ``oom_veto``."""
+        from dlrover_tpu.common.world import WorldDescriptor
+        from dlrover_tpu.lint.memcheck import HeadroomOracle
+
+        sc = self.sc
+        return HeadroomOracle(
+            totals={
+                "moments": sc.hbm_model_gb_per_node * sc.nodes * 1e9,
+                "temp": sc.hbm_fixed_gb * 1e9,
+            },
+            base=WorldDescriptor.parse(f"dp{sc.nodes}"),
+            budget_gb=sc.hbm_budget_gb,
+            assume_zero1=True,
+        )
 
     def _save_master_state(self):
         try:
@@ -849,10 +874,19 @@ class FleetRunner:
                 [rebased(r) for r in state["ledger"]], sort_keys=True
             ).encode()
         ).hexdigest()[:16]
+        # the memcheck OOM-veto evidence (.get: pre-veto ledgers and
+        # records restored from an old snapshot carry no "vetoes" key)
+        veto_recs = [
+            v for r in state["ledger"] for v in (r.get("vetoes") or [])
+        ]
         return {
             "armed": True,
             "decisions_total": rep["total"],
             "counts": rep["counts"],
+            "oom_vetoes": len(veto_recs),
+            "vetoed_worlds": sorted(
+                {int(v["world"]) for v in veto_recs}
+            ),
             "executed": [
                 {
                     "target": ex["target"],
@@ -1136,6 +1170,28 @@ class FleetRunner:
                  "gaps": gaps},
                 f"gaps >= {self.sc.planner_cooldown_vs}",
             )
+            if "min_oom_vetoes" in exp:
+                # the static headroom oracle actually refused work: at
+                # least this many over-budget candidates were priced
+                # out with decision reason oom_veto
+                check(
+                    "oom_candidates_vetoed",
+                    pl.get("oom_vetoes", 0) >= exp["min_oom_vetoes"],
+                    pl.get("oom_vetoes", 0),
+                    f">= {exp['min_oom_vetoes']}",
+                )
+            if exp.get("no_oom_world_admitted"):
+                # ZERO OOM-class admissions: no executed plan ever
+                # targeted a world the oracle vetoed in ANY round
+                vetoed_worlds = set(pl.get("vetoed_worlds") or [])
+                admitted = [
+                    e for e in executed
+                    if e["target_world"] in vetoed_worlds
+                ]
+                check(
+                    "no_oom_world_admitted", not admitted, admitted,
+                    f"no executed plan into {sorted(vetoed_worlds)}",
+                )
             if "max_executed_plans" in exp:
                 check(
                     "executed_plans_bounded",

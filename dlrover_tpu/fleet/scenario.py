@@ -130,6 +130,21 @@ class Scenario:
     planner_hysteresis: int = 2
     #: decision cadence on the virtual clock
     planner_interval_vs: float = 15.0
+    # -- memcheck headroom oracle (lint/memcheck.py, the static OOM
+    # veto): >0 arms the planner with a per-device HBM budget — every
+    # candidate world is priced by the analytic component model and
+    # over-budget candidates are refused with decision reason
+    # ``oom_veto`` before any plan can admit them
+    hbm_budget_gb: float = 0.0
+    #: sharded model-state GB per CURRENT node (the oracle's global
+    #: total is ``hbm_model_gb_per_node * nodes`` — a shrink packs it
+    #: onto fewer devices, which is what makes a world over-budget)
+    hbm_model_gb_per_node: float = 0.0
+    #: fixed per-device arena GB (temp — does not shrink with world)
+    hbm_fixed_gb: float = 0.0
+    #: per-device HBM occupancy (MB) workers report in their folded
+    #: WorkerReport (``tpu_hbm_used_mb`` — the measured leg)
+    hbm_used_mb: float = 0.0
     # -- version skew (docs/design/wirecheck.md): simulate an N-1
     # binary on one side of the wire via the serde-level shim
     # (lint/skew_shim.py). "old_master": the master behaves like the

@@ -46,6 +46,16 @@ docs/design/data_plane.md).
   digest), and attribution still summing to elapsed ±1%.
 - ``autoscale_smoke`` — a 60-node cut of the autoscale storm for
   tier-1 tests (seconds of real time), same planner gates.
+- ``oom_storm`` — the memcheck headroom oracle as the planner's OOM
+  veto (docs/design/memcheck.md): a 60-node fleet on a 1.3 GB/device
+  budget carries 1 GB/node of zero1-packed state, then loses 8 nodes
+  to preemption. The watchdog re-forms the surviving 52; the only
+  shrink neighbor (51) cannot fit the repacked state and must be
+  refused with decision reason ``oom_veto`` every round, while the
+  readopt back to 60 — which fits — still executes. Gates: vetoes
+  actually recorded in the decision ledger, ZERO executed plans into
+  any vetoed world, exactly one executed plan (the readopt), and
+  attribution still summing to elapsed.
 - ``smoke`` — a 40-node, 4-virtual-minute cut of the headline for
   tier-1 tests (seconds of real time).
 - ``perturbed_smoke`` — the racecheck schedule explorer
@@ -353,6 +363,60 @@ BUILTIN = {
             "unstable_windows": [[90, 225]],
             "readopt_not_before_vs": 220,
             "readopt_by_vs": 310,
+        },
+    },
+    "oom_storm": {
+        "name": "oom_storm",
+        "seed": 43,
+        "nodes": 60,
+        "min_nodes": 50,
+        "duration_vs": 420,
+        "step_time_s": 1.0,
+        "report_interval_vs": 10,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 50,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 5,
+        "gate_report_cap": 32,
+        "hang_window_vs": 30,
+        "planner": True,
+        "planner_cooldown_vs": 60,
+        "planner_horizon_vs": 400,
+        "planner_hysteresis": 2,
+        "planner_interval_vs": 10,
+        # the memcheck headroom oracle (lint/memcheck.py): 1 GB of
+        # zero1-packed state per node at full world (60 GB global) on a
+        # 1.3 GB/device budget with the standard 10% reserve -> usable
+        # 1.17 GB. Worlds >= 52 fit (60/52 = 1.154); every world <= 51
+        # is over budget (60/51 = 1.176) and must be refused with
+        # decision reason oom_veto, never admitted by an executed plan.
+        "hbm_budget_gb": 1.3,
+        "hbm_model_gb_per_node": 1.0,
+        "hbm_fixed_gb": 0.0,
+        # workers report per-device occupancy over the wire (the
+        # measured leg of the same story: WorkerReport.tpu_hbm_used_mb
+        # -> used_resource.tpu_hbm_used_mb)
+        "hbm_used_mb": 1000.0,
+        "faults": [
+            # 8 nodes preempted for 160vs: the watchdog re-forms the
+            # surviving 52, whose only shrink neighbor (51) cannot fit
+            # — every decision round at 52 must veto it, while the
+            # readopt back to 60 (which fits) still executes
+            {"kind": "preempt", "at_vs": 40,
+             "nodes": list(range(52, 60)), "duration_vs": 160},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.60,
+            "max_rpc_latency_s": 2.0,
+            "master_survives": True,
+            # the readopt is the one admissible plan; the vetoed 51
+            # never executes
+            "max_executed_plans": 1,
+            "min_executed_plans": 1,
+            "min_oom_vetoes": 3,
+            "no_oom_world_admitted": True,
+            "readopt_by_vs": 330,
         },
     },
     "seated_hang": {

@@ -426,6 +426,12 @@ class SimWorker:
                 cpu_percent=0.5,
                 memory_mb=1024.0,
                 tpu_duty_cycle=0.9,
+                # per-device HBM occupancy (scenario-shaped): lands in
+                # used_resource.tpu_hbm_used_mb, the measured input to
+                # the planner's HBM-feasibility projection
+                tpu_hbm_used_mb=float(
+                    getattr(self.sc, "hbm_used_mb", 0.0)
+                ),
                 timestamp=vt,
             )
         except OverloadedError as e:

@@ -1,16 +1,18 @@
-"""graftlint + shardcheck + racecheck + wirecheck CLI.
+"""graftlint + shardcheck + racecheck + wirecheck + memcheck CLI.
 
     python -m dlrover_tpu.lint [options] paths...       # AST rules
     python -m dlrover_tpu.lint --hlo dp4 [--hlo ...]    # IR rules
     python -m dlrover_tpu.lint --race [paths...]        # concurrency
     python -m dlrover_tpu.lint --wire [paths...]        # wire schema
+    python -m dlrover_tpu.lint --mem dp4 [--mem ...]    # memory model
 
 Exit codes: 0 clean (against the baseline / contracts / lock-order
 graph / wire schema + corpus), 1 new violations, unparsable files,
 missing contracts, or lock-graph/schema drift, 2 usage error.
 ``--fix-baseline`` rewrites the AST baseline; ``--fix-contracts``
-regenerates the SC001 collective-census contracts for the given mesh
-specs; ``--fix-lock-order`` / ``--fix-race-baseline`` re-record the
+regenerates the SC001 collective-census contracts (``--hlo``) or the
+MC001 memory contracts (``--mem``) for the given mesh specs;
+``--fix-lock-order`` / ``--fix-race-baseline`` re-record the
 RC001 acquisition graph and the racecheck baseline;
 ``--fix-wire-schema`` records a wire/durable schema change (give the
 compat rationale via ``--wire-note``) and ``--fix-wire-corpus``
@@ -18,12 +20,13 @@ regenerates the golden serialized corpus (all: use after deliberate
 grandfathering or a reviewed change, never to silence a new violation
 you should fix).
 
-The ``--hlo`` path lowers the pinned contract model (see
+The ``--hlo`` and ``--mem`` paths lower the pinned contract model (see
 lint/contract_model.py) on virtual CPU devices — no TPU, no live
-training process — and runs the SC rules over the lowered StableHLO +
-optimized HLO text. The ``--race`` path is a whole-repo analysis
-(cross-file lock identity), so it takes the package root, not single
-files (see lint/racecheck.py).
+training process — and run the SC rules over the lowered StableHLO +
+optimized HLO text (``--hlo``) or the MC rules over the per-device
+memory model of the compiled step (``--mem``). The ``--race`` path is
+a whole-repo analysis (cross-file lock identity), so it takes the
+package root, not single files (see lint/racecheck.py).
 """
 
 from __future__ import annotations
@@ -98,6 +101,28 @@ def main(argv=None) -> int:
         f"(default {shardcheck.DEFAULT_BYTE_TOLERANCE})",
     )
     p.add_argument(
+        "--mem",
+        action="append",
+        default=None,
+        metavar="MESHSPEC",
+        help="memory mode: lower the contract model for this mesh spec "
+        "(same grammar as --hlo; repeatable) and run the MC rules over "
+        "its static per-device memory model (lint/memcheck.py)",
+    )
+    p.add_argument(
+        "--device-class",
+        default="",
+        help="MC002: per-device HBM budget class for --mem "
+        "(v5e | v5p | cpu-host; default: no budget check)",
+    )
+    p.add_argument(
+        "--budget-gb",
+        type=float,
+        default=0.0,
+        help="MC002: explicit per-device HBM budget in GB for --mem "
+        "(overrides --device-class)",
+    )
+    p.add_argument(
         "--race",
         action="store_true",
         help="concurrency mode: whole-repo lock-order + guarded-by "
@@ -168,7 +193,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.list_rules:
-        from dlrover_tpu.lint import racecheck, wirecheck
+        from dlrover_tpu.lint import memcheck, racecheck, wirecheck
 
         for rid, name, doc in rule_catalog():
             print(f"{rid}  {name:28s} {doc}")
@@ -178,14 +203,16 @@ def main(argv=None) -> int:
             print(f"{rid}  {name:28s} {doc}")
         for rid, name, doc in wirecheck.WC_RULES:
             print(f"{rid}  {name:28s} {doc}")
+        for rid, name, doc in memcheck.MC_RULES:
+            print(f"{rid}  {name:28s} {doc}")
         return 0
     if args.wire:
-        if args.hlo or args.race or args.fix_baseline or args.no_baseline \
-                or args.rule:
+        if args.hlo or args.mem or args.race or args.fix_baseline \
+                or args.no_baseline or args.rule:
             print(
                 "error: --wire (schema mode) cannot be combined with "
-                "--hlo, --race, --fix-baseline, --no-baseline or "
-                "--rule — run them as separate invocations",
+                "--hlo, --mem, --race, --fix-baseline, --no-baseline "
+                "or --rule — run them as separate invocations",
                 file=sys.stderr,
             )
             return 2
@@ -197,11 +224,12 @@ def main(argv=None) -> int:
         )
         return 2
     if args.race:
-        if args.hlo or args.fix_baseline or args.no_baseline or args.rule:
+        if args.hlo or args.mem or args.fix_baseline or args.no_baseline \
+                or args.rule:
             print(
                 "error: --race (concurrency mode) cannot be combined "
-                "with --hlo, --fix-baseline, --no-baseline or --rule — "
-                "run them as separate invocations",
+                "with --hlo, --mem, --fix-baseline, --no-baseline or "
+                "--rule — run them as separate invocations",
                 file=sys.stderr,
             )
             return 2
@@ -212,18 +240,26 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.hlo:
-        if args.paths or args.fix_baseline or args.no_baseline or args.rule:
+    if args.hlo or args.mem:
+        if args.hlo and args.mem:
             print(
-                "error: --hlo (IR mode) cannot be combined with paths, "
+                "error: --hlo (IR mode) and --mem (memory mode) are "
+                "separate invocations (each owns --fix-contracts)",
+                file=sys.stderr,
+            )
+            return 2
+        if args.paths or args.fix_baseline or args.no_baseline or args.rule:
+            mode = "--hlo (IR mode)" if args.hlo else "--mem (memory mode)"
+            print(
+                f"error: {mode} cannot be combined with paths, "
                 "--fix-baseline, --no-baseline or --rule (AST mode) — "
                 "run them as separate invocations",
                 file=sys.stderr,
             )
             return 2
-        return _run_hlo(args)
+        return _run_hlo(args) if args.hlo else _run_mem(args)
     if args.fix_contracts:
-        print("error: --fix-contracts needs --hlo MESHSPEC",
+        print("error: --fix-contracts needs --hlo or --mem MESHSPEC",
               file=sys.stderr)
         return 2
     if not args.paths:
@@ -463,6 +499,127 @@ def _run_hlo(args) -> int:
             f" {sum(c['count'] for c in census.values())} collectives over"
             f" {len(census)} cell(s),"
             f" {len(kernels)} kernel target(s){overlap_note})"
+        )
+        failed = failed or bool(violations)
+    return 1 if failed else 0
+
+
+def _run_mem(args) -> int:
+    """Memory mode: one contract-model build per mesh spec, MC rules
+    over its static per-device memory model."""
+    from dlrover_tpu.lint import contract_model, memcheck
+
+    specs = []
+    worlds = []
+    for raw in args.mem:
+        try:
+            wd = shardcheck.WorldDescriptor.parse(raw)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        specs.append(wd.spec)  # canonicalized
+        w = 1
+        for s in wd.axis_sizes().values():
+            w *= s
+        worlds.append(w)
+
+    contract_model.ensure_cpu_devices(max(worlds))
+
+    failed = False
+    for spec in specs:
+        try:
+            payload = contract_model.build_memcheck(spec)
+        except Exception as e:
+            print(f"{spec}: lowering failed: {e}", file=sys.stderr)
+            failed = True
+            continue
+        if args.fix_contracts:
+            import jax
+
+            data = memcheck.write_mem_contract(
+                args.contracts, spec,
+                payload["components"], payload["peak_bytes"],
+                measured=payload["measured"],
+                extra={
+                    "config_hash": payload["config_hash"],
+                    "world": payload["world"],
+                    "axis_sizes": {
+                        a: s for a, s in payload["axis_sizes"].items()
+                        if s > 1
+                    },
+                    "jax_version": jax.__version__,
+                },
+            )
+            print(
+                f"memcheck: contract {spec} rewritten "
+                f"(peak {data['peak_bytes']} bytes/device, "
+                f"world={payload['world']})"
+            )
+            continue
+        try:
+            contract = memcheck.load_mem_contract(args.contracts, spec)
+        except ValueError as e:
+            print(f"{spec}: {e}", file=sys.stderr)
+            failed = True
+            continue
+        if contract is None:
+            print(
+                f"{spec}: no contract at "
+                f"{memcheck.mem_contract_path(args.contracts, spec)} — "
+                "generate one with --fix-contracts",
+                file=sys.stderr,
+            )
+            failed = True
+            continue
+        if (
+            contract.get("config_hash")
+            and contract["config_hash"] != payload["config_hash"]
+        ):
+            # unlike the lower-time hook, the CLI program is PINNED:
+            # a hash mismatch here means the contract is stale, and
+            # staying quiet would un-arm MC001 in CI
+            print(
+                f"{spec}: contract is for config "
+                f"{contract['config_hash']} but the pinned program is "
+                f"{payload['config_hash']} — regenerate with "
+                "--fix-contracts",
+                file=sys.stderr,
+            )
+            failed = True
+            continue
+        violations = memcheck.check_components(
+            payload["components"], payload["peak_bytes"], contract,
+            byte_tolerance=args.byte_tolerance, label=f"mem:{spec}",
+        )
+        violations.extend(memcheck.check_budget(
+            payload["peak_bytes"],
+            device_class=args.device_class, budget_gb=args.budget_gb,
+            label=f"mem:{spec}",
+        ))
+        for v in violations:
+            print(v.format())
+        better = memcheck.component_improvements(
+            payload["components"], payload["peak_bytes"], contract,
+            byte_tolerance=args.byte_tolerance,
+        )
+        if better:
+            print(
+                f"note: {spec} uses less memory than its contract "
+                f"({len(better)} component(s) improved — run "
+                "--fix-contracts to bank it):"
+            )
+            for line in better:
+                print(f"  {line}")
+        status = "FAIL" if violations else "ok"
+        delta = payload.get("argument_delta_frac")
+        delta_note = (
+            f", arguments explained to {delta:.2%}"
+            if delta is not None else ""
+        )
+        print(
+            f"memcheck: {spec} {status} ({len(violations)} violation(s),"
+            f" peak {payload['peak_bytes']} bytes/device"
+            f"{delta_note})"
         )
         failed = failed or bool(violations)
     return 1 if failed else 0

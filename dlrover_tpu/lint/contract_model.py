@@ -140,6 +140,32 @@ def build_contract_trainer(
     return trainer, state, batch
 
 
+def _pinned_flags():
+    """The contract-program flag pins, as one ExitStack: the SPEC
+    decides the variant; exported DLROVER_TPU_ZERO1 /
+    DLROVER_TPU_HIER_COLLECTIVES / DLROVER_TPU_OVERLAP_* would
+    otherwise override the knobs at init_state/lower time and build
+    (or ``--fix-contracts``: RECORD) the wrong program. The CE path
+    choice is part of the contracted program too, so the kernel
+    dispatch flags pin to their defaults (fused falls back to chunked
+    off-TPU — the recorded program is the PR 1 one)."""
+    import contextlib
+
+    from dlrover_tpu.common import flags
+
+    stack = contextlib.ExitStack()
+    for flag in (
+        flags.ZERO1,
+        flags.HIER_COLLECTIVES,
+        flags.OVERLAP_COLLECTIVES,
+        flags.OVERLAP_BUCKET_MB,
+        flags.CHUNKED_CE,
+        flags.FUSED_CE,
+    ):
+        stack.enter_context(flag.scoped(None))
+    return stack
+
+
 def build_program(
     spec: str, pinned: bool = True
 ) -> Tuple["shardcheck.StepProgram", object]:
@@ -147,9 +173,6 @@ def build_program(
     the zero-1 variant ``"dp4+zero1"``, or a multislice hierarchical
     variant like ``"dp4+2slice"``) and return
     ``(StepProgram, trainer)``."""
-    import contextlib
-
-    from dlrover_tpu.common import flags
     from dlrover_tpu.common.world import WorldDescriptor
 
     wd = WorldDescriptor.parse(spec)
@@ -158,20 +181,7 @@ def build_program(
     for s in axis_sizes.values():
         world *= s
     ensure_cpu_devices(world)
-    with contextlib.ExitStack() as stack:
-        # the spec decides the variant; exported DLROVER_TPU_ZERO1 /
-        # DLROVER_TPU_HIER_COLLECTIVES / DLROVER_TPU_OVERLAP_* would
-        # otherwise override the knobs at init_state/lower time and
-        # build (or --fix-contracts: RECORD) the wrong program
-        stack.enter_context(flags.ZERO1.scoped(None))
-        stack.enter_context(flags.HIER_COLLECTIVES.scoped(None))
-        stack.enter_context(flags.OVERLAP_COLLECTIVES.scoped(None))
-        stack.enter_context(flags.OVERLAP_BUCKET_MB.scoped(None))
-        # CE path choice is part of the contracted program too: pin the
-        # kernel dispatch flags to their defaults (fused falls back to
-        # chunked off-TPU, so the recorded census is the PR 1 program)
-        stack.enter_context(flags.CHUNKED_CE.scoped(None))
-        stack.enter_context(flags.FUSED_CE.scoped(None))
+    with _pinned_flags():
         trainer, _, _ = build_contract_trainer(
             axis_sizes, zero1=wd.zero1, n_slices=wd.n_slices,
             overlap=wd.overlap,
@@ -179,3 +189,25 @@ def build_program(
         program = trainer.step_ir(pinned=pinned)
     program.label = "hlo:" + wd.spec
     return program, trainer
+
+
+def build_memcheck(spec: str) -> Dict:
+    """Lower the contract model for ``spec`` under the same flag pins
+    as :func:`build_program` and return the trainer's memcheck payload
+    (lint/memcheck.py): the per-device component breakdown, analytic
+    peak and guarded measured bytes of the pinned program — the MC001
+    contract substrate."""
+    from dlrover_tpu.common.world import WorldDescriptor
+
+    wd = WorldDescriptor.parse(spec)
+    axis_sizes = wd.axis_sizes()
+    world = 1
+    for s in axis_sizes.values():
+        world *= s
+    ensure_cpu_devices(world)
+    with _pinned_flags():
+        trainer, _, _ = build_contract_trainer(
+            axis_sizes, zero1=wd.zero1, n_slices=wd.n_slices,
+            overlap=wd.overlap,
+        )
+        return trainer.memcheck_payload()

@@ -404,12 +404,17 @@ class MasterServicer:
             if action is not None:
                 actions.append(action)
             if request.has_resource:
+                # getattr: reports from pre-HBM senders deserialize
+                # without the field (wire default 0.0 = not measured)
                 self._job_manager.update_node_resource_usage(
                     node_type,
                     request.node_id,
                     request.cpu_percent,
                     request.memory_mb,
                     tpu_duty_cycle=request.tpu_duty_cycle,
+                    tpu_hbm_used_mb=getattr(
+                        request, "tpu_hbm_used_mb", 0.0
+                    ),
                 )
         if self._speed_monitor is not None:
             digest = request.digest or {}

@@ -96,11 +96,17 @@ def _shape_bytes(text: str) -> float:
     return total
 
 
-def _first_shape_elems(text: str, dims_wanted: Sequence[int]) -> float:
-    """Product of the selected dims of the FIRST shape in ``text``."""
+def _first_shape_elems(
+    text: str, dims_wanted: Sequence[int]
+) -> Optional[float]:
+    """Product of the selected dims of the FIRST shape in ``text``, or
+    ``None`` when no shape parses at all. A zero-sized dim yields a
+    real 0.0 — distinct from the no-shape case, so degenerate operands
+    (``f32[0,...]`` slices, 0-dim tensors from scalar psums) score
+    zero work instead of borrowing the scalar fallback."""
     m = _SHAPE_RE.search(text)
     if not m:
-        return 0.0
+        return None
     dims = [int(d) for d in m.group(2).split(",") if d]
     out = 1.0
     for i in dims_wanted:
@@ -121,7 +127,16 @@ class KernelSite:
 
     @property
     def cost(self) -> float:
-        return max(self.flops / PEAK_FLOPS, self.bytes / PEAK_BW_BYTES)
+        """Roofline weight. Zero-sized operands (scalar psums'
+        ``f32[]`` carry their 4 bytes; degenerate ``[0,...]`` slices
+        carry nothing) legitimately score 0.0 — attribute_step's
+        total-cost guard turns an all-zero program into all-zero
+        shares instead of dividing by the zero."""
+        flop_score = self.flops / PEAK_FLOPS if PEAK_FLOPS > 0 else 0.0
+        byte_score = (
+            self.bytes / PEAK_BW_BYTES if PEAK_BW_BYTES > 0 else 0.0
+        )
+        return max(flop_score, byte_score)
 
 
 def classify_site(opcode: str, target: str, op_name: str) -> str:
@@ -206,16 +221,20 @@ def iter_sites(hlo_text: str):
         target = target_m.group(1) if target_m else ""
         flops = 0.0
         if opcode == "dot":
-            out_elems = _first_shape_elems(
-                result_type, range(8)
-            ) or 1.0
+            out_elems = _first_shape_elems(result_type, range(8))
             cdims_m = _LHS_CDIMS_RE.search(args)
             cdims = (
                 [int(d) for d in cdims_m.group(1).split(",") if d]
                 if cdims_m else []
             )
-            contract = _first_shape_elems(args, cdims) or 1.0
-            flops = 2.0 * out_elems * contract
+            contract = _first_shape_elems(args, cdims)
+            # None = shape didn't parse (scalar fallback to 1); a real
+            # 0.0 from a zero-sized operand stays 0 — zero work
+            flops = (
+                2.0
+                * (1.0 if out_elems is None else out_elems)
+                * (1.0 if contract is None else contract)
+            )
         nbytes = _shape_bytes(result_type) + _shape_bytes(
             args.split(", metadata=")[0].split(", calls=")[0]
         )

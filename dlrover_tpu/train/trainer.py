@@ -1061,6 +1061,13 @@ class ElasticTrainer:
         # here, which keeps the poisoned executable out of the cache.
         self._maybe_shardcheck(lowered, compiled, mesh, mesh_config,
                                config_hash)
+        # memory-side analysis of the same build (lint/memcheck.py),
+        # opted in via DLROVER_TPU_MEMCHECK: the per-device memory
+        # model diffed against its contract and the device-class HBM
+        # budget. Strict mode raises BEFORE the cache put, like
+        # shardcheck — an executable that cannot fit its budget never
+        # becomes a warm hit.
+        self._maybe_memcheck(compiled, mesh, mesh_config, config_hash)
         self.warm.put(sig, compiled)
         warm_compile.compile_ledger.record(mesh.size, config_hash, dt, source)
         return compiled, {
@@ -1200,6 +1207,186 @@ class ElasticTrainer:
         for v in violations:
             logger.warning("shardcheck: %s", v.format())
 
+    # ---- memcheck (lint/memcheck.py) -----------------------------------
+    def _memcheck_leaves(self, tree):
+        """Flatten an avatar pytree into the plain
+        :class:`~dlrover_tpu.lint.memcheck.LeafAvatar` records the
+        jax-free memory model consumes: pytree path, global shape,
+        dtype name, and the flattened mesh axes of the leaf's
+        ``PartitionSpec``."""
+        from dlrover_tpu.lint import memcheck
+
+        records = []
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, av in flat:
+            spec = getattr(getattr(av, "sharding", None), "spec", None)
+            axes = []
+            for entry in tuple(spec) if spec is not None else ():
+                if entry is None:
+                    continue
+                if isinstance(entry, (tuple, list)):
+                    axes.extend(str(a) for a in entry)
+                else:
+                    axes.append(str(entry))
+            records.append(memcheck.LeafAvatar(
+                path=jax.tree_util.keystr(path),
+                shape=tuple(int(d) for d in av.shape),
+                dtype=np.dtype(av.dtype).name,
+                sharded_axes=tuple(axes),
+            ))
+        return records
+
+    def _memcheck_payload_of(
+        self, compiled, mesh, mesh_config, config_hash: str
+    ) -> dict:
+        """The static per-device memory model of one compiled build:
+        guarded ``memory_analysis()`` bytes plus the analytic per-leaf
+        breakdown that explains them (lint/memcheck.py)."""
+        from dlrover_tpu.lint import memcheck
+
+        accum = self._accum_for(mesh, mesh_config)
+        state_av, batch_av, _ = self._avatar_args(mesh, mesh_config, accum)
+        spec = self._contract_spec(mesh)
+        measured = memcheck.read_memory_analysis(
+            compiled, label=f"mem:{spec}"
+        )
+        components = memcheck.analytic_components(
+            self._memcheck_leaves(state_av),
+            self._memcheck_leaves(batch_av),
+            dict(mesh.shape),
+            measured,
+        )
+        payload = {
+            "mesh_spec": spec,
+            "config_hash": config_hash,
+            "world": int(mesh.size),
+            "axis_sizes": {a: int(s) for a, s in dict(mesh.shape).items()},
+            "components": components,
+            "peak_bytes": memcheck.analytic_peak_bytes(components),
+            "measured": measured,
+        }
+        delta = memcheck.explain_delta_frac(components, measured)
+        if delta is not None:
+            payload["argument_delta_frac"] = round(delta, 4)
+        return payload
+
+    @_pin_zero1
+    def memcheck_payload(self, mesh=None, mesh_config=None) -> dict:
+        """Build (AOT, host-only — warm cache makes repeats free) the
+        step for ``(mesh, mesh_config)`` and return its memory payload.
+        The CLI ``--mem`` mode and bench ``detail.hbm`` entry point:
+        like ``step_ir``, the substrate for any admissible world comes
+        from the avatars, so no TPU — and no live training process —
+        is needed."""
+        mesh = mesh if mesh is not None else self.mesh
+        mesh_config = (
+            mesh_config if mesh_config is not None else self.mesh_config
+        )
+        compiled, info = self.lower_step(mesh, mesh_config,
+                                         source="memcheck")
+        return self._memcheck_payload_of(
+            compiled, mesh, mesh_config, info["config_hash"]
+        )
+
+    def _headroom_oracle(
+        self, device_class: str = "", budget_gb: float = 0.0
+    ):
+        """The live program's static headroom oracle: the analytic
+        components at the CURRENT mesh lifted to global totals, so any
+        candidate world prices out without compiling it
+        (:class:`~dlrover_tpu.lint.memcheck.HeadroomOracle`)."""
+        from dlrover_tpu.lint import memcheck
+
+        accum = self._accum_for(self.mesh, self.mesh_config)
+        state_av, batch_av, _ = self._avatar_args(
+            self.mesh, self.mesh_config, accum
+        )
+        components = memcheck.analytic_components(
+            self._memcheck_leaves(state_av),
+            self._memcheck_leaves(batch_av),
+            dict(self.mesh.shape),
+        )
+        wd = self.world_descriptor(self.mesh)
+        return memcheck.HeadroomOracle.from_components(
+            components, wd,
+            device_class=device_class, budget_gb=budget_gb,
+            # candidates run the current program family: a bare-dp
+            # neighbor descriptor still packs moments like this build
+            assume_zero1=wd.zero1,
+        )
+
+    def _maybe_memcheck(self, compiled, mesh, mesh_config,
+                        config_hash: str):
+        """Lower-time hook, fifth invariant layer:
+        ``DLROVER_TPU_MEMCHECK`` 0=off, 1=warn, 2=strict (raise — the
+        build is rejected and nothing enters the executable cache).
+        MC001 runs only when a ``mem-<spec>`` contract for this program
+        exists (``DLROVER_TPU_MEMCHECK_CONTRACTS`` dir, default: the
+        checked-in contracts); MC002 only when a device class or
+        explicit budget is configured."""
+        mode = int(flags.MEMCHECK.get())
+        if not mode:
+            return
+        from dlrover_tpu.lint import memcheck
+
+        try:
+            payload = self._memcheck_payload_of(
+                compiled, mesh, mesh_config, config_hash
+            )
+            label = "mem:" + payload["mesh_spec"]
+            contracts_dir = (
+                flags.MEMCHECK_CONTRACTS.get()
+                or memcheck.DEFAULT_CONTRACTS_DIR
+            )
+            contract = memcheck.load_mem_contract(
+                contracts_dir, payload["mesh_spec"]
+            )
+            if (
+                contract is not None
+                and contract.get("config_hash")
+                and contract["config_hash"] != payload["config_hash"]
+            ):
+                # same mesh, different program (the checked-in tiny
+                # contract-model breakdowns vs a real model): at lower
+                # time that means "no contract", not a violation —
+                # mirror of the shardcheck hook's rule
+                logger.info(
+                    "memcheck: contract for %s is for config %s (this "
+                    "program: %s); MC001 skipped",
+                    label, contract["config_hash"],
+                    payload["config_hash"],
+                )
+                contract = None
+            violations = []
+            if contract is not None:
+                violations.extend(memcheck.check_components(
+                    payload["components"], payload["peak_bytes"],
+                    contract, label=label,
+                ))
+            violations.extend(memcheck.check_budget(
+                payload["peak_bytes"],
+                device_class=flags.MEMCHECK_DEVICE_CLASS.get(),
+                budget_gb=float(flags.MEMCHECK_BUDGET_GB.get()),
+                label=label,
+            ))
+        except Exception as e:
+            if isinstance(e, memcheck.MemcheckError):
+                raise
+            # analysis breakage must never take down a training build
+            logger.warning("memcheck hook failed: %s", e)
+            return
+        if not violations:
+            logger.info(
+                "memcheck: %s clean (%s contract, peak %d bytes/device)",
+                label, "with" if contract else "no",
+                payload["peak_bytes"],
+            )
+            return
+        if mode >= 2:
+            raise memcheck.MemcheckError(violations)
+        for v in violations:
+            logger.warning("memcheck: %s", v.format())
+
     @_pin_zero1
     def step_ir(self, mesh=None, mesh_config=None, pinned: bool = True):
         """Lower (and compile — on the host, no device execution) the
@@ -1245,12 +1432,14 @@ class ElasticTrainer:
         try:
             fn, info = self.lower_step(self.mesh, self.mesh_config)
         except Exception as e:
-            # strict shardcheck is a deliberate veto of this program —
-            # falling back to plain jit would run the exact program the
-            # check just rejected
-            from dlrover_tpu.lint import shardcheck
+            # strict shardcheck/memcheck is a deliberate veto of this
+            # program — falling back to plain jit would run the exact
+            # program the check just rejected
+            from dlrover_tpu.lint import memcheck, shardcheck
 
-            if isinstance(e, shardcheck.ShardcheckError):
+            if isinstance(
+                e, (shardcheck.ShardcheckError, memcheck.MemcheckError)
+            ):
                 raise
             logger.exception(
                 "AOT step build failed; falling back to plain jit"
@@ -1364,6 +1553,7 @@ class ElasticTrainer:
             targets = [hint] + [
                 t for t in targets if t.world_size != hint.world_size
             ]
+        targets = self._filter_speculation_targets(targets)
         if not targets:
             return
 
@@ -1394,6 +1584,40 @@ class ElasticTrainer:
                 [t.spec for t in targets],
                 " (planner-hinted)" if hint is not None else "",
             )
+
+    def _filter_speculation_targets(self, targets):
+        """memcheck's static headroom oracle over the speculative
+        worlds: drop neighbors whose predicted per-device peak cannot
+        fit the configured device-class budget, so no AOT compile is
+        wasted on a world the planner would oom-veto anyway. Unarmed
+        (no ``DLROVER_TPU_MEMCHECK_DEVICE_CLASS`` / ``_BUDGET_GB``) ->
+        targets pass through untouched."""
+        from dlrover_tpu.lint import memcheck
+
+        device_class = flags.MEMCHECK_DEVICE_CLASS.get()
+        budget_gb = float(flags.MEMCHECK_BUDGET_GB.get())
+        if memcheck.budget_bytes(device_class, budget_gb) <= 0:
+            return targets
+        try:
+            oracle = self._headroom_oracle(
+                device_class=device_class, budget_gb=budget_gb
+            )
+        except Exception as e:
+            logger.warning("memcheck speculation oracle failed: %s", e)
+            return targets
+        kept = []
+        for wd in targets:
+            verdict = oracle.fits(wd)
+            if verdict["fits"]:
+                kept.append(wd)
+            else:
+                logger.info(
+                    "speculation: skipping world %s (memcheck oom "
+                    "veto: predicted %d > usable %d bytes)",
+                    wd.spec, verdict["peak_bytes"],
+                    verdict["usable_bytes"],
+                )
+        return kept
 
     def apply_paral_config(self, state: dict, config: dict) -> dict:
         """Apply a master-pushed runtime config to the train state: a new

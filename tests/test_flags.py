@@ -1,0 +1,126 @@
+"""The typed flag registry (common/flags.py): ``scoped`` nesting —
+scoped-inside-scoped restores the OUTER pin, not the global default
+(the PR 17 bool round-trip bug showed this family was under-tested) —
+bool stringification through nested pins, the ``env_snapshot`` /
+``child_env`` sanctioned environment clones, and ``propagate``."""
+
+import os
+
+from dlrover_tpu.common import flags
+
+
+def _tmp_flag(kind, default):
+    # a throwaway flag NOT in the registry: tests must not perturb the
+    # catalog other tests read
+    return flags.EnvFlag("DLROVER_TPU_TEST_SCOPED", default, kind)
+
+
+def test_scoped_sets_and_restores_unset():
+    f = _tmp_flag("str", "d")
+    os.environ.pop(f.name, None)
+    with f.scoped("inner"):
+        assert f.get() == "inner"
+    assert f.raw() is None
+    assert f.get() == "d"
+
+
+def test_scoped_nesting_restores_outer_value_not_default():
+    f = _tmp_flag("int", 0)
+    os.environ.pop(f.name, None)
+    try:
+        with f.scoped(1):
+            assert f.get() == 1
+            with f.scoped(2):
+                assert f.get() == 2
+            # the inner exit must restore the OUTER pin, not fall all
+            # the way through to the unset default
+            assert f.get() == 1
+            assert f.raw() == "1"
+        assert f.raw() is None
+    finally:
+        os.environ.pop(f.name, None)
+
+
+def test_scoped_nesting_none_inside_value():
+    # scoped(None) = "explicitly unset" — nested under a pin, exiting
+    # it must bring the pin back
+    f = _tmp_flag("str", "d")
+    try:
+        with f.scoped("outer"):
+            with f.scoped(None):
+                assert f.raw() is None
+                assert f.get() == "d"
+            assert f.get() == "outer"
+        assert f.raw() is None
+    finally:
+        os.environ.pop(f.name, None)
+
+
+def test_scoped_nesting_restores_ambient_export():
+    # an operator-exported value survives a scoped pin-and-release
+    f = _tmp_flag("str", "d")
+    try:
+        os.environ[f.name] = "ambient"
+        with f.scoped("pinned"):
+            assert f.get() == "pinned"
+            with f.scoped(None):
+                assert f.get() == "d"
+            assert f.get() == "pinned"
+        assert f.get() == "ambient"
+    finally:
+        os.environ.pop(f.name, None)
+
+
+def test_scoped_bool_round_trip_nested():
+    # the PR 17 bug class: str(False) == "False" reads back TRUE under
+    # the raw != "0" parse; nested scopes must hold the "0"/"1" wire
+    # form at every level
+    f = _tmp_flag("bool", True)
+    try:
+        with f.scoped(False):
+            assert f.raw() == "0"
+            assert f.get() is False
+            with f.scoped(True):
+                assert f.raw() == "1"
+                assert f.get() is True
+            assert f.raw() == "0"
+            assert f.get() is False
+        assert f.raw() is None
+    finally:
+        os.environ.pop(f.name, None)
+
+
+def test_scoped_restores_on_exception():
+    f = _tmp_flag("str", "d")
+    try:
+        with f.scoped("outer"):
+            try:
+                with f.scoped("inner"):
+                    raise RuntimeError("boom")
+            except RuntimeError:
+                pass
+            assert f.get() == "outer"
+        assert f.raw() is None
+    finally:
+        os.environ.pop(f.name, None)
+
+
+def test_env_snapshot_is_a_full_clone():
+    key = "DLROVER_TPU_TEST_SNAPSHOT"
+    try:
+        os.environ[key] = "x"
+        snap = flags.env_snapshot()
+        assert snap[key] == "x"
+        # a clone, not a view: mutating it never writes the process env
+        snap[key] = "mutated"
+        assert os.environ[key] == "x"
+    finally:
+        os.environ.pop(key, None)
+
+
+def test_child_env_overrides_stringify_through_registry():
+    env = flags.child_env({flags.WARM_COMPILE.name: False})
+    # registry-known overrides take the flag's wire form ("0", never
+    # "False"); the rest of the environment rides along
+    assert env[flags.WARM_COMPILE.name] == "0"
+    assert env.get("PATH") == os.environ.get("PATH")

@@ -35,7 +35,7 @@ def test_builtin_scenarios_load():
         "headline_1k", "overload_10x", "smoke",
         "shard_storm_1k", "shard_storm_smoke", "seated_hang",
         "perturbed_smoke", "version_skew_old_master",
-        "version_skew_old_workers",
+        "version_skew_old_workers", "oom_storm",
     ):
         sc = load_scenario(name)
         assert sc.nodes > 0 and sc.duration_vs > 0
@@ -244,6 +244,39 @@ def test_autoscale_smoke_planner_gates(tmp_path):
     assert sum(cats.values()) == pytest.approx(
         v["attribution"]["elapsed_wall_s"], rel=0.01
     )
+
+
+def test_oom_storm_vetoes_and_admissions(tmp_path):
+    """The memcheck headroom oracle as the planner's OOM veto
+    (docs/design/memcheck.md): on a 1.3 GB/device budget with
+    1 GB/node of zero1-packed state, the post-preemption world of 52
+    still fits but its only shrink neighbor (51) cannot — every
+    decision round must record the refusal, and no executed plan may
+    ever admit a vetoed world, while the readopt back to 60 (which
+    fits) still executes."""
+    v = _run("oom_storm", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    pl = v["planner"]
+    assert pl["armed"]
+    # the oracle refused the shrink-to-51 candidate, repeatedly, and
+    # 51 is the ONLY world it ever refused (60 and 52 fit)
+    assert pl["oom_vetoes"] >= 3
+    assert pl["vetoed_worlds"] == [51]
+    # zero OOM-class admissions: the one executed plan is the readopt
+    assert len(pl["executed"]) == 1
+    assert pl["executed"][0]["target_world"] == 60
+    # the world never seated below the feasibility floor the oracle
+    # enforced (re-forms went to 52, never 51)
+    assert min(s for _, s in pl["world_timeline"]) >= 52
+
+
+def test_oom_storm_deterministic(tmp_path):
+    """Veto records are part of the decision ledger, so they fold into
+    the bit-determinism gate like every other decision field."""
+    v1 = _run("oom_storm", tmp_path / "a")
+    v2 = _run("oom_storm", tmp_path / "b")
+    assert v1["planner"]["ledger_digest"] == v2["planner"]["ledger_digest"]
+    assert v1["planner"]["oom_vetoes"] == v2["planner"]["oom_vetoes"]
 
 
 def test_autoscale_smoke_decisions_deterministic(tmp_path):

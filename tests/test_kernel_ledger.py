@@ -6,6 +6,7 @@ not invented), the top-k >=80 % cut, the cumulative ledger + /metrics
 lines, the ``kernel`` trace-spine lane, and end-to-end attribution of a
 real compiled llama grad step."""
 
+import math
 import urllib.request
 
 import jax
@@ -119,6 +120,65 @@ def test_attribute_step_invariants():
     assert all(
         r["seconds"] == 0.0
         for r in kl.attribute_step(None, 0.0, hlo_text=CANNED_HLO)
+    )
+
+
+# ---------------------------------------------------------------------------
+# zero-sized operand guards (degenerate [0,...] slices, scalar psums)
+# ---------------------------------------------------------------------------
+
+ZERO_HLO = """\
+HloModule jit_zero
+
+ENTRY %main (Arg_0.1: f32[0,128], Arg_1.2: f32[128,32]) -> f32[] {
+  %Arg_0.1 = f32[0,128]{1,0} parameter(0)
+  %Arg_1.2 = f32[128,32]{1,0} parameter(1)
+  %dot.1 = f32[0,32]{1,0} dot(f32[0,128]{1,0} %Arg_0.1, f32[128,32]{1,0} %Arg_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/mlp/dot_general"}
+  %reduce.1 = f32[] reduce(f32[0,32]{1,0} %dot.1, f32[] %Arg_1.2), dimensions={0,1}
+  ROOT %all-reduce.1 = f32[] all-reduce(f32[] %reduce.1), replica_groups={}, metadata={op_name="jit(step)/psum"}
+}
+"""
+
+ALL_ZERO_HLO = """\
+HloModule jit_allzero
+
+ENTRY %main (Arg_0.1: f32[0,128]) -> f32[0,128] {
+  %Arg_0.1 = f32[0,128]{1,0} parameter(0)
+  ROOT %all-reduce.1 = f32[0,128]{1,0} all-reduce(f32[0,128]{1,0} %Arg_0.1), replica_groups={}, metadata={op_name="jit(step)/psum"}
+}
+"""
+
+
+def test_zero_sized_dot_scores_zero_work():
+    sites = {s.opcode: s for s in kl.iter_sites(ZERO_HLO)}
+    dot = sites["dot"]
+    # a 0-row dot output is zero WORK — it must not borrow the scalar
+    # fallback (the old `or 1.0`) and claim 2*1*128 flops
+    assert dot.flops == 0.0
+    # only the non-degenerate operand carries bytes
+    assert dot.bytes == 4 * 128 * 32
+    # scalar psum: f32[] result + f32[] operand = 8 bytes, finite cost
+    ar = sites["all-reduce"]
+    assert ar.bytes == 8.0
+    assert ar.cost > 0.0
+    assert math.isfinite(ar.cost)
+
+
+def test_first_shape_elems_none_vs_zero():
+    # no parseable shape -> None (callers fall back to the scalar 1);
+    # a real zero-sized dim -> 0.0, which must stay 0, not become 1
+    assert kl._first_shape_elems("no shape here", range(8)) is None
+    assert kl._first_shape_elems("f32[0,32]{1,0}", range(8)) == 0.0
+    assert kl._first_shape_elems("f32[]", range(8)) == 1.0
+
+
+def test_all_zero_cost_program_attributes_without_dividing():
+    # every site zero-sized -> total roofline cost 0: shares come back
+    # all-zero instead of raising ZeroDivisionError
+    rows = kl.attribute_step(None, 0.25, hlo_text=ALL_ZERO_HLO)
+    assert rows
+    assert all(
+        r["share"] == 0.0 and r["seconds"] == 0.0 for r in rows
     )
 
 

@@ -791,3 +791,97 @@ def test_layout_intent_satisfied_by_reported_spec():
     p.decide(inputs=_inputs(ts=300.0, world=4, layout_spec="dp4",
                             kernel_breakdown=kb))
     assert p.intent() is None
+
+
+# ---------------------------------------------------------------------------
+# the memcheck headroom oracle: oom_veto
+# ---------------------------------------------------------------------------
+
+
+def _oom_oracle(budget_gb=10.0):
+    """64 GB of zero-1 moments on an 8-node fleet: at dp4 the repack is
+    17 GB/device against 9 GB usable — the shrink that wins the scoring
+    round cannot fit."""
+    from dlrover_tpu.lint.memcheck import HeadroomOracle
+
+    return HeadroomOracle(
+        totals={"moments": 64e9, "temp": 1e9},
+        base=WorldDescriptor.from_axis_sizes({"dp": 8}),
+        budget_gb=budget_gb,
+        assume_zero1=True,
+    )
+
+
+def _oom_inputs(ts=0.0):
+    # DCN-dominated 2-slice world: the slice-aligned shrink to dp4
+    # predicts the fastest step (see the dcn model test above) and wins
+    # the scoring round
+    return _inputs(ts=ts, world=8, n_slices=2,
+                   comm_links={"dcn": int(20e9)})
+
+
+def test_oom_veto_blocks_the_winning_shrink():
+    """The throughput winner cannot fit: the verdict is HOLD/oom_veto
+    NAMING the world the planner wanted — and no intent forms, so the
+    vetoed world is never growth-gated in and never pre-warmed."""
+    p = _planner(hysteresis=1, headroom_oracle=_oom_oracle())
+    d = p.decide(inputs=_oom_inputs())
+    assert d["verdict"] == HOLD
+    assert d["reason"] == "oom_veto"
+    assert d["target"] == "dp4" and d["target_world"] == 4
+    # the ledger evidence: which spec, predicted vs usable bytes
+    assert d["vetoes"], "a veto round must record its evidence"
+    v = next(r for r in d["vetoes"] if r["spec"] == "dp4")
+    assert v["predicted_bytes"] > v["usable_bytes"] > 0
+    assert v["budget_bytes"] == int(10e9)
+    # HOLD forms no intent: nothing to execute, gate, or speculate on
+    assert p.intent() is None
+    assert p.speculation_hint() == {}
+    assert not p.growth_allowed(8)
+    # and it never flips, however many rounds run
+    for t in (10.0, 20.0, 30.0, 40.0):
+        d = p.decide(inputs=_oom_inputs(ts=t))
+        assert d["verdict"] == HOLD and d["reason"] == "oom_veto"
+
+
+def test_unarmed_planner_takes_the_same_shrink():
+    """The control: without the oracle the identical signals RESIZE
+    into the world the armed planner refused."""
+    p = _planner(hysteresis=1)
+    d = _drive_to_resize(p, _oom_inputs)
+    assert d["verdict"] == RESIZE and d["target_world"] == 4
+    assert d["vetoes"] == []  # the key rides every record regardless
+
+
+def test_oracle_with_headroom_vetoes_nothing():
+    """Armed but roomy: every record still carries the (empty) vetoes
+    key, and the decision matches the unarmed planner's."""
+    p = _planner(hysteresis=1, headroom_oracle=_oom_oracle(budget_gb=64.0))
+    d = _drive_to_resize(p, _oom_inputs)
+    assert d["verdict"] == RESIZE and d["target_world"] == 4
+    assert d["vetoes"] == []
+
+
+def test_broken_oracle_degrades_to_unarmed():
+    """Static analysis must never veto by crashing: an oracle that
+    raises keeps every candidate and the decision proceeds."""
+
+    class _Boom:
+        def fits(self, wd):
+            raise RuntimeError("no pricing today")
+
+    p = _planner(hysteresis=1, headroom_oracle=_Boom())
+    d = _drive_to_resize(p, _oom_inputs)
+    assert d["verdict"] == RESIZE and d["target_world"] == 4
+
+
+def test_incumbent_is_never_vetoed():
+    """A budget nothing fits still HOLDs against the incumbent baseline
+    instead of emptying the candidate set: the fleet is already running
+    that world."""
+    p = _planner(hysteresis=1, headroom_oracle=_oom_oracle(budget_gb=0.001))
+    d = p.decide(inputs=_oom_inputs())
+    assert d["verdict"] == HOLD and d["reason"] == "oom_veto"
+    vetoed = {r["spec"] for r in d["vetoes"]}
+    assert "dp8+2slice" not in vetoed and "dp8" not in vetoed
+    assert vetoed, "every non-incumbent candidate is over this budget"

@@ -624,7 +624,12 @@ def test_checked_in_contracts_carry_custom_calls_section():
     cdir = os.path.join(
         os.path.dirname(shardcheck.__file__), "contracts"
     )
-    specs = [f[:-5] for f in os.listdir(cdir) if f.endswith(".json")]
+    specs = [
+        f[:-5] for f in os.listdir(cdir)
+        # the mem-* files are the OTHER contract family sharing this
+        # dir (memcheck MC001); each loader rejects the other's files
+        if f.endswith(".json") and not f.startswith("mem-")
+    ]
     assert specs
     for spec in specs:
         contract = shardcheck.load_contract(cdir, spec)
