@@ -162,6 +162,7 @@ class GoodputPlanner:
         min_gain_frac: float = 0.02,
         hbm_headroom_frac: float = 0.10,
         layout_cost_s: float = 5.0,
+        pp_microbatches: int = 4,
         hbm_capacity_gb: Optional[float] = None,
         dcn_gbps: Optional[float] = None,
         default_resize_cost_s: float = 30.0,
@@ -216,6 +217,10 @@ class GoodputPlanner:
         #: re-lower is a warm cache hit), not a membership change — far
         #: cheaper than resize_cost_s, but never free
         self.layout_cost_s = float(layout_cost_s)
+        #: microbatch count the pp executors run (the ``m`` in the
+        #: interleaved 1f1b bubble fraction (p-1)/(p*m)); the bubble is
+        #: the compute-side cost a pp layout candidate is charged
+        self.pp_microbatches = max(1, int(pp_microbatches))
         self._dcn_bytes_per_s = float(
             dcn_gbps if dcn_gbps is not None else flags.PLANNER_DCN_GBPS.get()
         ) * 1e9
@@ -436,7 +441,12 @@ class GoodputPlanner:
           plus gradient reduce-scatter ``(f-1)/f``, and the dp-axis
           all-reduce shrinks to its ``1/f`` shard;
         - zero-1: one extra sharded-parameter all-gather ``(d-1)/d``
-          after the update.
+          after the update;
+        - pp axis ``p``: each device holds ``1/p`` of the layers, so
+          the dp/fsdp/zero1 param-byte collectives all shrink by
+          ``1/p`` (the stage-boundary activation ppermutes are
+          activation bytes — ~0 in units of P, charged through the
+          bubble model instead).
 
         A *model*, not a measurement — it only ever scales the comm
         share the kernel ledger measured, so an error here distorts a
@@ -444,10 +454,21 @@ class GoodputPlanner:
         axes = wd.axis_sizes()
         d = axes.get("dp", 1)
         f = axes.get("fsdp", 1)
+        p = axes.get("pp", 1)
         grads = 2.0 * (d - 1) / d / f
         params = (2.0 * (f - 1) / f + (f - 1) / f) if f > 1 else 0.0
         z1 = (d - 1) / d if wd.zero1 else 0.0
-        return grads + params + z1
+        return (grads + params + z1) / p
+
+    def _bubble_fraction(self, wd: WorldDescriptor) -> float:
+        """Steady-state pipeline bubble of a candidate: the interleaved
+        1f1b model ``(p-1)/(p*m)`` the engine's schedule contract pins
+        (``parallel/pp_schedule.py``; virtual stages ``v=p``). Non-pp
+        worlds idle nothing."""
+        p = wd.pp
+        if p <= 1:
+            return 0.0
+        return (p - 1) / (p * self.pp_microbatches)
 
     def predict_layout_step_time(
         self, wd: WorldDescriptor, inputs: PlannerInputs
@@ -475,7 +496,15 @@ class GoodputPlanner:
         if not cur_ratio:
             return base
         scale = self._layout_comm_ratio(wd) / cur_ratio
-        return base * (1.0 - comm_share) + base * comm_share * scale
+        # the compute share carries the pipeline bubble: measured time
+        # is ideal work / (1 - bubble), so a pp flip rescales it by
+        # (1 - bubble_now) / (1 - bubble_candidate)
+        bubble_now = self._bubble_fraction(cur) if cur is not None else 0.0
+        compute_scale = (1.0 - bubble_now) / max(
+            1.0 - self._bubble_fraction(wd), 1e-6
+        )
+        return (base * (1.0 - comm_share) * compute_scale
+                + base * comm_share * scale)
 
     @staticmethod
     def _descriptor_of_spec(spec: str) -> Optional[WorldDescriptor]:
@@ -543,10 +572,23 @@ class GoodputPlanner:
 
     # -- candidates --------------------------------------------------------
 
-    def _descriptor(self, nodes: int, n_slices: int) -> Optional[WorldDescriptor]:
+    def _descriptor(
+        self, nodes: int, n_slices: int, pp: int = 1
+    ) -> Optional[WorldDescriptor]:
+        """A node-level candidate descriptor. ``pp`` > 1 preserves the
+        seated pipeline axis across the size change — a pp fleet's
+        resize is a per-stage dp rebalance (live_reshard
+        ``stage_transfer_plan`` kind ``dp_within_stage``), never a
+        silent collapse to pure dp. Falls back to the pure-dp world
+        when the stage count does not divide the candidate size or the
+        world is multislice (a sliced pp world moves the stage map and
+        is a different decision)."""
+        axes = {"dp": nodes}
+        if pp > 1 and n_slices <= 1 and nodes % pp == 0:
+            axes = {"dp": nodes // pp, "pp": pp}
         try:
             return WorldDescriptor.from_axis_sizes(
-                {"dp": nodes},
+                axes,
                 n_slices=max(1, n_slices),
                 hier=n_slices > 1,
             )
@@ -598,12 +640,15 @@ class GoodputPlanner:
             out.append(cur)
             seen.add(cur.spec)
             seen_nodes.add(world)
+        # the seated pipeline axis rides every size candidate: resizing
+        # a pp fleet rebalances dp within stages, it does not flatten
+        cur_pp = cur.pp if cur is not None else 1
         for nodes, slices in raw:
             if nodes < max(1, inputs.min_nodes) or nodes in seen_nodes:
                 continue
             if inputs.max_nodes > 0 and nodes > inputs.max_nodes:
                 continue
-            wd = self._descriptor(nodes, slices)
+            wd = self._descriptor(nodes, slices, pp=cur_pp)
             if wd is None:
                 continue
             if not self._hbm_feasible(wd, inputs):
@@ -691,6 +736,24 @@ class GoodputPlanner:
                 if f > 1:
                     axes["fsdp"] = f
                 _add(axes, cur_z1)
+        # pp re-factorizations — only when the fleet already REPORTS a
+        # pp layout (the engine is proven to slab this model; the
+        # planner cannot check n_layers % p from here): stage count
+        # halved/doubled (per-stage dp width moves the other way) and
+        # the pp exit (pure data axes) falls out of the dp/fsdp loop
+        # above. Scored by the same measured-comm-share model — all
+        # param collectives shrink 1/p, the compute share carries the
+        # interleaved 1f1b bubble (p-1)/(p*m) — so a flip is never
+        # adopted on an unmeasured claim.
+        cur_pp = cur_axes.get("pp", 1)
+        if cur_pp > 1:
+            for p in {cur_pp // 2, cur_pp * 2}:
+                if p > 1 and p != cur_pp and p <= world and world % p == 0:
+                    axes = dict(cur_axes)
+                    axes.pop("fsdp", None)
+                    axes["pp"] = p
+                    axes["dp"] = world // p
+                    _add(axes, cur_z1)
         # the zero-1 toggle on the current factorization
         _add(cur_axes, not cur_z1)
         return out

@@ -100,12 +100,15 @@ class WorldDescriptor:
         if self.n_slices < 1:
             raise ValueError(f"n_slices={self.n_slices} < 1")
         if self.n_slices > 1:
-            dp = self.axis_sizes().get("dp", 1)
-            if dp % self.n_slices:
+            sizes = self.axis_sizes()
+            dp = sizes.get("dp", 1)
+            pp = sizes.get("pp", 1)
+            if dp % self.n_slices and pp % self.n_slices:
                 raise ValueError(
-                    f"dp={dp} does not decompose over "
-                    f"{self.n_slices} slices (dp is the only axis "
-                    "allowed to span DCN)"
+                    f"neither dp={dp} nor pp={pp} decomposes over "
+                    f"{self.n_slices} slices (dp and pp are the only "
+                    "axes allowed to span DCN; dp spans when it can, "
+                    "else whole pp stages are pinned per slice)"
                 )
         if self.hier and self.n_slices <= 1:
             raise ValueError(
@@ -134,7 +137,10 @@ class WorldDescriptor:
     @property
     def dp_in(self) -> int:
         """In-slice dp width — the ICI half of the hierarchical
-        decomposition (``dp = n_slices x dp_in``)."""
+        decomposition (``dp = n_slices x dp_in``). When pp spans the
+        slices instead, all of dp is in-slice."""
+        if self.pp_spans_slices:
+            return self.dp
         return self.dp // self.n_slices
 
     @property
@@ -143,6 +149,39 @@ class WorldDescriptor:
 
     def axis_sizes(self) -> Dict[str, int]:
         return dict(self.axes)
+
+    # -- the stage map -----------------------------------------------------
+
+    @property
+    def pp(self) -> int:
+        return self.axis_sizes().get("pp", 1)
+
+    @property
+    def pp_spans_slices(self) -> bool:
+        """Whether the pp axis is the one crossing DCN: canonical rule
+        is dp spans when it decomposes over the slices, else whole pp
+        stages are pinned to slices — so every spec names exactly one
+        placement and ``pp2+2slice`` is unambiguous."""
+        return self.n_slices > 1 and self.dp % self.n_slices != 0
+
+    @property
+    def per_stage(self) -> int:
+        """Devices holding one pipeline stage (the per-stage reshard
+        unit live_reshard moves and warm_compile signs)."""
+        return self.world_size // self.pp
+
+    def stage_map(self) -> Tuple[Tuple[int, ...], ...]:
+        """Stage placement over slices: entry ``s`` is the tuple of
+        slice indices holding stage ``s``. dp-spanning (and single
+        slice) worlds replicate every stage across all slices;
+        pp-spanning worlds pin ``pp / n_slices`` contiguous stages per
+        slice. Canonical (derived, never stored) so contract specs,
+        transfer targets and AOT signatures can never disagree on it."""
+        if self.pp_spans_slices:
+            per = self.pp // self.n_slices
+            return tuple((s // per,) for s in range(self.pp))
+        all_slices = tuple(range(self.n_slices))
+        return tuple(all_slices for _ in range(self.pp))
 
     # -- the contract-spec grammar ---------------------------------------
 
@@ -271,11 +310,17 @@ class WorldDescriptor:
         """The speculation-hint payload on the rendezvous world poll:
         plain JSON-able dict, skew-safe (old agents drop the unknown
         field; new agents tolerate missing keys)."""
-        return {
+        out = {
             "spec": self.spec,
             "world": self.world_size,
             "n_slices": self.n_slices,
         }
+        if self.pp > 1:
+            # pipelined worlds also publish the stage map, so agents
+            # can pre-stage per-stage transfers without re-deriving it
+            out["pp"] = self.pp
+            out["stage_map"] = [list(s) for s in self.stage_map()]
+        return out
 
     @classmethod
     def from_wire(cls, payload: Optional[Dict]) -> Optional["WorldDescriptor"]:

@@ -296,7 +296,32 @@ class FleetRunner:
         # virtual time
         self.endpoint.gate.clock = self.clock.now
         master.job_manager.attach_gate(self.endpoint.gate)
+        if self.sc.layout_spec:
+            # seed the seated layout (what a real launcher passes the
+            # master): the planner's candidates preserve its stage axis
+            master.speed_monitor.report_layout(
+                self._seated_layout(self.sc.nodes)
+            )
         return master
+
+    def _seated_layout(self, size: int) -> str:
+        """The stage-preserving layout of a seated world of ``size``
+        nodes, derived from the scenario's declared layout: a pp
+        layout keeps its stage count and rebalances dp within stages
+        (the engine's per-stage reshard), any other layout — or a size
+        the stage count does not divide — degrades to pure dp."""
+        from dlrover_tpu.common.world import WorldDescriptor
+
+        try:
+            declared = WorldDescriptor.parse(self.sc.layout_spec)
+        except Exception:
+            return f"dp{size}"
+        pp = declared.pp
+        if pp > 1 and size % pp == 0:
+            return WorldDescriptor.from_axis_sizes(
+                {"dp": size // pp, "pp": pp}
+            ).spec
+        return f"dp{size}"
 
     def _planner_kwargs(self):
         if not self.sc.planner:
@@ -484,6 +509,14 @@ class FleetRunner:
                 # the seated-world timeline the planner verdicts read
                 # (capacity loss, gated waiting, adoption)
                 self._world_timeline.append((vt, size))
+                if self.sc.layout_spec and self.master is not None:
+                    # every re-seated world re-reports its
+                    # stage-preserving layout — the planner's next
+                    # decision round scores candidates against the
+                    # mesh the fleet actually re-formed to
+                    self.master.speed_monitor.report_layout(
+                        self._seated_layout(size)
+                    )
             steps = self.sc.tick_vs / self.sc.step_time_s
             self._progress += steps
             self.view.global_step = int(self._progress)
@@ -896,6 +929,13 @@ class FleetRunner:
                 for ex in rep["executed"]
             ],
             "intent": rep["intent"],
+            # the seated layout the monitor is reporting at verdict
+            # time (stage-preserving across re-forms when the scenario
+            # declares a pp layout)
+            "layout": (
+                self.master.speed_monitor.layout_spec()
+                if self.master else ""
+            ),
             "ledger_digest": ledger_digest,
             "world_timeline": [
                 [round(vt - self._base, 1), size]
@@ -1203,6 +1243,17 @@ class FleetRunner:
                     "planner_actually_acted",
                     len(executed) >= exp["min_executed_plans"],
                     len(executed), f">= {exp['min_executed_plans']}",
+                )
+            if "executed_target_specs" in exp:
+                # every executed plan named EXACTLY the layout the
+                # scenario demands, in order — a pp fleet's readopt
+                # must target the stage-preserving spec (per-stage dp
+                # rebalance), never a flattened pure-dp world
+                got = [e["target"] for e in executed]
+                check(
+                    "executed_plans_target_declared_layouts",
+                    got == exp["executed_target_specs"],
+                    got, f"== {exp['executed_target_specs']}",
                 )
             if "unstable_windows" in exp:
                 # NO plan may execute while the fleet is unstable (the

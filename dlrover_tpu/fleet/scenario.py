@@ -130,6 +130,13 @@ class Scenario:
     planner_hysteresis: int = 2
     #: decision cadence on the virtual clock
     planner_interval_vs: float = 15.0
+    #: the job's parallel layout as a contract spec ("dp4xpp2") —
+    #: reported to the master's SpeedMonitor, where the planner reads
+    #: it: a pp fleet's resize candidates preserve the stage axis
+    #: (per-stage dp rebalance), and every re-form re-reports the
+    #: stage-preserving layout of the re-seated size. "" = the pure-dp
+    #: default (pre-pp scenarios unchanged).
+    layout_spec: str = ""
     # -- memcheck headroom oracle (lint/memcheck.py, the static OOM
     # veto): >0 arms the planner with a per-device HBM budget — every
     # candidate world is priced by the analytic component model and

@@ -56,6 +56,19 @@ docs/design/data_plane.md).
   actually recorded in the decision ledger, ZERO executed plans into
   any vetoed world, exactly one executed plan (the readopt), and
   attribution still summing to elapsed.
+- ``pp_storm`` — elastic pipeline parallelism under chaos
+  (docs/design/pipeline_elasticity.md): an 8-node fleet seated as
+  ``dp4xpp2`` loses half its capacity (one dp rank per stage), the
+  watchdog re-forms the survivors as ``dp2xpp2`` — the layout report
+  tracks the stage-preserving re-seat — and when the capacity returns
+  the planner's readopt plan must target ``dp4xpp2``: a per-stage dp
+  rebalance, never a flattened pure-dp world. A master relaunch after
+  the readopt proves the layout survives the durable-state snapshot.
+  Gates: the executed plan list is EXACTLY ``["dp4xpp2"]``
+  (stage-preserving, planner-directed), the leased dataset converges
+  exactly-once through the storm, attribution sums to elapsed, and
+  the verdict — decision ledger included — is deterministic given the
+  seed.
 - ``smoke`` — a 40-node, 4-virtual-minute cut of the headline for
   tier-1 tests (seconds of real time).
 - ``perturbed_smoke`` — the racecheck schedule explorer
@@ -417,6 +430,62 @@ BUILTIN = {
             "min_oom_vetoes": 3,
             "no_oom_world_admitted": True,
             "readopt_by_vs": 330,
+        },
+    },
+    "pp_storm": {
+        "name": "pp_storm",
+        "seed": 47,
+        "nodes": 8,
+        "min_nodes": 4,
+        "duration_vs": 420,
+        "step_time_s": 1.0,
+        "report_interval_vs": 10,
+        "membership_poll_vs": 8,
+        "heartbeat_timeout_vs": 50,
+        "monitor_sweep_vs": 5,
+        "state_save_vs": 5,
+        "gate_report_cap": 32,
+        "hang_window_vs": 30,
+        # the fleet is a pipeline: 2 stages, dp4 within each — every
+        # resize candidate the planner scores must keep the stage axis
+        "layout_spec": "dp4xpp2",
+        # the data plane stays on through the storm: exactly-once must
+        # survive losing a dp rank from EVERY stage at once
+        "dataset_size": 24_000,
+        "shard_size": 100,
+        "lease_count": 8,
+        "lease_ttl_vs": 60,
+        "records_per_step": 25,
+        "planner": True,
+        "planner_cooldown_vs": 60,
+        "planner_horizon_vs": 400,
+        "planner_hysteresis": 2,
+        "planner_interval_vs": 10,
+        "faults": [
+            # half the fleet preempted — stage-symmetric (nodes 4-7
+            # are one dp rank of each stage in the block layout): the
+            # watchdog re-forms the surviving 4 as dp2xpp2
+            {"kind": "preempt", "at_vs": 40,
+             "nodes": list(range(4, 8)), "duration_vs": 160},
+            # SIGKILL the master AFTER the readopt: the relaunched
+            # master restores the layout report with the snapshot and
+            # keeps planning stage-preserving targets
+            {"kind": "master_relaunch", "at_vs": 330, "duration_vs": 10},
+        ],
+        "expect": {
+            "attribution_sum_tol": 0.01,
+            "goodput_min": 0.60,
+            "max_rpc_latency_s": 2.0,
+            "data_exactly_once": True,
+            "master_survives": True,
+            "relaunches": 1,
+            # the planner-directed per-stage rebalance: exactly one
+            # executed plan, and its target is the stage-preserving
+            # dp4xpp2 — not dp8
+            "max_executed_plans": 1,
+            "min_executed_plans": 1,
+            "executed_target_specs": ["dp4xpp2"],
+            "readopt_by_vs": 320,
         },
     },
     "seated_hang": {

@@ -42,6 +42,17 @@ VOCAB = 256
 #: overlap specs so every pre-existing contract keeps its config_hash.
 OVERLAP_GLOBAL_BATCH = 24
 
+#: pipeline-contract geometry (pp > 1 specs ONLY — non-pp contracts
+#: keep the 2-layer config and their config_hash): 4 layers over
+#: pp=2 x 2 virtual stages (one layer per chunk), 4 microbatches, so
+#: the interleaved 1F1B model bubble is (p-1)/(m*v) = 1/8 — the
+#: paper's (p-1)/(p*m) with v = p. The SC008 contract pins exactly
+#: this geometry.
+PP_LAYERS = 4
+PP_MICROBATCHES = 4
+PP_VIRTUAL_STAGES = 2
+PP_SCHEDULE = "1f1b"
+
 
 def ensure_cpu_devices(n: int) -> None:
     """Force the CPU platform with ≥ ``n`` virtual host devices. Must
@@ -94,12 +105,24 @@ def build_contract_trainer(
     world = 1
     for s in axis_sizes.values():
         world *= s
-    cfg = llama.LlamaConfig.tiny(
-        vocab_size=VOCAB, ce_chunk_size=CE_CHUNK
-    )
+    pp = axis_sizes.get("pp", 1)
+    if pp > 1:
+        # the pipeline variant of the pinned program: same tiny dims,
+        # 4 layers so pp=2 x v=2 holds one layer per chunk, explicit
+        # interleaved-1F1B schedule knobs — the SC008 geometry
+        cfg = llama.LlamaConfig.tiny(
+            vocab_size=VOCAB, ce_chunk_size=CE_CHUNK,
+            n_layers=PP_LAYERS, pp_schedule=PP_SCHEDULE,
+            pp_microbatches=PP_MICROBATCHES,
+            pp_virtual_stages=PP_VIRTUAL_STAGES,
+        )
+    else:
+        cfg = llama.LlamaConfig.tiny(
+            vocab_size=VOCAB, ce_chunk_size=CE_CHUNK
+        )
     mc = MeshConfig(
         dp=axis_sizes.get("dp", 1),
-        pp=axis_sizes.get("pp", 1),
+        pp=pp,
         fsdp=axis_sizes.get("fsdp", 1),
         ep=axis_sizes.get("ep", 1),
         sp=axis_sizes.get("sp", 1),
@@ -108,12 +131,18 @@ def build_contract_trainer(
     mesh = build_mesh(
         mc, devices=jax.devices()[:world], n_slices=n_slices
     )
-    specs = llama.param_specs(cfg)
+    specs = llama.param_specs(cfg, pp=mc.pp)
+    # pp steps feed the schedule's own microbatching: one accum row
+    # carrying the whole global batch (accum=1), so the loss call sees
+    # GLOBAL_BATCH rows to split into PP_MICROBATCHES microbatches
+    micro = (
+        GLOBAL_BATCH // mc.data_parallel_size if pp > 1 else MICRO_BATCH
+    )
     tc = TrainConfig(
         global_batch_size=(
             OVERLAP_GLOBAL_BATCH if overlap else GLOBAL_BATCH
         ),
-        micro_batch_size=MICRO_BATCH,
+        micro_batch_size=micro,
         warmup_steps=0,
         total_steps=100,
         zero1=zero1,
@@ -129,6 +158,13 @@ def build_contract_trainer(
     trainer.shardcheck_hints = {
         "seq_len": SEQ_LEN, "vocab": cfg.vocab_size,
     }
+    if pp > 1:
+        # arms the SC008 pipeline-schedule contract dimension
+        trainer.shardcheck_hints["pp_schedule"] = {
+            "schedule": cfg.pp_schedule,
+            "microbatches": cfg.pp_microbatches or mc.pp,
+            "virtual_stages": cfg.pp_virtual_stages,
+        }
     params = jax.device_put(
         llama.init_params(cfg, jax.random.key(0)),
         named_shardings(mesh, specs),

@@ -1054,6 +1054,201 @@ def check_overlap_against_contract(
 
 
 # ---------------------------------------------------------------------------
+# SC008 — pipeline-schedule contract (bubble fraction + stage handoffs)
+# ---------------------------------------------------------------------------
+#
+# The census counts the pp collectives; SC008 asks whether the
+# SCHEDULE that issues them survived. Two dimensions, both recorded in
+# the contract's ``pp_schedule`` section:
+#
+# - **bubble fraction** — the analytic steady-state pipeline bubble of
+#   the declared schedule geometry, ``(p-1)/(m·v)`` for (interleaved)
+#   1F1B over ideal compute ticks (with ``v = p`` virtual stages this
+#   is the classic ``(p-1)/(p·m)``), ``(p-1)/m`` for GPipe-style
+#   serial fill/drain. A change that re-serializes the schedule (drops
+#   virtual stages, shrinks the microbatch count, flips to gpipe)
+#   grows the fraction and fails the diff — same shape as SC006's
+#   "the stall came back" check, applied to pp instead of DCN.
+# - **stage-handoff pattern** — the static ``collective-permute|pp``
+#   instance count of the lowered program. The explicit 1F1B engine
+#   unrolls its tick table, so each scheduled hop is its own HLO op; a
+#   re-serialization that rolls the handoffs into a scan (or prunes
+#   scheduled hops) collapses this count even when the census bytes
+#   stay plausible.
+
+#: analytic bubble-fraction slack: the contract stores the model's
+#: fraction, the program recomputes it from its own geometry — any
+#: real schedule change moves it by >= 1/(m·v), far above float noise
+BUBBLE_FRACTION_SLACK = 0.005
+#: stage-handoff count tolerance: XLA may merge/split a permute pair
+#: across versions; a schedule change moves the count by O(ticks)
+PP_PERMUTE_COUNT_TOLERANCE = 0.10
+
+
+def schedule_bubble_fraction(
+    schedule: str, pp: int, microbatches: int, virtual_stages: int = 1
+) -> float:
+    """Steady-state pipeline bubble of the engine's schedules, as a
+    fraction of ideal compute ticks (parallel/pp_schedule.py tick
+    model: every microbatch×chunk costs one forward and one backward
+    tick per stage).
+
+    - interleaved 1f1b: fill/drain costs ``2(p-1)`` chunk-granular
+      ticks against ``2·m·v`` ideal ticks -> ``(p-1)/(m·v)``; with the
+      bench geometry ``v = p`` this is the paper's ``(p-1)/(p·m)``.
+    - gpipe / non-interleaved 1f1b (``v = 1``): ``(p-1)/m`` — the
+      fill/drain is microbatch-granular, so losing interleave DOUBLES
+      the bubble at ``v = 2`` and the contract diff sees it.
+    """
+    p = max(1, int(pp))
+    m = max(1, int(microbatches))
+    v = max(1, int(virtual_stages)) if schedule == "1f1b" else 1
+    if p == 1:
+        return 0.0
+    return (p - 1) / float(m * v)
+
+
+def pp_schedule_report(
+    program: StepProgram,
+    collectives: Optional[List[CollectiveOp]] = None,
+) -> Optional[Dict]:
+    """The program's pp-schedule fingerprint, or None when the mesh
+    has no pp axis. Geometry fields come from the lowering hints
+    (``program.pp_schedule``); the handoff evidence from the HLO —
+    every collective-permute whose pairs vary over ``pp`` (attribution
+    is link-free, so single- and multislice programs fingerprint
+    identically):
+
+    - ``ppermute_calls``: static op count. The per-stage layer
+      re-layout permutes at schedule entry/exit live here.
+    - ``ppermute_hops``: the same ops weighted by their enclosing
+      loop trip counts (SC006's honesty rule) — the tick loop rolls
+      the per-tick ring hops into a ``while`` whose trip count IS the
+      schedule length, so a re-serialization that stretches the
+      schedule moves this number even when the static count holds."""
+    p = program.axis_sizes.get("pp", 1)
+    if p <= 1:
+        return None
+    if collectives is None:
+        collectives = parse_collectives(program.hlo, program.coords())
+    pp_ops = [
+        op for op in collectives
+        if op.kind == "collective-permute" and "pp" in op.axes.split("+")
+    ]
+    permutes = len(pp_ops)
+    hops = 0
+    if pp_ops:
+        comps = _parse_hlo_module(program.hlo)
+        line_map: Dict[int, str] = {}
+        for comp in comps.values():
+            for ins in comp.instrs.values():
+                line_map[ins.line] = comp.name
+        while_ctx = _while_body_context(comps)
+        for op in pp_ops:
+            comp_name = line_map.get(op.line)
+            hops += (
+                _trip_product(comp_name, while_ctx) if comp_name else 1
+            )
+    out = {
+        "pp": int(p),
+        "ppermute_calls": int(permutes),
+        "ppermute_hops": int(hops),
+    }
+    hints = program.pp_schedule or {}
+    if hints.get("schedule"):
+        m = int(hints.get("microbatches", p))
+        v = int(hints.get("virtual_stages", 1))
+        out.update({
+            "schedule": hints["schedule"],
+            "microbatches": m,
+            "virtual_stages": v,
+            "bubble_fraction": round(
+                schedule_bubble_fraction(hints["schedule"], p, m, v), 6
+            ),
+        })
+    return out
+
+
+def check_pp_schedule_against_contract(
+    program: StepProgram,
+    contract: Dict,
+    report: Optional[Dict] = None,
+) -> List[Violation]:
+    """SC008: diff the program's pipeline-schedule fingerprint against
+    the contract's ``pp_schedule`` section. Fails when the analytic
+    bubble fraction grew (the schedule re-serialized — fewer virtual
+    stages, fewer microbatches, a gpipe fallback) or the static
+    stage-handoff pattern collapsed/exploded. Silent when the contract
+    has no ``pp_schedule`` section (non-pp contract) or on a
+    config-hash mismatch (SC001 already reports that)."""
+    ref = contract.get("pp_schedule")
+    if not ref:
+        return []
+    if contract.get("config_hash") and program.config_hash and \
+            contract["config_hash"] != program.config_hash:
+        return []
+    if report is None:
+        report = pp_schedule_report(program)
+    out: List[Violation] = []
+    if report is None:
+        out.append(
+            program.violation(
+                "SC008",
+                f"contract pins a pipeline schedule over pp="
+                f"{ref.get('pp')} but the program's mesh has no pp "
+                "axis — the pipeline engine was bypassed entirely; "
+                "justify and --fix-contracts, or restore the pp "
+                "layout.",
+            )
+        )
+        return out
+    ref_frac = float(ref.get("bubble_fraction", 0.0))
+    got_frac = report.get("bubble_fraction")
+    if ref_frac > 0.0 and got_frac is not None and \
+            got_frac > ref_frac + BUBBLE_FRACTION_SLACK:
+        out.append(
+            program.violation(
+                "SC008",
+                f"pipeline bubble fraction grew {ref_frac:.4f} -> "
+                f"{got_frac:.4f} (schedule "
+                f"{ref.get('schedule')}/m={ref.get('microbatches')}/"
+                f"v={ref.get('virtual_stages')} -> "
+                f"{report.get('schedule')}/m={report.get('microbatches')}"
+                f"/v={report.get('virtual_stages')}): the schedule "
+                "re-serialized — stages idle through a longer "
+                "fill/drain than the contract records. Justify and "
+                "--fix-contracts, or restore the interleaved 1F1B "
+                "schedule.",
+            )
+        )
+    for dim, what in (
+        ("ppermute_calls", "static stage-handoff op count"),
+        ("ppermute_hops", "trip-weighted stage-handoff executions"),
+    ):
+        ref_n = int(ref.get(dim, 0))
+        got_n = int(report[dim])
+        if ref_n <= 0:
+            continue
+        lo = ref_n * (1.0 - PP_PERMUTE_COUNT_TOLERANCE)
+        hi = ref_n * (1.0 + PP_PERMUTE_COUNT_TOLERANCE)
+        if not (lo <= got_n <= hi):
+            out.append(
+                program.violation(
+                    "SC008",
+                    f"stage-handoff pattern changed: {what} "
+                    f"{ref_n} in the contract, {got_n} in the program "
+                    f"(> {PP_PERMUTE_COUNT_TOLERANCE:.0%} tolerance). "
+                    "The tick loop's trip count IS the schedule "
+                    "length — a grown hop count means the schedule "
+                    "stretched (extra serial ticks), a collapsed one "
+                    "means scheduled hops were pruned. Justify and "
+                    "--fix-contracts, or restore the schedule.",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # StableHLO entry-signature parsing (SC002/SC003/SC004 substrate)
 # ---------------------------------------------------------------------------
 
@@ -1193,6 +1388,13 @@ class StepProgram:
     #: bytes per step" compares schedules honestly (hier-flat exposes
     #: its DCN leg once per MICROBATCH; overlap once per step)
     accum_steps: int = 1
+    #: pipeline-schedule geometry hints when the program runs pp > 1:
+    #: ``{"schedule": "1f1b"|"gpipe", "microbatches": m,
+    #: "virtual_stages": v}`` — arms the SC008 bubble-fraction /
+    #: stage-handoff contract dimension. None on non-pp programs (and
+    #: on callers that lower without the hints: SC008 then checks the
+    #: structural census only).
+    pp_schedule: Optional[Dict] = None
 
     def coords(self) -> MeshCoords:
         return MeshCoords(self.axis_sizes, n_slices=self.n_slices)
@@ -1765,6 +1967,7 @@ def check_program(
             )
         )
         out.extend(check_custom_calls_against_contract(program, contract))
+        out.extend(check_pp_schedule_against_contract(program, contract))
     if program.stablehlo:
         out.extend(check_replicated_large(program, replicated_threshold))
         out.extend(check_replicated_moments(program, replicated_threshold))
@@ -1844,6 +2047,12 @@ def write_contract(
                 "overlap_ratio",
             )
         }
+    # arms SC008: the pipeline-schedule fingerprint (bubble fraction
+    # of the declared geometry + static stage-handoff pattern).
+    # Recorded for every pp > 1 contract.
+    pp_report = pp_schedule_report(program)
+    if pp_report is not None:
+        data["pp_schedule"] = pp_report
     if extra:
         data.update(extra)
     path = contract_path(contracts_dir, mesh_spec)
@@ -1885,4 +2094,9 @@ SC_RULES: List[Tuple[str, str, str]] = [
      "lowered step, with operand/result shapes, diffed against the "
      "contract — a contracted kernel vanishing is a silent fallback "
      "to the reference path; a new one is un-reviewed."),
+    ("SC008", "pp-schedule-bubble",
+     "Pipeline-schedule fingerprint (analytic steady-state bubble "
+     "fraction of the declared geometry + static collective-permute|pp "
+     "stage-handoff count) diffed against the contract — vetoes a "
+     "change that re-serializes the interleaved 1F1B schedule."),
 ]

@@ -235,6 +235,10 @@ class SpeedMonitor:
         # master-side span buffer for the job timeline: closed downtime
         # brackets as (start, end) epoch pairs (bounded)
         self._downtime_spans: List[Tuple[float, float]] = []
+        # the seated world's parallel layout as a contract spec
+        # ("dp4xpp2"); "" = unreported. The planner's candidate
+        # generator reads it (stage-preserving resize targets).
+        self._layout_spec: str = ""
 
     # -- step samples -------------------------------------------------------
 
@@ -496,6 +500,22 @@ class SpeedMonitor:
             "ranks_reporting": len(per_rank),
         }
 
+    def report_layout(self, spec: str):
+        """The seated world's parallel layout, as a contract spec
+        (``"dp4xpp2"``): seeded by whoever launches the job and
+        re-reported whenever the seated mesh changes (re-form, executed
+        plan). The goodput planner reads it to generate layout- and
+        stage-preserving candidates — a pp fleet's resize targets keep
+        the pipeline axis instead of collapsing to pure dp."""
+        with self._lock:
+            self._layout_spec = str(spec or "")
+
+    def layout_spec(self) -> str:
+        """The last reported seated layout spec ("" = never reported —
+        the planner treats that as the pure-dp default)."""
+        with self._lock:
+            return self._layout_spec
+
     def record_ckpt_blocking(self, seconds: float, node_id: int = -1):
         """Training seconds a checkpoint save blocked the step loop for
         (CheckpointStepReport.blocking_s) — the save half of the
@@ -695,6 +715,7 @@ class SpeedMonitor:
                     str(k): v for k, v in self._overlap_ratio.items()
                 },
                 "last_progress_ts": self._last_progress_ts,
+                "layout_spec": self._layout_spec,
                 "straggler": self.straggler_detector.export_state(),
                 # when the old master dies with no open bracket, the
                 # restore path backdates the relaunch gap to this stamp
@@ -737,6 +758,9 @@ class SpeedMonitor:
                 int(k): float(v)
                 for k, v in (state.get("overlap_ratio") or {}).items()
             }
+            # a relaunched master must keep planning stage-preserving
+            # targets — an empty restore (old snapshot) keeps ""
+            self._layout_spec = str(state.get("layout_spec", ""))
         raw_blocking = state.get("ckpt_blocking_s") or {}
         if not isinstance(raw_blocking, dict):
             # pre-per-rank snapshot: one untagged total

@@ -308,17 +308,156 @@ def _attention(cfg: LlamaConfig, mesh: Optional[Mesh], q, k, v):
                            block_k=cfg.attn_block_k)
 
 
+# ---------------------------------------------------------------------------
+# Explicit-collective building blocks for the full-manual pp stages
+# ---------------------------------------------------------------------------
+# The pp executors run full-manual shard_map over EVERY mesh axis, so
+# nothing is partitioned automatically inside a stage: tp is megatron's
+# recipe (local head/ffn shards between the column-parallel matmuls and
+# a psum closing each row-parallel one), fsdp is ZeRO-3 (per-layer
+# all-gather inside the remat boundary, transposed by AD into a
+# reduce-scatter of the grads), and dp/ep reduce only at the loss/grad
+# sums. Size-1 axes make every collective a no-op, so one code path
+# serves every mesh.
+#
+# Two tp gradient disciplines coexist, picked by ``tp_mode``:
+#
+# - ``"native"`` (gpipe): the backward is shard_map's own transpose, so
+#   the row-parallel psum is a plain ``lax.psum`` and jax's scaled-
+#   partial cotangent discipline (transpose(psum)=psum, boundary psums
+#   over unmentioned axes) produces exact grads with no help.
+# - ``"marker"`` (1f1b / interleaved): the backward is hand-scheduled
+#   (per-slab jax.vjp + explicit end-of-schedule psums) under the
+#   convention that a tp-replicated tensor's cotangent IS the true
+#   total on every tp rank. The megatron f/g custom_vjp pair keeps the
+#   per-device vjps consistent with that convention: ``g`` (psum fwd /
+#   identity bwd) hands the replicated total straight to each rank's
+#   partial, ``f`` (identity fwd / psum bwd) sums the per-rank branch
+#   partials back to a replicated total.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_region_in(x, axis):
+    """Megatron ``f``: identity forward, psum backward."""
+    return x
+
+
+def _tp_region_in_fwd(x, axis):
+    return x, None
+
+
+def _tp_region_in_bwd(axis, _, g):
+    return (lax.psum(g, axis),)
+
+
+_tp_region_in.defvjp(_tp_region_in_fwd, _tp_region_in_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_region_out(x, axis):
+    """Megatron ``g``: psum forward, identity backward."""
+    return lax.psum(x, axis)
+
+
+def _tp_region_out_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_region_out_bwd(axis, _, g):
+    return (g,)
+
+
+_tp_region_out.defvjp(_tp_region_out_fwd, _tp_region_out_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_downscale(x, scale):
+    """Identity forward, ``g * scale`` backward (marker discipline
+    only): where a tp-replicated total cotangent meets a native
+    collective transpose that sums over tp (the lm_head gather's
+    reduce-scatter), pre-scaling by 1/tp makes that sum exact."""
+    return x
+
+
+def _grad_downscale_fwd(x, scale):
+    return x, None
+
+
+def _grad_downscale_bwd(scale, _, g):
+    return ((g * scale).astype(g.dtype),)
+
+
+_grad_downscale.defvjp(_grad_downscale_fwd, _grad_downscale_bwd)
+
+
+#: fsdp (ZeRO-3) all-gather dim per layer leaf, after the leading layer
+#: axis is scanned away: column-parallel wq/wk/wv/w_gate/w_up are
+#: (d, h)=P(FSDP, TP) -> gather dim 0; row-parallel wo/w_down are
+#: (h, d)=P(TP, FSDP) -> gather dim 1. Norm leaves are fsdp-replicated.
+_PP_FSDP_DIM = {
+    "wq": 0, "wk": 0, "wv": 0, "w_gate": 0, "w_up": 0,
+    "wo": 1, "w_down": 1,
+}
+
+
+def _gather_layer_params(lp, fsdp_size):
+    """ZeRO-3 gather of one layer's fsdp-sharded matrices. Called inside
+    the remat boundary so the backward re-gathers instead of saving the
+    full matrices; AD transposes each gather into a reduce-scatter, which
+    is exactly the grad layout the fsdp-sharded out_specs expect."""
+    if fsdp_size <= 1:
+        return lp
+    return {
+        k: (lax.all_gather(a, FSDP, axis=_PP_FSDP_DIM[k], tiled=True)
+            if k in _PP_FSDP_DIM else a)
+        for k, a in lp.items()
+    }
+
+
+def _gather_lm_head(lm_head, fsdp_size, tp_size, marker=False):
+    """Full (d, vocab) lm_head from its P(FSDP, TP) shard for the stage
+    head loss (the weight-gathered limit: no vocab-parallel CE yet).
+    Under the marker discipline the head compute downstream carries
+    tp-replicated TOTAL cotangents, so the tp gather's reduce-scatter
+    transpose needs the 1/tp downscale to stay exact; native AD
+    (gpipe) needs no correction."""
+    if tp_size > 1:
+        if marker:
+            lm_head = _grad_downscale(lm_head, 1.0 / tp_size)
+        lm_head = lax.all_gather(lm_head, TP, axis=1, tiled=True)
+    if fsdp_size > 1:
+        lm_head = lax.all_gather(lm_head, FSDP, axis=0, tiled=True)
+    return lm_head
+
+
 def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x,
-                   attn_fn=None):
+                   attn_fn=None, tp_size=1, tp_mode="native"):
     """One block: pre-norm attention + pre-norm swiglu, residual adds.
     ``attn_fn`` overrides the attention implementation — the pp stages
     pass a manual-axis ring/flash closure since they already sit inside a
-    shard_map."""
+    shard_map. ``tp_size > 1`` (full-manual pp stages only) runs the
+    megatron tp recipe explicitly: local head/ffn shards with a psum
+    closing each row-parallel matmul — native ``lax.psum`` or the f/g
+    marker pair depending on the executor's gradient discipline
+    (``tp_mode``, see the block comment above)."""
     dt = cfg.dtype
     b, s, d = x.shape
     h, kvh, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    marker = tp_size > 1 and tp_mode == "marker"
+    if tp_size > 1:
+        h //= tp_size
+        kvh //= tp_size
+
+    def close_row_parallel(partial):
+        if tp_size <= 1:
+            return partial
+        if marker:
+            return _tp_region_out(partial, TP)
+        return lax.psum(partial, TP)
 
     y = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    if marker:
+        y = _tp_region_in(y, TP)
     q = (y @ lp["wq"].astype(dt)).reshape(b, s, h, hd)
     k = (y @ lp["wk"].astype(dt)).reshape(b, s, kvh, hd)
     v = (y @ lp["wv"].astype(dt)).reshape(b, s, kvh, hd)
@@ -328,12 +467,14 @@ def _decoder_layer(cfg: LlamaConfig, mesh, inv_freq, positions, lp, x,
         attn = _attention(cfg, mesh, q, k, v).reshape(b, s, h * hd)
     else:
         attn = attn_fn(q, k, v).reshape(b, s, h * hd)
-    x = x + attn @ lp["wo"].astype(dt)
+    x = x + close_row_parallel(attn @ lp["wo"].astype(dt))
 
     y = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if marker:
+        y = _tp_region_in(y, TP)
     gate = checkpoint_name(jax.nn.silu(y @ lp["w_gate"].astype(dt)), "ffn_gate")
     up = checkpoint_name(y @ lp["w_up"].astype(dt), "ffn_up")
-    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    x = x + close_row_parallel((gate * up) @ lp["w_down"].astype(dt))
 
     if mesh is not None:
         from jax.sharding import NamedSharding
@@ -730,8 +871,10 @@ def _pp_loss_impl(
     262,489 there — Megatron owns the schedule); here the schedule itself
     is built from JAX primitives: layer-stacked params are sharded
     ``P(pp)`` on the layer axis so each stage holds a contiguous slab, and
-    a ``shard_map`` manual over the pp (and, when present, sp) axes runs
-    the schedule; tp/fsdp stay automatic inside the stages.
+    a ``shard_map`` manual over EVERY mesh axis runs the schedule with
+    explicit collectives — ``ppermute`` stage handoffs on pp, megatron
+    tp psums, ZeRO-3 fsdp gathers — on the portable explicit-collective
+    path (``ops/shard_map_compat.py``), with no ``auto=`` partitioning.
 
     Two schedules (``cfg.pp_schedule``):
 
@@ -757,6 +900,13 @@ def _pp_loss_impl(
         raise ValueError(f"batch={b} not divisible by pp_microbatches={n_micro}")
     mb = b // n_micro
     validate_for_mesh(cfg, mesh, seq_len=s)
+    dp_size, fsdp_size, ep_size, _ = _pp_sizes(mesh)
+    data_shards = dp_size * fsdp_size * ep_size
+    if mb % data_shards:
+        raise ValueError(
+            f"microbatch rows={mb} not divisible by the data shards "
+            f"dp*fsdp*ep={data_shards}"
+        )
     s_local = s // sp_size
 
     from jax.sharding import NamedSharding
@@ -787,23 +937,38 @@ def _pp_loss_impl(
     )
 
 
-def _pp_axis_names(mesh: Mesh, sp_size: int):
-    return {PP} | ({SP} if sp_size > 1 else set())
+#: full-manual in_specs for (x_micro, tgt_micro): microbatch index dim
+#: replicated, per-microbatch batch dim over the data axes, seq over sp
+_PP_X_SPEC = P(None, BATCH_AXES, SP, None)
+_PP_T_SPEC = P(None, BATCH_AXES, SP)
+#: loss-sum reduction axes: every mesh axis EXCEPT tp — the head compute
+#: between the f/g markers is tp-replicated, so its sums are already
+#: totals on each tp rank and a tp psum would overcount
+_PP_LOSS_AXES = (DP, PP, FSDP, EP, SP)
 
 
-def _pp_data_specs(sp_size: int):
-    """in_specs for (x_micro, tgt_micro) under the manual region: split
-    the seq axis over sp when composing, nothing otherwise (dp/fsdp/tp
-    stay automatic)."""
-    if sp_size > 1:
-        return P(None, None, SP, None), P(None, None, SP)
-    return P(), P()
+def _pp_sizes(mesh: Mesh):
+    """(dp, fsdp, ep, tp) sizes the full-manual stages collect over."""
+    shape = dict(mesh.shape)
+    return (shape.get(DP, 1), shape.get(FSDP, 1), shape.get(EP, 1),
+            shape.get(TP, 1))
 
 
-def _stage_layer_fn(cfg: LlamaConfig, mb: int, s_local: int, sp_size: int):
+def _pp_layer_specs(cfg: LlamaConfig, pp_size: int):
+    """Per-leaf in/out specs for the layer stack under full-manual pp:
+    the param_specs tp/fsdp layout with the layer axis over pp."""
+    return param_specs(cfg, pp=pp_size)["layers"]
+
+
+def _stage_layer_fn(cfg: LlamaConfig, mb: int, s_local: int, sp_size: int,
+                    fsdp_size: int = 1, tp_size: int = 1,
+                    tp_mode: str = "native"):
     """Build the per-stage decoder-layer fn INSIDE the manual region:
-    positions carry each sp rank's global sequence offset, and attention
-    is ring-on-sp (already inside the manual axes) or flash."""
+    positions carry each sp rank's global sequence offset, attention is
+    ring-on-sp (already inside the manual axes) or flash, fsdp matrices
+    are ZeRO-3-gathered per layer inside the remat boundary, and tp runs
+    the explicit megatron recipe (``_decoder_layer(tp_size=...)``).
+    ``mb`` is the LOCAL per-data-shard microbatch rows."""
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope_theta)
     if sp_size > 1:
         offset = lax.axis_index(SP) * s_local
@@ -823,14 +988,18 @@ def _stage_layer_fn(cfg: LlamaConfig, mb: int, s_local: int, sp_size: int):
     )
     # mesh=None inside the manual region: NamedSharding constraints on
     # the concrete mesh clash with the Manual context mesh; tp/fsdp
-    # placement inside stages is propagated by XLA from the param
-    # shardings instead
-    return _maybe_remat(
-        cfg,
-        functools.partial(
-            _decoder_layer, cfg, None, inv_freq, positions, attn_fn=attn_fn
-        ),
+    # placement inside stages is explicit (megatron markers + ZeRO-3
+    # gathers), never left to the partitioner
+    base = functools.partial(
+        _decoder_layer, cfg, None, inv_freq, positions, attn_fn=attn_fn,
+        tp_size=tp_size, tp_mode=tp_mode,
     )
+    if fsdp_size > 1:
+        def layer_fn(lp, x):
+            return base(_gather_layer_params(lp, fsdp_size), x)
+    else:
+        layer_fn = base
+    return _maybe_remat(cfg, layer_fn)
 
 
 def _head_loss_sums(cfg: LlamaConfig, out, final_norm, lm_head, tgt):
@@ -850,15 +1019,25 @@ def _pp_gpipe(
     cfg, mesh, pp_size, sp_size, n_micro, mb, s_local, params,
     x_micro, tgt_micro,
 ) -> jnp.ndarray:
+    """GPipe under full-manual shard_map: every mesh axis is manual, the
+    stages run explicit tp/fsdp collectives (``_stage_layer_fn``), and
+    the backward pipeline is pure autodiff — shard_map's transpose psums
+    each input's cotangent over its unmentioned axes, which is exactly
+    the dp/ep/fsdp/tp data reduction (``tp_mode="native"``: no markers,
+    jax's scaled-partial cotangent discipline is exact on its own)."""
     from dlrover_tpu.ops.shard_map_compat import shard_map
 
+    dp_size, fsdp_size, ep_size, tp_size = _pp_sizes(mesh)
+    mb_l = mb // (dp_size * fsdp_size * ep_size)
     n_ticks = n_micro + pp_size - 1
     fwd_perm = [(i, i + 1) for i in range(pp_size - 1)]
-    loss_axes = (PP, SP) if sp_size > 1 else PP
 
     def stage(layers_local, x_mb, tgt_mb, final_norm, lm_head):
         rank = lax.axis_index(PP)
-        layer_fn = _stage_layer_fn(cfg, mb, s_local, sp_size)
+        layer_fn = _stage_layer_fn(
+            cfg, mb_l, s_local, sp_size, fsdp_size, tp_size,
+            tp_mode="native",
+        )
 
         def run_slab(h):
             def body(carry, lp):
@@ -875,8 +1054,10 @@ def _pp_gpipe(
                 lax.dynamic_index_in_dim(x_mb, mb_in, keepdims=False),
                 recv,
             )
-            out = run_slab(inp)
-            recv_next = lax.ppermute(out, PP, fwd_perm)
+            with jax.named_scope("stage_fwd"):
+                out = run_slab(inp)
+            with jax.named_scope("pp_send_recv"):
+                recv_next = lax.ppermute(out, PP, fwd_perm)
             # collect finished microbatches (real only on the last stage;
             # early bubble writes land on index 0 and are overwritten by
             # the first valid tick)
@@ -885,8 +1066,8 @@ def _pp_gpipe(
             return (recv_next, outs), None
 
         init = (
-            jnp.zeros((mb, s_local, cfg.dim), cfg.dtype),
-            jnp.zeros((n_micro, mb, s_local, cfg.dim), cfg.dtype),
+            jnp.zeros((mb_l, s_local, cfg.dim), cfg.dtype),
+            jnp.zeros((n_micro, mb_l, s_local, cfg.dim), cfg.dtype),
         )
         (_, outs), _ = lax.scan(
             tick, init, jnp.arange(n_ticks, dtype=jnp.int32)
@@ -898,7 +1079,7 @@ def _pp_gpipe(
         # the row axis (non-last ranks contribute zeros, so each chunk
         # IS the last stage's data), every rank computes the head for
         # its chunk, and the CE sums psum back together.
-        rows = n_micro * mb
+        rows = n_micro * mb_l
         pad = (-rows) % pp_size
         is_last = (rank == pp_size - 1).astype(outs.dtype)
         outs_flat = outs.reshape(rows, s_local, cfg.dim) * is_last
@@ -916,22 +1097,21 @@ def _pp_gpipe(
         )
         my_tgts = lax.dynamic_slice_in_dim(tgts_flat, rank * chunk, chunk, 0)
         nll_sum, n_valid = _head_loss_sums(
-            cfg, my_rows, final_norm, lm_head, my_tgts
+            cfg, my_rows, final_norm,
+            _gather_lm_head(lm_head, fsdp_size, tp_size), my_tgts,
         )
-        nll_sum = lax.psum(nll_sum, loss_axes)
-        n_valid = lax.psum(n_valid, loss_axes)
+        nll_sum = lax.psum(nll_sum, _PP_LOSS_AXES)
+        n_valid = lax.psum(n_valid, _PP_LOSS_AXES)
         return nll_sum / jnp.maximum(n_valid, 1.0)
 
-    x_spec, t_spec = _pp_data_specs(sp_size)
     pipe = shard_map(
         stage,
         mesh=mesh,
         in_specs=(
-            jax.tree.map(lambda _: P(PP), params["layers"]),
-            x_spec, t_spec, P(), P(),
+            _pp_layer_specs(cfg, pp_size),
+            _PP_X_SPEC, _PP_T_SPEC, P(), P(FSDP, TP),
         ),
         out_specs=P(),
-        axis_names=_pp_axis_names(mesh, sp_size),
         check_vma=False,
     )
     return pipe(
@@ -988,14 +1168,18 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
     T = 2 * (n_micro + pp_size - 1)
     fwd_perm = [(i, i + 1) for i in range(pp_size - 1)]
     bwd_perm = [(i + 1, i) for i in range(pp_size - 1)]
-    loss_axes = (PP, SP) if sp_size > 1 else PP
+    dp_size, fsdp_size, ep_size, tp_size = _pp_sizes(mesh)
+    mb_l = mb // (dp_size * fsdp_size * ep_size)
     f32 = jnp.float32
 
     def stage(layers_local, x_mb, tgt_mb, final_norm, lm_head):
         rank = lax.axis_index(PP)
         is_first = rank == 0
         is_last = rank == pp_size - 1
-        layer_fn = _stage_layer_fn(cfg, mb, s_local, sp_size)
+        layer_fn = _stage_layer_fn(
+            cfg, mb_l, s_local, sp_size, fsdp_size, tp_size,
+            tp_mode="marker",
+        )
 
         def run_slab(layers_, h):
             def body(carry, lp):
@@ -1004,14 +1188,18 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
             out, _ = lax.scan(body, h, layers_)
             return out
 
-        act_shape = (mb, s_local, cfg.dim)
+        act_shape = (mb_l, s_local, cfg.dim)
 
         def head_grads(out, tgt):
             """Last stage only: loss sums + d(nll)/d(out, final_norm,
             lm_head) for one microbatch."""
 
             def nll_of(o, fn, lm):
-                nll, nv = _head_loss_sums(cfg, o, fn, lm, tgt)
+                nll, nv = _head_loss_sums(
+                    cfg, o, fn,
+                    _gather_lm_head(lm, fsdp_size, tp_size, marker=True),
+                    tgt,
+                )
                 return nll, nv
 
             (nll, nv), grads = jax.value_and_grad(
@@ -1047,7 +1235,8 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
                     lax.dynamic_index_in_dim(x_mb, i_f, keepdims=False),
                     recv_act,
                 )
-                out = run_slab(layers_local, inp)
+                with jax.named_scope("stage_fwd"):
+                    out = run_slab(layers_local, inp)
                 act_buf = lax.dynamic_update_index_in_dim(
                     act_buf, inp, i_f % pp_size, 0
                 )
@@ -1070,7 +1259,8 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
                 (act_buf, gin_buf, nll, nv, g_fn, g_lm),
             )
             # collective OUTSIDE the cond: every rank participates
-            recv_act = lax.ppermute(out, PP, fwd_perm)
+            with jax.named_scope("pp_send_recv"):
+                recv_act = lax.ppermute(out, PP, fwd_perm)
 
             # ---- backward op ------------------------------------------
             def bwd_branch(ops):
@@ -1085,8 +1275,9 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
                 inp = lax.dynamic_index_in_dim(
                     act_buf, i_b % pp_size, keepdims=False
                 )
-                _, pull = jax.vjp(run_slab, layers_local, inp)
-                gl, gx = pull(g_out)
+                with jax.named_scope("stage_bwd"):
+                    _, pull = jax.vjp(run_slab, layers_local, inp)
+                    gl, gx = pull(g_out)
                 g_layers = jax.tree.map(jnp.add, g_layers, gl)
                 g_x = jnp.where(
                     is_first,
@@ -1103,7 +1294,8 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
             (g_layers, g_x), gx = lax.cond(
                 do_bwd, bwd_branch, bwd_skip, (g_layers, g_x)
             )
-            recv_grad = lax.ppermute(gx, PP, bwd_perm)
+            with jax.named_scope("pp_send_recv"):
+                recv_grad = lax.ppermute(gx, PP, bwd_perm)
 
             return (recv_act, recv_grad, act_buf, gin_buf,
                     g_layers, g_fn, g_lm, g_x, nll, nv), None
@@ -1123,8 +1315,8 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
         (_, _, _, _, g_layers, g_fn, g_lm, g_x, nll, nv), _ = lax.scan(
             tick, init, jnp.arange(T, dtype=jnp.int32)
         )
-        nll = lax.psum(nll, loss_axes)
-        nv = lax.psum(nv, loss_axes)
+        nll = lax.psum(nll, _PP_LOSS_AXES)
+        nv = lax.psum(nv, _PP_LOSS_AXES)
         loss = nll / jnp.maximum(nv, 1.0)
         # d(mean)/d(sums): grads above are for nll_sum; scale to the mean
         scale = (1.0 / jnp.maximum(nv, 1.0)).astype(f32)
@@ -1134,28 +1326,35 @@ def _pp_1f1b_run(static: _PPStatic, layers, x_micro, final_norm, lm_head,
         g_x = (g_x.astype(f32) * scale).astype(cfg.dtype)
         g_fn = g_fn * scale
         g_lm = (g_lm.astype(f32) * scale).astype(g_lm.dtype)
-        if sp_size > 1:
-            # every sp rank ran the full slab on its seq chunk: layer/head
-            # grads sum over sp (g_x stays per-chunk: it is seq-sharded)
-            g_layers = jax.tree.map(
-                lambda a: lax.psum(a, SP), g_layers
+        # End-of-schedule reductions (the hand-scheduled backward never
+        # crossed a shard_map boundary, so the data reductions native AD
+        # would get from the transpose happen here explicitly):
+        # - dp/ep/sp replicas each saw their own rows -> sum layer/head
+        #   grads over the data axes
+        # - fsdp: matrix-leaf grads were already reduce-scattered by the
+        #   gather transpose inside the slab vjp; fsdp-replicated leaves
+        #   (norms, final_norm) saw fsdp's share of the batch -> sum
+        # - tp: the marker discipline keeps tp-replicated cotangents as
+        #   true totals -> never sum over tp
+        # - pp: head grads / g_x are real on one stage only -> replicate
+        data_axes = (DP, EP, SP)
+        g_layers = {
+            k: lax.psum(
+                a, data_axes if k in _PP_FSDP_DIM else data_axes + (FSDP,)
             )
-            g_fn = lax.psum(g_fn, SP)
-            g_lm = lax.psum(g_lm, SP)
-        # g_x / head grads are real on one pp rank only; psum replicates
+            for k, a in g_layers.items()
+        }
+        g_fn = lax.psum(g_fn, data_axes + (FSDP, PP))
+        g_lm = lax.psum(g_lm, data_axes + (PP,))
         g_x = lax.psum(g_x, PP)
-        g_fn = lax.psum(g_fn, PP)
-        g_lm = lax.psum(g_lm, PP)
         return loss, g_layers, g_x, g_fn, g_lm
 
-    x_spec, t_spec = _pp_data_specs(sp_size)
-    layer_specs = jax.tree.map(lambda _: P(PP), layers)
+    layer_specs = _pp_layer_specs(cfg, pp_size)
     pipe = shard_map(
         stage,
         mesh=mesh,
-        in_specs=(layer_specs, x_spec, t_spec, P(), P()),
-        out_specs=(P(), layer_specs, x_spec, P(), P()),
-        axis_names=_pp_axis_names(mesh, sp_size),
+        in_specs=(layer_specs, _PP_X_SPEC, _PP_T_SPEC, P(), P(FSDP, TP)),
+        out_specs=(P(), layer_specs, _PP_X_SPEC, P(), P(FSDP, TP)),
         check_vma=False,
     )
     loss, g_layers, g_x, g_fn, g_lm = pipe(
@@ -1223,13 +1422,17 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
 
     ring_fwd = [(i, (i + 1) % pp_size) for i in range(pp_size)]
     ring_bwd = [(i, (i - 1) % pp_size) for i in range(pp_size)]
+    dp_size, fsdp_size, ep_size, tp_size = _pp_sizes(mesh)
+    mb_l = mb // (dp_size * fsdp_size * ep_size)
     f32 = jnp.float32
 
     def stage(layers_local, x_mb, tgt_mb, final_norm, lm_head):
         rank = lax.axis_index(PP)
         is_last = rank == pp_size - 1
-        layer_fn = _stage_layer_fn(cfg, mb, s_local, 1)
-        act_shape = (mb, s_local, cfg.dim)
+        layer_fn = _stage_layer_fn(
+            cfg, mb_l, s_local, 1, fsdp_size, tp_size, tp_mode="marker"
+        )
+        act_shape = (mb_l, s_local, cfg.dim)
 
         def run_chunk(layers_, h):
             def body(carry, lp):
@@ -1256,7 +1459,11 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
 
         def head_grads(out, tgt):
             def nll_of(o, fn, lm):
-                nll, nv = _head_loss_sums(cfg, o, fn, lm, tgt)
+                nll, nv = _head_loss_sums(
+                    cfg, o, fn,
+                    _gather_lm_head(lm, fsdp_size, tp_size, marker=True),
+                    tgt,
+                )
                 return nll, nv
 
             (nll, nv), grads = jax.value_and_grad(
@@ -1276,8 +1483,9 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
              g_layers, g_fn, g_lm, g_x, nll, nv) = carry
 
             # -- ring delivery of the previous tick's outputs ----------
-            win_f = lax.ppermute(wire_f, PP, ring_fwd)
-            win_b = lax.ppermute(wire_b, PP, ring_bwd)
+            with jax.named_scope("pp_send_recv"):
+                win_f = lax.ppermute(wire_f, PP, ring_fwd)
+                win_b = lax.ppermute(wire_b, PP, ring_bwd)
 
             def pick(name):
                 return lax.dynamic_index_in_dim(
@@ -1306,7 +1514,8 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
                     lax.dynamic_index_in_dim(x_mb, f_i, keepdims=False),
                     b_get(recv_act, f_u, f_i % S),
                 )
-                out = run_chunk(chunk_params(f_u), inp)
+                with jax.named_scope("stage_fwd"):
+                    out = run_chunk(chunk_params(f_u), inp)
                 act_saved = b_set(act_saved, inp, f_u, f_i % S)
                 is_lastc = is_last & (f_u == v - 1)
                 tgt = lax.dynamic_index_in_dim(tgt_mb, f_i, keepdims=False)
@@ -1336,8 +1545,9 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
                 g_layers, g_x = ops
                 g_out = b_get(recv_grad, b_u, b_i % S)
                 inp = b_get(act_saved, b_u, b_i % S)
-                _, pull = jax.vjp(run_chunk, chunk_params(b_u), inp)
-                gl, gx = pull(g_out)
+                with jax.named_scope("stage_bwd"):
+                    _, pull = jax.vjp(run_chunk, chunk_params(b_u), inp)
+                    gl, gx = pull(g_out)
 
                 def acc(dst, g):
                     cur = lax.dynamic_slice_in_dim(dst, b_u * Lc, Lc, 0)
@@ -1380,8 +1590,8 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
         )
         carry, _ = lax.scan(tick, init, dev_tables)
         (_, _, _, _, _, g_layers, g_fn, g_lm, g_x, nll, nv) = carry
-        nll = lax.psum(nll, PP)
-        nv = lax.psum(nv, PP)
+        nll = lax.psum(nll, _PP_LOSS_AXES)
+        nv = lax.psum(nv, _PP_LOSS_AXES)
         loss = nll / jnp.maximum(nv, 1.0)
         scale = (1.0 / jnp.maximum(nv, 1.0)).astype(f32)
         g_layers = jax.tree.map(
@@ -1390,19 +1600,27 @@ def _pp_interleaved_run(static: _PPStatic, layers, x_micro, final_norm,
         g_x = (g_x.astype(f32) * scale).astype(cfg.dtype)
         g_fn = g_fn * scale
         g_lm = (g_lm.astype(f32) * scale).astype(g_lm.dtype)
-        # g_x / head grads are real on one pp rank only; psum replicates
+        # same explicit end-of-schedule reductions as plain 1f1b (see
+        # there): data axes summed, fsdp already scattered for matrix
+        # leaves, tp never summed (marker discipline), pp replicated
+        data_axes = (DP, EP, SP)
+        g_layers = {
+            k: lax.psum(
+                a, data_axes if k in _PP_FSDP_DIM else data_axes + (FSDP,)
+            )
+            for k, a in g_layers.items()
+        }
+        g_fn = lax.psum(g_fn, data_axes + (FSDP, PP))
+        g_lm = lax.psum(g_lm, data_axes + (PP,))
         g_x = lax.psum(g_x, PP)
-        g_fn = lax.psum(g_fn, PP)
-        g_lm = lax.psum(g_lm, PP)
         return loss, g_layers, g_x, g_fn, g_lm
 
-    layer_specs = jax.tree.map(lambda _: P(PP), layers_rm)
+    layer_specs = _pp_layer_specs(cfg, pp_size)
     pipe = shard_map(
         stage,
         mesh=mesh,
-        in_specs=(layer_specs, P(), P(), P(), P()),
-        out_specs=(P(), layer_specs, P(), P(), P()),
-        axis_names={PP},
+        in_specs=(layer_specs, _PP_X_SPEC, _PP_T_SPEC, P(), P(FSDP, TP)),
+        out_specs=(P(), layer_specs, _PP_X_SPEC, P(), P(FSDP, TP)),
         check_vma=False,
     )
     loss, g_layers_rm, g_x, g_fn, g_lm = pipe(

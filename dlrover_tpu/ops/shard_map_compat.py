@@ -5,6 +5,14 @@ the top level and the replication-check kwarg is ``check_vma``. Older
 jaxlibs (0.4.x, this image) ship it under ``jax.experimental`` with the
 kwarg named ``check_rep``. One import point so every call site stays
 written in the modern idiom.
+
+This compat layer is why the pipeline engine (``models/llama.py`` pp
+executors) is written FULL-MANUAL — every mesh axis mapped, every
+collective explicit (``ppermute`` stage handoffs, megatron tp psums,
+ZeRO-3 fsdp gathers). Full-manual programs lower identically on every
+jax this shim spans; partial-manual (``axis_names=`` subsets) depends
+on the legacy best-effort ``auto=`` translation that XLA CHECK-aborts
+on for exactly those programs (see ``supports_partial_manual``).
 """
 
 from __future__ import annotations

@@ -154,14 +154,24 @@ def _build_multislice_mesh(
     if n % n_slices:
         raise ValueError(f"{n} devices not divisible by {n_slices} slices")
     per_slice = n // n_slices
-    if config.dp % n_slices:
+    # canonical DCN placement (WorldDescriptor.pp_spans_slices): dp
+    # spans the slices when it decomposes, else whole pp stages are
+    # pinned per slice — activations ride DCN on the stage boundary
+    # ppermute while fsdp/ep/sp/tp collectives stay on one slice's ICI
+    pp_spans = config.dp % n_slices != 0
+    if pp_spans and config.pp % n_slices:
         raise ValueError(
-            f"dp={config.dp} must be divisible by n_slices={n_slices}: dp is "
-            "the only axis allowed to span DCN (fsdp/ep/sp/tp collectives "
-            "must stay on one slice's ICI)"
+            f"neither dp={config.dp} nor pp={config.pp} is divisible by "
+            f"n_slices={n_slices}: dp and pp are the only axes allowed "
+            "to span DCN (fsdp/ep/sp/tp collectives must stay on one "
+            "slice's ICI)"
         )
-    within = (config.dp // n_slices) * config.pp * config.fsdp \
-        * config.ep * config.sp * config.tp
+    if pp_spans:
+        within = config.dp * (config.pp // n_slices) * config.fsdp \
+            * config.ep * config.sp * config.tp
+    else:
+        within = (config.dp // n_slices) * config.pp * config.fsdp \
+            * config.ep * config.sp * config.tp
     if within != per_slice:
         raise ValueError(
             f"per-slice mesh ({within}) != devices per slice ({per_slice})"
@@ -174,26 +184,37 @@ def _build_multislice_mesh(
         )
     else:
         ordered = list(devices)  # contiguous chunks = virtual slices
-    try:
-        from jax.experimental import mesh_utils
+    if not pp_spans:
+        try:
+            from jax.experimental import mesh_utils
 
-        if None not in slice_ids and len(slice_ids) == n_slices:
-            ici = (config.dp // n_slices, config.pp, config.fsdp,
-                   config.ep, config.sp, config.tp)
-            dcn = (n_slices, 1, 1, 1, 1, 1)
-            arr = mesh_utils.create_hybrid_device_mesh(
-                ici, dcn, devices=ordered
-            )
-            return Mesh(arr, AXIS_ORDER)
-    except Exception:
-        pass
-    # manual hybrid layout: slice-major over the outer dp slab, so
-    # mesh[d, ...] with d // (dp/n_slices) selecting the slice
+            if None not in slice_ids and len(slice_ids) == n_slices:
+                ici = (config.dp // n_slices, config.pp, config.fsdp,
+                       config.ep, config.sp, config.tp)
+                dcn = (n_slices, 1, 1, 1, 1, 1)
+                arr = mesh_utils.create_hybrid_device_mesh(
+                    ici, dcn, devices=ordered
+                )
+                return Mesh(arr, AXIS_ORDER)
+        except Exception:
+            pass
+        # manual hybrid layout: slice-major over the outer dp slab, so
+        # mesh[d, ...] with d // (dp/n_slices) selecting the slice
+        arr = np.array(ordered).reshape(
+            (n_slices, config.dp // n_slices, config.pp, config.fsdp,
+             config.ep, config.sp, config.tp)
+        ).reshape(tuple(config.shape()[a] for a in AXIS_ORDER))
+        return Mesh(arr, AXIS_ORDER)
+    # pp-spanning layout: slice-major over the stage axis, so stage s
+    # lives wholly on slice s // (pp/n_slices) (the stage map) and only
+    # the stage-boundary ppermute crosses DCN
     arr = np.array(ordered).reshape(
-        (n_slices, config.dp // n_slices, config.pp, config.fsdp,
+        (n_slices, config.pp // n_slices, config.dp, config.fsdp,
          config.ep, config.sp, config.tp)
-    ).reshape(tuple(config.shape()[a] for a in AXIS_ORDER))
-    return Mesh(arr, AXIS_ORDER)
+    ).reshape((config.pp, config.dp, config.fsdp, config.ep,
+               config.sp, config.tp))
+    arr = np.moveaxis(arr, 0, 1)  # -> (dp, pp, fsdp, ep, sp, tp)
+    return Mesh(np.ascontiguousarray(arr), AXIS_ORDER)
 
 
 def mesh_slice_of(mesh: Mesh, n_slices: int, dp_index: int) -> int:
@@ -215,6 +236,23 @@ def mesh_slice_of(mesh: Mesh, n_slices: int, dp_index: int) -> int:
         raise ValueError(f"dp_index={dp_index} outside dp axis of {dp}")
     per = dp // n_slices
     return dp_index // per
+
+
+def mesh_slice_of_stage(mesh: Mesh, n_slices: int, pp_index: int) -> int:
+    """Which slice a given pp-stage index lives on under the
+    pp-spanning slice-major layout (``stage s -> slice s // (pp/n)``,
+    the mesh-side face of ``WorldDescriptor.stage_map``)."""
+    if n_slices < 1:
+        raise ValueError(f"n_slices={n_slices} must be >= 1")
+    pp = mesh.shape[PP]
+    if pp % n_slices:
+        raise ValueError(
+            f"pp={pp} does not tile into n_slices={n_slices} whole "
+            "slices (the stage-pinned layout requires pp % n_slices == 0)"
+        )
+    if not 0 <= pp_index < pp:
+        raise ValueError(f"pp_index={pp_index} outside pp axis of {pp}")
+    return pp_index // (pp // n_slices)
 
 
 def config_for(world) -> MeshConfig:

@@ -147,6 +147,11 @@ def classify_site(opcode: str, target: str, op_name: str) -> str:
     s = (op_name or "").lower()
     t = (target or "").lower()
     if opcode in _COLLECTIVES:
+        if "pp_send_recv" in s:
+            # pp stage handoff (ppermute under the pp executors' scope):
+            # its own census row instead of folding into comm.collective-
+            # permute, so the bench/metrics can see pipeline comm
+            return "comm.pp_send_recv"
         if "dcn" in s or "bucket" in s or "hier" in s:
             return "comm.dcn_bucket"
         return f"comm.{opcode.replace('-start', '')}"
@@ -165,7 +170,8 @@ def classify_site(opcode: str, target: str, op_name: str) -> str:
 def _kernel_family(s: str) -> Optional[str]:
     """Family from the op_name scope path. The ops plant
     ``jax.named_scope`` markers at their custom_vjp fwd/bwd boundaries
-    (attention_fwd/bwd, fused_ce_*/chunked_ce_*, optimizer_update), so
+    (attention_fwd/bwd, fused_ce_*/chunked_ce_*, optimizer_update; the
+    pp executors add stage_fwd/stage_bwd + pp_send_recv), so
     every primitive they trace — Pallas custom-call or reference-path
     dot — carries its operator in the metadata; the attention einsum
     specs are the fallback for unscoped reference code."""
@@ -186,6 +192,14 @@ def _kernel_family(s: str) -> Optional[str]:
     if ("fused_ce" in s or "chunked_ce" in s or "cross_entropy" in s
             or "lm_head" in s or "unembed" in s):
         return "ce.bwd" if bwd else "ce.fwd"
+    # pp stage slabs: anything inside the executors' stage scopes that a
+    # more specific family above didn't claim (attention/ce markers win
+    # because they are checked first). gpipe's backward is the AD
+    # transpose of the fwd scope -> transpose(stage_fwd) counts as bwd.
+    if "stage_bwd" in s:
+        return "stage.bwd"
+    if "stage_fwd" in s:
+        return "stage.bwd" if bwd else "stage.fwd"
     return None
 
 

@@ -59,6 +59,7 @@ __all__ = [
     "LiveReshardError",
     "state_shardings",
     "state_targets",
+    "stage_transfer_plan",
     "transfer_state",
     "ResizeLedger",
     "resize_ledger",
@@ -144,11 +145,63 @@ def _bridge_leaf(leaf, sharding):
     )
 
 
+def stage_transfer_plan(old_world, new_world) -> Optional[Dict[str, Any]]:
+    """Per-stage movement plan for a pp-aware resize, derived from the
+    same :class:`~dlrover_tpu.common.world.WorldDescriptor` pair that
+    keys the AOT executable — so what moves and what signs can never
+    disagree. Returns ``None`` when neither world pipelines (the plain
+    dp/fsdp transfer needs no stage bookkeeping). Kinds:
+
+    - ``dp_within_stage``: stage count unchanged — each stage's data
+      axes shrink/grow in place, layer slabs never cross stages;
+    - ``stage_rebalance``: stage count changed — layer slabs re-slab
+      (new stage ``s'`` takes the old-stage fraction
+      ``[s'*old_pp/new_pp, (s'+1)*old_pp/new_pp)``);
+
+    plus, per new stage, its slice placement before/after (from the
+    canonical ``stage_map``) — ``cross_slice`` marks a stage whose
+    bytes must ride DCN."""
+    if old_world is None or new_world is None:
+        return None
+    old_pp, new_pp = old_world.pp, new_world.pp
+    if old_pp <= 1 and new_pp <= 1:
+        return None
+    kind = "dp_within_stage" if old_pp == new_pp else "stage_rebalance"
+    old_map, new_map = old_world.stage_map(), new_world.stage_map()
+    stages = []
+    for s in range(new_pp):
+        # old stages whose layer slab lands (fully or partly) on s:
+        # the old-stage fraction [s/new_pp, (s+1)/new_pp) of the stack
+        lo = s * old_pp // new_pp
+        hi = -(-(s + 1) * old_pp // new_pp)  # ceil
+        src = tuple(range(lo, max(lo + 1, hi)))
+        src_slices = sorted({sl for o in src if o < old_pp
+                             for sl in old_map[o]})
+        dst_slices = list(new_map[s])
+        stages.append({
+            "stage": s,
+            "src_stages": list(src),
+            "src_slices": src_slices,
+            "dst_slices": dst_slices,
+            "cross_slice": bool(src_slices) and src_slices != dst_slices,
+        })
+    return {
+        "kind": kind,
+        "old_pp": old_pp,
+        "new_pp": new_pp,
+        "from": old_world.spec,
+        "to": new_world.spec,
+        "stages": stages,
+    }
+
+
 def transfer_state(
     state: PyTree,
     shardings: PyTree,
     *,
     block: bool = True,
+    old_world=None,
+    new_world=None,
 ) -> tuple:
     """Move ``state`` onto the shardings' mesh device-to-device.
 
@@ -169,6 +222,9 @@ def transfer_state(
     t0 = time.perf_counter()
     m0 = time.monotonic()
     info: Dict[str, Any] = {"path": "direct", "leaves_bridged": 0}
+    plan = stage_transfer_plan(old_world, new_world)
+    if plan is not None:
+        info["stage_plan"] = plan
     try:
         new_state = jax.device_put(state, shardings)
     except Exception as e:

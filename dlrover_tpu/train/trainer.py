@@ -1118,6 +1118,10 @@ class ElasticTrainer:
                 mesh_config if mesh_config is not None
                 else self.mesh_config,
             ),
+            # pipeline-schedule geometry for the SC008 bubble-fraction
+            # contract dimension — supplied by callers that know the
+            # model's schedule knobs (contract_model, bench)
+            pp_schedule=hints.get("pp_schedule"),
         )
 
     def world_descriptor(self, mesh: Optional[Mesh] = None) -> WorldDescriptor:

@@ -357,10 +357,20 @@ def neighbor_worlds(
     worlds are whole-slice multiples. Candidates become world minus one
     slice (the most common multislice loss), half the slices, world
     plus one slice; every candidate must tile into whole slices AND the
-    refit dp must still decompose over the surviving slice count (dp is
-    the only axis allowed to span DCN). A slice loss then resizes warm:
-    the speculated executable was compiled on the slice-major neighbor
-    mesh the re-seated world actually forms."""
+    refit dp (or, for stage-pinned pp worlds, pp) must still decompose
+    over the surviving slice count (dp and pp are the only axes allowed
+    to span DCN). A slice loss then resizes warm: the speculated
+    executable was compiled on the slice-major neighbor mesh the
+    re-seated world actually forms.
+
+    Stage-aware enumeration (``pp > 1``): each candidate world size is
+    tried both pp-preserving (shrink/grow the data axes WITHIN every
+    stage — `parallel.mesh.remesh` keeps model axes) and with the stage
+    count rebalanced (pp halved / doubled, layers re-slabbed), so a
+    node loss that starves a stage of its dp width still has a
+    speculated executable waiting."""
+    import dataclasses as _dc
+
     from dlrover_tpu.common.world import WorldDescriptor
     from dlrover_tpu.parallel.mesh import remesh as remesh_config
 
@@ -375,43 +385,53 @@ def neighbor_worlds(
                world + per_slice]
     else:
         raw = [world - node, world // 2, world + node]
+    pp0 = getattr(mesh_config, "pp", 1)
+    base_cfgs = [mesh_config]
+    if pp0 > 1:
+        if pp0 % 2 == 0:
+            base_cfgs.append(_dc.replace(mesh_config, pp=pp0 // 2))
+        base_cfgs.append(_dc.replace(mesh_config, pp=pp0 * 2))
     out: List[WorldDescriptor] = []
     seen: set = set()
     for w in raw:
-        if w <= 0 or w == world or w in seen:
+        if w <= 0 or w == world:
             continue
         if w > n_devices_available:
             continue
-        try:
-            refit = remesh_config(mesh_config, w)
-            resolved = refit.resolve(w)
-            dp = resolved.data_parallel_size
-        except ValueError:
-            continue
-        if global_batch_size % (micro_batch_size * dp):
-            continue
-        slices = 1
-        if per_slice:
-            slices = w // per_slice
-            if w % per_slice:
+        for base in base_cfgs:
+            try:
+                refit = remesh_config(base, w)
+                resolved = refit.resolve(w)
+                dp = resolved.data_parallel_size
+            except ValueError:
                 continue
-            # the surviving world must still host a legal multislice
-            # mesh: dp spans DCN, nothing else may
-            if slices > 1 and resolved.dp % slices:
+            if global_batch_size % (micro_batch_size * dp):
                 continue
-        try:
-            out.append(
-                WorldDescriptor.from_axis_sizes(
+            slices = 1
+            if per_slice:
+                slices = w // per_slice
+                if w % per_slice:
+                    continue
+                # the surviving world must still host a legal
+                # multislice mesh: dp spans DCN when it can, else
+                # whole pp stages pin to slices; nothing else may
+                if slices > 1 and resolved.dp % slices \
+                        and resolved.pp % slices:
+                    continue
+            try:
+                cand = WorldDescriptor.from_axis_sizes(
                     resolved.shape(),
                     n_slices=max(1, slices),
                     hier=slices > 1,
                 )
-            )
-        except ValueError:
-            continue
-        seen.add(w)
-        if len(out) >= max_targets:
-            break
+            except ValueError:
+                continue
+            if cand.spec in seen:
+                continue
+            seen.add(cand.spec)
+            out.append(cand)
+            if len(out) >= max_targets:
+                return out
     return out
 
 

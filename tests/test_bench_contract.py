@@ -342,3 +342,82 @@ def test_cached_tpu_result_staleness_flag(tmp_path, monkeypatch):
     assert bench._load_cached_tpu_result(path) is None
     assert bench._load_cached_tpu_result(str(tmp_path / "nope.json")) \
         is None
+
+
+@pytest.mark.slow
+def test_bench_pp_resize_contract(tmp_path):
+    """ISSUE 19 acceptance, pinned on the 8-device CPU world: the
+    resize phase's two pipeline legs. The ``pp`` leg shrinks dp2xpp2
+    to pp2 through the per-stage transfer plan and must land warm —
+    the post-resize step dispatches the stage-aware speculatively
+    compiled executable — with the schedule-table bubble fraction
+    matching the analytic ``(p-1)/(p·m)``. The ``pp_multislice`` leg
+    pins one stage per virtual slice and must attribute the stage-1
+    handoff to DCN before collapsing the slice boundary.
+
+    Slow-marked: two extra cold pp compiles in the bench subprocess
+    don't fit the tier-1 870 s budget; CI runs this test explicitly in
+    the tier1.yml pp-resize-contract step."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DLROVER_BENCH_PROBE_ATTEMPTS"] = "1"
+    env["DLROVER_BENCH_PHASES"] = "resize"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "").replace(
+            "--xla_force_host_platform_device_count=8", ""
+        ).strip() + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    # the speculative thread only arms with a persistent compile
+    # cache to land its executables in — without this the warm leg
+    # silently degrades to a cold rebuild
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jitcache")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+
+    pp = d["detail"]["resize"]["pp"]
+    assert "error" not in pp, pp
+    assert pp["from"] == "dp2xpp2" and pp["to"] == "pp2"
+    # shrinking dp within stages never crosses a stage boundary
+    assert pp["stage_plan_kind"] == "dp_within_stage"
+    # the acceptance bar: the schedule table's measured fill/drain
+    # fraction IS the paper's closed form (p-1)/(p·m) for v = p
+    assert pp["bubble_fraction_analytic"] == pytest.approx(0.125)
+    assert pp["bubble_fraction"] == pytest.approx(
+        pp["bubble_fraction_analytic"], abs=0.02
+    )
+    assert pp["speculation_completed"] is True
+    # the definitive warm evidence: the post-resize step landed on the
+    # speculatively-built executable, and it beat the cold rebuild
+    assert pp["warm_hit"] is True
+    assert 0 < pp["warm_downtime_s"] < pp["cold_downtime_s"]
+    assert pp["warm_cold_ratio"] < 0.9
+    assert "loss_mismatch" not in pp, pp
+    # SC008 fingerprint of the live post-resize program rides the
+    # trajectory JSON: same analytic bubble, rolled tick loop
+    rep = pp["pp_schedule_report"]
+    assert rep["pp"] == 2 and rep["schedule"] == "1f1b"
+    assert rep["bubble_fraction"] == pytest.approx(0.125)
+    assert rep["ppermute_hops"] > rep["ppermute_calls"] > 0
+    census = pp["collective_census"]
+    assert any(
+        k.startswith("collective-permute") and "pp" in k for k in census
+    ), census
+
+    ms = d["detail"]["resize"]["pp_multislice"]
+    assert "error" not in ms, ms
+    assert ms["from"] == "pp2+2slice" and ms["to"] == "pp2"
+    # one stage per virtual slice; the stage count survives the
+    # collapse (dp_within_stage), but stage 1's leg crosses the
+    # (virtual) DCN cut — exactly the per-stage plan's cross_slice mark
+    assert ms["stage_map"] == [[0], [1]]
+    assert ms["stage_plan_kind"] == "dp_within_stage"
+    assert ms["cross_slice_stages"] == [1]
+    assert ms["census_dcn_bytes"] > 0
+    assert ms["pp_schedule_report"]["bubble_fraction"] == \
+        pytest.approx(0.125)
+    assert ms["cross_slice_resize_s"] > 0

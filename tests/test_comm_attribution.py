@@ -29,7 +29,6 @@ from dlrover_tpu.profiler.comm import (
     measure_mesh_bandwidths,
     start_metrics_server,
 )
-from tests.markers import legacy_pp_xfail
 
 
 @pytest.fixture(autouse=True)
@@ -116,7 +115,6 @@ def test_ulysses_records_all_to_alls():
     assert scatter.nbytes == 3 * 2 * 16 * 4 * hd * 4
 
 
-@legacy_pp_xfail
 def test_pipeline_records_act_hops():
     mc = MeshConfig(dp=1, pp=2, fsdp=1, sp=1, tp=2).resolve(4)
     mesh = build_mesh(mc, devices=jax.devices()[:4])

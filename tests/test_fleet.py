@@ -35,7 +35,7 @@ def test_builtin_scenarios_load():
         "headline_1k", "overload_10x", "smoke",
         "shard_storm_1k", "shard_storm_smoke", "seated_hang",
         "perturbed_smoke", "version_skew_old_master",
-        "version_skew_old_workers", "oom_storm",
+        "version_skew_old_workers", "oom_storm", "pp_storm",
     ):
         sc = load_scenario(name)
         assert sc.nodes > 0 and sc.duration_vs > 0
@@ -277,6 +277,52 @@ def test_oom_storm_deterministic(tmp_path):
     v2 = _run("oom_storm", tmp_path / "b")
     assert v1["planner"]["ledger_digest"] == v2["planner"]["ledger_digest"]
     assert v1["planner"]["oom_vetoes"] == v2["planner"]["oom_vetoes"]
+
+
+def test_pp_storm_stage_preserving_rebalance(tmp_path):
+    """Elastic pipeline parallelism under chaos (ISSUE 19,
+    docs/design/pipeline_elasticity.md): an 8-node dp4xpp2 fleet loses
+    one dp rank from every stage, the watchdog re-forms the survivors
+    as dp2xpp2 (the layout report tracks the stage-preserving
+    re-seat), and the planner's readopt plan targets dp4xpp2 — a
+    per-stage dp rebalance, never a flattened dp8 — while the leased
+    data plane converges exactly-once and a post-readopt master
+    relaunch restores the layout from the durable snapshot."""
+    v = _run("pp_storm", tmp_path / "run")
+    assert v["ok"], v["checks"]
+    pl = v["planner"]
+    assert pl["armed"]
+    # the planner-directed per-stage rebalance: ONE executed plan and
+    # its target spec carries the stage axis
+    assert len(pl["executed"]) == 1
+    assert pl["executed"][0]["target"] == "dp4xpp2"
+    assert pl["executed"][0]["target_world"] == 8
+    # the seated-world story: full pipeline -> half the dp width ->
+    # full again, and the monitor's layout ends stage-preserving
+    # (reported by the post-relaunch master, i.e. it survived the
+    # durable-state snapshot)
+    sizes = [s for _, s in pl["world_timeline"]]
+    assert sizes[0] == 8 and 4 in sizes and sizes[-1] == 8
+    assert pl["layout"] == "dp4xpp2"
+    # exactly-once through the storm: fenced acks tile [0, size)
+    dp = v["data_plane"]
+    assert dp["acked_records"] == dp["dataset_size"] == 24_000
+    assert dp["overlaps"] == 0 and dp["gaps"] == 0
+    assert v["master_relaunches"] == 1
+    cats = v["attribution"]["categories"]
+    assert sum(cats.values()) == pytest.approx(
+        v["attribution"]["elapsed_wall_s"], rel=0.01
+    )
+
+
+def test_pp_storm_deterministic(tmp_path):
+    """The pp readopt decision is ledgered like every other: two runs
+    of the same seed produce identical ledgers and verdicts."""
+    v1 = _run("pp_storm", tmp_path / "a")
+    v2 = _run("pp_storm", tmp_path / "b")
+    assert v1["planner"]["ledger_digest"] == v2["planner"]["ledger_digest"]
+    assert v1["determinism_digest"] == v2["determinism_digest"]
+    assert v1["planner"]["executed"] == v2["planner"]["executed"]
 
 
 def test_autoscale_smoke_decisions_deterministic(tmp_path):

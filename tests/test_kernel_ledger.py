@@ -50,6 +50,15 @@ jax.config.update("jax_platforms", "cpu")
     ("all-gather-start", "", "", "comm.all-gather"),
     ("reduce-scatter", "", "dcn_bucket_3/psum_scatter",
      "comm.dcn_bucket"),
+    # pp executor scopes: stage handoff + slab compute
+    ("collective-permute", "", "jit(step)/pp_send_recv/ppermute",
+     "comm.pp_send_recv"),
+    ("dot", "", "jit(step)/stage_fwd/scan/dot_general", "stage.fwd"),
+    ("fusion", "", "jit(step)/stage_bwd/scan/mul", "stage.bwd"),
+    ("dot", "", "jit(step)/transpose(stage_fwd)/dot", "stage.bwd"),
+    # specific op markers win over the enclosing stage scope
+    ("dot", "", "jit(step)/stage_fwd/attention_fwd/dot",
+     "attention.fwd"),
     ("dot", "", "jit(f)/mlp/dot_general", "matmul"),
     ("fusion", "", "jit(f)/gelu", "other"),
 ])

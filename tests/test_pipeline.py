@@ -15,7 +15,6 @@ import pytest
 from dlrover_tpu.models import llama
 from dlrover_tpu.parallel import MeshConfig, build_mesh, named_shardings
 from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
-from tests.markers import legacy_pp_xfail
 
 CFG = llama.LlamaConfig.tiny(n_layers=4)
 
@@ -37,9 +36,9 @@ def _pp_mesh(pp, tp=2):
 
 @pytest.mark.parametrize("pp,tp,n_micro", [
     # n_micro defaults to pp; tp>1 beside manual pp = partial-manual
-    pytest.param(4, 2, 0, marks=legacy_pp_xfail),
+    (4, 2, 0),
     # more microbatches than stages (smaller bubble)
-    pytest.param(2, 2, 4, marks=legacy_pp_xfail),
+    (2, 2, 4),
     (2, 1, 2),
 ])
 def test_pp_loss_matches_single_device(params, toks, pp, tp, n_micro):
@@ -55,7 +54,6 @@ def test_pp_loss_matches_single_device(params, toks, pp, tp, n_micro):
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
-@legacy_pp_xfail
 def test_pp_grads_match_single_device(params, toks):
     """Backward through scan + ppermute must produce the same gradients
     as the plain model — the reverse pipeline is pure autodiff."""
@@ -74,7 +72,6 @@ def test_pp_grads_match_single_device(params, toks):
         )
 
 
-@legacy_pp_xfail
 def test_pp_trainer_step_converges(toks):
     # fresh params: donated steps may free buffers device_put aliased
     # from the shared fixture
@@ -99,7 +96,6 @@ def test_pp_trainer_step_converges(toks):
     assert losses[-1] < losses[0] - 0.1, losses
 
 
-@legacy_pp_xfail
 def test_pp_composes_with_dp(params, toks):
     """dp=2 x pp=2 x tp=2: the batch axes must land on the per-microbatch
     dim, not the microbatch index (regression: the reshape used to leave
@@ -191,7 +187,6 @@ def _grad_err(a, b):
         lambda x, y: float(jnp.max(jnp.abs(x - y))), a, b)))
 
 
-@legacy_pp_xfail
 @pytest.mark.parametrize("n_micro", [0, 4])
 def test_1f1b_matches_gpipe_and_single_device(params, toks, n_micro):
     """The fused 1F1B schedule computes the SAME loss and gradients as
@@ -218,7 +213,6 @@ def test_1f1b_matches_gpipe_and_single_device(params, toks, n_micro):
     assert _grad_err(g_1, g_g) < 1e-4
 
 
-@legacy_pp_xfail
 def test_1f1b_composes_with_fsdp(params, toks):
     """pp=2 x fsdp=2: the manual pp schedule with fsdp auto inside."""
     cfg = llama.LlamaConfig.tiny(n_layers=4, pp_schedule="1f1b")
@@ -258,7 +252,6 @@ def test_gpipe_composes_with_sp_ring(params, toks):
     assert _grad_err(g, g_ref) < 1e-3
 
 
-@legacy_pp_xfail
 def test_1f1b_trainer_step_converges(toks):
     cfg = llama.LlamaConfig.tiny(n_layers=4, pp_schedule="1f1b")
     mc, mesh = _pp_mesh(2, 2)

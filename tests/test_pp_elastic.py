@@ -1,0 +1,220 @@
+"""The pp-elasticity surface (ISSUE 19): the stage-map grammar on
+WorldDescriptor, the per-stage transfer plan, stage-aware speculative
+neighbors, the planner's stage-preserving resize candidates, and the
+SpeedMonitor layout report the fleet wires them together with.
+
+The end-to-end legs live in ``test_bench_contract.py`` (warm per-stage
+reshard) and ``test_fleet.py`` (the ``pp_storm`` scenario); these are
+the unit contracts those legs stand on.
+"""
+
+import pytest
+
+from dlrover_tpu.brain.planner import GoodputPlanner, PlannerInputs
+from dlrover_tpu.common.world import WorldDescriptor
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.train.live_reshard import stage_transfer_plan
+
+
+# ---------------------------------------------------------------------------
+# stage-map grammar: every spec names exactly one placement
+# ---------------------------------------------------------------------------
+
+
+def test_stage_map_single_slice_replicates():
+    wd = WorldDescriptor.parse("dp2xpp2")
+    assert not wd.pp_spans_slices
+    assert wd.stage_map() == ((0,), (0,))
+
+
+def test_stage_map_pp_spans_when_dp_cannot():
+    # dp=1 does not decompose over 2 slices -> whole stages pin, one
+    # per slice (the activation handoffs ARE the DCN traffic)
+    wd = WorldDescriptor.parse("pp2+2slice")
+    assert wd.pp_spans_slices
+    assert wd.stage_map() == ((0,), (1,))
+    # pp4 over 2 slices: 2 contiguous stages per slice
+    assert WorldDescriptor.parse("pp4+2slice").stage_map() == (
+        (0,), (0,), (1,), (1,),
+    )
+
+
+def test_stage_map_dp_spans_when_it_decomposes():
+    # dp=2 over 2 slices: dp crosses DCN, every stage lives on every
+    # slice (the gradient all-reduce is the DCN traffic instead)
+    wd = WorldDescriptor.parse("dp2xpp2+2slice")
+    assert not wd.pp_spans_slices
+    assert wd.stage_map() == ((0, 1), (0, 1))
+
+
+def test_wire_carries_stage_map_only_for_pp_worlds():
+    flat = WorldDescriptor.parse("dp4").to_wire()
+    assert "pp" not in flat and "stage_map" not in flat
+    wire = WorldDescriptor.parse("pp2+2slice").to_wire()
+    assert wire["pp"] == 2
+    assert wire["stage_map"] == [[0], [1]]
+    # round-trip: the hint payload re-parses to the same world
+    back = WorldDescriptor.from_wire(wire)
+    assert back is not None and back.spec == "pp2+2slice"
+    assert back.stage_map() == ((0,), (1,))
+
+
+# ---------------------------------------------------------------------------
+# per-stage transfer plans (train/live_reshard.py)
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_plan_none_without_pipelining():
+    assert stage_transfer_plan(
+        WorldDescriptor.parse("dp4"), WorldDescriptor.parse("dp2")
+    ) is None
+
+
+def test_transfer_plan_dp_within_stage():
+    """Same stage count: data axes move, layer slabs never cross a
+    stage boundary (each new stage sources only itself)."""
+    plan = stage_transfer_plan(
+        WorldDescriptor.parse("dp2xpp2"), WorldDescriptor.parse("pp2")
+    )
+    assert plan["kind"] == "dp_within_stage"
+    assert plan["old_pp"] == plan["new_pp"] == 2
+    for st in plan["stages"]:
+        assert st["src_stages"] == [st["stage"]]
+        assert not st["cross_slice"]
+
+
+def test_transfer_plan_stage_rebalance_reslabs_layers():
+    """Stage count halves: each new stage takes a contiguous pair of
+    old-stage layer slabs."""
+    plan = stage_transfer_plan(
+        WorldDescriptor.parse("pp4"), WorldDescriptor.parse("pp2")
+    )
+    assert plan["kind"] == "stage_rebalance"
+    assert [st["src_stages"] for st in plan["stages"]] == [[0, 1], [2, 3]]
+
+
+def test_transfer_plan_marks_cross_slice_stages():
+    """Collapsing the stage-per-slice world onto one slice: stage 0
+    stays put, stage 1's bytes must ride DCN."""
+    plan = stage_transfer_plan(
+        WorldDescriptor.parse("pp2+2slice"), WorldDescriptor.parse("pp2")
+    )
+    assert plan["kind"] == "dp_within_stage"
+    assert [st["cross_slice"] for st in plan["stages"]] == [False, True]
+    assert plan["stages"][1]["src_slices"] == [1]
+    assert plan["stages"][1]["dst_slices"] == [0]
+
+
+# ---------------------------------------------------------------------------
+# stage-aware speculative neighbors (train/warm_compile.py)
+# ---------------------------------------------------------------------------
+
+
+def test_neighbor_worlds_preserve_the_stage_axis():
+    """A dp2xpp2 world's compile-ahead targets keep pp=2: the halving
+    lands on pp2 (dp exits), never on a flattened dp2-only pipeline
+    collapse; the one-off candidate (world 3) cannot hold the stage
+    axis and is dropped rather than flattened."""
+    from dlrover_tpu.parallel import config_for
+    from dlrover_tpu.train.warm_compile import neighbor_worlds
+
+    wd = WorldDescriptor.parse("dp2xpp2")
+    specs = [
+        w.spec
+        for w in neighbor_worlds(
+            4, config_for(wd),
+            n_devices_available=8,
+            global_batch_size=8, micro_batch_size=4,
+        )
+    ]
+    assert specs == ["pp2", "dp2"]
+    assert all(
+        WorldDescriptor.parse(s).pp == 2 or s == "dp2" for s in specs
+    )
+
+
+# ---------------------------------------------------------------------------
+# planner: resize candidates preserve the seated pipeline
+# ---------------------------------------------------------------------------
+
+
+def _inputs(**kw):
+    kw.setdefault("ts", 0.0)
+    kw.setdefault("world", 4)
+    kw.setdefault("step_p50_s", 1.0)
+    kw.setdefault("resize_cost_s", 10.0)
+    return PlannerInputs(**kw)
+
+
+def test_planner_candidates_stage_preserving():
+    """With the monitor reporting a pp layout, every divisible size
+    candidate keeps the stage axis: the readopt of waiting capacity
+    targets dp4xpp2, not dp8 — the pp_storm scenario's core gate."""
+    p = GoodputPlanner(clock=lambda: 0.0)
+    specs = [
+        w.spec
+        for w in p.candidates(_inputs(waiting=4, layout_spec="dp2xpp2"))
+    ]
+    assert specs[0] == "dp2xpp2"  # the incumbent HOLD baseline
+    assert "dp4xpp2" in specs
+    assert "dp8" not in specs
+    # the indivisible one-unit shrink (3 nodes) degrades to pure dp —
+    # a legitimate (priced) candidate, not a hidden stage collapse
+    assert "dp3" in specs
+
+
+def test_planner_candidates_pure_dp_without_pp_layout():
+    p = GoodputPlanner(clock=lambda: 0.0)
+    specs = [w.spec for w in p.candidates(_inputs(waiting=4))]
+    assert "dp8" in specs
+    assert all("pp" not in s for s in specs)
+
+
+def test_planner_layout_flips_gated_on_reported_pp():
+    """Same-world pp re-factorizations appear only when the fleet
+    already REPORTS a pp layout (the engine is proven to slab this
+    model); a pure-dp fleet never sees a speculative pp flip."""
+    p = GoodputPlanner(clock=lambda: 0.0)
+    with_pp = {
+        w.spec
+        for w in p.layout_candidates(_inputs(layout_spec="dp2xpp2"))
+    }
+    assert "pp4" in with_pp
+    without = {
+        w.spec for w in p.layout_candidates(_inputs(layout_spec="dp4"))
+    }
+    assert not any("pp" in s for s in without)
+
+
+# ---------------------------------------------------------------------------
+# the SpeedMonitor layout report (master/monitor/speed_monitor.py)
+# ---------------------------------------------------------------------------
+
+
+def test_speed_monitor_layout_report_roundtrip_and_snapshot():
+    sm = SpeedMonitor(clock=lambda: 0.0)
+    assert sm.layout_spec() == ""
+    sm.report_layout("dp4xpp2")
+    assert sm.layout_spec() == "dp4xpp2"
+    # the durable snapshot carries it: a relaunched master keeps
+    # planning stage-preserving targets
+    state = sm.export_state()
+    assert state["layout_spec"] == "dp4xpp2"
+    sm2 = SpeedMonitor(clock=lambda: 0.0)
+    sm2.import_state(state)
+    assert sm2.layout_spec() == "dp4xpp2"
+    # an old snapshot without the key restores to the default
+    del state["layout_spec"]
+    sm3 = SpeedMonitor(clock=lambda: 0.0)
+    sm3.import_state(state)
+    assert sm3.layout_spec() == ""
+
+
+def test_planner_reads_layout_from_monitor():
+    """The observe() duck-type hook: a monitor exposing layout_spec()
+    feeds the planner's candidate generator."""
+    sm = SpeedMonitor(clock=lambda: 0.0)
+    sm.report_layout("dp2xpp2")
+    p = GoodputPlanner(clock=lambda: 0.0, speed_monitor=sm)
+    inputs = p.observe(now=0.0)
+    assert inputs.layout_spec == "dp2xpp2"

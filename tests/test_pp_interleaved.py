@@ -23,7 +23,6 @@ from dlrover_tpu.parallel.pp_schedule import (
     plain_1f1b_chunk_ticks,
 )
 from dlrover_tpu.train.trainer import ElasticTrainer, TrainConfig
-from tests.markers import legacy_pp_xfail
 
 
 # ---------------------------------------------------------------------------
@@ -159,7 +158,6 @@ def test_interleaved_loss_matches_single_device(pp, v, n_layers, n_micro):
     np.testing.assert_allclose(got, ref, rtol=1e-4)
 
 
-@legacy_pp_xfail
 def test_interleaved_grads_match_single_device():
     cfg = llama.LlamaConfig.tiny(
         n_layers=4, pp_schedule="1f1b", pp_virtual_stages=2,
@@ -224,7 +222,6 @@ def test_interleaved_rank_major_layout_matches_canonical():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-@legacy_pp_xfail
 def test_interleaved_grads_match_with_fsdp():
     """Interleaved 1F1B composed with fsdp (ZeRO param sharding inside
     the stages): gradients still match the single-device model."""
@@ -252,7 +249,6 @@ def test_interleaved_grads_match_with_fsdp():
         )
 
 
-@legacy_pp_xfail
 def test_interleaved_matches_plain_1f1b():
     n_micro = 4
     cfg_p = llama.LlamaConfig.tiny(
@@ -279,7 +275,6 @@ def test_interleaved_matches_plain_1f1b():
     np.testing.assert_allclose(inter, plain, rtol=1e-5)
 
 
-@legacy_pp_xfail
 def test_interleaved_trainer_step_converges():
     cfg = llama.LlamaConfig.tiny(
         n_layers=4, pp_schedule="1f1b", pp_virtual_stages=2,
