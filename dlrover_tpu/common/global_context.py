@@ -13,17 +13,21 @@ no caching — which is what makes runtime mutation take effect.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict
 
 from dlrover_tpu.common.constants import DefaultValues
 from dlrover_tpu.common.log import logger
 
 
 class MasterConfigContext:
-    """Thread-safe, runtime-mutable master tunables (process singleton)."""
+    """Thread-safe, runtime-mutable master tunables.
 
-    _instance: Optional["MasterConfigContext"] = None
-    _instance_lock = threading.Lock()
+    One instance per job, owned by
+    :class:`~dlrover_tpu.master.job_container.JobContainer` (the old
+    process-singleton machinery is retired; statecheck ST003 keeps it
+    from coming back). Consumers hold the instance and re-read attributes
+    per use — that per-use read is what makes runtime mutation land.
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -46,19 +50,6 @@ class MasterConfigContext:
         # -- rendezvous (rendezvous.manager, re-read per completion check) ---
         # last-call window past min_nodes before the round closes
         self.rdzv_waiting_timeout = 60.0
-
-    # ------------------------------------------------------------------
-    @classmethod
-    def singleton(cls) -> "MasterConfigContext":
-        with cls._instance_lock:
-            if cls._instance is None:
-                cls._instance = cls()
-            return cls._instance
-
-    @classmethod
-    def reset_singleton(cls):
-        with cls._instance_lock:
-            cls._instance = None
 
     # ------------------------------------------------------------------
     def update(self, values: Dict[str, Any]) -> Dict[str, Any]:
@@ -126,4 +117,11 @@ _parse_bool = parse_bool  # internal callers predate the public name
 
 
 def get_master_config() -> MasterConfigContext:
-    return MasterConfigContext.singleton()
+    """Legacy ambient accessor: the process-default container's config.
+
+    Kept for composition roots (masters resolve it once at construction
+    and inject the instance down); RPC-handler call graphs must read the
+    injected config instead (statecheck ST004)."""
+    from dlrover_tpu.master.job_container import default_container
+
+    return default_container().config

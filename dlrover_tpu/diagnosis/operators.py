@@ -68,19 +68,22 @@ class CheckTrainingHangOperator(InferenceOperator):
     and the fleet has been silent for `silence_secs` of step reports."""
 
     def __init__(self, data_manager: DiagnosisDataManager, speed_monitor=None,
-                 silence_secs=None):
+                 silence_secs=None, config=None):
         super().__init__(data_manager)
         self._speed_monitor = speed_monitor
-        # None → runtime-tunable global context value at check time
+        # None → runtime-tunable per-job config value at check time
         self._silence_secs_override = silence_secs
+        self._config = config
 
     @property
     def _silence_secs(self) -> float:
         if self._silence_secs_override is not None:
             return self._silence_secs_override
-        from dlrover_tpu.common.global_context import get_master_config
+        if self._config is None:
+            from dlrover_tpu.common.global_context import get_master_config
 
-        return get_master_config().seconds_hang_threshold
+            self._config = get_master_config()
+        return self._config.seconds_hang_threshold
 
     def is_compatible(self, inference: Inference) -> bool:
         return inference == HANG_PROBLEM

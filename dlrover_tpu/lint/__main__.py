@@ -1,14 +1,17 @@
-"""graftlint + shardcheck + racecheck + wirecheck + memcheck CLI.
+"""graftlint + shardcheck + racecheck + wirecheck + memcheck +
+statecheck CLI.
 
     python -m dlrover_tpu.lint [options] paths...       # AST rules
     python -m dlrover_tpu.lint --hlo dp4 [--hlo ...]    # IR rules
     python -m dlrover_tpu.lint --race [paths...]        # concurrency
     python -m dlrover_tpu.lint --wire [paths...]        # wire schema
     python -m dlrover_tpu.lint --mem dp4 [--mem ...]    # memory model
+    python -m dlrover_tpu.lint --state [paths...]       # state inventory
 
 Exit codes: 0 clean (against the baseline / contracts / lock-order
-graph / wire schema + corpus), 1 new violations, unparsable files,
-missing contracts, or lock-graph/schema drift, 2 usage error.
+graph / wire schema + corpus / state inventory), 1 new violations,
+unparsable files, missing contracts, or lock-graph/schema/inventory
+drift, 2 usage error.
 ``--fix-baseline`` rewrites the AST baseline; ``--fix-contracts``
 regenerates the SC001 collective-census contracts (``--hlo``) or the
 MC001 memory contracts (``--mem``) for the given mesh specs;
@@ -16,9 +19,10 @@ MC001 memory contracts (``--mem``) for the given mesh specs;
 RC001 acquisition graph and the racecheck baseline;
 ``--fix-wire-schema`` records a wire/durable schema change (give the
 compat rationale via ``--wire-note``) and ``--fix-wire-corpus``
-regenerates the golden serialized corpus (all: use after deliberate
-grandfathering or a reviewed change, never to silence a new violation
-you should fix).
+regenerates the golden serialized corpus; ``--fix-state-inventory``
+regenerates the ST001 state inventory, preserving its hand-triaged
+whitelist (all: use after deliberate grandfathering or a reviewed
+change, never to silence a new violation you should fix).
 
 The ``--hlo`` and ``--mem`` paths lower the pinned contract model (see
 lint/contract_model.py) on virtual CPU devices — no TPU, no live
@@ -190,10 +194,31 @@ def main(argv=None) -> int:
         help="compat note recorded in the schema history by "
         "--fix-wire-schema",
     )
+    p.add_argument(
+        "--state",
+        action="store_true",
+        help="state mode: mutable-state inventory diff against the "
+        "checked-in lint/state_inventory.json, tenant-isolation rules "
+        "(ST001-ST004) and the baseline-liveness gate ST005 "
+        "(docs/design/statecheck.md)",
+    )
+    p.add_argument(
+        "--state-inventory",
+        default=None,
+        help="state inventory file (default: the checked-in "
+        "dlrover_tpu/lint/state_inventory.json)",
+    )
+    p.add_argument(
+        "--fix-state-inventory",
+        action="store_true",
+        help="regenerate the state section of the inventory from the "
+        "current tree (the whitelist is hand-maintained and preserved)",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
-        from dlrover_tpu.lint import memcheck, racecheck, wirecheck
+        from dlrover_tpu.lint import memcheck, racecheck, statecheck, \
+            wirecheck
 
         for rid, name, doc in rule_catalog():
             print(f"{rid}  {name:28s} {doc}")
@@ -205,7 +230,27 @@ def main(argv=None) -> int:
             print(f"{rid}  {name:28s} {doc}")
         for rid, name, doc in memcheck.MC_RULES:
             print(f"{rid}  {name:28s} {doc}")
+        for rid, name, doc in statecheck.ST_RULES:
+            print(f"{rid}  {name:28s} {doc}")
         return 0
+    if args.state:
+        if args.hlo or args.mem or args.race or args.wire \
+                or args.fix_baseline or args.no_baseline or args.rule:
+            print(
+                "error: --state (state mode) cannot be combined with "
+                "--hlo, --mem, --race, --wire, --fix-baseline, "
+                "--no-baseline or --rule — run them as separate "
+                "invocations",
+                file=sys.stderr,
+            )
+            return 2
+        return _run_state(args)
+    if args.fix_state_inventory:
+        print(
+            "error: --fix-state-inventory needs --state",
+            file=sys.stderr,
+        )
+        return 2
     if args.wire:
         if args.hlo or args.mem or args.race or args.fix_baseline \
                 or args.no_baseline or args.rule:
@@ -307,6 +352,29 @@ def main(argv=None) -> int:
         result = engine.run(args.paths, baseline_path=args.baseline,
                             rules=rules)
     engine.report(result)
+    return 1 if result.failed else 0
+
+
+def _run_state(args) -> int:
+    """State mode: mutable-state inventory diff + tenant-isolation
+    rules + baseline liveness."""
+    from dlrover_tpu.lint import statecheck
+
+    paths = args.paths or ["dlrover_tpu"]
+    result = statecheck.run(
+        paths,
+        inventory_path=args.state_inventory,
+        fix_inventory=args.fix_state_inventory,
+    )
+    if args.fix_state_inventory:
+        n = len(result.scanner.state)
+        print(
+            f"statecheck: inventory "
+            f"{args.state_inventory or statecheck.DEFAULT_INVENTORY} "
+            f"rewritten ({n} state entr{'y' if n == 1 else 'ies'}; "
+            "whitelist preserved)"
+        )
+    statecheck.report(result)
     return 1 if result.failed else 0
 
 

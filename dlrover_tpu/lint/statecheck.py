@@ -1,0 +1,682 @@
+"""statecheck: static mutable-state inventory + tenant-isolation lint.
+
+graftlint checks per-file AST rules, shardcheck the lowered IR, racecheck
+the lock structure, wirecheck the RPC schema, memcheck the device-memory
+contracts — this is the sixth machine-checked invariant layer: the
+master's *process-mutable state surface*. The multi-job refactor
+(``master/job_container.py``) moved every piece of per-job state behind
+an explicit container; statecheck is what keeps it there:
+
+- the **state inventory** (``lint/state_inventory.json``) enumerates
+  every piece of process-mutable state in ``dlrover_tpu/master/``,
+  ``common/`` and ``rpc/`` — module-level mutable bindings, mutable
+  class attributes, singleton patterns, ``global``-rebound module
+  names, and the JobContainer's own per-job slots — each classified
+  ``per_job`` (lives behind the container), ``process_global``
+  (whitelisted, with a reason), or ``violation`` (neither). The file
+  is checked in and two-sided-diffed like wirecheck's schema: state
+  that exists but is not inventoried fails (ST001), and inventory
+  entries whose code is gone fail as drift, so the file never rots.
+- **ST002** fails on any scanned state that is neither a per-job slot
+  nor whitelisted — the "new module-level cache" regression gate.
+- **ST003** fails on bare singleton patterns (``_instance`` class
+  slots, ``singleton()``/``reset_singleton()`` classmethods): per-job
+  state must be a JobContainer slot, not a process singleton.
+- **ST004** walks the servicer's handler dispatch tables and flags any
+  ambient-accessor call (``get_job_context``, ``get_master_config``,
+  ``default_container``, ``singleton``...) reachable from an RPC
+  handler within two call-graph hops (racecheck's resolution rules):
+  handlers operate on state *injected at composition time*, so one
+  process can serve two jobs without the handlers cross-reading.
+- **ST005** is the baseline-liveness gate: every entry in
+  ``lint/baseline.json`` and ``lint/racecheck_baseline.json`` must
+  still resolve to a real file containing the recorded line text —
+  entries referencing symbols retired by later PRs fail until the
+  baseline is regenerated.
+
+Suppression reuses the graftlint comment syntax (``# graftlint:
+disable=ST002 <why>``). There is deliberately NO baseline file:
+state either has a classification or the build fails. CLI:
+``python -m dlrover_tpu.lint --state`` (exit 0 clean / 1 findings or
+inventory drift / 2 usage); ``--fix-state-inventory`` regenerates the
+``state`` section, preserving the hand-triaged whitelist.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from dlrover_tpu.lint.engine import (
+    DEFAULT_BASELINE,
+    Severity,
+    SourceFile,
+    Violation,
+    iter_py_files,
+)
+from dlrover_tpu.lint.racecheck import (
+    DEFAULT_RACE_BASELINE,
+    FuncInfo,
+    RepoModel,
+    _module_name,
+)
+
+#: checked-in inventory (regenerate with --fix-state-inventory)
+DEFAULT_INVENTORY = os.path.join(
+    os.path.dirname(__file__), "state_inventory.json"
+)
+
+#: the master's tenant-state scope: everything an RPC handler can reach
+SCOPE_PREFIXES = ("master/", "common/", "rpc/")
+
+#: constructors whose result is process-mutable state when bound at
+#: module or class level
+MUTABLE_CALLS = {
+    "dict", "list", "set", "defaultdict", "OrderedDict", "deque",
+    "Counter", "count",
+}
+#: lock constructors: concurrency structure is racecheck's artifact,
+#: not state inventory
+LOCK_CALLS = {"Lock", "RLock", "Condition", "Event", "Semaphore",
+              "maybe_track", "local"}
+
+#: legacy ambient accessors: composition roots may call them; RPC
+#: handler call graphs may not (ST004)
+AMBIENT_ACCESSORS = {
+    "get_job_context",
+    "get_master_config",
+    "default_container",
+    "singleton",
+    "singleton_instance",
+}
+
+#: method names that mark a class as a bare singleton (ST003)
+SINGLETON_METHODS = {"singleton", "singleton_instance", "reset_singleton"}
+#: class-attribute names that mark a singleton slot
+SINGLETON_ATTRS = {"_instance", "_singleton", "_INSTANCE"}
+
+ST_RULES = (
+    ("ST001", "untracked-state",
+     "process-mutable state not recorded in lint/state_inventory.json"),
+    ("ST002", "state-violation",
+     "mutable state that is neither a per-job container slot nor a "
+     "whitelisted process-global"),
+    ("ST003", "bare-singleton",
+     "singleton pattern outside the JobContainer registry"),
+    ("ST004", "ambient-access-in-handler",
+     "RPC handler call graph reaches a process-ambient state accessor"),
+    ("ST005", "dead-baseline-entry",
+     "baseline entry no longer resolves to a real source line"),
+)
+
+
+# ---------------------------------------------------------------------------
+# the state scan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StateDef:
+    state_id: str  # module.qualname (racecheck id convention)
+    kind: str  # module_mutable | class_attr_mutable | singleton |
+    #          # module_global_rebind | per_job_slot
+    path: str
+    line: int
+    classification: str = ""  # per_job | process_global | violation
+
+
+def _in_scope(rel_path: str) -> bool:
+    """Package files are scoped to master/common/rpc; files outside the
+    package (test fixtures under tmp dirs) are always in scope so the
+    seeded-regression tests can exercise the rules directly."""
+    p = rel_path.replace(os.sep, "/")
+    if "dlrover_tpu/" in p:
+        sub = p.split("dlrover_tpu/", 1)[-1]
+        return sub.startswith(SCOPE_PREFIXES)
+    return True
+
+
+def _mutable_value(value: Optional[ast.AST]) -> bool:
+    """Is this expression a process-mutable container/builder?"""
+    if value is None:
+        return False
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        from dlrover_tpu.lint.rules import dotted_name
+
+        callee = dotted_name(value.func).rsplit(".", 1)[-1]
+        if callee in LOCK_CALLS:
+            return False
+        return callee in MUTABLE_CALLS
+    return False
+
+
+#: kind precedence when one name is detected twice (a module dict that
+#: is also ``global``-rebound keeps the mutable kind)
+_KIND_RANK = {
+    "singleton": 0,
+    "per_job_slot": 1,
+    "module_mutable": 2,
+    "class_attr_mutable": 3,
+    "module_global_rebind": 4,
+}
+
+
+class StateScanner:
+    """One pass over the sources; collects every StateDef."""
+
+    def __init__(self):
+        self.state: Dict[str, StateDef] = {}
+        self.sources: Dict[str, SourceFile] = {}
+        self.errors: List[str] = []
+
+    def _add(self, state_id: str, kind: str, src: SourceFile, node):
+        line = getattr(node, "lineno", 1)
+        old = self.state.get(state_id)
+        if old is not None and _KIND_RANK[old.kind] <= _KIND_RANK[kind]:
+            return
+        self.state[state_id] = StateDef(
+            state_id, kind, src.rel_path.replace(os.sep, "/"), line
+        )
+
+    def scan_file(self, src: SourceFile):
+        module = _module_name(src.rel_path)
+        self.sources[src.rel_path] = src
+        # module-level mutable bindings
+        for node in src.tree.body:
+            self._scan_binding(src, module, "", node)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(src, module, node)
+            elif isinstance(node, ast.Global):
+                for name in node.names:
+                    self._add(
+                        f"{module}.{name}", "module_global_rebind", src, node
+                    )
+
+    def _scan_binding(self, src: SourceFile, module: str, cls: str, node):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            return
+        value = node.value
+        if not _mutable_value(value):
+            return
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id.startswith("__") and t.id.endswith("__"):
+                continue  # __all__ and friends
+            owner = f"{module}.{cls}" if cls else module
+            kind = "class_attr_mutable" if cls else "module_mutable"
+            self._add(f"{owner}.{t.id}", kind, src, node)
+
+    def _scan_class(self, src: SourceFile, module: str, cls: ast.ClassDef):
+        singleton_site = None
+        for node in cls.body:
+            self._scan_binding(src, module, cls.name, node)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in SINGLETON_ATTRS:
+                        singleton_site = singleton_site or node
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in SINGLETON_METHODS
+            ):
+                singleton_site = singleton_site or node
+        if singleton_site is not None:
+            self._add(
+                f"{module}.{cls.name}", "singleton", src, singleton_site
+            )
+        if cls.name == "JobContainer":
+            self._scan_container(src, module, cls)
+
+    def _scan_container(self, src: SourceFile, module: str,
+                        cls: ast.ClassDef):
+        """Every ``self.X = ...`` in JobContainer.__init__ is a per-job
+        slot: removing one from the container changes the inventory and
+        fails the two-sided diff, same as adding ambient state."""
+        for node in cls.body:
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "__init__"
+            ):
+                for stmt in ast.walk(node):
+                    if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            self._add(
+                                f"{module}.{cls.name}.{t.attr}",
+                                "per_job_slot", src, stmt,
+                            )
+
+
+def scan_state(paths: Sequence[str]) -> StateScanner:
+    scanner = StateScanner()
+    for full, display in iter_py_files(paths):
+        if not _in_scope(display):
+            continue
+        try:
+            with open(full, encoding="utf-8") as f:
+                text = f.read()
+            src = SourceFile(full, text, rel_path=display)
+        except (OSError, SyntaxError, ValueError) as e:
+            scanner.errors.append(f"{display}: unparsable: {e}")
+            continue
+        scanner.scan_file(src)
+    return scanner
+
+
+def classify(scanner: StateScanner, whitelist: Dict[str, str]) -> None:
+    for sd in scanner.state.values():
+        if sd.kind == "per_job_slot":
+            sd.classification = "per_job"
+        elif sd.state_id in whitelist:
+            sd.classification = "process_global"
+        else:
+            sd.classification = "violation"
+
+
+# ---------------------------------------------------------------------------
+# the checked-in inventory
+# ---------------------------------------------------------------------------
+
+
+def load_inventory(path: str) -> Optional[Dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return None
+    if not isinstance(data, dict) or "state" not in data:
+        raise ValueError(f"{path}: not a statecheck inventory file")
+    return data
+
+
+def write_inventory(
+    path: str, scanner: StateScanner, whitelist: Dict[str, str]
+) -> Dict:
+    data = {
+        "comment": (
+            "statecheck state inventory: every piece of process-mutable "
+            "state in master/, common/ and rpc/, classified per_job "
+            "(JobContainer slot), process_global (whitelisted below, "
+            "with a reason), or violation. CI two-sided-diffs this "
+            "file. Regenerate the state section with: python -m "
+            "dlrover_tpu.lint --state --fix-state-inventory dlrover_tpu/ "
+            "(the whitelist is hand-maintained and preserved)."
+        ),
+        "version": 1,
+        "whitelist": {k: whitelist[k] for k in sorted(whitelist)},
+        "state": {
+            sd.state_id: {
+                "kind": sd.kind,
+                "path": sd.path,
+                "classification": sd.classification,
+            }
+            for sd in sorted(
+                scanner.state.values(), key=lambda s: s.state_id
+            )
+        },
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+
+def _violation(
+    src: Optional[SourceFile], rule: str, path: str, line: int, message: str
+) -> Optional[Violation]:
+    if src is not None and src.suppressed(rule, line):
+        return None
+    snippet = src.snippet_at(line) if src is not None else ""
+    return Violation(
+        rule=rule, path=path, line=line, col=0, message=message,
+        snippet=snippet, severity=Severity.ERROR,
+    )
+
+
+def check_inventory(
+    scanner: StateScanner, checked_in: Optional[Dict]
+) -> Tuple[List[Violation], List[str]]:
+    """ST001 + ST002: the two-sided diff plus the classification gate."""
+    violations: List[Violation] = []
+    drift: List[str] = []
+    recorded = (checked_in or {}).get("state", {})
+    if checked_in is None:
+        drift.append(
+            "no checked-in state_inventory.json — generate it with "
+            "--state --fix-state-inventory and triage every entry"
+        )
+    for sd in sorted(scanner.state.values(), key=lambda s: s.state_id):
+        src = scanner.sources.get(sd.path)
+        entry = recorded.get(sd.state_id)
+        if checked_in is not None and entry is None:
+            v = _violation(
+                src, "ST001", sd.path, sd.line,
+                f"process-mutable state {sd.state_id} ({sd.kind}) is not "
+                "in lint/state_inventory.json — every piece of mutable "
+                "master state must be inventoried and classified. Run "
+                "--state --fix-state-inventory, then either move the "
+                "state into the JobContainer or whitelist it with a "
+                "reason.",
+            )
+            if v is not None:
+                violations.append(v)
+        elif entry is not None and (
+            entry.get("kind") != sd.kind
+            or entry.get("classification") != sd.classification
+        ):
+            drift.append(
+                f"state_inventory.json: {sd.state_id} drifted "
+                f"(recorded {entry.get('kind')}/"
+                f"{entry.get('classification')}, scanned {sd.kind}/"
+                f"{sd.classification}) — run --fix-state-inventory"
+            )
+        if sd.classification == "violation":
+            v = _violation(
+                src, "ST002", sd.path, sd.line,
+                f"{sd.state_id} ({sd.kind}) is process-mutable state "
+                "outside the per-job container and not whitelisted: a "
+                "second job in this process would share it. Move it "
+                "onto JobContainer (or an instance the container owns), "
+                "or add a whitelist entry to lint/state_inventory.json "
+                "with the reason it is legitimately process-global.",
+            )
+            if v is not None:
+                violations.append(v)
+    for state_id in sorted(set(recorded) - set(scanner.state)):
+        drift.append(
+            f"state_inventory.json: stale entry {state_id} no longer "
+            "exists in the tree — run --fix-state-inventory to shrink "
+            "the inventory"
+        )
+    return violations, drift
+
+
+def check_st003(
+    scanner: StateScanner, whitelist: Dict[str, str]
+) -> List[Violation]:
+    out: List[Violation] = []
+    for sd in sorted(scanner.state.values(), key=lambda s: s.state_id):
+        if sd.kind != "singleton" or sd.state_id in whitelist:
+            continue
+        src = scanner.sources.get(sd.path)
+        v = _violation(
+            src, "ST003", sd.path, sd.line,
+            f"{sd.state_id} is a bare singleton (instance slot / "
+            "singleton classmethods): per-job state must live on the "
+            "JobContainer so two jobs in one process stay isolated. "
+            "Make the class an injected container slot, or whitelist "
+            "it with the reason it is process-scoped.",
+        )
+        if v is not None:
+            out.append(v)
+    return out
+
+
+# -- ST004: handler call graphs ---------------------------------------------
+
+
+def _handler_funcs(model: RepoModel) -> List[FuncInfo]:
+    """Seed set: every method wired into a ``self._get_handlers`` /
+    ``self._report_handlers`` dispatch dict, plus the ``get``/``report``
+    entry points of the class owning the dict."""
+    out: List[FuncInfo] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    for (module, cls, name), info in sorted(model.funcs.items()):
+        if name != "__init__" or not cls:
+            continue
+        handler_names: Set[str] = set()
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            is_table = any(
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and t.attr in ("_get_handlers", "_report_handlers")
+                for t in node.targets
+            )
+            if not is_table or not isinstance(node.value, ast.Dict):
+                continue
+            for v in node.value.values:
+                if (
+                    isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self"
+                ):
+                    handler_names.add(v.attr)
+        if not handler_names:
+            continue
+        handler_names |= {"get", "report"}
+        for h in sorted(handler_names):
+            key = (module, cls, h)
+            g = model.funcs.get(key)
+            if g is not None and key not in seen:
+                seen.add(key)
+                out.append(g)
+    return out
+
+
+def _accessor_calls(info: FuncInfo) -> Iterable[Tuple[ast.Call, str]]:
+    for node in ast.walk(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        if name in AMBIENT_ACCESSORS:
+            yield node, name
+
+
+def check_st004(model: RepoModel, hops: int = 2) -> List[Violation]:
+    out: List[Violation] = []
+    seen_sites: Set[Tuple[str, int]] = set()
+    handlers = _handler_funcs(model)
+    for h in handlers:
+        visited: Set[Tuple[str, str, str]] = set()
+        frontier: List[Tuple[FuncInfo, List[str]]] = [(h, [h.name])]
+        for depth in range(hops + 1):
+            nxt: List[Tuple[FuncInfo, List[str]]] = []
+            for info, chain in frontier:
+                key = (info.module, info.cls, info.name)
+                if key in visited:
+                    continue
+                visited.add(key)
+                if info.name in AMBIENT_ACCESSORS and depth > 0:
+                    continue  # flagged at the call site already
+                for call_node, name in _accessor_calls(info):
+                    site = (
+                        info.src.rel_path,
+                        getattr(call_node, "lineno", 1),
+                    )
+                    if site in seen_sites:
+                        continue
+                    seen_sites.add(site)
+                    v = _violation(
+                        info.src, "ST004", info.src.rel_path,
+                        getattr(call_node, "lineno", 1),
+                        f"{name}() reached from RPC handler "
+                        f"{' -> '.join(chain)}: handlers must use state "
+                        "injected at composition time (the servicer's "
+                        "job_context/config parameters), never the "
+                        "process-ambient accessor — a second job in "
+                        "this process would cross-read. Thread the "
+                        "dependency through the constructor.",
+                    )
+                    if v is not None:
+                        out.append(v)
+                if depth < hops:
+                    for call in sorted(info.calls):
+                        for g in model.callees(info, call):
+                            gkey = (g.module, g.cls, g.name)
+                            if gkey not in visited:
+                                nxt.append((g, chain + [g.name]))
+            frontier = nxt
+    out.sort(key=lambda v: (v.path, v.line))
+    return out
+
+
+# -- ST005: baseline liveness -----------------------------------------------
+
+
+def check_st005(
+    baseline_paths: Optional[Sequence[str]] = None,
+    root: str = ".",
+) -> List[str]:
+    """Every grandfathered finding must still point at a live source
+    line: (path exists) and (snippet appears among the file's stripped
+    lines). Dead entries mean a PR retired the symbol without
+    regenerating the baseline — the file rots into noise."""
+    if baseline_paths is None:
+        baseline_paths = (DEFAULT_BASELINE, DEFAULT_RACE_BASELINE)
+    problems: List[str] = []
+    for bpath in baseline_paths:
+        try:
+            with open(bpath, encoding="utf-8") as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            continue
+        except ValueError as e:
+            problems.append(f"{bpath}: unreadable baseline: {e}")
+            continue
+        stripped_cache: Dict[str, Optional[Set[str]]] = {}
+        for fp, entry in sorted(data.get("violations", {}).items()):
+            rel = entry.get("path", "")
+            snippet = entry.get("snippet", "")
+            target = os.path.join(root, rel)
+            if rel not in stripped_cache:
+                try:
+                    with open(target, encoding="utf-8") as f:
+                        stripped_cache[rel] = {
+                            ln.strip() for ln in f.read().splitlines()
+                        }
+                except OSError:
+                    stripped_cache[rel] = None
+            lines = stripped_cache[rel]
+            if lines is None:
+                problems.append(
+                    f"{os.path.basename(bpath)}: ST005 entry {fp} "
+                    f"({entry.get('rule')}) points at missing file "
+                    f"{rel} — regenerate the baseline"
+                )
+            elif snippet and snippet not in lines:
+                problems.append(
+                    f"{os.path.basename(bpath)}: ST005 entry {fp} "
+                    f"({entry.get('rule')}, {rel}) no longer matches "
+                    "any source line — the site was fixed or retired; "
+                    "regenerate the baseline to shrink it"
+                )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# one-call entry (CLI and the tier-1 test share it)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StateResult:
+    violations: List[Violation]
+    drift: List[str]
+    dead_baseline: List[str]
+    errors: List[str]
+    scanner: StateScanner
+
+    @property
+    def failed(self) -> bool:
+        return bool(
+            self.violations or self.drift or self.dead_baseline
+            or self.errors
+        )
+
+
+def run(
+    paths: Sequence[str],
+    inventory_path: Optional[str] = None,
+    fix_inventory: bool = False,
+    check_baselines: bool = True,
+    baseline_paths: Optional[Sequence[str]] = None,
+) -> StateResult:
+    inventory_path = inventory_path or DEFAULT_INVENTORY
+    checked_in = load_inventory(inventory_path)
+    whitelist = dict((checked_in or {}).get("whitelist", {}))
+    scanner = scan_state(paths)
+    classify(scanner, whitelist)
+    model = RepoModel.build(paths)
+    errors = list(scanner.errors)
+    if fix_inventory:
+        write_inventory(inventory_path, scanner, whitelist)
+        checked_in = load_inventory(inventory_path)
+    violations, drift = check_inventory(scanner, checked_in)
+    violations += check_st003(scanner, whitelist)
+    violations += check_st004(model)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    dead = (
+        check_st005(baseline_paths=baseline_paths)
+        if check_baselines
+        else []
+    )
+    return StateResult(violations, drift, dead, errors, scanner)
+
+
+def report(result: StateResult, out=None) -> None:
+    import sys
+
+    out = out or sys.stdout
+    for v in result.violations:
+        print(v.format(), file=out)
+    for d in result.drift:
+        print(d, file=out)
+    for d in result.dead_baseline:
+        print(d, file=out)
+    for e in result.errors:
+        print(f"ERROR {e}", file=out)
+    n = result.scanner.state
+    by_class: Dict[str, int] = {}
+    for sd in n.values():
+        by_class[sd.classification] = by_class.get(sd.classification, 0) + 1
+    print(
+        f"statecheck: {len(result.violations)} finding(s), "
+        f"{len(result.drift)} inventory drift(s), "
+        f"{len(result.dead_baseline)} dead baseline entr"
+        f"{'y' if len(result.dead_baseline) == 1 else 'ies'}, "
+        f"{len(result.errors)} errors over {len(n)} state entr"
+        f"{'y' if len(n) == 1 else 'ies'} "
+        f"({by_class.get('per_job', 0)} per_job, "
+        f"{by_class.get('process_global', 0)} process_global, "
+        f"{by_class.get('violation', 0)} violation)",
+        file=out,
+    )

@@ -38,15 +38,21 @@ class DiagnosisManager:
         speed_monitor=None,
         interval_secs: float = 60.0,
         data_expire_secs: float = 600.0,
+        job_context=None,
+        config=None,
     ):
-        self._job_context = get_job_context()
+        self._job_context = (
+            job_context if job_context is not None else get_job_context()
+        )
         self._data_manager = DiagnosisDataManager(data_expire_secs)
         self._speed_monitor = speed_monitor
         self._interval = interval_secs
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._operators = [
-            CheckTrainingHangOperator(self._data_manager, speed_monitor),
+            CheckTrainingHangOperator(
+                self._data_manager, speed_monitor, config=config
+            ),
             CheckFailureNodeOperator(self._data_manager),
             ResolveTrainingHangOperator(self._data_manager),
             ResolveFailureNodeOperator(self._data_manager),

@@ -23,10 +23,9 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import logger
 from dlrover_tpu.master.diagnosis.manager import DiagnosisManager
-from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.job_container import JobContainer, install
 from dlrover_tpu.master.node.dist_job_manager import DistributedJobManager
 from dlrover_tpu.master.node.job_auto_scaler import JobAutoScaler
-from dlrover_tpu.master.node.job_context import get_job_context
 from dlrover_tpu.master.rendezvous.kv_store import KVStoreService
 from dlrover_tpu.master.rendezvous.manager import (
     ElasticTrainingRendezvousManager,
@@ -49,23 +48,31 @@ class DistributedJobMaster:
         job_args: JobArgs,
         port: int = 0,
         k8s_client=None,
+        container: Optional[JobContainer] = None,
     ):
         self.job_args = job_args
         self._client = k8s_client or get_k8s_client(job_args.namespace)
 
-        # durable continuity state (shard queues, goodput ledger, relaunch
-        # budgets) — survives an operator-relaunched master pod
-        from dlrover_tpu.master.state_store import (
-            MasterStateManager,
-            create_state_backend,
-        )
+        # per-job state container (docs/design/statecheck.md): every
+        # piece of mutable master state hangs off it, keyed by job_uid.
+        # The durable backend survives an operator-relaunched master pod
+        # (shard queues, goodput ledger, relaunch budgets).
+        from dlrover_tpu.master.state_store import create_state_backend
 
-        self.state_manager = MasterStateManager(
-            create_state_backend(job_args.job_name, self._client),
-            job_uid=job_args.job_uid,
-        )
+        if container is None:
+            container = JobContainer(
+                job_uid=job_args.job_uid,
+                job_name=job_args.job_name,
+                state_backend=create_state_backend(
+                    job_args.job_name, self._client
+                ),
+            )
+        install(container)
+        self.container = container
+        ctx = container.job_context
+        self.state_manager = container.state_manager
 
-        self.speed_monitor = SpeedMonitor()
+        self.speed_monitor = container.speed_monitor
         worker_spec = job_args.worker_spec
         self.speed_monitor.set_target_worker_num(worker_spec.group.count)
         self.task_manager = TaskManager(
@@ -74,8 +81,12 @@ class DistributedJobMaster:
         )
 
         self.rdzv_managers = {
-            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(),
-            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(),
+            RendezvousName.TRAINING: ElasticTrainingRendezvousManager(
+                config=container.config
+            ),
+            RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(
+                config=container.config
+            ),
         }
         for mgr in self.rdzv_managers.values():
             # waiting_timeout omitted: the managers re-read the live
@@ -109,11 +120,7 @@ class DistributedJobMaster:
             )
             # brain-seeded runtime tunables (global_context.py:110-169 in
             # the reference — a TODO there, a live path here)
-            from dlrover_tpu.common.global_context import get_master_config
-
-            get_master_config().seed_from_brain(
-                optimizer.fetch_master_config
-            )
+            container.config.seed_from_brain(optimizer.fetch_master_config)
         else:
             optimizer = LocalOptimizer(
                 min_workers=worker_spec.min_nodes or 1,
@@ -137,7 +144,10 @@ class DistributedJobMaster:
         if brain_addr:
             reporters.append(BrainStatsReporter(optimizer))
         self.metric_collector = JobMetricCollector(
-            speed_monitor=self.speed_monitor, reporters=reporters
+            speed_monitor=self.speed_monitor,
+            reporters=reporters,
+            job_context=ctx,
+            metrics=container.metrics,
         )
         # the goodput planner (brain/planner.py, DLROVER_TPU_PLANNER):
         # scale decisions from the measured goodput ledger instead of
@@ -150,13 +160,14 @@ class DistributedJobMaster:
             self.planner = GoodputPlanner(
                 speed_monitor=self.speed_monitor,
                 rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
-                job_context=get_job_context(),
+                job_context=ctx,
                 min_nodes=worker_spec.min_nodes or 1,
                 max_nodes=(
                     worker_spec.max_nodes or worker_spec.group.count
                 ),
                 node_unit=job_args.node_unit,
             )
+            container.attach_planner(self.planner)
             self.rdzv_managers[RendezvousName.TRAINING].set_growth_gate(
                 self.planner.growth_allowed
             )
@@ -167,6 +178,8 @@ class DistributedJobMaster:
             strategy_generator=SimpleStrategyGenerator(),
             metric_collector=self.metric_collector,
             planner=self.planner,
+            job_context=ctx,
+            config=container.config,
         )
         self.job_manager = DistributedJobManager(
             job_args=job_args,
@@ -178,6 +191,8 @@ class DistributedJobMaster:
             error_monitor=self.error_monitor,
             resource_optimizer=optimizer,
             state_manager=self.state_manager,
+            job_context=ctx,
+            config=container.config,
         )
         # data shards of dead workers go back to the todo queue
         # (reference TaskRescheduleCallback, event_callback.py:111-130)
@@ -197,9 +212,11 @@ class DistributedJobMaster:
         )
 
         self.kv_store = KVStoreService()
-        self.sync_service = SyncService(get_job_context())
+        self.sync_service = SyncService(ctx)
         self.diagnosis_manager = DiagnosisManager(
-            speed_monitor=self.speed_monitor
+            speed_monitor=self.speed_monitor,
+            job_context=ctx,
+            config=container.config,
         )
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
@@ -211,6 +228,7 @@ class DistributedJobMaster:
             sync_service=self.sync_service,
             metric_collector=self.metric_collector,
             planner=self.planner,
+            job_context=ctx,
         )
         self._server = RpcServer(self.servicer, port=port)
         # backpressure must stay inside the liveness budget: a worker
@@ -227,7 +245,7 @@ class DistributedJobMaster:
         self.hang_watchdog = HangWatchdog(
             speed_monitor=self.speed_monitor,
             rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
-            job_context=get_job_context(),
+            job_context=ctx,
             task_manager=self.task_manager,
         )
         self.port = self._server.port

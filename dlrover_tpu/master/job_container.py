@@ -1,0 +1,149 @@
+"""JobContainer: every piece of per-job master state behind one root.
+
+ROADMAP item 3 (multi-tenant control plane): the source paper's brain is a
+*cluster-level* service — one control plane serving every job — while the
+master here grew up 1-process : 1-job on process singletons
+(``JobContext.singleton_instance()``, ``MasterConfigContext.singleton()``).
+This module is the state half of that gap, taken greedily: a
+:class:`JobContainer` owns the JobContext (node registry + diagnosis
+actions), the runtime-mutable master config, the durable state store, the
+SpeedMonitor (goodput ledger), the metrics registry and the planner slot
+for ONE job-uid, and a keyed registry replaces the singletons. Single-job
+behavior is unchanged: each master installs its container as the process
+default, and the legacy accessors (``get_job_context()`` /
+``get_master_config()``) delegate to it.
+
+The shape is machine-checked by statecheck (docs/design/statecheck.md):
+this module's registry is the single whitelisted root of per-job state,
+the per-job slots below are enumerated in ``lint/state_inventory.json``,
+and a new bare singleton or an RPC-handler call graph reaching an ambient
+accessor fails ``python -m dlrover_tpu.lint --state``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional
+
+from dlrover_tpu.common.global_context import MasterConfigContext
+from dlrover_tpu.master.node.job_context import JobContext
+
+
+class JobContainer:
+    """All mutable master state for one job, keyed by ``job_uid``.
+
+    Every attribute assigned in ``__init__`` from a class constructor is a
+    **per-job slot**: statecheck records each one in the state inventory,
+    so removing state from the container (or growing state outside it)
+    shows up as a reviewable contract diff.
+    """
+
+    def __init__(
+        self,
+        job_uid: str = "",
+        job_name: str = "",
+        state_backend=None,
+        clock=None,
+    ):
+        from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+        from dlrover_tpu.master.state_store import (
+            MasterStateManager,
+            MemoryStateBackend,
+        )
+        from dlrover_tpu.master.stats.job_collector import JobMetrics
+
+        self.job_uid = job_uid
+        self.job_name = job_name
+        #: node registry + diagnosis action queue (master/node/job_context)
+        self.job_context = JobContext()
+        #: runtime-mutable master tunables; consumers hold THIS instance
+        #: and re-read attributes per use, so a brain/admin update still
+        #: retunes a live master (the old singleton's contract, kept)
+        self.config = MasterConfigContext()
+        #: durable continuity state (shard queues, ledger, node registry)
+        self.state_manager = MasterStateManager(
+            state_backend if state_backend is not None
+            else MemoryStateBackend(),
+            job_uid=job_uid,
+        )
+        #: goodput ledger + step/straggler observation (injectable clock)
+        self.speed_monitor = SpeedMonitor(clock=clock)
+        #: the job metrics registry (runtime sample window + model info)
+        self.metrics = JobMetrics()
+        #: goodput planner slot — attached by the master when armed
+        self.planner = None
+
+    def attach_planner(self, planner) -> None:
+        self.planner = planner
+
+    @classmethod
+    def fresh(cls, **kwargs) -> "JobContainer":
+        """Build a container and install it as the process default.
+
+        The one-call replacement for the retired
+        ``JobContext.reset_singleton()`` / ``MasterConfigContext
+        .reset_singleton()`` test plumbing: a test (or a relaunched
+        in-process master) that needs virgin state asks for a fresh
+        container instead of resetting N singletons one by one.
+        """
+        container = cls(**kwargs)
+        install(container)
+        return container
+
+
+# -- the process registry ----------------------------------------------------
+#
+# The ONE sanctioned piece of process-global mutable state in the master
+# tree: the job-uid -> container map plus the default slot the legacy
+# accessors resolve through. Whitelisted in lint/state_inventory.json;
+# everything else mutable must live inside a container (statecheck ST002).
+
+_registry_lock = threading.Lock()
+_containers: Dict[str, JobContainer] = {}
+_default: Optional[JobContainer] = None
+#: distinct registry keys for anonymous (job_uid="") containers, so two
+#: uid-less containers in one process never collide in the map
+_anon_ids = itertools.count()
+
+
+def install(container: JobContainer) -> JobContainer:
+    """Register ``container`` under its job_uid and make it the process
+    default (the instance the legacy accessors return)."""
+    global _default
+    with _registry_lock:
+        key = container.job_uid or f"<anonymous-{next(_anon_ids)}>"
+        _containers[key] = container
+        _default = container
+    return container
+
+
+def default_container() -> JobContainer:
+    """The process-default container; lazily created so library code can
+    run (tests, tools) without a master having installed one."""
+    global _default
+    with _registry_lock:
+        if _default is None:
+            _default = JobContainer()
+            _containers[f"<anonymous-{next(_anon_ids)}>"] = _default
+        return _default
+
+
+def container_for(job_uid: str) -> Optional[JobContainer]:
+    with _registry_lock:
+        return _containers.get(job_uid)
+
+
+def containers() -> Dict[str, JobContainer]:
+    with _registry_lock:
+        return dict(_containers)
+
+
+def reset() -> None:
+    """Drop every registered container (test isolation: the autouse
+    fixture calls this around each test, so no job state leaks between
+    tests through the process default)."""
+    global _default
+    with _registry_lock:
+        _containers.clear()
+        _default = None

@@ -14,7 +14,7 @@ from typing import Dict, Optional
 
 from dlrover_tpu.common.constants import JobExitReason, RendezvousName
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.master.node.job_context import JobContext, get_job_context
+from dlrover_tpu.master.job_container import JobContainer, install
 from dlrover_tpu.master.node.job_manager import LocalJobManager
 from dlrover_tpu.master.rendezvous.kv_store import KVStoreService
 from dlrover_tpu.master.rendezvous.manager import (
@@ -22,7 +22,6 @@ from dlrover_tpu.master.rendezvous.manager import (
     NetworkCheckRendezvousManager,
 )
 from dlrover_tpu.master.rendezvous.sync_service import SyncService
-from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
 from dlrover_tpu.master.servicer import MasterServicer
 from dlrover_tpu.master.shard.task_manager import TaskManager
 from dlrover_tpu.rpc.transport import RpcServer
@@ -43,24 +42,32 @@ class LocalJobMaster:
         hang_window_s: Optional[float] = None,
         planner: Optional[bool] = None,
         planner_kwargs: Optional[Dict] = None,
+        container: Optional[JobContainer] = None,
     ):
         from dlrover_tpu.common import flags
         from dlrover_tpu.master.monitor.error_monitor import ErrorMonitor
-        from dlrover_tpu.master.state_store import (
-            MasterStateManager,
-            create_state_backend,
-        )
-        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+        from dlrover_tpu.master.state_store import create_state_backend
 
+        # per-job state container: every piece of mutable master state
+        # lives here (docs/design/statecheck.md). A fresh master gets a
+        # fresh container — the old reset-the-singletons dance — and
+        # installs it as the process default for legacy ambient lookups.
         # continuity state: memory by default (dies with the process, the
         # standalone contract); DLROVER_TPU_STATE_BACKEND=file makes a
         # killed-and-relaunched master resume shard queues and the ledger
-        self.state_manager = MasterStateManager(
-            create_state_backend(flags.JOB_NAME.get())
-        )
+        if container is None:
+            container = JobContainer(
+                job_name=flags.JOB_NAME.get(),
+                state_backend=create_state_backend(flags.JOB_NAME.get()),
+                clock=clock,
+            )
+        install(container)
+        self.container = container
+        ctx = container.job_context
+        self.state_manager = container.state_manager
         # clock: injectable "now" for the goodput ledger (the fleet
         # chaos harness drives it virtually; None = wall time)
-        self.speed_monitor = SpeedMonitor(clock=clock)
+        self.speed_monitor = container.speed_monitor
         self.speed_monitor.set_target_worker_num(node_num)
         self.task_manager = TaskManager(
             speed_monitor=self.speed_monitor,
@@ -69,15 +76,19 @@ class LocalJobMaster:
             lease_ttl=lease_ttl,
         )
         self.error_monitor = ErrorMonitor()
+        from dlrover_tpu.master.stats.job_collector import JobMetricCollector
+
         self.metric_collector = JobMetricCollector(
-            speed_monitor=self.speed_monitor
+            speed_monitor=self.speed_monitor,
+            job_context=ctx,
+            metrics=container.metrics,
         )
         self.rdzv_managers = {
             RendezvousName.TRAINING: ElasticTrainingRendezvousManager(
-                clock=clock
+                clock=clock, config=container.config
             ),
             RendezvousName.NETWORK_CHECK: NetworkCheckRendezvousManager(
-                clock=clock
+                clock=clock, config=container.config
             ),
         }
         self.job_manager = LocalJobManager(
@@ -87,6 +98,7 @@ class LocalJobMaster:
             rdzv_managers=self.rdzv_managers,
             eviction_hysteresis=eviction_hysteresis,
             clock=clock,
+            job_context=ctx,
         )
         for mgr in self.rdzv_managers.values():
             mgr.update_rdzv_params(
@@ -98,11 +110,13 @@ class LocalJobMaster:
                 node_unit=1,
             )
         self.kv_store = KVStoreService()
-        self.sync_service = SyncService(get_job_context())
+        self.sync_service = SyncService(ctx)
         from dlrover_tpu.master.diagnosis.manager import DiagnosisManager
 
         self.diagnosis_manager = DiagnosisManager(
-            speed_monitor=self.speed_monitor
+            speed_monitor=self.speed_monitor,
+            job_context=ctx,
+            config=container.config,
         )
         # the goodput planner (brain/planner.py): observe→decide→act
         # over the SpeedMonitor's measured ledgers. Armed by the ctor
@@ -128,12 +142,13 @@ class LocalJobMaster:
             self.planner = GoodputPlanner(
                 speed_monitor=self.speed_monitor,
                 rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
-                job_context=get_job_context(),
+                job_context=ctx,
                 clock=clock,
                 min_nodes=min_n,
                 max_nodes=node_num,
                 **(planner_kwargs or {}),
             )
+            container.attach_planner(self.planner)
             self.rdzv_managers[RendezvousName.TRAINING].set_growth_gate(
                 self.planner.growth_allowed
             )
@@ -141,10 +156,12 @@ class LocalJobMaster:
                 optimizer=LocalOptimizer(
                     min_workers=min_n, max_workers=node_num
                 ),
-                scaler=LocalScaler(),
+                scaler=LocalScaler(job_context=ctx),
                 speed_monitor=self.speed_monitor,
                 planner=self.planner,
                 clock=clock,
+                job_context=ctx,
+                config=container.config,
             )
         self.servicer = MasterServicer(
             task_manager=self.task_manager,
@@ -156,6 +173,7 @@ class LocalJobMaster:
             sync_service=self.sync_service,
             elastic_run_configs=elastic_run_configs,
             planner=self.planner,
+            job_context=ctx,
         )
         self._server = RpcServer(self.servicer, port=port)
         # Overloaded replies advertise how far a worker may widen its
@@ -175,7 +193,7 @@ class LocalJobMaster:
         self.hang_watchdog = HangWatchdog(
             speed_monitor=self.speed_monitor,
             rdzv_manager=self.rdzv_managers[RendezvousName.TRAINING],
-            job_context=get_job_context(),
+            job_context=ctx,
             task_manager=self.task_manager,
             window_s=hang_window_s,
             clock=clock,
@@ -253,7 +271,7 @@ class LocalJobMaster:
                     self._exit_code = 1
                     break
                 if self.job_manager.all_workers_exited():
-                    workers = get_job_context().workers()
+                    workers = self.container.job_context.workers()
                     if workers:
                         self._exit_reason = JobExitReason.SUCCEEDED
                         break
@@ -299,9 +317,9 @@ def start_local_master(
     """Test/standalone helper: boot a master, return it (already serving).
 
     This is the in-process harness the reference builds its whole test suite
-    on (``python/tests/test_utils.py:337-349``).
+    on (``python/tests/test_utils.py:337-349``). The master's ctor builds
+    and installs a fresh JobContainer, so no reset dance is needed here.
     """
-    JobContext.reset_singleton()
     master = LocalJobMaster(port=port, node_num=node_num, **kw)
     master.prepare()
     return master

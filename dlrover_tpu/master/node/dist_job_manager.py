@@ -50,8 +50,18 @@ class DistributedJobManager(JobManager):
         error_monitor=None,
         resource_optimizer=None,
         state_manager=None,
+        job_context=None,
+        config=None,
     ):
-        super().__init__(job_args, speed_monitor, error_monitor)
+        super().__init__(
+            job_args, speed_monitor, error_monitor, job_context=job_context
+        )
+        # the per-job runtime-mutable config instance (JobContainer
+        # slot); resolved once here, attributes re-read per use so a
+        # brain/admin update still retunes the live manager
+        self._config = (
+            config if config is not None else get_master_config()
+        )
         self._scaler = scaler
         #: durable node-registry persistence (master relaunch continuity)
         self._state_manager = state_manager
@@ -71,7 +81,9 @@ class DistributedJobManager(JobManager):
         )
 
         self._replica_managers = {
-            rtype: make_replica_manager(rtype, job_args, resource_optimizer)
+            rtype: make_replica_manager(
+                rtype, job_args, resource_optimizer, config=self._config
+            )
             for rtype in (job_args.replicas if job_args else {})
         }
         self._make_replica_manager = make_replica_manager
@@ -121,13 +133,13 @@ class DistributedJobManager(JobManager):
     def _heartbeat_timeout(self) -> float:
         if self._heartbeat_timeout_override is not None:
             return self._heartbeat_timeout_override
-        return get_master_config().heartbeat_timeout
+        return self._config.heartbeat_timeout
 
     @property
     def _pending_timeout(self) -> float:
         if self._pending_timeout_override is not None:
             return self._pending_timeout_override
-        return get_master_config().pending_timeout
+        return self._config.pending_timeout
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -444,8 +456,8 @@ class DistributedJobManager(JobManager):
     # -- periodic monitoring ------------------------------------------------
 
     def _monitor_loop(self):
-        # interval read per tick: runtime-tunable via the global context
-        while not self._stop_evt.wait(get_master_config().monitor_interval):
+        # interval read per tick: runtime-tunable via the injected config
+        while not self._stop_evt.wait(self._config.monitor_interval):
             try:
                 self._check_heartbeats()
             except Exception:

@@ -48,6 +48,8 @@ class JobAutoScaler:
         refine_cooldown_secs: float = 300.0,
         planner=None,
         clock: Optional[Callable[[], float]] = None,
+        job_context=None,
+        config=None,
     ):
         self._optimizer = optimizer
         self._scaler = scaler
@@ -60,7 +62,12 @@ class JobAutoScaler:
         #: (never read time.time() directly here — the harness drives
         #: the scaler loop on virtual time, and a test pins it)
         self._clock = clock or time.time
-        # None → read the runtime-mutable global context each cycle
+        # the per-job runtime-mutable config (JobContainer slot):
+        # attributes re-read per cycle, so a brain/admin update retunes
+        # the live loop; explicit ctor args still override
+        self._config = (
+            config if config is not None else get_master_config()
+        )
         self._interval_override = interval_secs
         self._sample_after_steps_override = sample_after_steps
         #: hyperparam refinement (reference simple_strategy_generator):
@@ -69,7 +76,9 @@ class JobAutoScaler:
         self._metric_collector = metric_collector
         self._refine_cooldown = refine_cooldown_secs
         self._last_refine_ts = 0.0
-        self._job_context = get_job_context()
+        self._job_context = (
+            job_context if job_context is not None else get_job_context()
+        )
         self._cordoned_hot_hosts: set = set()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -79,17 +88,17 @@ class JobAutoScaler:
     def _interval(self) -> float:
         if self._interval_override is not None:
             return self._interval_override
-        return get_master_config().seconds_interval_to_optimize
+        return self._config.seconds_interval_to_optimize
 
     @property
     def _sample_after_steps(self) -> int:
         if self._sample_after_steps_override is not None:
             return self._sample_after_steps_override
-        return get_master_config().sample_count_to_adjust_worker
+        return self._config.sample_count_to_adjust_worker
 
     @property
     def _autoscale_enabled(self) -> bool:
-        return get_master_config().auto_worker_enabled
+        return self._config.auto_worker_enabled
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -120,7 +129,7 @@ class JobAutoScaler:
         now = self._clock() if now is None else now
         if self._started_ts == 0.0:
             self._started_ts = now
-        warmup = get_master_config().seconds_to_autoscale_worker
+        warmup = self._config.seconds_to_autoscale_worker
         if now - self._started_ts < warmup:
             return None  # let rendezvous + first steps settle first
         return self.optimize_once(now=now)

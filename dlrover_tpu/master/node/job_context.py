@@ -59,10 +59,13 @@ class DiagnosisActionQueue:
 
 
 class JobContext:
-    """All mutable job state the master holds, keyed by (type, id)."""
+    """All mutable job state the master holds, keyed by (type, id).
 
-    _instance = None
-    _instance_lock = threading.Lock()
+    One instance per job, owned by
+    :class:`~dlrover_tpu.master.job_container.JobContainer` (the old
+    process-singleton machinery is retired; statecheck ST003 keeps it
+    from coming back).
+    """
 
     def __init__(self):
         from dlrover_tpu.lint.lock_tracker import maybe_track
@@ -79,18 +82,6 @@ class JobContext:
         #: replacement nodes never reuse an id whose (released) pod the
         #: restored registry no longer tracks
         self._id_floor: Dict[str, int] = {}
-
-    @classmethod
-    def singleton_instance(cls) -> "JobContext":
-        with cls._instance_lock:
-            if cls._instance is None:
-                cls._instance = JobContext()
-            return cls._instance
-
-    @classmethod
-    def reset_singleton(cls):
-        with cls._instance_lock:
-            cls._instance = None
 
     # -- nodes ------------------------------------------------------------
 
@@ -161,4 +152,11 @@ class JobContext:
 
 
 def get_job_context() -> JobContext:
-    return JobContext.singleton_instance()
+    """Legacy ambient accessor: the process-default container's context.
+
+    Kept for composition roots and harness code; RPC-handler call graphs
+    must use the injected ``job_context`` instead (statecheck ST004).
+    """
+    from dlrover_tpu.master.job_container import default_container
+
+    return default_container().job_context

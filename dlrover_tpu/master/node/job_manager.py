@@ -30,11 +30,21 @@ from dlrover_tpu.master.node.status_flow import get_node_state_flow
 class JobManager(ABC):
     """Shared API the servicer and master loop program against."""
 
-    def __init__(self, job_args=None, speed_monitor=None, error_monitor=None):
+    def __init__(
+        self,
+        job_args=None,
+        speed_monitor=None,
+        error_monitor=None,
+        job_context=None,
+    ):
         self._job_args = job_args
         self._speed_monitor = speed_monitor
         self._error_monitor = error_monitor
-        self._job_context = get_job_context()
+        # injected per-job context (JobContainer slot); the ambient
+        # accessor is a composition-root fallback only
+        self._job_context = (
+            job_context if job_context is not None else get_job_context()
+        )
         self._stopped = False
         # shed-aware liveness (docs/design/fleet_harness.md, closed
         # gap): the RPC admission gate records which node each shed
@@ -261,8 +271,11 @@ class LocalJobManager(JobManager):
         rdzv_managers=None,
         eviction_hysteresis: Optional[int] = None,
         clock=None,
+        job_context=None,
     ):
-        super().__init__(job_args, speed_monitor, error_monitor)
+        super().__init__(
+            job_args, speed_monitor, error_monitor, job_context=job_context
+        )
         self._heartbeat_timeout = heartbeat_timeout
         # rendezvous managers, when wired, get a dead node's waiting
         # slot released at eviction so a pending round stops stalling

@@ -38,10 +38,13 @@ def replica_manager(node_type: str) -> Callable:
 
 
 def make_replica_manager(
-    node_type: str, job_args=None, resource_optimizer=None
+    node_type: str, job_args=None, resource_optimizer=None, config=None
 ) -> "ReplicaManager":
     cls = _REGISTRY.get(node_type, WorkerReplicaManager)
-    return cls(job_args=job_args, resource_optimizer=resource_optimizer)
+    return cls(
+        job_args=job_args, resource_optimizer=resource_optimizer,
+        config=config,
+    )
 
 
 class ReplicaManager:
@@ -49,9 +52,14 @@ class ReplicaManager:
 
     node_type = NodeType.WORKER
 
-    def __init__(self, job_args=None, resource_optimizer=None):
+    def __init__(self, job_args=None, resource_optimizer=None, config=None):
         self._job_args = job_args
         self._resource_optimizer = resource_optimizer
+        # the per-job runtime-mutable config (relaunch_always re-read
+        # per decision); ambient fallback is for direct construction
+        self._config = (
+            config if config is not None else get_master_config()
+        )
 
     # -- relaunch policy -------------------------------------------------
 
@@ -67,7 +75,7 @@ class ReplicaManager:
             return False
         if not node.relaunchable:
             return False
-        if get_master_config().relaunch_always:
+        if self._config.relaunch_always:
             return True  # operator override: budget and reason ignored
         reason = node.exit_reason or NodeExitReason.UNKNOWN_ERROR
         return self._reason_allows_relaunch(node, reason)

@@ -81,13 +81,23 @@ class _WorldSnapshot:
 
 
 class RendezvousManager(ABC):
-    def __init__(self, name: str, clock=None):
+    def __init__(self, name: str, clock=None, config=None):
         self.name = name
         from dlrover_tpu.lint.lock_tracker import maybe_track
 
         self._lock = maybe_track(
             Lock(), "master.rendezvous.manager.RendezvousManager._lock"
         )
+        # the per-job runtime-mutable config: rdzv_waiting_timeout is
+        # re-read per completion check, so a brain/operator update
+        # retunes a running job's last-call window. Resolved ONCE here —
+        # the completion path is handler-reachable and must not reach
+        # for the ambient accessor (statecheck ST004).
+        if config is None:
+            from dlrover_tpu.common.global_context import get_master_config
+
+            config = get_master_config()
+        self._config = config
         # injectable "now": the waiting-timeout completion path and the
         # join stamps must share the clock that drives the job (the
         # fleet harness forms rounds in virtual time; wall time there
@@ -310,11 +320,7 @@ class RendezvousManager(ABC):
             # last-call window of a running job
             timeout = p.waiting_timeout
             if timeout is None:
-                from dlrover_tpu.common.global_context import (
-                    get_master_config,
-                )
-
-                timeout = get_master_config().rdzv_waiting_timeout
+                timeout = self._config.rdzv_waiting_timeout
             since_last = self._clock() - self._lastcall_time
             if since_last >= timeout and self._effective_world_size(waiting) > 0:
                 completed = True
@@ -364,8 +370,8 @@ class RendezvousManager(ABC):
 
 
 class ElasticTrainingRendezvousManager(RendezvousManager):
-    def __init__(self, clock=None):
-        super().__init__(RendezvousName.TRAINING, clock=clock)
+    def __init__(self, clock=None, config=None):
+        super().__init__(RendezvousName.TRAINING, clock=clock, config=config)
 
     def get_comm_world(
         self, node_id: int
@@ -397,8 +403,10 @@ class NetworkCheckRendezvousManager(RendezvousManager):
     rounds is a straggler.
     """
 
-    def __init__(self, clock=None):
-        super().__init__(RendezvousName.NETWORK_CHECK, clock=clock)
+    def __init__(self, clock=None, config=None):
+        super().__init__(
+            RendezvousName.NETWORK_CHECK, clock=clock, config=config
+        )
         self._node_status: Dict[int, Dict[int, bool]] = {}  # round -> id -> ok
         self._node_times: Dict[int, Dict[int, float]] = {}  # round -> id -> sec
         self._check_round = 0

@@ -56,11 +56,18 @@ class LocalScaler(Scaler):
     tests can assert on scaling decisions.
     """
 
-    def __init__(self, job_name: str = "", node_type: str = NodeType.WORKER):
+    def __init__(
+        self,
+        job_name: str = "",
+        node_type: str = NodeType.WORKER,
+        job_context=None,
+    ):
         super().__init__(job_name)
         self._node_type = node_type
         self.executed_plans: List[ScalePlan] = []
-        self._job_context = get_job_context()
+        self._job_context = (
+            job_context if job_context is not None else get_job_context()
+        )
 
     def scale(self, plan: ScalePlan):
         if plan.empty():

@@ -13,7 +13,6 @@ from typing import Dict, Optional
 from dlrover_tpu.common import messages as msg
 from dlrover_tpu.common.constants import NodeType, RendezvousName
 from dlrover_tpu.common.log import logger
-from dlrover_tpu.master.node.job_context import get_job_context
 from dlrover_tpu.master.rendezvous.kv_store import KVStoreService
 from dlrover_tpu.master.rendezvous.manager import (
     ElasticTrainingRendezvousManager,
@@ -36,6 +35,7 @@ class MasterServicer:
         elastic_run_configs: Optional[Dict] = None,
         metric_collector=None,
         planner=None,
+        job_context=None,
     ):
         self._metric_collector = metric_collector
         #: goodput planner (brain/planner.py): the membership poll
@@ -51,9 +51,15 @@ class MasterServicer:
         }
         self._diagnosis_manager = diagnosis_manager
         self._kv_store = kv_store or KVStoreService()
-        self._sync_service = sync_service or SyncService(get_job_context())
+        if job_context is None:
+            # composition-root fallback only: handlers never reach for
+            # the ambient accessor themselves (statecheck ST004)
+            from dlrover_tpu.master.node.job_context import get_job_context
+
+            job_context = get_job_context()
+        self._job_context = job_context
+        self._sync_service = sync_service or SyncService(job_context)
         self._elastic_run_configs = elastic_run_configs or {}
-        self._job_context = get_job_context()
         self.start_training_time: float = 0.0
 
         self._get_handlers = {

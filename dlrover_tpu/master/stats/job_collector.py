@@ -108,14 +108,18 @@ class JobMetricCollector:
         speed_monitor=None,
         reporters: Optional[List[StatsReporter]] = None,
         interval: float = 30.0,
+        job_context=None,
+        metrics: Optional[JobMetrics] = None,
     ):
         self._speed_monitor = speed_monitor
         # the collector's own ``metrics`` window always records; reporters
         # are additional sinks (log, brain)
         self._reporters = reporters if reporters is not None else []
         self._interval = interval
-        self._job_context = get_job_context()
-        self.metrics = JobMetrics()
+        self._job_context = (
+            job_context if job_context is not None else get_job_context()
+        )
+        self.metrics = metrics if metrics is not None else JobMetrics()
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

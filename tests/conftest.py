@@ -23,19 +23,18 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
-from dlrover_tpu.master.node.job_context import JobContext  # noqa: E402
-
 
 @pytest.fixture(autouse=True)
-def _reset_job_context():
-    """Each test gets fresh JobContext / MasterConfigContext singletons."""
-    from dlrover_tpu.common.global_context import MasterConfigContext
+def _reset_job_container():
+    """Each test gets a fresh per-job state world: dropping every
+    JobContainer resets JobContext, MasterConfigContext, SpeedMonitor,
+    metrics and state-store handles in one move (the old per-singleton
+    reset dance)."""
+    from dlrover_tpu.master import job_container
 
-    JobContext.reset_singleton()
-    MasterConfigContext.reset_singleton()
+    job_container.reset()
     yield
-    JobContext.reset_singleton()
-    MasterConfigContext.reset_singleton()
+    job_container.reset()
 
 
 @pytest.fixture

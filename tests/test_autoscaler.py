@@ -22,7 +22,9 @@ from dlrover_tpu.master.scaler.base import LocalScaler
 
 
 def setup_function(_):
-    JobContext.reset_singleton()
+    from dlrover_tpu.master.job_container import JobContainer
+
+    JobContainer.fresh()
 
 
 def add_workers(n, status=NodeStatus.RUNNING):
@@ -248,9 +250,10 @@ def test_autoscaler_refines_hyperparams_from_model_report():
         def cordon(self, host):
             pass
 
-    JobContext.reset_singleton()
+    from dlrover_tpu.master.job_container import JobContainer
+
+    ctx = JobContainer.fresh().job_context
     try:
-        ctx = get_job_context()
         collector = JobMetricCollector()
         servicer = MasterServicer(metric_collector=collector)
         servicer.report(msg.ModelInfoReport(
@@ -287,7 +290,9 @@ def test_autoscaler_refines_hyperparams_from_model_report():
                 1e-3
             )
     finally:
-        JobContext.reset_singleton()
+        from dlrover_tpu.master import job_container
+
+        job_container.reset()
 
 
 def test_autoscaler_planner_path_executes_one_plan_per_cooldown():

@@ -44,7 +44,9 @@ from dlrover_tpu.master.node.job_context import JobContext, get_job_context
 
 
 def make_manager():
-    JobContext.reset_singleton()
+    from dlrover_tpu.master.job_container import JobContainer
+
+    JobContainer.fresh()
     return DiagnosisManager(interval_secs=3600)
 
 

@@ -82,7 +82,6 @@ def test_job_manager_reads_runtime_mutations():
 
 
 def test_brain_serves_master_config_end_to_end():
-    MasterConfigContext.reset_singleton()
     server = BrainServer(port=0)
     server.start()
     try:

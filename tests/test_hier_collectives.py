@@ -246,6 +246,7 @@ def test_parity_zero1_dp4_2slice():
     assert any("'dp'" in s for s in specs), specs
 
 
+@pytest.mark.slow
 def test_parity_overlap_replicated_dp4_2slice():
     """satellite (bucketing parity): 8 steps, replicated weight
     update — the overlap schedule (pipelined DCN exchange + post-scan
@@ -259,6 +260,7 @@ def test_parity_overlap_replicated_dp4_2slice():
     _assert_parity(l_f, l_o, s_f, s_o)
 
 
+@pytest.mark.slow
 def test_parity_overlap_zero1_dp4_2slice():
     """satellite (bucketing parity), zero-1 scatter mode: the bucketed
     psum_scatter exchange lands the same shards as the fused chained
@@ -287,12 +289,18 @@ def test_overlap_kill_switch_restores_hier_program(monkeypatch):
     assert tr._contract_spec(tr.mesh) == "dp4"
 
 
+@pytest.mark.slow
 def test_overlap_engine_bucket_bounds_do_not_change_math():
     """Engine-level: ANY bucket bound — single-bucket degenerate, a
     bound that cuts mid-list (non-dividing), one-leaf-per-bucket —
     produces gradients equal to the fused engine's, in both weight
     -update layouts (per-element addition order is identical by
-    construction; tolerance covers op-fusion rounding)."""
+    construction; tolerance covers op-fusion rounding).
+
+    Slow-marked: the 3 overlap-parity compile matrices (~48 s of cold
+    compiles) would push the tier-1 ``-m 'not slow'`` sweep past its
+    870 s budget; CI runs them in an explicit tier1.yml step, same
+    pattern as the bench contracts."""
     mesh = build_mesh(
         MeshConfig(dp=-1).resolve(4), devices=jax.devices()[:4],
         n_slices=2,

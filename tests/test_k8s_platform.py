@@ -29,9 +29,11 @@ from tests.k8s_fakes import ELASTICJOB_CR, make_fake_client, make_pod
 
 @pytest.fixture(autouse=True)
 def fresh_context():
-    JobContext.reset_singleton()
+    from dlrover_tpu.master import job_container
+
+    job_container.reset()
     yield
-    JobContext.reset_singleton()
+    job_container.reset()
 
 
 def make_job_args() -> JobArgs:

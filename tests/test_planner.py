@@ -642,16 +642,13 @@ def test_observe_reads_reported_hbm_through_job_context():
     from dlrover_tpu.common import messages as msg
     from dlrover_tpu.common.constants import NodeStatus, NodeType
     from dlrover_tpu.common.node import Node
-    from dlrover_tpu.master.node.job_context import (
-        JobContext,
-        get_job_context,
-    )
     from dlrover_tpu.master.node.job_manager import LocalJobManager
     from dlrover_tpu.master.servicer import MasterServicer
 
-    JobContext.reset_singleton()
+    from dlrover_tpu.master.job_container import JobContainer
+
+    ctx = JobContainer.fresh().job_context
     try:
-        ctx = get_job_context()
         for i in range(2):
             ctx.update_node(Node(NodeType.WORKER, i,
                                  status=NodeStatus.RUNNING))
@@ -679,7 +676,9 @@ def test_observe_reads_reported_hbm_through_job_context():
         p2 = GoodputPlanner(job_context=ctx, clock=lambda: 0.0)
         assert p2.observe().hbm_used_bytes == 0.0
     finally:
-        JobContext.reset_singleton()
+        from dlrover_tpu.master import job_container
+
+        job_container.reset()
 
 
 # ---------------------------------------------------------------------------

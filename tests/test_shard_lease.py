@@ -719,9 +719,10 @@ def test_watchdog_excludes_silent_members_and_bills_hang():
     from dlrover_tpu.master.node.job_context import JobContext, get_job_context
     from dlrover_tpu.common.node import Node
 
+    from dlrover_tpu.master.job_container import JobContainer
+
     clock, sm, rdzv, wd = _hang_rig()
-    JobContext.reset_singleton()
-    ctx = get_job_context()
+    ctx = JobContainer.fresh().job_context
     wd._job_context = ctx
     t0 = clock.t
     for nid in range(4):

@@ -151,7 +151,9 @@ def test_node_budget_state_roundtrip():
     from dlrover_tpu.scheduler.job import JobArgs
     from tests.k8s_fakes import ELASTICJOB_CR
 
-    JobContext.reset_singleton()
+    from dlrover_tpu.master.job_container import JobContainer
+
+    JobContainer.fresh()
     try:
         client, _ = make_fake_client()
         args = JobArgs.from_elasticjob_cr(ELASTICJOB_CR)
@@ -174,7 +176,7 @@ def test_node_budget_state_roundtrip():
         mgr.persist_node_state()
 
         # relaunched master: fresh context, same backend
-        JobContext.reset_singleton()
+        JobContainer.fresh()
         mgr2 = DistributedJobManager(
             job_args=args,
             scaler=PodScaler(args, client, master_addr="m:1"),
@@ -188,7 +190,9 @@ def test_node_budget_state_roundtrip():
         # id sequence continues past the persisted max, never reusing 1-4
         assert ctx2.next_node_id(NodeType.WORKER) == 6
     finally:
-        JobContext.reset_singleton()
+        from dlrover_tpu.master import job_container
+
+        job_container.reset()
 
 
 @pytest.mark.parametrize("backend_kind", ["file", "configmap"])

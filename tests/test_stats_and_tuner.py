@@ -32,7 +32,6 @@ def local_master():
     master = start_local_master(node_num=1)
     yield master
     master.stop()
-    JobContext.reset_singleton()
 
 
 def test_metric_collector_samples_context(local_master):
